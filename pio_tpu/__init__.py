@@ -1,0 +1,24 @@
+"""pio_tpu — a TPU-native machine-learning server framework.
+
+A from-scratch re-design of Apache PredictionIO's capabilities (reference:
+/root/reference, Scala/Spark) for TPU hardware: REST event collection with
+pluggable storage, engines as DataSource -> Preparator -> Algorithm(s) ->
+Serving (DASE) pipelines, a single-controller JAX training workflow over a
+`jax.sharding.Mesh` (pjit + XLA collectives instead of Spark shuffles),
+metric-driven evaluation/tuning, and a deploy server keeping models resident
+in HBM.
+
+Package layout (mirrors SURVEY.md section 7):
+  data/        event model, storage abstraction, backends   (reference: data/)
+  server/      event server, webhooks, admin, dashboard     (reference: data/api, tools/)
+  controller/  DASE + Evaluation public API                 (reference: core/controller)
+  workflow/    train/eval/deploy runtime                    (reference: core/workflow)
+  ops/         JAX/Pallas numeric kernels (ALS, NB, ...)    (replaces Spark MLlib)
+  parallel/    mesh, sharding, collectives helpers          (replaces Spark cluster)
+  models/      engine templates, the model zoo              (reference: examples/)
+  e2/          engine-building helper lib                   (reference: e2/)
+  tools/       CLI + ops commands                           (reference: tools/)
+  utils/       config, json, time helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+from pio_tpu.data.datamap import DataMap, PropertyMap, DataMapError
+from pio_tpu.data.event import Event, EventValidationError, validate_event
+from pio_tpu.data.bimap import BiMap, EntityIdIndex
+
+__all__ = [
+    "DataMap",
+    "PropertyMap",
+    "DataMapError",
+    "Event",
+    "EventValidationError",
+    "validate_event",
+    "BiMap",
+    "EntityIdIndex",
+]

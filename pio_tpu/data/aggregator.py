@@ -1,0 +1,94 @@
+"""Property aggregation — replay $set/$unset/$delete into PropertyMaps.
+
+Exact behavioral contract of reference LEventAggregator.scala:10-145 /
+PEventAggregator.scala:24-209:
+
+ * events are folded in eventTime order;
+ * $set merges properties (right-biased); the first $set creates the map;
+ * $unset removes the named keys (no-op before any $set);
+ * $delete drops the entity entirely (a later $set resurrects it);
+ * non-special events do not touch the fold, including update times;
+ * first/lastUpdated are min/max eventTime over *special* events only;
+ * entities whose final state is deleted are absent from the result.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from datetime import datetime
+from typing import Iterable
+
+from pio_tpu.data.datamap import DataMap, PropertyMap
+from pio_tpu.data.event import Event
+
+
+class _Prop:
+    __slots__ = ("fields", "first_updated", "last_updated")
+
+    def __init__(self):
+        self.fields: dict | None = None
+        self.first_updated: datetime | None = None
+        self.last_updated: datetime | None = None
+
+
+def _fold(prop: _Prop, e: Event) -> None:
+    if e.event == "$set":
+        if prop.fields is None:
+            prop.fields = dict(e.properties.fields)
+        else:
+            prop.fields.update(e.properties.fields)
+    elif e.event == "$unset":
+        if prop.fields is not None:
+            for k in e.properties.key_set():
+                prop.fields.pop(k, None)
+    elif e.event == "$delete":
+        prop.fields = None
+    else:
+        return  # non-special events do not update times either
+    if prop.first_updated is None or e.event_time < prop.first_updated:
+        prop.first_updated = e.event_time
+    if prop.last_updated is None or e.event_time > prop.last_updated:
+        prop.last_updated = e.event_time
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> PropertyMap | None:
+    """Fold one entity's events (reference aggregatePropertiesSingle)."""
+    prop = _Prop()
+    for e in sorted(events, key=lambda ev: ev.event_time):
+        _fold(prop, e)
+    if prop.fields is None:
+        return None
+    return PropertyMap(
+        fields=prop.fields,
+        first_updated=prop.first_updated,
+        last_updated=prop.last_updated,
+    )
+
+
+def aggregate_properties(events: Iterable[Event]) -> dict[str, PropertyMap]:
+    """Group by entityId then fold (reference aggregateProperties).
+
+    Returns entityId -> PropertyMap, omitting deleted entities.
+    """
+    by_entity: dict[str, list[Event]] = defaultdict(list)
+    for e in events:
+        by_entity[e.entity_id].append(e)
+    out: dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        pm = aggregate_properties_single(evs)
+        if pm is not None:
+            out[entity_id] = pm
+    return out
+
+
+def required_filter(
+    props: dict[str, PropertyMap], required: Iterable[str] | None
+) -> dict[str, PropertyMap]:
+    """Keep entities that define every `required` property
+    (reference PEventAggregator required-fields filter)."""
+    if not required:
+        return props
+    req = list(required)
+    return {
+        k: v for k, v in props.items() if all(v.contains(r) for r in req)
+    }

@@ -1,0 +1,60 @@
+"""Shared backend helpers: event filtering + id generation."""
+
+from __future__ import annotations
+
+import uuid
+from datetime import datetime
+from typing import Sequence
+
+from pio_tpu.data.event import Event
+
+DEFAULT_FIND_LIMIT = 20  # reference EventServer.scala:351 default page size
+
+
+def new_event_id() -> str:
+    return uuid.uuid4().hex
+
+
+def match_event(
+    e: Event,
+    start_time: datetime | None = None,
+    until_time: datetime | None = None,
+    entity_type: str | None = None,
+    entity_id: str | None = None,
+    event_names: Sequence[str] | None = None,
+    target_entity_type=...,
+    target_entity_id=...,
+) -> bool:
+    """Predicate form of the reference's find filters (LEvents.scala:220-280).
+
+    start_time inclusive, until_time exclusive; `...` = don't-care for the
+    target-entity filters, None = must-be-absent.
+    """
+    if start_time is not None and e.event_time < start_time:
+        return False
+    if until_time is not None and e.event_time >= until_time:
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if event_names is not None and e.event not in event_names:
+        return False
+    if target_entity_type is not ... and e.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not ... and e.target_entity_id != target_entity_id:
+        return False
+    return True
+
+
+def apply_limit(events: list[Event], limit: int | None, reversed_: bool) -> list[Event]:
+    """Sort by eventTime (reversed = newest first) and page.
+
+    limit semantics follow the reference: None -> default 20, -1 -> all.
+    """
+    events.sort(key=lambda e: e.event_time, reverse=reversed_)
+    if limit is None:
+        limit = DEFAULT_FIND_LIMIT
+    if limit is not None and limit >= 0:
+        events = events[:limit]
+    return events

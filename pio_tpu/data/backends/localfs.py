@@ -1,0 +1,49 @@
+"""Local-filesystem model blob store.
+
+Plays the role of reference data/.../storage/localfs/LocalFSModels.scala (and
+hdfs/HDFSModels.scala): MODELDATA repository storing model blobs as files.
+Checkpoint directories from orbax also live under the same root; this DAO
+covers the opaque-blob path used by pickled local models.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pio_tpu.data import dao as d
+from pio_tpu.data.storage import Backend
+
+
+class LocalFSBackend(Backend):
+    def __init__(self, config):
+        super().__init__(config)
+        self.path = config.properties.get("PATH", ".pio_models")
+        os.makedirs(self.path, exist_ok=True)
+
+    def models(self):
+        return _FSModels(self.path)
+
+
+class _FSModels(d.ModelsDAO):
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, model_id: str) -> str:
+        safe = model_id.replace("/", "_")
+        return os.path.join(self.root, f"pio_model_{safe}.bin")
+
+    def insert(self, m: d.Model):
+        with open(self._path(m.id), "wb") as f:
+            f.write(m.models)
+
+    def get(self, model_id):
+        p = self._path(model_id)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return d.Model(model_id, f.read())
+
+    def delete(self, model_id):
+        p = self._path(model_id)
+        if os.path.exists(p):
+            os.remove(p)

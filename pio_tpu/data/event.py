@@ -1,0 +1,192 @@
+"""Canonical event record + validation.
+
+Behavioral contract mirrors reference data/.../storage/Event.scala:8-164:
+same fields, same validation rules (empty checks, target-entity pairing,
+$set/$unset/$delete special events, `pio_`/`$` reserved prefixes, built-in
+entity type `pio_pr`).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from datetime import datetime
+from typing import Any, Sequence
+
+from pio_tpu.data.datamap import DataMap
+from pio_tpu.utils.time import ensure_aware, format_time, parse_time, utcnow
+
+SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete"})
+BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+BUILTIN_PROPERTIES: frozenset[str] = frozenset()
+
+
+class EventValidationError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Event:
+    """One event (reference Event.scala:40-58)."""
+
+    event: str
+    entity_type: str
+    entity_id: str
+    target_entity_type: str | None = None
+    target_entity_id: str | None = None
+    properties: DataMap = field(default_factory=DataMap)
+    event_time: datetime = field(default_factory=utcnow)
+    tags: tuple[str, ...] = ()
+    pr_id: str | None = None
+    event_id: str | None = None
+    creation_time: datetime = field(default_factory=utcnow)
+
+    def __post_init__(self):
+        object.__setattr__(self, "event_time", ensure_aware(self.event_time))
+        object.__setattr__(self, "creation_time", ensure_aware(self.creation_time))
+        if not isinstance(self.properties, DataMap):
+            object.__setattr__(self, "properties", DataMap(dict(self.properties)))
+        if not isinstance(self.tags, tuple):
+            object.__setattr__(self, "tags", tuple(self.tags))
+
+    def with_id(self, event_id: str) -> "Event":
+        return replace(self, event_id=event_id)
+
+    # -- wire format (reference EventJson4sSupport.scala APISerializer) -----
+    def to_api_dict(self, with_id: bool = True) -> dict[str, Any]:
+        d: dict[str, Any] = {}
+        if with_id and self.event_id is not None:
+            d["eventId"] = self.event_id
+        d.update(
+            event=self.event,
+            entityType=self.entity_type,
+            entityId=self.entity_id,
+        )
+        if self.target_entity_type is not None:
+            d["targetEntityType"] = self.target_entity_type
+        if self.target_entity_id is not None:
+            d["targetEntityId"] = self.target_entity_id
+        d["properties"] = dict(self.properties.fields)
+        d["eventTime"] = format_time(self.event_time)
+        if self.tags:
+            d["tags"] = list(self.tags)
+        if self.pr_id is not None:
+            d["prId"] = self.pr_id
+        d["creationTime"] = format_time(self.creation_time)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_api_dict(), sort_keys=True)
+
+    @staticmethod
+    def from_api_dict(d: dict[str, Any]) -> "Event":
+        try:
+            event = d["event"]
+            entity_type = d["entityType"]
+            entity_id = d["entityId"]
+        except KeyError as e:
+            raise EventValidationError(f"field {e.args[0]} is required") from e
+        for k in ("event", "entityType", "entityId"):
+            if not isinstance(d[k], str):
+                raise EventValidationError(f"field {k} must be a string")
+        props = d.get("properties", {}) or {}
+        if not isinstance(props, dict):
+            raise EventValidationError("properties must be a JSON object")
+        ev_time = d.get("eventTime")
+        try:
+            event_time = parse_time(ev_time) if ev_time else utcnow()
+        except (ValueError, TypeError, AttributeError) as e:
+            raise EventValidationError(f"invalid eventTime: {ev_time}") from e
+        creation = d.get("creationTime")
+        try:
+            creation_time = parse_time(creation) if creation else utcnow()
+        except (ValueError, TypeError, AttributeError) as e:
+            raise EventValidationError(f"invalid creationTime: {creation}") from e
+        tags = d.get("tags", []) or []
+        if not isinstance(tags, list) or not all(isinstance(t, str) for t in tags):
+            raise EventValidationError("tags must be a list of strings")
+        return Event(
+            event=event,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            target_entity_type=d.get("targetEntityType"),
+            target_entity_id=d.get("targetEntityId"),
+            properties=DataMap(dict(props)),
+            event_time=event_time,
+            tags=tuple(tags),
+            pr_id=d.get("prId"),
+            event_id=d.get("eventId"),
+            creation_time=creation_time,
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "Event":
+        return Event.from_api_dict(json.loads(s))
+
+
+def is_reserved_prefix(name: str) -> bool:
+    """Reference Event.scala:75-76."""
+    return name.startswith("$") or name.startswith("pio_")
+
+
+def is_special_event(name: str) -> bool:
+    return name in SPECIAL_EVENTS
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise EventValidationError(msg)
+
+
+def validate_event(e: Event) -> None:
+    """Full validation contract of reference Event.scala:109-163."""
+    _require(bool(e.event), "event must not be empty.")
+    _require(bool(e.entity_type), "entityType must not be empty string.")
+    _require(bool(e.entity_id), "entityId must not be empty string.")
+    _require(
+        e.target_entity_type is None or bool(e.target_entity_type),
+        "targetEntityType must not be empty string",
+    )
+    _require(
+        e.target_entity_id is None or bool(e.target_entity_id),
+        "targetEntityId must not be empty string.",
+    )
+    _require(
+        (e.target_entity_type is None) == (e.target_entity_id is None),
+        "targetEntityType and targetEntityId must be specified together.",
+    )
+    _require(
+        not (e.event == "$unset" and e.properties.is_empty()),
+        "properties cannot be empty for $unset event",
+    )
+    _require(
+        not is_reserved_prefix(e.event) or is_special_event(e.event),
+        f"{e.event} is not a supported reserved event name.",
+    )
+    _require(
+        not is_special_event(e.event)
+        or (e.target_entity_type is None and e.target_entity_id is None),
+        f"Reserved event {e.event} cannot have targetEntity",
+    )
+    _require(
+        not is_reserved_prefix(e.entity_type) or e.entity_type in BUILTIN_ENTITY_TYPES,
+        f"The entityType {e.entity_type} is not allowed. "
+        "'pio_' is a reserved name prefix.",
+    )
+    _require(
+        e.target_entity_type is None
+        or not is_reserved_prefix(e.target_entity_type)
+        or e.target_entity_type in BUILTIN_ENTITY_TYPES,
+        f"The targetEntityType {e.target_entity_type} is not allowed. "
+        "'pio_' is a reserved name prefix.",
+    )
+    for k in e.properties.key_set():
+        _require(
+            not is_reserved_prefix(k) or k in BUILTIN_PROPERTIES,
+            f"The property {k} is not allowed. 'pio_' is a reserved name prefix.",
+        )
+
+
+def validate_events(events: Sequence[Event]) -> None:
+    for e in events:
+        validate_event(e)

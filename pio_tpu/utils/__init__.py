@@ -1,0 +1,3 @@
+from pio_tpu.utils.time import parse_time, format_time, utcnow
+
+__all__ = ["parse_time", "format_time", "utcnow"]

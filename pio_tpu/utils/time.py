@@ -1,0 +1,46 @@
+"""Time helpers.
+
+The reference uses joda-time `DateTime` with ISO-8601 wire format and a UTC
+default zone (reference: data/.../storage/Event.scala:68 defaultTimeZone).
+We use stdlib timezone-aware `datetime` throughout; naive datetimes are
+interpreted as UTC.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+
+UTC = timezone.utc
+
+
+def utcnow() -> datetime:
+    return datetime.now(tz=UTC)
+
+
+def ensure_aware(dt: datetime) -> datetime:
+    """Interpret naive datetimes as UTC (joda default-zone behavior)."""
+    if dt.tzinfo is None:
+        return dt.replace(tzinfo=UTC)
+    return dt
+
+
+def parse_time(s: str) -> datetime:
+    """Parse an ISO-8601 timestamp (the Event Server wire format).
+
+    Accepts 'Z' suffix and fractional seconds; naive input is taken as UTC
+    (reference: data/.../storage/Utils.scala stringToDateTime).
+    """
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    return ensure_aware(datetime.fromisoformat(s))
+
+
+def format_time(dt: datetime) -> str:
+    """ISO-8601 with millisecond precision, matching the reference's wire
+    format (e.g. 2004-12-13T21:39:45.618-08:00)."""
+    dt = ensure_aware(dt)
+    return dt.isoformat(timespec="milliseconds")
+
+
+def millis(dt: datetime) -> int:
+    return int(ensure_aware(dt).timestamp() * 1000)

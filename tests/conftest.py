@@ -1,0 +1,54 @@
+"""Test bootstrap: force JAX onto CPU with 8 virtual devices so every
+sharding/collective test exercises a real multi-device mesh without TPU
+hardware (the reference's analogue is Spark local[4] contexts,
+core/src/test/.../BaseTest.scala:12-50)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def memory_storage():
+    """A fresh all-in-memory Storage (the reference's test-mode backends)."""
+    from pio_tpu.data.storage import Storage
+
+    env = {
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    }
+    return Storage(env=env, test=True)
+
+
+@pytest.fixture()
+def sqlite_storage(tmp_path):
+    from pio_tpu.data.storage import Storage
+
+    env = {
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    }
+    s = Storage(env=env)
+    yield s
+    s.close()
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def any_storage(request, memory_storage, sqlite_storage):
+    """Parameterized over backends, mirroring the reference's LEventsSpec /
+    PEventsSpec pattern of running one spec body against every backend
+    (LEventsSpec.scala:22-75)."""
+    return memory_storage if request.param == "memory" else sqlite_storage

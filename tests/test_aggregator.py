@@ -1,0 +1,103 @@
+"""$set/$unset/$delete fold semantics (reference LEventAggregator.scala,
+LEventAggregatorSpec)."""
+
+from datetime import datetime, timedelta, timezone
+
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.aggregator import (
+    aggregate_properties,
+    aggregate_properties_single,
+    required_filter,
+)
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def sev(name, entity_id, props, minutes):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity_id,
+        properties=DataMap(props),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_set_merge_latest_wins():
+    pm = aggregate_properties_single([
+        sev("$set", "u1", {"a": 1, "b": 2}, 0),
+        sev("$set", "u1", {"b": 3, "c": 4}, 10),
+    ])
+    assert pm.fields == {"a": 1, "b": 3, "c": 4}
+    assert pm.first_updated == T0
+    assert pm.last_updated == T0 + timedelta(minutes=10)
+
+
+def test_order_is_event_time_not_arrival():
+    pm = aggregate_properties_single([
+        sev("$set", "u1", {"b": 3}, 10),
+        sev("$set", "u1", {"a": 1, "b": 2}, 0),  # arrives later, is earlier
+    ])
+    assert pm.fields == {"a": 1, "b": 3}
+
+
+def test_unset_removes_keys():
+    pm = aggregate_properties_single([
+        sev("$set", "u1", {"a": 1, "b": 2}, 0),
+        sev("$unset", "u1", {"a": None}, 5),
+    ])
+    assert pm.fields == {"b": 2}
+
+
+def test_unset_before_set_is_noop():
+    pm = aggregate_properties_single([
+        sev("$unset", "u1", {"a": 1}, 0),
+        sev("$set", "u1", {"a": 2}, 5),
+    ])
+    assert pm.fields == {"a": 2}
+    # but the $unset still counts toward firstUpdated
+    assert pm.first_updated == T0
+
+
+def test_delete_drops_entity():
+    assert aggregate_properties_single([
+        sev("$set", "u1", {"a": 1}, 0),
+        sev("$delete", "u1", {}, 5),
+    ]) is None
+
+
+def test_set_after_delete_resurrects():
+    pm = aggregate_properties_single([
+        sev("$set", "u1", {"a": 1}, 0),
+        sev("$delete", "u1", {}, 5),
+        sev("$set", "u1", {"b": 2}, 10),
+    ])
+    assert pm.fields == {"b": 2}
+
+
+def test_non_special_events_ignored():
+    pm = aggregate_properties_single([
+        sev("$set", "u1", {"a": 1}, 0),
+        sev("rate", "u1", {"a": 999}, 5),
+    ])
+    assert pm.fields == {"a": 1}
+    assert pm.last_updated == T0  # rate does not advance lastUpdated
+
+
+def test_aggregate_multi_entity():
+    out = aggregate_properties([
+        sev("$set", "u1", {"a": 1}, 0),
+        sev("$set", "u2", {"a": 2}, 0),
+        sev("$delete", "u2", {}, 1),
+    ])
+    assert set(out) == {"u1"}
+    assert out["u1"].fields == {"a": 1}
+
+
+def test_required_filter():
+    props = aggregate_properties([
+        sev("$set", "u1", {"a": 1, "b": 1}, 0),
+        sev("$set", "u2", {"a": 2}, 0),
+    ])
+    assert set(required_filter(props, ["a", "b"])) == {"u1"}
+    assert set(required_filter(props, None)) == {"u1", "u2"}

@@ -1,0 +1,68 @@
+"""DataMap semantics (reference DataMapSpec / DataMap.scala)."""
+
+import pytest
+
+from pio_tpu.data import DataMap, DataMapError
+from pio_tpu.data.bimap import BiMap, EntityIdIndex
+
+import numpy as np
+
+
+def test_get_required_and_optional():
+    dm = DataMap({"a": 1, "b": "x", "c": None, "f": 2.5, "l": [1, 2]})
+    assert dm.get("a") == 1
+    assert dm.get("a", int) == 1
+    assert dm.get("f", float) == 2.5
+    assert dm.get("a", float) == 1.0  # int widens to float
+    with pytest.raises(DataMapError):
+        dm.get("missing")
+    with pytest.raises(DataMapError):
+        dm.get("c")  # null behaves like missing for required get
+    assert dm.get_opt("c") is None
+    assert dm.get_opt("missing") is None
+    assert dm.get_or_else("missing", 7) == 7
+    with pytest.raises(DataMapError):
+        dm.get("b", int)
+
+
+def test_bool_not_int():
+    dm = DataMap({"t": True})
+    assert dm.get("t", bool) is True
+    with pytest.raises(DataMapError):
+        dm.get("t", int)
+
+
+def test_merge_and_remove():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 3, "z": 4})
+    assert a.merge(b).fields == {"x": 1, "y": 3, "z": 4}
+    assert a.remove(["x"]).fields == {"y": 2}
+    assert a.fields == {"x": 1, "y": 2}  # immutable
+
+
+def test_json_roundtrip():
+    dm = DataMap({"a": [1, {"b": None}], "s": "t"})
+    assert DataMap.from_json(dm.to_json()) == dm
+
+
+def test_bimap_string_int():
+    bm = BiMap.string_int(["b", "a", "b", "c"])
+    assert len(bm) == 3
+    assert bm["b"] == 0 and bm["a"] == 1 and bm["c"] == 2
+    inv = bm.inverse()
+    assert inv[0] == "b"
+    assert "a" in bm and "z" not in bm
+    np.testing.assert_array_equal(bm.map_array(["c", "a"]), np.array([2, 1]))
+
+
+def test_bimap_unique_values_required():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_entity_id_index_roundtrip():
+    idx = EntityIdIndex(["u%d" % i for i in range(100)])
+    enc = idx.encode(["u3", "u99", "u0"])
+    assert enc.dtype == np.int32
+    assert idx.decode(enc) == ["u3", "u99", "u0"]
+    assert len(idx) == 100

@@ -1,0 +1,125 @@
+"""Event model + validation contract tests (reference Event.scala:109-163)."""
+
+import json
+from datetime import datetime, timezone
+
+import pytest
+
+from pio_tpu.data import DataMap, Event, EventValidationError, validate_event
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+def test_basic_event_valid():
+    validate_event(ev())
+    validate_event(ev(target_entity_type="item", target_entity_id="i1"))
+    validate_event(ev(event="$set", properties=DataMap({"a": 1})))
+    validate_event(ev(event="$delete"))
+
+
+def test_empty_fields_rejected():
+    for kw in (
+        dict(event=""),
+        dict(entity_type=""),
+        dict(entity_id=""),
+        dict(target_entity_type="", target_entity_id="i"),
+        dict(target_entity_type="item", target_entity_id=""),
+    ):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(**kw))
+
+
+def test_target_entity_must_pair():
+    with pytest.raises(EventValidationError):
+        validate_event(ev(target_entity_type="item"))
+    with pytest.raises(EventValidationError):
+        validate_event(ev(target_entity_id="i1"))
+
+
+def test_unset_requires_properties():
+    with pytest.raises(EventValidationError):
+        validate_event(ev(event="$unset"))
+    validate_event(ev(event="$unset", properties=DataMap({"a": 1})))
+
+
+def test_reserved_prefix_event_names():
+    with pytest.raises(EventValidationError):
+        validate_event(ev(event="$other"))
+    with pytest.raises(EventValidationError):
+        validate_event(ev(event="pio_thing"))
+
+
+def test_special_event_cannot_have_target():
+    with pytest.raises(EventValidationError):
+        validate_event(
+            ev(event="$set", properties=DataMap({"a": 1}),
+               target_entity_type="item", target_entity_id="i1")
+        )
+
+
+def test_reserved_entity_types():
+    with pytest.raises(EventValidationError):
+        validate_event(ev(entity_type="pio_user"))
+    validate_event(ev(entity_type="pio_pr"))  # built-in allowed
+    with pytest.raises(EventValidationError):
+        validate_event(ev(target_entity_type="pio_x", target_entity_id="i"))
+
+
+def test_reserved_property_names():
+    with pytest.raises(EventValidationError):
+        validate_event(ev(properties=DataMap({"pio_score": 1})))
+    with pytest.raises(EventValidationError):
+        validate_event(ev(properties=DataMap({"$brush": 1})))
+
+
+def test_json_roundtrip():
+    e = ev(
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties=DataMap({"rating": 4.5, "tags": ["a", "b"]}),
+        event_time=datetime(2020, 5, 1, 12, 30, 45, 618000, tzinfo=timezone.utc),
+        tags=("t1",),
+        pr_id="pr-9",
+        event_id="abc",
+    )
+    d = e.to_api_dict()
+    assert d["eventTime"].startswith("2020-05-01T12:30:45.618")
+    e2 = Event.from_json(json.dumps(d))
+    assert e2.event == e.event
+    assert e2.properties == e.properties
+    assert e2.event_time == e.event_time
+    assert e2.tags == e.tags
+    assert e2.pr_id == "pr-9"
+    assert e2.event_id == "abc"
+
+
+def test_from_api_dict_errors():
+    with pytest.raises(EventValidationError):
+        Event.from_api_dict({"event": "rate"})  # missing entity fields
+    with pytest.raises(EventValidationError):
+        Event.from_api_dict(
+            {"event": "e", "entityType": "u", "entityId": "1",
+             "eventTime": "not-a-time"}
+        )
+    with pytest.raises(EventValidationError):
+        Event.from_api_dict(
+            {"event": "e", "entityType": "u", "entityId": "1",
+             "creationTime": "garbage"}
+        )
+    with pytest.raises(EventValidationError):
+        Event.from_api_dict(
+            {"event": "e", "entityType": "u", "entityId": "1", "eventTime": 7}
+        )
+    with pytest.raises(EventValidationError):
+        Event.from_api_dict(
+            {"event": "e", "entityType": "u", "entityId": "1", "tags": "foo"}
+        )
+
+
+def test_naive_event_time_becomes_utc():
+    e = ev(event_time=datetime(2020, 1, 1, 0, 0, 0))
+    assert e.event_time.tzinfo is not None

@@ -1,0 +1,269 @@
+"""Storage locator + metadata DAOs, run against memory and sqlite backends
+(the reference's parameterized LEventsSpec pattern)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.dao import (
+    AccessKey, App, Channel, EngineInstance, EvaluationInstance, Model,
+)
+from pio_tpu.data.storage import Storage, StorageError, parse_env
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def test_parse_env_sources_and_repos():
+    env = {
+        "PIO_STORAGE_SOURCES_PGSQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_PGSQL_PATH": "/tmp/x.db",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PGSQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    }
+    sources, repos = parse_env(env)
+    assert sources["PGSQL"].type == "sqlite"
+    assert sources["PGSQL"].properties["PATH"] == "/tmp/x.db"
+    assert repos == {"METADATA": "PGSQL", "EVENTDATA": "MEM"}
+
+
+def test_zero_config_defaults():
+    sources, repos = parse_env({})
+    assert set(repos) == {"METADATA", "EVENTDATA", "MODELDATA"}
+    assert sources[repos["METADATA"]].type == "sqlite"
+
+
+def test_unknown_backend_type():
+    env = {
+        "PIO_STORAGE_SOURCES_X_TYPE": "hbase9000",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "X",
+    }
+    s = Storage(env=env)
+    with pytest.raises(StorageError):
+        s.get_metadata_apps()
+
+
+def test_verify_all(memory_storage):
+    assert memory_storage.verify_all() == []
+
+
+def test_apps_crud(any_storage):
+    apps = any_storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "myapp", "desc"))
+    assert app_id is not None
+    assert apps.get(app_id).name == "myapp"
+    assert apps.get_by_name("myapp").id == app_id
+    assert apps.insert(App(0, "myapp")) is None  # duplicate name
+    apps.update(App(app_id, "myapp2", None))
+    assert apps.get_by_name("myapp2") is not None
+    assert len(apps.get_all()) == 1
+    apps.delete(app_id)
+    assert apps.get(app_id) is None
+
+
+def test_access_keys(any_storage):
+    ak = any_storage.get_metadata_access_keys()
+    key = ak.insert(AccessKey("", 7, ("rate", "buy")))
+    assert key and len(key) == 64
+    got = ak.get(key)
+    assert got.appid == 7 and got.events == ("rate", "buy")
+    key2 = ak.insert(AccessKey("fixed-key", 7))
+    assert key2 == "fixed-key"
+    assert ak.insert(AccessKey("fixed-key", 8)) is None  # duplicate
+    assert {k.key for k in ak.get_by_appid(7)} == {key, "fixed-key"}
+    ak.delete(key)
+    assert ak.get(key) is None
+
+
+def test_channels(any_storage):
+    ch = any_storage.get_metadata_channels()
+    cid = ch.insert(Channel(0, "mobile", 7))
+    assert cid is not None
+    assert ch.insert(Channel(0, "bad name!", 7)) is None  # invalid name
+    assert ch.insert(Channel(0, "x" * 17, 7)) is None  # too long
+    assert [c.name for c in ch.get_by_appid(7)] == ["mobile"]
+    ch.delete(cid)
+    assert ch.get(cid) is None
+
+
+def _instance(i, status, start_minutes):
+    return EngineInstance(
+        id=i, status=status,
+        start_time=T0 + timedelta(minutes=start_minutes), end_time=T0,
+        engine_id="eng", engine_version="1", engine_variant="default",
+        engine_factory="mod.Factory",
+    )
+
+
+def test_engine_instances_latest_completed(any_storage):
+    ei = any_storage.get_metadata_engine_instances()
+    ei.insert(_instance("a", "COMPLETED", 0))
+    ei.insert(_instance("b", "COMPLETED", 10))
+    ei.insert(_instance("c", "INIT", 20))
+    latest = ei.get_latest_completed("eng", "1", "default")
+    assert latest.id == "b"
+    assert ei.get_latest_completed("eng", "2", "default") is None
+    from dataclasses import replace
+    ei.update(replace(ei.get("c"), status="COMPLETED"))
+    assert ei.get_latest_completed("eng", "1", "default").id == "c"
+
+
+def test_evaluation_instances(any_storage):
+    dao = any_storage.get_metadata_evaluation_instances()
+    iid = dao.insert(EvaluationInstance(
+        id="", status="INIT", start_time=T0, end_time=T0,
+        evaluation_class="ev.Cls",
+    ))
+    got = dao.get(iid)
+    assert got.status == "INIT"
+    from dataclasses import replace
+    dao.update(replace(got, status="EVALCOMPLETED", evaluator_results="r=1"))
+    assert dao.get_completed()[0].evaluator_results == "r=1"
+
+
+def test_models_blob(any_storage):
+    models = any_storage.get_model_data_models()
+    blob = b"\x00\x01binary\xff" * 100
+    models.insert(Model("inst1", blob))
+    assert models.get("inst1").models == blob
+    models.insert(Model("inst1", b"v2"))  # upsert
+    assert models.get("inst1").models == b"v2"
+    models.delete("inst1")
+    assert models.get("inst1") is None
+
+
+def test_localfs_models(tmp_path):
+    env = {
+        "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+    }
+    s = Storage(env=env)
+    models = s.get_model_data_models()
+    models.insert(Model("m/1", b"data"))
+    assert models.get("m/1").models == b"data"
+    models.delete("m/1")
+    assert models.get("m/1") is None
+
+
+# ---------------------------------------------------------------------------
+# events DAO
+# ---------------------------------------------------------------------------
+
+def _rate(uid, iid, minutes, rating=None):
+    props = {"rating": rating} if rating is not None else {}
+    return Event(
+        event="rate", entity_type="user", entity_id=uid,
+        target_entity_type="item", target_entity_id=iid,
+        properties=DataMap(props), event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_events_crud(any_storage):
+    ev = any_storage.get_events()
+    assert ev.init(1)
+    eid = ev.insert(_rate("u1", "i1", 0, 4.0), 1)
+    got = ev.get(eid, 1)
+    assert got.entity_id == "u1" and got.properties.get("rating") == 4.0
+    assert got.event_id == eid
+    assert ev.delete(eid, 1)
+    assert ev.get(eid, 1) is None
+    assert not ev.delete(eid, 1)
+
+
+def test_events_namespace_isolation(any_storage):
+    ev = any_storage.get_events()
+    ev.init(1)
+    ev.init(1, channel_id=5)
+    ev.insert(_rate("u1", "i1", 0), 1)
+    ev.insert(_rate("u2", "i2", 0), 1, channel_id=5)
+    assert [e.entity_id for e in ev.find(1, limit=-1)] == ["u1"]
+    assert [e.entity_id for e in ev.find(1, channel_id=5, limit=-1)] == ["u2"]
+    assert ev.remove(1, channel_id=5)
+    ev.init(1, channel_id=5)
+    assert list(ev.find(1, channel_id=5, limit=-1)) == []
+
+
+def test_events_uninitialized_namespace_raises(any_storage):
+    ev = any_storage.get_events()
+    with pytest.raises(StorageError):
+        ev.insert(_rate("u1", "i1", 0), 99)
+    with pytest.raises(StorageError):
+        list(ev.find(99))
+    with pytest.raises(StorageError):
+        ev.get("x", 99)
+    with pytest.raises(StorageError):
+        ev.delete("x", 99)
+
+
+def test_events_find_filters(any_storage):
+    ev = any_storage.get_events()
+    ev.init(2)
+    for m in range(10):
+        ev.insert(_rate(f"u{m % 3}", f"i{m}", m), 2)
+    ev.insert(Event(event="$set", entity_type="item", entity_id="i0",
+                    properties=DataMap({"cat": "a"}),
+                    event_time=T0 + timedelta(minutes=100)), 2)
+
+    # time range [2, 5)
+    out = list(ev.find(2, start_time=T0 + timedelta(minutes=2),
+                       until_time=T0 + timedelta(minutes=5), limit=-1))
+    assert len(out) == 3
+
+    # entity filters
+    assert all(e.entity_id == "u1"
+               for e in ev.find(2, entity_type="user", entity_id="u1", limit=-1))
+    # event names
+    assert len(list(ev.find(2, event_names=["$set"], limit=-1))) == 1
+    # target entity: don't-care vs must-be-absent
+    assert len(list(ev.find(2, limit=-1))) == 11
+    assert len(list(ev.find(2, target_entity_type=None, limit=-1))) == 1
+    assert len(list(ev.find(2, target_entity_type="item",
+                            target_entity_id="i4", limit=-1))) == 1
+    # ordering + limit + reversed
+    first_two = list(ev.find(2, limit=2))
+    assert [e.event_time for e in first_two] == sorted(
+        e.event_time for e in first_two)
+    newest = next(iter(ev.find(2, limit=1, reversed=True)))
+    assert newest.event == "$set"
+
+
+def test_events_default_limit_is_20(any_storage):
+    ev = any_storage.get_events()
+    ev.init(3)
+    for m in range(30):
+        ev.insert(_rate("u", f"i{m}", m), 3)
+    assert len(list(ev.find(3))) == 20  # reference default page size
+    assert len(list(ev.find(3, limit=-1))) == 30
+
+
+def test_events_aggregate_properties(any_storage):
+    ev = any_storage.get_events()
+    ev.init(4)
+    ev.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                    properties=DataMap({"cat": "a", "price": 10}),
+                    event_time=T0), 4)
+    ev.insert(Event(event="$unset", entity_type="item", entity_id="i1",
+                    properties=DataMap({"price": None}),
+                    event_time=T0 + timedelta(minutes=1)), 4)
+    ev.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                    properties=DataMap({"x": 1}), event_time=T0), 4)
+    ev.insert(_rate("u1", "i1", 2), 4)
+
+    props = ev.aggregate_properties(4, entity_type="item")
+    assert set(props) == {"i1"}
+    assert props["i1"].fields == {"cat": "a"}
+    props_u = ev.aggregate_properties(4, entity_type="user")
+    assert props_u["u1"].fields == {"x": 1}
+
+
+def test_find_single_entity(any_storage):
+    ev = any_storage.get_events()
+    ev.init(5)
+    for m in range(5):
+        ev.insert(_rate("u1", f"i{m}", m), 5)
+    ev.insert(_rate("u2", "i9", 9), 5)
+    out = list(ev.find_single_entity(5, "user", "u1", limit=3))
+    assert len(out) == 3
+    assert out[0].target_entity_id == "i4"  # newest first
