@@ -1,0 +1,315 @@
+"""Event Server — the REST ingestion API.
+
+Route + status-code parity with reference data/.../api/EventServer.scala:
+  GET  /                         -> {"status": "alive"}
+  POST /events.json              -> 201 {"eventId": ...} | 400 | 401 | 403
+  GET  /events/<id>.json         -> 200 event | 404
+  DELETE /events/<id>.json       -> 200 {"message":"Found"} | 404
+  GET  /events.json              -> 200 [events] | 404 when empty | 400
+  POST /batch/events.json        -> 200 [per-event {status,...}] | 400 if >50
+  GET  /stats.json               -> 200 stats (when --stats)
+  POST /webhooks/<name>.json     -> JSON connector ingest
+  GET  /webhooks/<name>.json     -> connector presence check
+  POST /webhooks/<name>          -> form connector ingest
+Auth: ?accessKey= or Authorization header; per-key event-name whitelist
+(EventServer.scala:90-140); optional ?channel= resolved against the app's
+channels.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass
+from typing import Any
+
+from pio_tpu.data.dao import AccessKey, Channel
+from pio_tpu.data.event import Event, EventValidationError, validate_event
+from pio_tpu.data.storage import Storage, get_storage
+from pio_tpu.server.http import HttpApp, HttpServer, Request
+from pio_tpu.server.plugins import PluginContext, PluginRejection
+from pio_tpu.server.stats import Stats
+from pio_tpu.server.webhooks import ConnectorException, default_connectors
+from pio_tpu.utils.time import parse_time
+
+MAX_EVENTS_PER_BATCH = 50  # reference EventServer.scala:68
+
+
+@dataclass
+class EventServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    stats: bool = False
+
+
+class AuthError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def build_event_app(
+    storage: Storage | None = None,
+    config: EventServerConfig | None = None,
+    plugin_context: PluginContext | None = None,
+) -> HttpApp:
+    storage = storage or get_storage()
+    config = config or EventServerConfig()
+    plugins = plugin_context or PluginContext()
+    events_dao = storage.get_events()
+    access_keys = storage.get_metadata_access_keys()
+    channels = storage.get_metadata_channels()
+    stats = Stats()
+    json_connectors, form_connectors = default_connectors()
+
+    app = HttpApp("eventserver")
+    app.stats = stats  # exposed for tests/ops
+
+    # -- auth (reference withAccessKey, EventServer.scala:90-128) -----------
+    def authenticate(req: Request) -> tuple[AccessKey, int | None]:
+        key = req.params.get("accessKey", "")
+        if not key:
+            # HTTP Basic: the access key is the username, empty password
+            # (reference EventServer.scala:113-117)
+            header = req.header("authorization")
+            if header.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(header[6:]).decode("utf-8")
+                    key = decoded.split(":", 1)[0]
+                except (ValueError, UnicodeDecodeError):
+                    raise AuthError(401, "Invalid accessKey.")
+        if not key:
+            raise AuthError(401, "Missing accessKey.")
+        ak = access_keys.get(key)
+        if ak is None:
+            raise AuthError(401, "Invalid accessKey.")
+        channel_name = req.params.get("channel")
+        if channel_name is None:
+            return ak, None
+        for ch in channels.get_by_appid(ak.appid):
+            if ch.name == channel_name:
+                return ak, ch.id
+        raise AuthError(401, "Invalid channel.")
+
+    def check_event_allowed(ak: AccessKey, event_name: str) -> None:
+        # per-key whitelist (reference EventServer.scala:272)
+        if ak.events and event_name not in ak.events:
+            raise AuthError(
+                403, f"{event_name} events are not allowed"
+            )
+
+    def insert_one(ak: AccessKey, channel_id: int | None, d: dict) -> str:
+        event = Event.from_api_dict(d)
+        validate_event(event)
+        check_event_allowed(ak, event.event)
+        for blocker in plugins.input_blockers:
+            blocker.process(d, {"appId": ak.appid, "channelId": channel_id})
+        for sniffer in plugins.input_sniffers:
+            try:
+                sniffer.process(d, {"appId": ak.appid, "channelId": channel_id})
+            except Exception:  # noqa: BLE001 - sniffers cannot fail requests
+                pass
+        event_id = events_dao.insert(event, ak.appid, channel_id)
+        if config.stats:  # gated like reference EventServer.scala:284-285
+            stats.update(ak.appid, 201, event.event, event.entity_type)
+        return event_id
+
+    # -- routes -------------------------------------------------------------
+    @app.route("GET", r"/")
+    def root(req: Request):
+        return 200, {"status": "alive"}
+
+    @app.route("POST", r"/events\.json")
+    def create_event(req: Request):
+        try:
+            ak, channel_id = authenticate(req)
+            body = req.json()
+            if not isinstance(body, dict):
+                return 400, {"message": "request body must be a JSON object"}
+            event_id = insert_one(ak, channel_id, body)
+            return 201, {"eventId": event_id}
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        except (EventValidationError, json.JSONDecodeError) as e:
+            return 400, {"message": str(e)}
+        except PluginRejection as e:
+            return 403, {"message": str(e)}
+
+    @app.route("GET", r"/events/([^/]+)\.json")
+    def get_event(req: Request):
+        try:
+            ak, channel_id = authenticate(req)
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        event = events_dao.get(req.path_args[0], ak.appid, channel_id)
+        if event is None:
+            return 404, {"message": "Not Found"}
+        return 200, event.to_api_dict()
+
+    @app.route("DELETE", r"/events/([^/]+)\.json")
+    def delete_event(req: Request):
+        try:
+            ak, channel_id = authenticate(req)
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        found = events_dao.delete(req.path_args[0], ak.appid, channel_id)
+        if found:
+            return 200, {"message": "Found"}
+        return 404, {"message": "Not Found"}
+
+    @app.route("GET", r"/events\.json")
+    def find_events(req: Request):
+        try:
+            ak, channel_id = authenticate(req)
+            p = req.params
+
+            def opt_time(name):
+                return parse_time(p[name]) if name in p else None
+
+            def opt_nullable(name):
+                # "&targetEntityType=" (empty) means must-be-absent; missing
+                # means don't-care — mirroring Option[Option[String]]
+                if name not in p:
+                    return ...
+                return p[name] or None
+
+            limit = int(p.get("limit", 20))
+            out = list(
+                events_dao.find(
+                    app_id=ak.appid,
+                    channel_id=channel_id,
+                    start_time=opt_time("startTime"),
+                    until_time=opt_time("untilTime"),
+                    entity_type=p.get("entityType"),
+                    entity_id=p.get("entityId"),
+                    event_names=[p["event"]] if "event" in p else None,
+                    target_entity_type=opt_nullable("targetEntityType"),
+                    target_entity_id=opt_nullable("targetEntityId"),
+                    limit=limit,
+                    reversed=p.get("reversed", "false").lower() == "true",
+                )
+            )
+            if not out:
+                return 404, {"message": "Not Found"}
+            return 200, [e.to_api_dict() for e in out]
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+
+    @app.route("POST", r"/batch/events\.json")
+    def batch_events(req: Request):
+        try:
+            ak, channel_id = authenticate(req)
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        try:
+            body = req.json()
+        except json.JSONDecodeError as e:
+            return 400, {"message": str(e)}
+        if not isinstance(body, list):
+            return 400, {"message": "request body must be a JSON array"}
+        if len(body) > MAX_EVENTS_PER_BATCH:
+            return 400, {
+                "message": "Batch request must have less than or equal to "
+                f"{MAX_EVENTS_PER_BATCH} events"
+            }
+        results = []
+        for d in body:
+            try:
+                if not isinstance(d, dict):
+                    raise EventValidationError("event must be a JSON object")
+                event_id = insert_one(ak, channel_id, d)
+                results.append({"status": 201, "eventId": event_id})
+            except (EventValidationError, ValueError) as e:
+                results.append({"status": 400, "message": str(e)})
+            except AuthError as e:
+                results.append({"status": e.status, "message": e.message})
+            except PluginRejection as e:
+                results.append({"status": 403, "message": str(e)})
+            except Exception as e:  # noqa: BLE001 - per-event isolation
+                results.append({"status": 500, "message": str(e)})
+        return 200, results
+
+    @app.route("GET", r"/stats\.json")
+    def get_stats(req: Request):
+        if not config.stats:
+            return 404, {
+                "message": "To see stats, launch Event Server with --stats"
+            }
+        try:
+            ak, _ = authenticate(req)
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        return 200, stats.get(ak.appid)
+
+    # -- webhooks (reference api/Webhooks.scala:44-151) ---------------------
+    @app.route("POST", r"/webhooks/([^/]+)\.json")
+    def webhook_json(req: Request):
+        name = req.path_args[0]
+        connector = json_connectors.get(name)
+        if connector is None:
+            return 404, {"message": f"webhook {name} not supported"}
+        try:
+            ak, channel_id = authenticate(req)
+            data = req.json()
+            if not isinstance(data, dict):
+                return 400, {"message": "webhook body must be a JSON object"}
+            event_json = connector.to_event_json(data)
+            event_id = insert_one(ak, channel_id, event_json)
+            return 201, {"eventId": event_id}
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        except (ConnectorException, EventValidationError, json.JSONDecodeError) as e:
+            return 400, {"message": str(e)}
+
+    @app.route("GET", r"/webhooks/([^/]+)\.json")
+    def webhook_json_check(req: Request):
+        name = req.path_args[0]
+        try:
+            authenticate(req)
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        if name in json_connectors:
+            return 200, {"message": f"Ok. Will interpret JSON in {name} format"}
+        return 404, {"message": f"webhook {name} not supported"}
+
+    @app.route("POST", r"/webhooks/([^/.]+)")
+    def webhook_form(req: Request):
+        name = req.path_args[0]
+        connector = form_connectors.get(name)
+        if connector is None:
+            return 404, {"message": f"webhook {name} not supported"}
+        try:
+            ak, channel_id = authenticate(req)
+            event_json = connector.to_event_json(req.form())
+            event_id = insert_one(ak, channel_id, event_json)
+            return 201, {"eventId": event_id}
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        except (ConnectorException, EventValidationError) as e:
+            return 400, {"message": str(e)}
+
+    @app.route("GET", r"/webhooks/([^/.]+)")
+    def webhook_form_check(req: Request):
+        name = req.path_args[0]
+        try:
+            authenticate(req)
+        except AuthError as e:
+            return e.status, {"message": e.message}
+        if name in form_connectors:
+            return 200, {"message": f"Ok. Will interpret form in {name} format"}
+        return 404, {"message": f"webhook {name} not supported"}
+
+    return app
+
+
+def create_event_server(
+    storage: Storage | None = None,
+    config: EventServerConfig | None = None,
+    plugin_context: PluginContext | None = None,
+) -> HttpServer:
+    config = config or EventServerConfig()
+    app = build_event_app(storage, config, plugin_context)
+    return HttpServer(app, host=config.ip, port=config.port)

@@ -1,0 +1,153 @@
+"""Minimal threaded HTTP core shared by the event server, admin server,
+dashboard, and deploy server.
+
+Replaces the reference's spray/akka actor HTTP stack (EventServer.scala:219,
+CreateServer.scala:463) with a stdlib ThreadingHTTPServer + a regex route
+table. Deliberately dependency-free: the control plane is not the TPU hot
+path, and zero-install operation matters more than raw HTTP throughput here.
+Handlers return (status, json-serializable body).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    params: dict[str, str]            # query params (first value wins)
+    headers: dict[str, str]
+    body: bytes = b""
+    path_args: tuple[str, ...] = ()   # regex captures from the route pattern
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> dict[str, str]:
+        parsed = urllib.parse.parse_qs(
+            self.body.decode("utf-8"), keep_blank_values=True
+        )
+        return {k: v[0] for k, v in parsed.items()}
+
+    def header(self, name: str, default: str = "") -> str:
+        """Case-insensitive header lookup (headers are stored lowercased)."""
+        return self.headers.get(name.lower(), default)
+
+
+Handler = Callable[[Request], tuple[int, Any]]
+
+
+class HttpApp:
+    """Route table: (method, compiled path regex) -> handler."""
+
+    def __init__(self, name: str = "pio"):
+        self.name = name
+        self.routes: list[tuple[str, re.Pattern, Handler]] = []
+
+    def route(self, method: str, pattern: str):
+        compiled = re.compile("^" + pattern + "$")
+
+        def deco(fn: Handler) -> Handler:
+            self.routes.append((method.upper(), compiled, fn))
+            return fn
+
+        return deco
+
+    def dispatch(self, req: Request) -> tuple[int, Any]:
+        path_matched = False
+        for method, pattern, fn in self.routes:
+            m = pattern.match(req.path)
+            if not m:
+                continue
+            path_matched = True
+            if method != req.method:
+                continue
+            req.path_args = m.groups()
+            return fn(req)
+        if path_matched:
+            return 405, {"message": "Method Not Allowed"}
+        return 404, {"message": "Not Found"}
+
+
+class HttpServer:
+    """Threaded HTTP server wrapping an HttpApp; bind/serve/shutdown."""
+
+    def __init__(self, app: HttpApp, host: str = "127.0.0.1", port: int = 0):
+        self.app = app
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _handle(self):
+                parsed = urllib.parse.urlparse(self.path)
+                params = {
+                    k: v[0]
+                    for k, v in urllib.parse.parse_qs(
+                        parsed.query, keep_blank_values=True
+                    ).items()
+                }
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = Request(
+                    method=self.command,
+                    path=parsed.path,
+                    params=params,
+                    # lowercase keys: HTTP header names are case-insensitive
+                    headers={k.lower(): v for k, v in self.headers.items()},
+                    body=body,
+                )
+                try:
+                    status, payload = outer.app.dispatch(req)
+                except json.JSONDecodeError:
+                    status, payload = 400, {"message": "Invalid JSON body"}
+                except Exception as e:  # noqa: BLE001 - last-resort 500
+                    status, payload = 500, {"message": f"{type(e).__name__}: {e}"}
+                if isinstance(payload, (bytes, str)) :
+                    data = payload.encode() if isinstance(payload, str) else payload
+                    ctype = "text/html; charset=utf-8"
+                else:
+                    data = json.dumps(payload).encode("utf-8")
+                    ctype = "application/json; charset=utf-8"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST = do_DELETE = do_PUT = _handle
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "HttpServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"{self.app.name}-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

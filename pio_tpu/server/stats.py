@@ -1,0 +1,75 @@
+"""In-memory ingest statistics with hourly cutoff.
+
+Reference data/.../api/Stats.scala:27-96 + StatsActor.scala:28-75: per-app
+counters keyed by (event name, entityType, status), kept for the previous
+and current hour, served at /stats.json.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from dataclasses import dataclass
+from datetime import datetime, timedelta
+
+from pio_tpu.utils.time import utcnow
+
+
+@dataclass(frozen=True)
+class KV:
+    app_id: int
+    status: int
+    event: str
+    entity_type: str
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hour_start = self._floor_hour(utcnow())
+        self._current: Counter = Counter()
+        self._previous: Counter = Counter()
+
+    @staticmethod
+    def _floor_hour(dt: datetime) -> datetime:
+        return dt.replace(minute=0, second=0, microsecond=0)
+
+    def _cutoff(self, now: datetime):
+        hour = self._floor_hour(now)
+        if hour > self._hour_start:
+            if hour - self._hour_start == timedelta(hours=1):
+                self._previous = self._current
+            else:
+                self._previous = Counter()
+            self._current = Counter()
+            self._hour_start = hour
+
+    def update(self, app_id: int, status: int, event: str, entity_type: str):
+        with self._lock:
+            self._cutoff(utcnow())
+            self._current[KV(app_id, status, event, entity_type)] += 1
+
+    def get(self, app_id: int) -> dict:
+        """Counts for the previous full hour + current hour so far."""
+        with self._lock:
+            self._cutoff(utcnow())
+
+            def rows(c: Counter):
+                return [
+                    {
+                        "event": k.event,
+                        "entityType": k.entity_type,
+                        "status": k.status,
+                        "count": n,
+                    }
+                    for k, n in sorted(
+                        c.items(), key=lambda kv: (kv[0].event, kv[0].status)
+                    )
+                    if k.app_id == app_id
+                ]
+
+            return {
+                "hourStart": self._hour_start.isoformat(),
+                "currentHour": rows(self._current),
+                "previousHour": rows(self._previous),
+            }

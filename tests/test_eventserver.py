@@ -1,0 +1,271 @@
+"""Event Server REST tests over a real socket (reference
+EventServiceSpec.scala / spray-testkit — here: live HTTP on port 0)."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from pio_tpu.data.dao import AccessKey, App, Channel
+from pio_tpu.server.eventserver import EventServerConfig, create_event_server
+from pio_tpu.server.plugins import EventServerPlugin, PluginContext, PluginRejection
+
+
+@pytest.fixture()
+def server(memory_storage):
+    apps = memory_storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "testapp"))
+    keys = memory_storage.get_metadata_access_keys()
+    keys.insert(AccessKey("KEY", app_id, ()))
+    keys.insert(AccessKey("RATEONLY", app_id, ("rate",)))
+    channels = memory_storage.get_metadata_channels()
+    cid = channels.insert(Channel(0, "mobile", app_id))
+    ev = memory_storage.get_events()
+    ev.init(app_id)
+    ev.init(app_id, cid)
+
+    class Blocker(EventServerPlugin):
+        plugin_name = "blocker"
+        plugin_type = EventServerPlugin.INPUT_BLOCKER
+
+        def process(self, event_dict, context):
+            if event_dict.get("event") == "blocked":
+                raise PluginRejection("blocked by plugin")
+
+    srv = create_event_server(
+        memory_storage,
+        EventServerConfig(ip="127.0.0.1", port=0, stats=True),
+        PluginContext([Blocker()]),
+    ).start()
+    yield srv
+    srv.stop()
+
+
+def call(srv, method, path, body=None, form=None, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{srv.port}{path}" + (f"?{qs}" if qs else "")
+    if form is not None:
+        data = urllib.parse.urlencode(form).encode()
+        headers = {"Content-Type": "application/x-www-form-urlencoded"}
+    elif body is not None:
+        data = json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+    else:
+        data, headers = None, {}
+    req = urllib.request.Request(url, data=data, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        payload = e.read().decode()
+        return e.code, json.loads(payload) if payload else {}
+
+
+RATE = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4},
+    "eventTime": "2026-01-01T00:00:00.000Z",
+}
+
+
+def test_alive(server):
+    assert call(server, "GET", "/") == (200, {"status": "alive"})
+
+
+def test_basic_auth_header(server):
+    import base64
+    url = f"http://127.0.0.1:{server.port}/events.json"
+    token = base64.b64encode(b"KEY:").decode()
+    req = urllib.request.Request(
+        url, data=json.dumps(RATE).encode(),
+        headers={"Content-Type": "application/json",
+                 "authorization": f"Basic {token}"},  # lowercase header too
+        method="POST")
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 201
+    bad = urllib.request.Request(
+        url, data=json.dumps(RATE).encode(),
+        headers={"Authorization": "Basic !!!notb64"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bad)
+    assert ei.value.code == 401
+
+
+def test_empty_target_filter_means_absent(server):
+    call(server, "POST", "/events.json", body=RATE, accessKey="KEY")
+    noTarget = {"event": "signup", "entityType": "user", "entityId": "u7"}
+    call(server, "POST", "/events.json", body=noTarget, accessKey="KEY")
+    # "&targetEntityType=" (blank) = must-be-absent
+    status, out = call(server, "GET", "/events.json", accessKey="KEY",
+                       targetEntityType="")
+    assert status == 200
+    assert [e["event"] for e in out] == ["signup"]
+
+
+def test_auth_required(server):
+    status, body = call(server, "POST", "/events.json", body=RATE)
+    assert status == 401
+    status, _ = call(server, "POST", "/events.json", body=RATE, accessKey="WRONG")
+    assert status == 401
+
+
+def test_create_get_delete_event(server):
+    status, body = call(server, "POST", "/events.json", body=RATE, accessKey="KEY")
+    assert status == 201 and "eventId" in body
+    eid = body["eventId"]
+    status, got = call(server, "GET", f"/events/{eid}.json", accessKey="KEY")
+    assert status == 200 and got["entityId"] == "u1" and got["eventId"] == eid
+    status, msg = call(server, "DELETE", f"/events/{eid}.json", accessKey="KEY")
+    assert (status, msg) == (200, {"message": "Found"})
+    status, _ = call(server, "GET", f"/events/{eid}.json", accessKey="KEY")
+    assert status == 404
+
+
+def test_invalid_event_400(server):
+    bad = dict(RATE, event="$badname")
+    status, body = call(server, "POST", "/events.json", body=bad, accessKey="KEY")
+    assert status == 400 and "reserved" in body["message"]
+
+
+def test_event_whitelist(server):
+    status, _ = call(server, "POST", "/events.json", body=RATE, accessKey="RATEONLY")
+    assert status == 201
+    buy = dict(RATE, event="buy")
+    status, body = call(server, "POST", "/events.json", body=buy, accessKey="RATEONLY")
+    assert status == 403 and "not allowed" in body["message"]
+
+
+def test_channel_routing(server):
+    status, _ = call(server, "POST", "/events.json", body=RATE,
+                     accessKey="KEY", channel="mobile")
+    assert status == 201
+    status, _ = call(server, "POST", "/events.json", body=RATE,
+                     accessKey="KEY", channel="nosuch")
+    assert status == 401
+    # default channel does not see the mobile event
+    status, _ = call(server, "GET", "/events.json", accessKey="KEY")
+    assert status == 404
+    status, out = call(server, "GET", "/events.json", accessKey="KEY",
+                       channel="mobile")
+    assert status == 200 and len(out) == 1
+
+
+def test_find_filters_and_404_when_empty(server):
+    for i in range(5):
+        e = dict(RATE, entityId=f"u{i % 2}", targetEntityId=f"i{i}",
+                 eventTime=f"2026-01-01T00:0{i}:00.000Z")
+        assert call(server, "POST", "/events.json", body=e, accessKey="KEY")[0] == 201
+    status, out = call(server, "GET", "/events.json", accessKey="KEY",
+                       entityType="user", entityId="u1")
+    assert status == 200 and len(out) == 2
+    status, out = call(server, "GET", "/events.json", accessKey="KEY", limit=3)
+    assert len(out) == 3
+    status, out = call(server, "GET", "/events.json", accessKey="KEY",
+                       reversed="true", limit=1)
+    assert out[0]["targetEntityId"] == "i4"
+    status, out = call(server, "GET", "/events.json", accessKey="KEY",
+                       startTime="2026-01-01T00:02:00.000Z",
+                       untilTime="2026-01-01T00:04:00.000Z")
+    assert len(out) == 2
+    status, _ = call(server, "GET", "/events.json", accessKey="KEY",
+                     entityId="nobody")
+    assert status == 404
+
+
+def test_batch(server):
+    good = dict(RATE)
+    bad = {"event": "", "entityType": "user", "entityId": "x"}
+    status, out = call(server, "POST", "/batch/events.json",
+                       body=[good, bad, good], accessKey="KEY")
+    assert status == 200
+    assert [r["status"] for r in out] == [201, 400, 201]
+    status, body = call(server, "POST", "/batch/events.json",
+                        body=[good] * 51, accessKey="KEY")
+    assert status == 400 and "50" in body["message"]
+
+
+def test_batch_whitelist_applies(server):
+    buy = dict(RATE, event="buy")
+    status, out = call(server, "POST", "/batch/events.json",
+                       body=[dict(RATE), buy], accessKey="RATEONLY")
+    assert [r["status"] for r in out] == [201, 403]
+
+
+def test_plugin_blocker(server):
+    blocked = dict(RATE, event="blocked")
+    status, body = call(server, "POST", "/events.json", body=blocked,
+                        accessKey="KEY")
+    assert status == 403 and "plugin" in body["message"]
+
+
+def test_stats(server):
+    call(server, "POST", "/events.json", body=RATE, accessKey="KEY")
+    call(server, "POST", "/events.json", body=dict(RATE, event="buy"),
+         accessKey="KEY")
+    # webhook ingests must count too
+    call(server, "POST", "/webhooks/segmentio.json", accessKey="KEY",
+         body={"version": "2", "type": "track", "userId": "u", "event": "x",
+               "timestamp": "2026-01-01T00:00:00Z"})
+    status, out = call(server, "GET", "/stats.json", accessKey="KEY")
+    assert status == 200
+    counts = {r["event"]: r["count"] for r in out["currentHour"]}
+    assert counts["rate"] >= 1 and counts["buy"] == 1 and counts["track"] == 1
+
+
+def test_unknown_route_and_method(server):
+    status, _ = call(server, "GET", "/nope.json", accessKey="KEY")
+    assert status == 404
+    status, _ = call(server, "PUT", "/events.json", accessKey="KEY", body={})
+    assert status == 405
+
+
+def test_webhook_segmentio(server):
+    payload = {
+        "version": "2",
+        "type": "track",
+        "userId": "u42",
+        "event": "signup",
+        "properties": {"plan": "pro"},
+        "timestamp": "2026-01-02T03:04:05.000Z",
+    }
+    status, body = call(server, "POST", "/webhooks/segmentio.json",
+                        body=payload, accessKey="KEY")
+    assert status == 201
+    status, got = call(server, "GET", f"/events/{body['eventId']}.json",
+                       accessKey="KEY")
+    assert got["event"] == "track"
+    assert got["entityId"] == "u42"
+    assert got["properties"]["event"] == "signup"
+    # presence check + unknown connector
+    assert call(server, "GET", "/webhooks/segmentio.json", accessKey="KEY")[0] == 200
+    assert call(server, "POST", "/webhooks/nope.json", body={}, accessKey="KEY")[0] == 404
+    # malformed payload -> 400
+    status, _ = call(server, "POST", "/webhooks/segmentio.json",
+                     body={"type": "track"}, accessKey="KEY")
+    assert status == 400
+
+
+def test_webhook_mailchimp_form(server):
+    form = {
+        "type": "subscribe",
+        "fired_at": "2026-01-02 21:31:18",
+        "data[id]": "8a25ff1d98",
+        "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp",
+        "data[merges][LNAME]": "API",
+    }
+    status, body = call(server, "POST", "/webhooks/mailchimp",
+                        form=form, accessKey="KEY")
+    assert status == 201
+    _, got = call(server, "GET", f"/events/{body['eventId']}.json", accessKey="KEY")
+    assert got["event"] == "subscribe"
+    assert got["entityId"] == "8a25ff1d98"
+    assert got["properties"]["merges"]["FNAME"] == "MailChimp"
+    assert got["eventTime"].startswith("2026-01-02T21:31:18")

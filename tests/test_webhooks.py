@@ -1,0 +1,163 @@
+"""Pure connector transform tests (reference ConnectorTestUtil pattern:
+SegmentIOConnectorSpec, MailChimpConnectorSpec, Example*ConnectorSpec)."""
+
+import pytest
+
+from pio_tpu.data.event import Event, validate_event
+from pio_tpu.server.webhooks import ConnectorException
+from pio_tpu.server.webhooks.example import ExampleFormConnector, ExampleJsonConnector
+from pio_tpu.server.webhooks.mailchimp import MailChimpConnector
+from pio_tpu.server.webhooks.segmentio import SegmentIOConnector
+
+
+def check(event_json: dict) -> Event:
+    """Every connector output must pass full event validation."""
+    e = Event.from_api_dict(event_json)
+    validate_event(e)
+    return e
+
+
+def test_segmentio_identify():
+    out = SegmentIOConnector().to_event_json({
+        "version": "2", "type": "identify", "userId": "u1",
+        "traits": {"email": "a@b.c"},
+        "timestamp": "2026-01-01T00:00:00Z",
+        "context": {"ip": "1.2.3.4"},
+    })
+    e = check(out)
+    assert e.event == "identify" and e.entity_type == "user"
+    assert out["properties"]["traits"]["email"] == "a@b.c"
+    assert out["properties"]["context"]["ip"] == "1.2.3.4"
+
+
+def test_segmentio_anonymous_fallback_and_errors():
+    c = SegmentIOConnector()
+    out = c.to_event_json({
+        "version": "2", "type": "page", "anonymousId": "anon9",
+        "name": "home", "timestamp": "2026-01-01T00:00:00Z",
+    })
+    assert out["entityId"] == "anon9"
+    with pytest.raises(ConnectorException):
+        c.to_event_json({"type": "track", "userId": "u",
+                         "timestamp": "2026-01-01T00:00:00Z"})  # no version
+    with pytest.raises(ConnectorException):
+        c.to_event_json({"version": "2", "type": "track",
+                         "timestamp": "2026-01-01T00:00:00Z"})  # no user
+    with pytest.raises(ConnectorException):
+        c.to_event_json({"version": "2", "type": "bogus", "userId": "u",
+                         "timestamp": "2026-01-01T00:00:00Z"})
+
+
+def test_segmentio_group_alias_screen():
+    c = SegmentIOConnector()
+    g = c.to_event_json({"version": "2", "type": "group", "userId": "u",
+                         "groupId": "g7", "traits": {"n": 1},
+                         "timestamp": "2026-01-01T00:00:00Z"})
+    assert g["properties"]["group_id"] == "g7"
+    a = c.to_event_json({"version": "2", "type": "alias", "userId": "u",
+                         "previousId": "old",
+                         "timestamp": "2026-01-01T00:00:00Z"})
+    assert a["properties"]["previous_id"] == "old"
+    s = c.to_event_json({"version": "2", "type": "screen", "userId": "u",
+                         "name": "Home", "properties": {"w": 320},
+                         "timestamp": "2026-01-01T00:00:00Z"})
+    assert s["properties"]["name"] == "Home"
+    check(g), check(a), check(s)
+
+
+MC_BASE = {
+    "fired_at": "2026-01-02 21:31:18",
+    "data[id]": "8a25ff1d98",
+    "data[list_id]": "a6b5da1054",
+    "data[email]": "api@mailchimp.com",
+    "data[email_type]": "html",
+    "data[merges][EMAIL]": "api@mailchimp.com",
+    "data[merges][FNAME]": "MailChimp",
+    "data[ip_opt]": "10.20.10.30",
+}
+
+
+def test_mailchimp_subscribe_unsubscribe_profile():
+    c = MailChimpConnector()
+    sub = c.to_event_json(dict(MC_BASE, type="subscribe"))
+    e = check(sub)
+    assert e.event == "subscribe" and e.entity_id == "8a25ff1d98"
+    assert sub["properties"]["merges"]["FNAME"] == "MailChimp"
+
+    unsub = c.to_event_json(dict(
+        MC_BASE, type="unsubscribe",
+        **{"data[action]": "unsub", "data[reason]": "manual",
+           "data[campaign_id]": "cb398d21d2"}))
+    assert unsub["properties"]["action"] == "unsub"
+    check(unsub)
+
+    prof = c.to_event_json(dict(MC_BASE, type="profile"))
+    assert prof["event"] == "profile"
+
+
+def test_mailchimp_upemail_cleaned_campaign():
+    c = MailChimpConnector()
+    up = c.to_event_json({
+        "type": "upemail", "fired_at": "2026-01-02 21:31:18",
+        "data[new_id]": "new123", "data[list_id]": "l1",
+        "data[new_email]": "n@x.c", "data[old_email]": "o@x.c",
+    })
+    assert up["entityId"] == "new123"
+    cl = c.to_event_json({
+        "type": "cleaned", "fired_at": "2026-01-02 21:31:18",
+        "data[list_id]": "l1", "data[campaign_id]": "c1",
+        "data[reason]": "hard", "data[email]": "bad@x.c",
+    })
+    assert cl["entityType"] == "list" and cl["entityId"] == "l1"
+    camp = c.to_event_json({
+        "type": "campaign", "fired_at": "2026-01-02 21:31:18",
+        "data[id]": "c9", "data[subject]": "Hi", "data[status]": "sent",
+        "data[reason]": "", "data[list_id]": "l1",
+    })
+    assert camp["entityType"] == "campaign"
+    check(up), check(cl), check(camp)
+
+
+def test_mailchimp_errors():
+    c = MailChimpConnector()
+    with pytest.raises(ConnectorException):
+        c.to_event_json({"type": "subscribe"})  # missing fired_at
+    with pytest.raises(ConnectorException):
+        c.to_event_json(dict(MC_BASE, type="subscribe",
+                             fired_at="not a time"))
+    with pytest.raises(ConnectorException):
+        c.to_event_json(dict(MC_BASE, type="wat"))
+
+
+def test_example_json_connector():
+    c = ExampleJsonConnector()
+    ua = c.to_event_json({
+        "type": "userAction", "userId": "as34smg4", "event": "do_something",
+        "context": {"ip": "24.5.68.47"}, "anotherProperty1": 100,
+        "timestamp": "2015-01-02T00:30:12.984Z",
+    })
+    e = check(ua)
+    assert e.event == "do_something" and e.target_entity_type is None
+    uai = c.to_event_json({
+        "type": "userActionItem", "userId": "u", "event": "view",
+        "itemId": "kfjd312bc", "context": {"ip": "1.2.3.4"},
+        "timestamp": "2015-01-15T04:20:23.567Z",
+    })
+    e2 = check(uai)
+    assert e2.target_entity_id == "kfjd312bc"
+    with pytest.raises(ConnectorException):
+        c.to_event_json({"type": "userAction", "userId": "u"})
+
+
+def test_example_form_connector():
+    c = ExampleFormConnector()
+    out = c.to_event_json({
+        "type": "userAction", "userId": "as34smg4", "event": "do_something",
+        "context[ip]": "24.5.68.47", "context[prop1]": "2.345",
+        "anotherProperty1": "100",
+        "timestamp": "2015-01-02T00:30:12.984Z",
+    })
+    e = check(out)
+    assert out["properties"]["context"]["ip"] == "24.5.68.47"
+    with pytest.raises(ConnectorException):
+        c.to_event_json({"type": "unknown"})
