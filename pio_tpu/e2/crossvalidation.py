@@ -1,0 +1,76 @@
+"""k-fold cross-validation splitters.
+
+Reference e2/.../evaluation/CrossValidation.scala:9-39 `splitData`: fold i's
+test set is every example whose index % k == i; train is the rest. Same
+index-mod-k contract here, vectorized over numpy columns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from pio_tpu.data.eventstore import Interactions
+
+
+@dataclass(frozen=True)
+class FoldInfo:
+    fold: int
+    k: int
+
+
+def split_indices(n: int, k: int) -> list[tuple[np.ndarray, np.ndarray]]:
+    """-> [(train_idx, test_idx)] per fold, index-mod-k."""
+    idx = np.arange(n)
+    return [((idx % k) != f, (idx % k) == f) for f in range(k)]
+
+
+def split_data(
+    rows: Sequence[Any], k: int
+) -> list[tuple[list[Any], FoldInfo, list[Any]]]:
+    """Generic splitter over a row list (reference splitData shape)."""
+    out = []
+    for f in range(k):
+        train = [r for i, r in enumerate(rows) if i % k != f]
+        test = [r for i, r in enumerate(rows) if i % k == f]
+        out.append((train, FoldInfo(f, k), test))
+    return out
+
+
+def split_interactions(
+    data: Interactions,
+    k: int,
+    num: int = 10,
+) -> list[tuple[Interactions, FoldInfo, list[tuple[dict, Any]]]]:
+    """Interactions -> k folds of (train, info, [(query, actual)]).
+
+    Queries follow the recommendation template shape {"user", "num"}; the
+    actual is the list of held-out item ids for that user (what the metric
+    layer scores against, reference MetricEvaluator input shape)."""
+    if k <= 1:
+        return []
+    folds = []
+    n = len(data)
+    for train_mask, test_mask in split_indices(n, k):
+        train = Interactions(
+            user_idx=data.user_idx[train_mask],
+            item_idx=data.item_idx[train_mask],
+            values=data.values[train_mask],
+            users=data.users,
+            items=data.items,
+        )
+        qa: list[tuple[dict, Any]] = []
+        test_users = data.user_idx[test_mask]
+        test_items = data.item_idx[test_mask]
+        by_user: dict[int, list[int]] = {}
+        for u, i in zip(test_users, test_items):
+            by_user.setdefault(int(u), []).append(int(i))
+        for u, item_list in sorted(by_user.items()):
+            qa.append((
+                {"user": data.users.id_of(u), "num": num},
+                [data.items.id_of(i) for i in item_list],
+            ))
+        folds.append((train, FoldInfo(len(folds), k), qa))
+    return folds

@@ -1,0 +1,17 @@
+from pio_tpu.parallel.mesh import (
+    MeshConfig,
+    create_mesh,
+    shard_batch,
+    replicate,
+    DATA_AXIS,
+    MODEL_AXIS,
+)
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "shard_batch",
+    "replicate",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+]

@@ -1,0 +1,85 @@
+"""Device mesh + sharding helpers — the replacement for the reference's
+Spark cluster substrate (SURVEY.md section 2 "Parallelism & distributed-
+communication components").
+
+The reference scales by partitioning RDDs over Spark executors and shuffling
+between stages; here a `jax.sharding.Mesh` over TPU chips plays that role:
+ * axis "data"  — batch/entity sharding (Spark's RDD partitioning);
+ * axis "model" — factor/feature sharding (MLlib's block matrices);
+collectives (psum/all_gather/reduce_scatter over ICI) replace shuffles.
+
+Multi-host: `jax.devices()` already spans hosts under jax.distributed; the
+same mesh axes then ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Mesh shape: data-parallel x model-parallel. -1 = use all remaining."""
+
+    data: int = -1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int]:
+        model = self.model if self.model > 0 else 1
+        data = self.data if self.data > 0 else n_devices // model
+        if data * model > n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} needs {data * model} devices, "
+                f"have {n_devices}"
+            )
+        return data, model
+
+
+def create_mesh(
+    config: MeshConfig | None = None, devices: list | None = None
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    config = config or MeshConfig()
+    data, model = config.resolve(len(devices))
+    dev_array = np.array(devices[: data * model]).reshape(data, model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis split across the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0, fill=0):
+    """Pad `axis` of x up to a multiple (XLA wants static, divisible shapes)."""
+    n = x.shape[axis]
+    target = math.ceil(n / multiple) * multiple if n else multiple
+    if target == n:
+        return x, n
+    pad_width = [(0, 0)] * x.ndim
+    pad_width[axis] = (0, target - n)
+    return np.pad(x, pad_width, constant_values=fill), n
+
+
+def shard_batch(x: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Host numpy -> device array sharded on the data axis (the analogue of
+    parallelize()-ing an RDD). Pads the leading axis to the mesh size."""
+    n_data = mesh.shape[DATA_AXIS]
+    padded, _ = pad_to_multiple(x, n_data, axis=0)
+    return jax.device_put(padded, data_sharding(mesh))
+
+
+def replicate(x, mesh: Mesh) -> jax.Array:
+    return jax.device_put(x, replicated(mesh))

@@ -116,98 +116,97 @@ def build_event_app(
         return event_id
 
     # -- routes -------------------------------------------------------------
+    def authed(fn):
+        """Wrap a handler with authentication + the AuthError/403/400 status
+        mapping all routes share (the reference's withAccessKey directive)."""
+
+        def wrapper(req: Request):
+            try:
+                ak, channel_id = authenticate(req)
+                return fn(req, ak, channel_id)
+            except AuthError as e:
+                return e.status, {"message": e.message}
+            except PluginRejection as e:
+                return 403, {"message": str(e)}
+            except (
+                EventValidationError,
+                ConnectorException,
+                json.JSONDecodeError,
+                ValueError,
+            ) as e:
+                return 400, {"message": str(e)}
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
     @app.route("GET", r"/")
     def root(req: Request):
         return 200, {"status": "alive"}
 
     @app.route("POST", r"/events\.json")
-    def create_event(req: Request):
-        try:
-            ak, channel_id = authenticate(req)
-            body = req.json()
-            if not isinstance(body, dict):
-                return 400, {"message": "request body must be a JSON object"}
-            event_id = insert_one(ak, channel_id, body)
-            return 201, {"eventId": event_id}
-        except AuthError as e:
-            return e.status, {"message": e.message}
-        except (EventValidationError, json.JSONDecodeError) as e:
-            return 400, {"message": str(e)}
-        except PluginRejection as e:
-            return 403, {"message": str(e)}
+    @authed
+    def create_event(req: Request, ak, channel_id):
+        body = req.json()
+        if not isinstance(body, dict):
+            return 400, {"message": "request body must be a JSON object"}
+        event_id = insert_one(ak, channel_id, body)
+        return 201, {"eventId": event_id}
 
     @app.route("GET", r"/events/([^/]+)\.json")
-    def get_event(req: Request):
-        try:
-            ak, channel_id = authenticate(req)
-        except AuthError as e:
-            return e.status, {"message": e.message}
+    @authed
+    def get_event(req: Request, ak, channel_id):
         event = events_dao.get(req.path_args[0], ak.appid, channel_id)
         if event is None:
             return 404, {"message": "Not Found"}
         return 200, event.to_api_dict()
 
     @app.route("DELETE", r"/events/([^/]+)\.json")
-    def delete_event(req: Request):
-        try:
-            ak, channel_id = authenticate(req)
-        except AuthError as e:
-            return e.status, {"message": e.message}
+    @authed
+    def delete_event(req: Request, ak, channel_id):
         found = events_dao.delete(req.path_args[0], ak.appid, channel_id)
         if found:
             return 200, {"message": "Found"}
         return 404, {"message": "Not Found"}
 
     @app.route("GET", r"/events\.json")
-    def find_events(req: Request):
-        try:
-            ak, channel_id = authenticate(req)
-            p = req.params
+    @authed
+    def find_events(req: Request, ak, channel_id):
+        p = req.params
 
-            def opt_time(name):
-                return parse_time(p[name]) if name in p else None
+        def opt_time(name):
+            return parse_time(p[name]) if name in p else None
 
-            def opt_nullable(name):
-                # "&targetEntityType=" (empty) means must-be-absent; missing
-                # means don't-care — mirroring Option[Option[String]]
-                if name not in p:
-                    return ...
-                return p[name] or None
+        def opt_nullable(name):
+            # "&targetEntityType=" (empty) means must-be-absent; missing
+            # means don't-care — mirroring Option[Option[String]]
+            if name not in p:
+                return ...
+            return p[name] or None
 
-            limit = int(p.get("limit", 20))
-            out = list(
-                events_dao.find(
-                    app_id=ak.appid,
-                    channel_id=channel_id,
-                    start_time=opt_time("startTime"),
-                    until_time=opt_time("untilTime"),
-                    entity_type=p.get("entityType"),
-                    entity_id=p.get("entityId"),
-                    event_names=[p["event"]] if "event" in p else None,
-                    target_entity_type=opt_nullable("targetEntityType"),
-                    target_entity_id=opt_nullable("targetEntityId"),
-                    limit=limit,
-                    reversed=p.get("reversed", "false").lower() == "true",
-                )
+        limit = int(p.get("limit", 20))
+        out = list(
+            events_dao.find(
+                app_id=ak.appid,
+                channel_id=channel_id,
+                start_time=opt_time("startTime"),
+                until_time=opt_time("untilTime"),
+                entity_type=p.get("entityType"),
+                entity_id=p.get("entityId"),
+                event_names=[p["event"]] if "event" in p else None,
+                target_entity_type=opt_nullable("targetEntityType"),
+                target_entity_id=opt_nullable("targetEntityId"),
+                limit=limit,
+                reversed=p.get("reversed", "false").lower() == "true",
             )
-            if not out:
-                return 404, {"message": "Not Found"}
-            return 200, [e.to_api_dict() for e in out]
-        except AuthError as e:
-            return e.status, {"message": e.message}
-        except ValueError as e:
-            return 400, {"message": str(e)}
+        )
+        if not out:
+            return 404, {"message": "Not Found"}
+        return 200, [e.to_api_dict() for e in out]
 
     @app.route("POST", r"/batch/events\.json")
-    def batch_events(req: Request):
-        try:
-            ak, channel_id = authenticate(req)
-        except AuthError as e:
-            return e.status, {"message": e.message}
-        try:
-            body = req.json()
-        except json.JSONDecodeError as e:
-            return 400, {"message": str(e)}
+    @authed
+    def batch_events(req: Request, ak, channel_id):
+        body = req.json()
         if not isinstance(body, list):
             return 400, {"message": "request body must be a JSON array"}
         if len(body) > MAX_EVENTS_PER_BATCH:
@@ -233,71 +232,52 @@ def build_event_app(
         return 200, results
 
     @app.route("GET", r"/stats\.json")
-    def get_stats(req: Request):
+    @authed
+    def get_stats(req: Request, ak, channel_id):
         if not config.stats:
             return 404, {
                 "message": "To see stats, launch Event Server with --stats"
             }
-        try:
-            ak, _ = authenticate(req)
-        except AuthError as e:
-            return e.status, {"message": e.message}
         return 200, stats.get(ak.appid)
 
     # -- webhooks (reference api/Webhooks.scala:44-151) ---------------------
     @app.route("POST", r"/webhooks/([^/]+)\.json")
-    def webhook_json(req: Request):
+    @authed
+    def webhook_json(req: Request, ak, channel_id):
         name = req.path_args[0]
         connector = json_connectors.get(name)
         if connector is None:
             return 404, {"message": f"webhook {name} not supported"}
-        try:
-            ak, channel_id = authenticate(req)
-            data = req.json()
-            if not isinstance(data, dict):
-                return 400, {"message": "webhook body must be a JSON object"}
-            event_json = connector.to_event_json(data)
-            event_id = insert_one(ak, channel_id, event_json)
-            return 201, {"eventId": event_id}
-        except AuthError as e:
-            return e.status, {"message": e.message}
-        except (ConnectorException, EventValidationError, json.JSONDecodeError) as e:
-            return 400, {"message": str(e)}
+        data = req.json()
+        if not isinstance(data, dict):
+            return 400, {"message": "webhook body must be a JSON object"}
+        event_json = connector.to_event_json(data)
+        event_id = insert_one(ak, channel_id, event_json)
+        return 201, {"eventId": event_id}
 
     @app.route("GET", r"/webhooks/([^/]+)\.json")
-    def webhook_json_check(req: Request):
+    @authed
+    def webhook_json_check(req: Request, ak, channel_id):
         name = req.path_args[0]
-        try:
-            authenticate(req)
-        except AuthError as e:
-            return e.status, {"message": e.message}
         if name in json_connectors:
             return 200, {"message": f"Ok. Will interpret JSON in {name} format"}
         return 404, {"message": f"webhook {name} not supported"}
 
     @app.route("POST", r"/webhooks/([^/.]+)")
-    def webhook_form(req: Request):
+    @authed
+    def webhook_form(req: Request, ak, channel_id):
         name = req.path_args[0]
         connector = form_connectors.get(name)
         if connector is None:
             return 404, {"message": f"webhook {name} not supported"}
-        try:
-            ak, channel_id = authenticate(req)
-            event_json = connector.to_event_json(req.form())
-            event_id = insert_one(ak, channel_id, event_json)
-            return 201, {"eventId": event_id}
-        except AuthError as e:
-            return e.status, {"message": e.message}
-        except (ConnectorException, EventValidationError) as e:
-            return 400, {"message": str(e)}
+        event_json = connector.to_event_json(req.form())
+        event_id = insert_one(ak, channel_id, event_json)
+        return 201, {"eventId": event_id}
 
     @app.route("GET", r"/webhooks/([^/.]+)")
-    def webhook_form_check(req: Request):
+    @authed
+    def webhook_form_check(req: Request, ak, channel_id):
         name = req.path_args[0]
-        try:
-            authenticate(req)
-        except AuthError as e:
-            return e.status, {"message": e.message}
         if name in form_connectors:
             return 200, {"message": f"Ok. Will interpret form in {name} format"}
         return 404, {"message": f"webhook {name} not supported"}

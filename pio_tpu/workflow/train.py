@@ -1,0 +1,111 @@
+"""Train workflow — read -> prepare -> train -> persist -> record.
+
+Mirrors reference CoreWorkflow.runTrain (core/.../workflow/CoreWorkflow.scala:42-98)
+and CreateWorkflow's EngineInstance bookkeeping (CreateWorkflow.scala:133-273):
+ * EngineInstance inserted with status INIT, updated COMPLETED/FAILED;
+ * models serialized into the MODELDATA repository keyed by instance id;
+ * deploy later picks getLatestCompleted — never a half-trained run.
+"""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from dataclasses import replace
+from typing import Any
+
+from pio_tpu.controller.base import TrainingInterruption
+from pio_tpu.controller.engine import Engine, EngineParams
+from pio_tpu.data.dao import EngineInstance, Model
+from pio_tpu.data.storage import Storage
+from pio_tpu.utils.time import utcnow
+from pio_tpu.workflow.checkpoint import models_from_bytes, models_to_bytes
+from pio_tpu.workflow.context import WorkflowContext, create_workflow_context
+
+log = logging.getLogger("pio_tpu.workflow")
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    storage: Storage,
+    engine_id: str,
+    engine_version: str = "1",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    batch: str = "",
+    ctx: WorkflowContext | None = None,
+    stop_after_read: bool = False,
+    stop_after_prepare: bool = False,
+) -> str:
+    """Returns the EngineInstance id (status COMPLETED on success)."""
+    ctx = ctx or create_workflow_context(storage)
+    instances = storage.get_metadata_engine_instances()
+    now = utcnow()
+    instance_id = instances.insert(
+        EngineInstance(
+            id="",
+            status="INIT",
+            start_time=now,
+            end_time=now,
+            engine_id=engine_id,
+            engine_version=engine_version,
+            engine_variant=engine_variant,
+            engine_factory=engine_factory,
+            batch=batch,
+            datasource_params=f"{engine_params.datasource}",
+            preparator_params=f"{engine_params.preparator}",
+            algorithms_params=f"{engine_params.algorithms}",
+            serving_params=f"{engine_params.serving}",
+        )
+    )
+    instance = instances.get(instance_id)
+    try:
+        models = engine.train(
+            ctx,
+            engine_params,
+            stop_after_read=stop_after_read,
+            stop_after_prepare=stop_after_prepare,
+        )
+        blob = models_to_bytes(models)
+        storage.get_model_data_models().insert(Model(instance_id, blob))
+        instances.update(
+            replace(instance, status="COMPLETED", end_time=utcnow())
+        )
+        log.info("training %s COMPLETED (%d bytes of models)",
+                 instance_id, len(blob))
+        return instance_id
+    except TrainingInterruption:
+        instances.update(replace(instance, status="INTERRUPTED", end_time=utcnow()))
+        raise
+    except Exception:
+        log.error("training %s FAILED:\n%s", instance_id, traceback.format_exc())
+        instances.update(replace(instance, status="FAILED", end_time=utcnow()))
+        raise
+
+
+def load_models(
+    storage: Storage,
+    engine: Engine,
+    engine_params: EngineParams,
+    instance_id: str,
+    ctx: WorkflowContext | None = None,
+) -> list[Any]:
+    """Restore an instance's models and run per-algorithm deploy prep
+    (reference Engine.prepareDeploy, Engine.scala:196-266 — minus the
+    retrain-on-deploy hack: device models restore straight from bytes)."""
+    ctx = ctx or create_workflow_context(storage)
+    record = storage.get_model_data_models().get(instance_id)
+    if record is None:
+        raise ValueError(f"no models stored for engine instance {instance_id}")
+    models = models_from_bytes(record.models)
+    _, _, algos, _ = engine._doers(engine_params)
+    if len(models) != len(algos):
+        raise ValueError(
+            f"instance {instance_id} has {len(models)} models but engine "
+            f"params define {len(algos)} algorithms"
+        )
+    return [
+        algo.prepare_model_for_deploy(ctx, m)
+        for algo, m in zip(algos, models)
+    ]

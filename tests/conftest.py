@@ -5,13 +5,17 @@ core/src/test/.../BaseTest.scala:12-50)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force CPU with 8 virtual devices. The machine env pre-sets
+# JAX_PLATFORMS=axon (the real-TPU tunnel) and sitecustomize imports jax at
+# interpreter startup, so env vars are snapshotted before conftest runs —
+# the explicit config API is the only reliable override here.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

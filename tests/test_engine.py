@@ -1,0 +1,175 @@
+"""Engine train/eval contract tests with fake DASE doers (the reference's
+EngineTest.scala + SampleEngine.scala pattern)."""
+
+import pytest
+
+from pio_tpu.controller import (
+    AverageServing,
+    DataSource,
+    Doer,
+    Engine,
+    EngineParams,
+    FirstServing,
+    IdentityPreparator,
+    LAlgorithm,
+    Params,
+    Preparator,
+    Serving,
+    SimpleEngine,
+    TrainingInterruption,
+    engine_params_from_variant,
+)
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DSParams(Params):
+    n: int = 3
+
+
+class DS(DataSource):
+    params_class = DSParams
+
+    def __init__(self, params: DSParams = DSParams()):
+        self.params = params
+
+    def read_training(self, ctx):
+        return list(range(self.params.n))
+
+    def read_eval(self, ctx):
+        # two folds; queries are {"q": i}, actuals are i
+        return [
+            (list(range(self.params.n)), {"fold": f},
+             [({"q": i}, i) for i in range(3)])
+            for f in range(2)
+        ]
+
+
+class Prep(Preparator):
+    def prepare(self, ctx, td):
+        return [x * 10 for x in td]
+
+
+@dataclass(frozen=True)
+class AlgoParams(Params):
+    mult: int = 1
+
+
+class Algo(LAlgorithm):
+    params_class = AlgoParams
+
+    def __init__(self, params: AlgoParams = AlgoParams()):
+        self.params = params
+
+    def train(self, ctx, pd):
+        return {"sum": sum(pd), "mult": self.params.mult}
+
+    def predict(self, model, query):
+        return model["sum"] * self.params.mult + query["q"]
+
+
+class SumServing(Serving):
+    def serve(self, query, predictions):
+        return sum(predictions)
+
+
+def make_engine():
+    return Engine(
+        DS, Prep, {"a": Algo, "b": Algo}, {"first": FirstServing, "sum": SumServing}
+    )
+
+
+def params(algos, serving="first"):
+    return EngineParams(
+        datasource=("", DSParams(n=3)),
+        preparator=("", None),
+        algorithms=algos,
+        serving=(serving, None),
+    )
+
+
+def test_train_multi_algo():
+    engine = make_engine()
+    models = engine.train(None, params([("a", AlgoParams(1)), ("b", AlgoParams(2))]))
+    assert models == [{"sum": 30, "mult": 1}, {"sum": 30, "mult": 2}]
+
+
+def test_train_unknown_stage_name():
+    engine = make_engine()
+    with pytest.raises(ValueError, match="algorithm"):
+        engine.train(None, params([("zzz", None)]))
+
+
+def test_stop_after_read_and_prepare():
+    engine = make_engine()
+    with pytest.raises(TrainingInterruption) as e:
+        engine.train(None, params([("a", None)]), stop_after_read=True)
+    assert e.value.stage == "read"
+    with pytest.raises(TrainingInterruption) as e:
+        engine.train(None, params([("a", None)]), stop_after_prepare=True)
+    assert e.value.stage == "prepare"
+
+
+def test_eval_serving_combination():
+    engine = make_engine()
+    ep = params([("a", AlgoParams(1)), ("b", AlgoParams(2))], serving="sum")
+    results = engine.eval(None, ep)
+    assert len(results) == 2  # two folds
+    eval_info, qpa = results[0]
+    assert eval_info == {"fold": 0}
+    # prediction for query q: (30*1+q) + (30*2+q)
+    for (q, p, a) in qpa:
+        assert p == 30 + q["q"] + 60 + q["q"]
+        assert a == q["q"]
+
+
+def test_simple_engine():
+    engine = SimpleEngine(DS, Algo)
+    # SimpleEngine: identity prep -> sum over raw td = 3
+    models = engine.train(None, EngineParams(algorithms=[("", None)]))
+    assert models[0]["sum"] == 3
+
+
+def test_doer_fallbacks():
+    class NoParams:
+        pass
+
+    assert isinstance(Doer(NoParams), NoParams)
+    assert isinstance(Doer(NoParams, None), NoParams)
+    a = Doer(Algo, {"mult": 5})
+    assert a.params.mult == 5
+    with pytest.raises(ValueError, match="unknown params"):
+        Doer(Algo, {"nope": 1})
+
+
+def test_engine_params_from_variant():
+    engine = make_engine()
+    variant = {
+        "id": "default",
+        "engineFactory": "x.y.Factory",
+        "datasource": {"params": {"n": 7}},
+        "algorithms": [
+            {"name": "a", "params": {"mult": 3}},
+            {"name": "b", "params": {}},
+        ],
+        "serving": {"name": "sum"},
+    }
+    ep = engine.engine_params_from_variant(variant)
+    assert ep.datasource[1].n == 7
+    assert ep.algorithms[0] == ("a", AlgoParams(3))
+    assert ep.serving[0] == "sum"
+    models = engine.train(None, ep)
+    assert models[0] == {"sum": 210, "mult": 3}
+
+
+def test_engine_params_variant_unknown_algo():
+    engine = make_engine()
+    with pytest.raises(ValueError, match="not in engine"):
+        engine.engine_params_from_variant(
+            {"algorithms": [{"name": "zzz"}]}
+        )
+
+
+def test_average_serving():
+    s = AverageServing()
+    assert s.serve({}, [1.0, 3.0]) == 2.0

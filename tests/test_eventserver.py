@@ -202,6 +202,16 @@ def test_plugin_blocker(server):
     status, body = call(server, "POST", "/events.json", body=blocked,
                         accessKey="KEY")
     assert status == 403 and "plugin" in body["message"]
+    # webhook path maps plugin rejection to 403 too (not 500)
+    payload = {"version": "2", "type": "track", "userId": "u",
+               "event": "blocked", "timestamp": "2026-01-01T00:00:00Z"}
+    # the segmentio connector emits event type "track", so trigger via a
+    # connector whose output event name is "blocked": use examplejson
+    ua = {"type": "userAction", "userId": "u", "event": "blocked",
+          "anotherProperty1": 1, "timestamp": "2026-01-01T00:00:00Z"}
+    status, body = call(server, "POST", "/webhooks/examplejson.json",
+                        body=ua, accessKey="KEY")
+    assert status == 403, body
 
 
 def test_stats(server):

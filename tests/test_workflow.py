@@ -1,0 +1,164 @@
+"""Train workflow + end-to-end slice: events in storage -> ALS engine train
+-> instance/model persistence -> restore -> predict (the minimum end-to-end
+slice of SURVEY.md section 7 phase 3)."""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller import EngineParams
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.dao import App
+from pio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+from pio_tpu.workflow.context import create_workflow_context
+from pio_tpu.workflow.train import load_models, run_train
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+@pytest.fixture()
+def seeded_storage(memory_storage):
+    """App 'mlapp' with a clustered rating structure: even users love even
+    items, odd users love odd items."""
+    apps = memory_storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "mlapp"))
+    ev = memory_storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    minute = 0
+    for u in range(24):
+        for i in range(16):
+            match = (u % 2) == (i % 2)
+            if rng.random() < (0.8 if match else 0.15):
+                rating = 5 if match else 1
+                ev.insert(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap({"rating": rating}),
+                        event_time=T0 + timedelta(minutes=minute),
+                    ),
+                    app_id,
+                )
+                minute += 1
+    # a few buy events (implicit)
+    for u in range(4):
+        ev.insert(
+            Event(
+                event="buy",
+                entity_type="user",
+                entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{u % 2}",
+                event_time=T0 + timedelta(minutes=minute + u),
+            ),
+            app_id,
+        )
+    return memory_storage
+
+
+def engine_and_params():
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="mlapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=8, num_iterations=8, lambda_=0.05, chunk=1024))],
+    )
+    return engine, ep
+
+
+def test_end_to_end_train_persist_restore_predict(seeded_storage):
+    engine, ep = engine_and_params()
+    ctx = create_workflow_context(seeded_storage, use_mesh=False)
+    instance_id = run_train(
+        engine, ep, seeded_storage,
+        engine_id="rec", engine_factory="pio_tpu.models.recommendation.RecommendationEngine",
+        ctx=ctx,
+    )
+    instances = seeded_storage.get_metadata_engine_instances()
+    assert instances.get(instance_id).status == "COMPLETED"
+    assert instances.get_latest_completed("rec", "1", "default").id == instance_id
+
+    # restore through the deploy path and query
+    models = load_models(seeded_storage, engine, ep, instance_id, ctx=ctx)
+    algo = engine._doers(ep)[2][0]
+    result = algo.predict(models[0], {"user": "u0", "num": 5})
+    items = [s["item"] for s in result["itemScores"]]
+    assert len(items) == 5
+    # user 0 (even) should mostly get even items
+    even = sum(1 for it in items if int(it[1:]) % 2 == 0)
+    assert even >= 4, items
+    # scores sorted descending
+    scores = [s["score"] for s in result["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_predict_unknown_user_and_lists(seeded_storage):
+    engine, ep = engine_and_params()
+    ctx = create_workflow_context(seeded_storage, use_mesh=False)
+    models = engine.train(ctx, ep)
+    algo = engine._doers(ep)[2][0]
+    assert algo.predict(models[0], {"user": "ghost", "num": 3}) == {"itemScores": []}
+    r = algo.predict(models[0], {"user": "u0", "num": 3,
+                                 "whiteList": ["i0", "i2", "i4"]})
+    assert all(s["item"] in {"i0", "i2", "i4"} for s in r["itemScores"])
+    # whitelist candidates are scored directly: all 3 slots fill
+    assert len(r["itemScores"]) == 3
+    # unknown whitelist items are skipped, not crashed on
+    r = algo.predict(models[0], {"user": "u0", "num": 3,
+                                 "whiteList": ["i0", "nope"]})
+    assert [s["item"] for s in r["itemScores"]] == ["i0"]
+    r = algo.predict(models[0], {"user": "u0", "num": 3, "blackList": ["i0"]})
+    assert all(s["item"] != "i0" for s in r["itemScores"])
+
+
+def test_train_on_mesh(seeded_storage):
+    """Same engine trained over the 8-device CPU mesh (sharded ALS path)."""
+    engine, ep = engine_and_params()
+    ctx = create_workflow_context(seeded_storage, use_mesh=True)
+    assert ctx.mesh is not None and ctx.mesh.devices.size == 8
+    models = engine.train(ctx, ep)
+    algo = engine._doers(ep)[2][0]
+    result = algo.predict(models[0], {"user": "u1", "num": 5})
+    items = [s["item"] for s in result["itemScores"]]
+    odd = sum(1 for it in items if int(it[1:]) % 2 == 1)
+    assert odd >= 4, items
+
+
+def test_failed_training_marks_instance(seeded_storage):
+    engine, ep = engine_and_params()
+    bad = EngineParams(
+        datasource=("", DataSourceParams(app_name="does-not-exist")),
+        algorithms=ep.algorithms,
+    )
+    ctx = create_workflow_context(seeded_storage, use_mesh=False)
+    with pytest.raises(Exception):
+        run_train(engine, bad, seeded_storage, engine_id="rec", ctx=ctx)
+    statuses = {i.status for i in
+                seeded_storage.get_metadata_engine_instances().get_all()}
+    assert "FAILED" in statuses
+
+
+def test_batch_predict_vectorized(seeded_storage):
+    engine, ep = engine_and_params()
+    ctx = create_workflow_context(seeded_storage, use_mesh=False)
+    models = engine.train(ctx, ep)
+    algo = engine._doers(ep)[2][0]
+    queries = [{"user": f"u{i}", "num": 3} for i in range(6)] + [
+        {"user": "ghost", "num": 3}]
+    batch = algo.batch_predict(models[0], queries)
+    assert len(batch) == 7
+    assert batch[-1] == {"itemScores": []}
+    # batch results match single predicts
+    for q, b in zip(queries[:3], batch[:3]):
+        single = algo.predict(models[0], q)
+        assert [s["item"] for s in single["itemScores"]] == [
+            s["item"] for s in b["itemScores"]]
