@@ -11,6 +11,7 @@ explicit namespaces table.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sqlite3
 import threading
@@ -73,6 +74,7 @@ class SqliteBackend(Backend):
             path = ":memory:"
         if path != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._lock = threading.RLock()
@@ -82,6 +84,21 @@ class SqliteBackend(Backend):
 
     def close(self):
         with self._lock:
+            # fold the WAL back into the main db file so a plain file copy of
+            # PATH is a complete backup (operators expect that); sqlite
+            # reports BUSY via the result row, not an exception
+            try:
+                row = self._conn.execute(
+                    "PRAGMA wal_checkpoint(TRUNCATE)"
+                ).fetchone()
+                if row and row[0] == 1:
+                    logging.getLogger("pio_tpu.storage").warning(
+                        "wal_checkpoint busy: %s-wal not merged; copy the "
+                        "-wal/-shm sidecars too when backing up",
+                        self._path,
+                    )
+            except sqlite3.Error:
+                pass
             self._conn.close()
 
     def _exec(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:

