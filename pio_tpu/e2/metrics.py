@@ -1,0 +1,52 @@
+"""Ranking metrics for the recommendation templates.
+
+The reference's similarproduct/ecommerce evaluation examples define
+Precision@K-style metrics over PredictedResult.itemScores vs. actual item
+sets; these are the shared vectorized implementations.
+"""
+
+from __future__ import annotations
+
+from pio_tpu.controller.evaluation import OptionAverageMetric
+
+
+def _predicted_items(prediction) -> list[str]:
+    if isinstance(prediction, dict):
+        return [s["item"] for s in prediction.get("itemScores", [])]
+    return list(prediction or [])
+
+
+class PrecisionAtK(OptionAverageMetric):
+    """Fraction of the top-k predictions that are in the actual set.
+    Queries with no predictions score None (excluded, reference
+    OptionAverageMetric semantics)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"Precision@{self.k}"
+
+    def calculate_one(self, query, prediction, actual):
+        pred = _predicted_items(prediction)[: self.k]
+        if not pred:
+            return None
+        actual_set = set(actual or [])
+        return sum(1 for p in pred if p in actual_set) / len(pred)
+
+
+class RecallAtK(OptionAverageMetric):
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"Recall@{self.k}"
+
+    def calculate_one(self, query, prediction, actual):
+        actual_set = set(actual or [])
+        if not actual_set:
+            return None
+        pred = _predicted_items(prediction)[: self.k]
+        return sum(1 for p in pred if p in actual_set) / len(actual_set)

@@ -1,0 +1,103 @@
+"""Evaluation workflow — EvaluationInstance lifecycle around MetricEvaluator.
+
+Mirrors reference CoreWorkflow.runEvaluation (core/.../CoreWorkflow.scala:100-157)
++ EvaluationWorkflow.scala:17-27: insert EvaluationInstance, run
+engine.eval x params via the evaluator, persist one-liner/JSON/HTML results,
+mark EVALCOMPLETED.
+"""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from dataclasses import replace
+from typing import Sequence
+
+from pio_tpu.controller.engine import Engine, EngineParams
+from pio_tpu.controller.evaluation import (
+    Evaluation,
+    Metric,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from pio_tpu.data.dao import EvaluationInstance
+from pio_tpu.data.storage import Storage
+from pio_tpu.utils.time import utcnow
+from pio_tpu.workflow.context import WorkflowContext, create_workflow_context
+
+log = logging.getLogger("pio_tpu.workflow")
+
+
+def run_evaluation(
+    engine: Engine,
+    metric: Metric,
+    engine_params_list: Sequence[EngineParams],
+    storage: Storage,
+    other_metrics: Sequence[Metric] = (),
+    evaluation_class: str = "",
+    params_generator_class: str = "",
+    batch: str = "",
+    output_path: str | None = None,
+    ctx: WorkflowContext | None = None,
+) -> tuple[str, MetricEvaluatorResult]:
+    """Returns (evaluation instance id, result)."""
+    ctx = ctx or create_workflow_context(storage)
+    instances = storage.get_metadata_evaluation_instances()
+    now = utcnow()
+    instance_id = instances.insert(
+        EvaluationInstance(
+            id="",
+            status="INIT",
+            start_time=now,
+            end_time=now,
+            evaluation_class=evaluation_class,
+            engine_params_generator_class=params_generator_class,
+            batch=batch,
+        )
+    )
+    instance = instances.get(instance_id)
+    try:
+        evaluator = MetricEvaluator(
+            metric, other_metrics=other_metrics, output_path=output_path
+        )
+        result = evaluator.evaluate_base(ctx, engine, engine_params_list)
+        instances.update(
+            replace(
+                instance,
+                status="EVALCOMPLETED",
+                end_time=utcnow(),
+                evaluator_results=result.one_liner(),
+                evaluator_results_html=result.to_html(),
+                evaluator_results_json=result.to_json(),
+            )
+        )
+        log.info("evaluation %s EVALCOMPLETED best=%s",
+                 instance_id, result.best_score.score)
+        return instance_id, result
+    except Exception:
+        log.error("evaluation %s FAILED:\n%s", instance_id, traceback.format_exc())
+        instances.update(
+            replace(instance, status="EVALFAILED", end_time=utcnow())
+        )
+        raise
+
+
+def run_evaluation_class(
+    evaluation_class: type[Evaluation],
+    generator_class,
+    storage: Storage,
+    **kwargs,
+) -> tuple[str, MetricEvaluatorResult]:
+    """Run an Evaluation subclass with an EngineParamsGenerator (the
+    `pio eval Evaluation ParamsGenerator` entry shape)."""
+    engine, metric = evaluation_class.engine_metric()
+    return run_evaluation(
+        engine=engine,
+        metric=metric,
+        engine_params_list=list(generator_class.engine_params_list),
+        storage=storage,
+        other_metrics=list(evaluation_class.metrics),
+        evaluation_class=evaluation_class.__name__,
+        params_generator_class=generator_class.__name__,
+        **kwargs,
+    )

@@ -1,0 +1,335 @@
+"""Metric/MetricEvaluator/FastEval/evaluation-workflow tests (reference
+MetricTest, MetricEvaluatorTest, FastEvalEngineTest, EvaluationWorkflowTest)."""
+
+import math
+from dataclasses import dataclass
+
+import pytest
+
+from pio_tpu.controller import (
+    AverageMetric,
+    DataSource,
+    Engine,
+    EngineParams,
+    FastEvalEngine,
+    FirstServing,
+    IdentityPreparator,
+    LAlgorithm,
+    MetricEvaluator,
+    OptionAverageMetric,
+    Params,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from pio_tpu.e2.metrics import PrecisionAtK, RecallAtK
+from pio_tpu.workflow.evaluate import run_evaluation
+
+
+# ---------------------------------------------------------------------------
+# metric math (reference MetricTest)
+# ---------------------------------------------------------------------------
+
+class Abs(AverageMetric):
+    def calculate_one(self, q, p, a):
+        return abs(p - a)
+
+
+class MaybeAbs(OptionAverageMetric):
+    def calculate_one(self, q, p, a):
+        return None if a is None else abs(p - a)
+
+
+class SSum(SumMetric):
+    def calculate_one(self, q, p, a):
+        return p
+
+
+class SStd(StdevMetric):
+    def calculate_one(self, q, p, a):
+        return p
+
+
+DATA = [
+    (None, [({}, 1.0, 2.0), ({}, 3.0, 3.0)]),
+    (None, [({}, 5.0, 1.0)]),
+]
+
+
+def test_average_metric():
+    assert Abs().calculate(None, DATA) == pytest.approx((1 + 0 + 4) / 3)
+
+
+def test_option_average_excludes_none():
+    data = [(None, [({}, 1.0, 2.0), ({}, 9.0, None)])]
+    assert MaybeAbs().calculate(None, data) == pytest.approx(1.0)
+    assert math.isnan(MaybeAbs().calculate(None, [(None, [({}, 1.0, None)])]))
+
+
+def test_plain_average_raises_on_none():
+    class Sloppy(AverageMetric):
+        def calculate_one(self, q, p, a):
+            return None
+
+    with pytest.raises(ValueError, match="returned None"):
+        Sloppy().calculate(None, DATA)
+
+
+def test_nan_never_best_for_lower_is_better():
+    # lower-is-better metric: a NaN-scoring params must not win
+    class NanErr(OptionAverageMetric):
+        higher_is_better = False
+
+        def calculate_one(self, q, p, a):
+            return None if a is None else abs(p - a)
+
+    engine = make_engine()
+
+    class NanDS(DS):
+        def read_eval(self, ctx):
+            return [({}, {"fold": 0}, [({"q": 1}, None)])]  # all-None actuals
+
+    nan_engine = Engine(NanDS, Prep, {"algo": Algo}, FirstServing)
+    from pio_tpu.controller import MetricEvaluator as ME
+    # score param grids through separate engines then compare manually
+    r = ME(NanErr()).evaluate_base(None, engine, grid([1.0, 2.0]))
+    assert r.best_engine_params.algorithms[0][1].w == 1.0  # error 0 wins
+    r2 = ME(NanErr()).evaluate_base(None, nan_engine, grid([1.0]))
+    assert math.isnan(r2.best_score.score)  # only NaN available -> reported
+
+
+def test_sum_stdev_zero_metrics():
+    assert SSum().calculate(None, DATA) == pytest.approx(9.0)
+    import numpy as np
+    assert SStd().calculate(None, DATA) == pytest.approx(
+        float(np.std([1.0, 3.0, 5.0])))
+    assert ZeroMetric().calculate(None, DATA) == 0.0
+
+
+def test_precision_recall_at_k():
+    data = [(None, [
+        ({}, {"itemScores": [{"item": "a", "score": 1}, {"item": "b", "score": 0.5}]},
+         ["a", "c"]),
+        ({}, {"itemScores": []}, ["a"]),  # no predictions -> excluded
+    ])]
+    assert PrecisionAtK(2).calculate(None, data) == pytest.approx(0.5)
+    # recall: q1 = 1/2; q2 has actuals but no predictions -> 0; mean = 0.25
+    assert RecallAtK(2).calculate(None, data) == pytest.approx(0.25)
+    assert PrecisionAtK(2).header == "Precision@2"
+
+
+# ---------------------------------------------------------------------------
+# fake engine for evaluator tests
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DSP(Params):
+    n: int = 4
+
+
+class DS(DataSource):
+    params_class = DSP
+    read_count = 0
+
+    def __init__(self, params: DSP = DSP()):
+        self.params = params
+
+    def read_training(self, ctx):
+        return list(range(self.params.n))
+
+    def read_eval(self, ctx):
+        DS.read_count += 1
+        return [
+            (list(range(self.params.n)), {"fold": f},
+             [({"q": i}, float(i)) for i in range(4)])
+            for f in range(2)
+        ]
+
+
+class Prep(IdentityPreparator):
+    prepare_count = 0
+
+    def prepare(self, ctx, td):
+        Prep.prepare_count += 1
+        return td
+
+
+@dataclass(frozen=True)
+class AP(Params):
+    w: float = 1.0
+
+
+class Algo(LAlgorithm):
+    params_class = AP
+    train_count = 0
+
+    def __init__(self, params: AP = AP()):
+        self.params = params
+
+    def train(self, ctx, pd):
+        Algo.train_count += 1
+        return {"w": self.params.w}
+
+    def predict(self, model, query):
+        return model["w"] * query["q"]
+
+
+class Err(AverageMetric):
+    higher_is_better = False
+
+    def calculate_one(self, q, p, a):
+        return abs(p - a)
+
+
+def reset_counts():
+    DS.read_count = 0
+    Prep.prepare_count = 0
+    Algo.train_count = 0
+
+
+def make_engine(fast=False):
+    cls = FastEvalEngine if fast else Engine
+    return cls(DS, Prep, {"algo": Algo}, FirstServing)
+
+
+def grid(ws):
+    return [
+        EngineParams(datasource=("", DSP()), algorithms=[("algo", AP(w))])
+        for w in ws
+    ]
+
+
+# ---------------------------------------------------------------------------
+# MetricEvaluator (reference MetricEvaluatorTest)
+# ---------------------------------------------------------------------------
+
+def test_metric_evaluator_picks_best():
+    reset_counts()
+    engine = make_engine()
+    result = MetricEvaluator(Err()).evaluate_base(
+        None, engine, grid([0.5, 1.0, 2.0])
+    )
+    # w=1.0 predicts exactly -> error 0 -> best (lower is better)
+    assert result.best_idx == 1
+    assert result.best_engine_params.algorithms[0][1].w == 1.0
+    assert result.best_score.score == pytest.approx(0.0)
+    assert len(result.engine_params_scores) == 3
+
+
+def test_metric_evaluator_writes_best_json(tmp_path):
+    engine = make_engine()
+    out = tmp_path / "best.json"
+    MetricEvaluator(Err(), output_path=str(out)).evaluate_base(
+        None, engine, grid([0.5, 1.0])
+    )
+    import json
+    best = json.loads(out.read_text())
+    assert best["algorithmParamsList"][0]["params"]["w"] == 1.0
+
+
+def test_metric_evaluator_other_metrics():
+    engine = make_engine()
+    result = MetricEvaluator(Err(), other_metrics=[ZeroMetric()]).evaluate_base(
+        None, engine, grid([1.0])
+    )
+    assert result.engine_params_scores[0][1].other_scores == [0.0]
+    assert result.other_metric_headers == ["ZeroMetric"]
+
+
+# ---------------------------------------------------------------------------
+# FastEvalEngine prefix caching (reference FastEvalEngineTest: exact stage
+# run counts across a params grid)
+# ---------------------------------------------------------------------------
+
+def test_fasteval_cache_hit_counts():
+    reset_counts()
+    engine = make_engine(fast=True)
+    # 3 params sharing datasource+preparator, differing only in algo params
+    MetricEvaluator(Err()).evaluate_base(None, engine, grid([0.5, 1.0, 2.0]))
+    assert DS.read_count == 1          # datasource ran once
+    assert Prep.prepare_count == 2     # once per fold, one prefix
+    # 3 algo params x 2 folds trains
+    assert Algo.train_count == 6
+    # prefix caches: prep prefix hit for params 2,3 (ds consulted only on
+    # prep miss, so its own counter stays at 1 miss / 0 hits)
+    assert engine.cache_misses["datasource"] == 1
+    assert engine.cache_hits["preparator"] == 2
+    assert engine.cache_misses["algorithms"] == 3
+    assert engine.cache_hits["algorithms"] == 0
+
+
+def test_fasteval_same_params_full_hit():
+    reset_counts()
+    engine = make_engine(fast=True)
+    ep = grid([1.0])[0]
+    r1 = engine.eval(None, ep)
+    r2 = engine.eval(None, ep)
+    assert engine.cache_hits["algorithms"] == 1
+    assert Algo.train_count == 2  # 2 folds, once
+    assert [qpa for _, qpa in r1] == [qpa for _, qpa in r2]
+
+
+def test_fasteval_datasource_change_busts_cache():
+    reset_counts()
+    engine = make_engine(fast=True)
+    ep1 = EngineParams(datasource=("", DSP(n=4)), algorithms=[("algo", AP())])
+    ep2 = EngineParams(datasource=("", DSP(n=5)), algorithms=[("algo", AP())])
+    engine.eval(None, ep1)
+    engine.eval(None, ep2)
+    assert DS.read_count == 2
+    assert engine.cache_hits["datasource"] == 0
+
+
+def test_fasteval_matches_plain_engine():
+    reset_counts()
+    plain = make_engine()
+    fast = make_engine(fast=True)
+    ep = grid([2.0])[0]
+    r_plain = plain.eval(None, ep)
+    r_fast = fast.eval(None, ep)
+    assert [(ei, qpa) for ei, qpa in r_plain] == [
+        (ei, qpa) for ei, qpa in r_fast]
+
+
+# ---------------------------------------------------------------------------
+# evaluation workflow lifecycle (reference EvaluationWorkflowTest)
+# ---------------------------------------------------------------------------
+
+def test_run_evaluation_lifecycle(memory_storage, tmp_path):
+    engine = make_engine(fast=True)
+    out = tmp_path / "best.json"
+    instance_id, result = run_evaluation(
+        engine=engine,
+        metric=Err(),
+        engine_params_list=grid([0.5, 1.0]),
+        storage=memory_storage,
+        other_metrics=[ZeroMetric()],
+        evaluation_class="TestEval",
+        output_path=str(out),
+        ctx=None,
+    )
+    dao = memory_storage.get_metadata_evaluation_instances()
+    inst = dao.get(instance_id)
+    assert inst.status == "EVALCOMPLETED"
+    assert inst.evaluator_results.startswith("[0.0]")
+    assert "bestScore" in inst.evaluator_results_json
+    assert "<table>" in inst.evaluator_results_html
+    assert dao.get_completed()[0].id == instance_id
+    assert out.exists()
+
+
+def test_run_evaluation_failure_marks_instance(memory_storage):
+    engine = make_engine()
+
+    class Boom(AverageMetric):
+        def calculate_one(self, q, p, a):
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        run_evaluation(
+            engine=engine, metric=Boom(),
+            engine_params_list=grid([1.0]),
+            storage=memory_storage,
+        )
+    dao = memory_storage.get_metadata_evaluation_instances()
+    assert any(i.status == "EVALFAILED" for i in dao.get_all())
