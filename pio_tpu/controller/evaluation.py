@@ -121,9 +121,18 @@ class ZeroMetric(Metric[float]):
 
 class EngineParamsGenerator:
     """Tuning search space (reference EngineParamsGenerator.scala).
-    Subclass and set engine_params_list."""
+    Subclass and set engine_params_list (None default avoids a shared
+    mutable class-level list across subclasses)."""
 
-    engine_params_list: list[EngineParams] = []
+    engine_params_list: list[EngineParams] | None = None
+
+    @classmethod
+    def params_list(cls) -> list[EngineParams]:
+        if not cls.engine_params_list:
+            raise ValueError(
+                f"{cls.__name__} must define engine_params_list"
+            )
+        return list(cls.engine_params_list)
 
 
 class Evaluation:
@@ -134,7 +143,11 @@ class Evaluation:
 
     engine: Engine = None
     metric: Metric = None
-    metrics: list[Metric] = []
+    metrics: list[Metric] | None = None
+
+    @classmethod
+    def other_metrics(cls) -> list[Metric]:
+        return list(cls.metrics or [])
 
     @classmethod
     def engine_metric(cls) -> tuple[Engine, Metric]:

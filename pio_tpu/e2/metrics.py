@@ -17,9 +17,10 @@ def _predicted_items(prediction) -> list[str]:
 
 
 class PrecisionAtK(OptionAverageMetric):
-    """Fraction of the top-k predictions that are in the actual set.
-    Queries with no predictions score None (excluded, reference
-    OptionAverageMetric semantics)."""
+    """tp / min(k, |actual|) over the top-k predictions — the reference
+    recommendation-template metric shape. Queries with no *actuals* score
+    None (excluded); an engine returning few/no predictions is penalized,
+    not excluded, so tuning cannot be gamed by under-predicting."""
 
     def __init__(self, k: int = 10):
         self.k = k
@@ -29,11 +30,12 @@ class PrecisionAtK(OptionAverageMetric):
         return f"Precision@{self.k}"
 
     def calculate_one(self, query, prediction, actual):
-        pred = _predicted_items(prediction)[: self.k]
-        if not pred:
-            return None
         actual_set = set(actual or [])
-        return sum(1 for p in pred if p in actual_set) / len(pred)
+        if not actual_set:
+            return None
+        pred = _predicted_items(prediction)[: self.k]
+        tp = sum(1 for p in pred if p in actual_set)
+        return tp / min(self.k, len(actual_set))
 
 
 class RecallAtK(OptionAverageMetric):

@@ -1,0 +1,264 @@
+"""E-commerce recommendation template — ALS + serve-time business rules.
+
+Parity target: reference examples/scala-parallel-ecommercerecommendation/
+train-with-rate-event/src/main/scala/ALSAlgorithm.scala:148-341:
+ * implicit ALS over view/buy events;
+ * serve-time filtering: seen items (live LEventStore read of the user's
+   view/buy events), "unavailableItems" constraint entity, whiteList /
+   blackList, category filter;
+ * cold start: unknown users are served from their recent view events —
+   average the viewed items' factors and recommend by similarity.
+
+TPU-native: scoring is the factor matmul + top_k; the serve-time storage
+reads go through EventStore.find_by_entity (SURVEY.md section 7 flags this
+as the "DB query inside the predict path" hazard — reads are bounded by
+`limit` and hit the indexed entity columns).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import numpy as np
+
+from pio_tpu.controller.base import (
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    PAlgorithm,
+    Params,
+)
+from pio_tpu.controller.engine import Engine, EngineFactory
+from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.ops import als
+from pio_tpu.ops.similarity import cosine_topk, mean_vector
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple[str, ...] = ("view", "buy")
+
+
+@dataclass
+class ECommerceData:
+    interactions: Interactions
+    item_categories: dict[str, list[str]]
+
+    def sanity_check(self):
+        self.interactions.sanity_check()
+
+
+class ECommerceDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx) -> ECommerceData:
+        p = self.params
+        events = ctx.event_store.find(
+            app_name=p.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(p.event_names),
+        )
+        # buy weighs heavier than view (reference train-with-rate-event
+        # maps buy to a stronger implicit signal)
+        inter = to_interactions(
+            events,
+            value_fn=lambda e: 4.0 if e.event == "buy" else 1.0,
+            dedup="sum",
+        )
+        item_props = ctx.event_store.aggregate_properties(
+            app_name=p.app_name, entity_type="item"
+        )
+        cats = {
+            iid: pm.get_or_else("categories", [])
+            for iid, pm in item_props.items()
+        }
+        return ECommerceData(inter, cats)
+
+
+@dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    app_name: str = ""            # serve-time event reads
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int | None = None
+    chunk: int = 65536
+    unseen_only: bool = True      # filter items the user has seen
+    seen_events: tuple[str, ...] = ("view", "buy")
+    recent_events: tuple[str, ...] = ("view",)   # cold-start signal
+    recent_count: int = 10
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class ECommerceModel:
+    factors: als.ALSModel
+    users: Any
+    items: Any
+    item_categories: dict
+
+    def tree_flatten(self):
+        return (self.factors,), (self.users, self.items, self.item_categories)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+class ECommAlgorithm(PAlgorithm):
+    params_class = ECommAlgorithmParams
+
+    def __init__(self, params: ECommAlgorithmParams):
+        self.params = params
+        self._event_store = None  # bound at predict time via ctx-free reads
+
+    def train(self, ctx, data: ECommerceData) -> ECommerceModel:
+        data.sanity_check()
+        inter = data.interactions
+        p = self.params
+        ap = als.ALSParams(
+            rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+            alpha=p.alpha, implicit=True,
+            seed=p.seed if p.seed is not None else 3, chunk=p.chunk,
+        )
+        if ctx.mesh is not None and ctx.mesh.devices.size > 1:
+            factors = als.als_train_sharded(
+                inter.user_idx, inter.item_idx, inter.values,
+                inter.n_users, inter.n_items, ap, ctx.mesh,
+            )
+        else:
+            factors = als.als_train(
+                inter.user_idx, inter.item_idx, inter.values,
+                inter.n_users, inter.n_items, ap,
+            )
+        self._event_store = ctx.event_store
+        return ECommerceModel(
+            factors, inter.users, inter.items, data.item_categories
+        )
+
+    # -- serve-time storage access ------------------------------------------
+    def _bind_store(self):
+        if self._event_store is None:
+            from pio_tpu.data.eventstore import EventStore
+
+            self._event_store = EventStore()
+
+    def prepare_model_for_deploy(self, ctx, model: ECommerceModel):
+        self._event_store = ctx.event_store
+        return model
+
+    def _seen_items(self, user: str) -> set[str]:
+        """Live read of the user's seen items (reference
+        LEventStore.findByEntity with seenEvents, ALSAlgorithm.scala:200-230)."""
+        if not self.params.unseen_only or self._event_store is None:
+            return set()
+        try:
+            events = self._event_store.find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.seen_events),
+                limit=-1,
+            )
+            return {
+                e.target_entity_id for e in events if e.target_entity_id
+            }
+        except Exception:  # noqa: BLE001 - storage outage must not kill serving
+            return set()
+
+    def _unavailable_items(self) -> set[str]:
+        """Constraint entity 'unavailableItems' (reference
+        ALSAlgorithm.scala:232-260: latest $set on constraint entity)."""
+        if self._event_store is None:
+            return set()
+        try:
+            props = self._event_store.aggregate_properties(
+                app_name=self.params.app_name, entity_type="constraint"
+            )
+            pm = props.get("unavailableItems")
+            return set(pm.get_or_else("items", [])) if pm else set()
+        except Exception:  # noqa: BLE001
+            return set()
+
+    def _recent_item_vector(self, model: ECommerceModel, user: str):
+        """Cold start: average factors of recently-viewed items (reference
+        ALSAlgorithm.scala:262-300)."""
+        if self._event_store is None:
+            return None
+        try:
+            events = self._event_store.find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.recent_events),
+                limit=self.params.recent_count,
+                latest=True,
+            )
+        except Exception:  # noqa: BLE001
+            return None
+        idx = [
+            model.items.index_of(e.target_entity_id)
+            for e in events
+            if e.target_entity_id and e.target_entity_id in model.items
+        ]
+        if not idx:
+            return None
+        return mean_vector(model.factors.item_factors, np.array(idx))
+
+    def predict(self, model: ECommerceModel, query: dict) -> dict:
+        user = query.get("user", "")
+        num = int(query.get("num", 10))
+        self._bind_store()
+        exclude = set(query.get("blackList") or ())
+        exclude |= self._seen_items(user)
+        exclude |= self._unavailable_items()
+        white = set(query.get("whiteList") or ()) or None
+        categories = set(query.get("categories") or ()) or None
+
+        n_items = model.factors.item_factors.shape[0]
+        k = min(num + len(exclude) + 32, n_items)
+        if user in model.users:
+            uidx = model.users.index_of(user)
+            scores, idx = als.recommend_topk(
+                model.factors, np.array([model.users.index_of(user)]), k
+            )
+            scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
+        else:
+            qv = self._recent_item_vector(model, user)
+            if qv is None:
+                return {"itemScores": []}
+            scores, idx = cosine_topk(model.factors.item_factors, qv, k)
+            scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
+
+        out = []
+        for item, s in zip(model.items.decode(idx), scores):
+            if item in exclude:
+                continue
+            if white is not None and item not in white:
+                continue
+            if categories is not None:
+                if not (set(model.item_categories.get(item, ())) & categories):
+                    continue
+            out.append({"item": item, "score": float(s)})
+            if len(out) >= num:
+                break
+        return {"itemScores": out}
+
+
+class ECommerceEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            ECommerceDataSource,
+            IdentityPreparator,
+            {"ecomm": ECommAlgorithm},
+            FirstServing,
+        )

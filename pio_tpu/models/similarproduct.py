@@ -1,0 +1,175 @@
+"""Similar-product engine template — item-to-item similarity over ALS factors.
+
+Parity target: reference examples/scala-parallel-similarproduct/* : DataSource
+reads $set events for users/items plus view/like events; ALS.trainImplicit
+learns item factors; query {"items": [...], "num": N, "categories"?,
+"whiteList"?, "blackList"?} returns the most cosine-similar items to the
+query set, excluding the query items themselves
+(ALSAlgorithm.scala cosine loop; multi/LikeAlgorithm.scala:21-86). TPU-native:
+the per-item cosine RDD map becomes one normalized matmul + top_k
+(ops/similarity.py); category filtering reads item properties aggregated at
+train time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import numpy as np
+
+from pio_tpu.controller.base import (
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    PAlgorithm,
+    Params,
+)
+from pio_tpu.controller.engine import Engine, EngineFactory
+from pio_tpu.data.bimap import EntityIdIndex
+from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.ops import als
+from pio_tpu.ops.similarity import cosine_topk, mean_vector
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple[str, ...] = ("view", "like")
+
+
+@dataclass
+class SimilarProductData:
+    interactions: Interactions
+    item_categories: dict[str, list[str]]  # item id -> categories
+
+    def sanity_check(self):
+        self.interactions.sanity_check()
+
+
+class SimilarProductDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx) -> SimilarProductData:
+        p = self.params
+        events = ctx.event_store.find(
+            app_name=p.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(p.event_names),
+        )
+        inter = to_interactions(events, value_fn=lambda e: 1.0, dedup="sum")
+        item_props = ctx.event_store.aggregate_properties(
+            app_name=p.app_name, entity_type="item"
+        )
+        cats = {
+            iid: pm.get_or_else("categories", [])
+            for iid, pm in item_props.items()
+        }
+        return SimilarProductData(inter, cats)
+
+
+@dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int | None = None
+    chunk: int = 65536
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SimilarProductModel:
+    """Item factors + id index + categories (reference ALSModel with
+    productFeatures + items map)."""
+
+    item_factors: jax.Array
+    items: EntityIdIndex
+    item_categories: dict
+
+    def tree_flatten(self):
+        return (self.item_factors,), (self.items, self.item_categories)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+class ALSSimilarityAlgorithm(PAlgorithm):
+    params_class = ALSAlgorithmParams
+
+    def __init__(self, params: ALSAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx, data: SimilarProductData) -> SimilarProductModel:
+        data.sanity_check()
+        inter = data.interactions
+        p = self.params
+        ap = als.ALSParams(
+            rank=p.rank, iterations=p.num_iterations, reg=p.lambda_,
+            alpha=p.alpha, implicit=True,
+            seed=p.seed if p.seed is not None else 3, chunk=p.chunk,
+        )
+        if ctx.mesh is not None and ctx.mesh.devices.size > 1:
+            factors = als.als_train_sharded(
+                inter.user_idx, inter.item_idx, inter.values,
+                inter.n_users, inter.n_items, ap, ctx.mesh,
+            )
+        else:
+            factors = als.als_train(
+                inter.user_idx, inter.item_idx, inter.values,
+                inter.n_users, inter.n_items, ap,
+            )
+        return SimilarProductModel(
+            factors.item_factors, inter.items, data.item_categories
+        )
+
+    def predict(self, model: SimilarProductModel, query: dict) -> dict:
+        """Reference ALSAlgorithm.predict: average query-item vectors,
+        cosine top-k over the catalog, filter query items / categories /
+        white / black lists."""
+        items = query.get("items") or []
+        num = int(query.get("num", 10))
+        known = [i for i in items if i in model.items]
+        if not known:
+            return {"itemScores": []}
+        q_idx = model.items.encode(known)
+        qv = mean_vector(model.item_factors, q_idx)
+        exclude = set(items) | set(query.get("blackList") or ())
+        white = set(query.get("whiteList") or ()) or None
+        categories = set(query.get("categories") or ()) or None
+        # over-fetch to survive filtering
+        k = min(num + len(exclude) + 32, model.item_factors.shape[0])
+        scores, idx = cosine_topk(model.item_factors, qv, k)
+        scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
+        out = []
+        for i, s in zip(model.items.decode(idx), scores):
+            if i in exclude:
+                continue
+            if white is not None and i not in white:
+                continue
+            if categories is not None:
+                item_cats = set(model.item_categories.get(i, ()))
+                if not (item_cats & categories):
+                    continue
+            out.append({"item": i, "score": float(s)})
+            if len(out) >= num:
+                break
+        return {"itemScores": out}
+
+
+class SimilarProductEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            SimilarProductDataSource,
+            IdentityPreparator,
+            {"als": ALSSimilarityAlgorithm},
+            FirstServing,
+        )

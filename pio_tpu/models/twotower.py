@@ -1,0 +1,303 @@
+"""Two-tower neural retrieval template — the flagship pjit model.
+
+The new-capability template from BASELINE.json ("Two-tower neural recommender
+template (new PAlgorithm, pjit data-parallel)"): user and item towers
+(embedding + MLP) trained with in-batch sampled softmax over (user, item)
+interaction pairs. This is where the mesh design shows its axes:
+
+ * batch is sharded over the "data" axis (pure dp);
+ * embedding tables and MLP kernels are sharded over the "model" axis
+   (Megatron-style tp: vocab-sharded embeddings, alternating column/row
+   sharded Dense kernels);
+ * the in-batch softmax runs over the GLOBAL batch: XLA inserts the
+   all_gather/psum for the (B, B) logits automatically from the sharding
+   annotations — the "let GSPMD insert collectives" recipe.
+
+Serving: item embeddings are precomputed into a matrix at train end; query =
+user tower forward + the same top-k matmul path the ALS templates use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pio_tpu.controller.base import (
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    PAlgorithm,
+    Params,
+)
+from pio_tpu.controller.engine import Engine, EngineFactory
+from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.ops.similarity import cosine_topk
+from pio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+class Tower(nn.Module):
+    """Embedding + 2-layer MLP -> L2-normalized embedding."""
+
+    vocab: int
+    embed_dim: int
+    hidden_dim: int
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, ids):  # (B,) int32
+        # vocab-sharded table (tp): rows split over the model axis
+        e = nn.Embed(
+            self.vocab, self.embed_dim,
+            embedding_init=nn.initializers.normal(0.02),
+        )(ids)
+        h = nn.Dense(self.hidden_dim)(e)       # column-sharded kernel
+        h = nn.relu(h)
+        z = nn.Dense(self.out_dim)(h)          # row-sharded kernel
+        return z / (jnp.linalg.norm(z, axis=-1, keepdims=True) + 1e-8)
+
+
+@dataclass(frozen=True)
+class TwoTowerParams(Params):
+    embed_dim: int = 64
+    hidden_dim: int = 128
+    out_dim: int = 32
+    temperature: float = 0.05
+    learning_rate: float = 1e-3
+    batch_size: int = 1024
+    steps: int = 200
+    seed: int = 0
+
+
+def param_shardings(params_tree, mesh: Mesh):
+    """Sharding tree for the tower params: embeddings vocab-sharded, Dense
+    kernels alternately column/row sharded over the model axis."""
+
+    def spec_for(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        if leaf.ndim == 2:
+            if any("Embed" in n or "embedding" in n for n in names):
+                return P(MODEL_AXIS, None)      # vocab-sharded
+            if "Dense_0" in names:
+                return P(None, MODEL_AXIS)      # column parallel
+            if "Dense_1" in names:
+                return P(MODEL_AXIS, None)      # row parallel
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, spec_for(path, leaf)),
+        params_tree,
+    )
+
+
+def make_towers(n_users: int, n_items: int, p: TwoTowerParams):
+    user_tower = Tower(n_users, p.embed_dim, p.hidden_dim, p.out_dim)
+    item_tower = Tower(n_items, p.embed_dim, p.hidden_dim, p.out_dim)
+    return user_tower, item_tower
+
+
+def init_params(n_users: int, n_items: int, p: TwoTowerParams):
+    user_tower, item_tower = make_towers(n_users, n_items, p)
+    ku, ki = jax.random.split(jax.random.PRNGKey(p.seed))
+    dummy = jnp.zeros((1,), jnp.int32)
+    return {
+        "user": user_tower.init(ku, dummy)["params"],
+        "item": item_tower.init(ki, dummy)["params"],
+    }
+
+
+def make_train_step(n_users: int, n_items: int, p: TwoTowerParams, optimizer):
+    user_tower, item_tower = make_towers(n_users, n_items, p)
+
+    def loss_fn(params, u_ids, i_ids):
+        u = user_tower.apply({"params": params["user"]}, u_ids)   # (B, d)
+        v = item_tower.apply({"params": params["item"]}, i_ids)   # (B, d)
+        logits = (u @ v.T) / p.temperature                        # (B, B)
+        labels = jnp.arange(u_ids.shape[0])
+        # symmetric in-batch softmax (user->item and item->user)
+        l1 = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        l2 = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+        return (l1.mean() + l2.mean()) / 2
+
+    def train_step(params, opt_state, u_ids, i_ids):
+        loss, grads = jax.value_and_grad(loss_fn)(params, u_ids, i_ids)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, (user_tower, item_tower)
+
+
+def train_two_tower(
+    inter: Interactions,
+    p: TwoTowerParams,
+    mesh: Mesh | None = None,
+) -> tuple[dict, jax.Array, Any]:
+    """-> (params, item_embeddings matrix, towers). Sharded over the mesh
+    when given; single-device jit otherwise."""
+    optimizer = optax.adam(p.learning_rate)
+    train_step, towers = make_train_step(
+        inter.n_users, inter.n_items, p, optimizer
+    )
+    params = init_params(inter.n_users, inter.n_items, p)
+    opt_state = optimizer.init(params)
+
+    batch = min(p.batch_size, max(8, len(inter)))
+    if mesh is not None:
+        n_data = mesh.shape[DATA_AXIS]
+        batch = max(n_data, batch - batch % n_data)  # divisible by dp
+        p_shard = param_shardings(params, mesh)
+        o_shard = param_shardings_for_opt(opt_state, params, p_shard, mesh)
+        batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        step = jax.jit(
+            train_step,
+            in_shardings=(p_shard, o_shard, batch_sharding, batch_sharding),
+            out_shardings=(p_shard, o_shard, NamedSharding(mesh, P())),
+        )
+        params = jax.device_put(params, p_shard)
+        opt_state = jax.device_put(opt_state, o_shard)
+    else:
+        step = jax.jit(train_step)
+
+    rng = np.random.default_rng(p.seed)
+    n = len(inter)
+    loss = None
+    for _ in range(p.steps):
+        idx = rng.integers(0, n, size=batch)
+        u = jnp.asarray(inter.user_idx[idx], jnp.int32)
+        i = jnp.asarray(inter.item_idx[idx], jnp.int32)
+        if mesh is not None:
+            u = jax.device_put(u, batch_sharding)
+            i = jax.device_put(i, batch_sharding)
+        params, opt_state, loss = step(params, opt_state, u, i)
+
+    # materialize all item embeddings for serving
+    item_ids = jnp.arange(inter.n_items, dtype=jnp.int32)
+    item_emb = towers[1].apply({"params": jax.device_get(params)["item"]}, item_ids)
+    return jax.device_get(params), item_emb, towers
+
+
+def param_shardings_for_opt(opt_state, params, p_shard, mesh: Mesh):
+    """Optimizer state mirrors param shapes: reuse the param shardings for
+    matching leaves, replicate scalars (adam's count etc.)."""
+    flat_params, _ = jax.tree_util.tree_flatten(params)
+    shapes = {id(l): s for l, s in zip(
+        flat_params, jax.tree_util.tree_leaves(p_shard))}
+
+    def for_leaf(leaf):
+        if hasattr(leaf, "shape") and leaf.ndim >= 1:
+            # match by shape against param shardings
+            for pl, ps in zip(flat_params, jax.tree_util.tree_leaves(p_shard)):
+                if hasattr(pl, "shape") and pl.shape == leaf.shape:
+                    return ps
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(for_leaf, opt_state)
+
+
+# ---------------------------------------------------------------------------
+# DASE wrapper
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TwoTowerDataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple[str, ...] = ("view", "buy", "rate")
+
+
+class TwoTowerDataSource(DataSource):
+    params_class = TwoTowerDataSourceParams
+
+    def __init__(self, params: TwoTowerDataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx) -> Interactions:
+        events = ctx.event_store.find(
+            app_name=self.params.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.event_names),
+        )
+        return to_interactions(events, value_fn=lambda e: 1.0, dedup="sum")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class TwoTowerModel:
+    params: dict           # tower params (host pytree after train)
+    item_embeddings: jax.Array
+    users: Any
+    items: Any
+    config: TwoTowerParams
+
+    def tree_flatten(self):
+        return (self.params, self.item_embeddings), (
+            self.users, self.items, self.config,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+
+class TwoTowerAlgorithm(PAlgorithm):
+    params_class = TwoTowerParams
+
+    def __init__(self, params: TwoTowerParams = TwoTowerParams()):
+        self.params = params
+
+    def train(self, ctx, inter: Interactions) -> TwoTowerModel:
+        inter.sanity_check()
+        mesh = ctx.mesh if ctx and ctx.mesh and ctx.mesh.devices.size > 1 else None
+        params, item_emb, _ = train_two_tower(inter, self.params, mesh)
+        return TwoTowerModel(
+            params=params, item_embeddings=item_emb,
+            users=inter.users, items=inter.items, config=self.params,
+        )
+
+    def _user_embedding(self, model: TwoTowerModel, uidx: int) -> jax.Array:
+        tower = Tower(
+            len(model.users), model.config.embed_dim,
+            model.config.hidden_dim, model.config.out_dim,
+        )
+        return tower.apply(
+            {"params": model.params["user"]},
+            jnp.asarray([uidx], jnp.int32),
+        )
+
+    def predict(self, model: TwoTowerModel, query: dict) -> dict:
+        user = query.get("user", "")
+        num = int(query.get("num", 10))
+        if user not in model.users:
+            return {"itemScores": []}
+        uv = self._user_embedding(model, model.users.index_of(user))
+        black = set(query.get("blackList") or ())
+        k = min(num + len(black), model.item_embeddings.shape[0])
+        scores, idx = cosine_topk(model.item_embeddings, uv, k)
+        scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
+        out = []
+        for item, s in zip(model.items.decode(idx), scores):
+            if item in black:
+                continue
+            out.append({"item": item, "score": float(s)})
+            if len(out) >= num:
+                break
+        return {"itemScores": out}
+
+
+class TwoTowerEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            TwoTowerDataSource,
+            IdentityPreparator,
+            {"twotower": TwoTowerAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,128 @@
+"""Random forest classifier (host-side numpy).
+
+Parity target: MLlib RandomForest as used by the classification template's
+add-algorithm variant (examples/scala-parallel-classification/add-algorithm/
+src/main/scala/RandomForestAlgorithm.scala:28-43). Tree induction is
+branchy, data-dependent control flow — exactly what XLA is bad at — and the
+reference runs it on tiny property tables, so this deliberately stays a
+host-side numpy implementation (the L-algorithm shape); batched *inference*
+could move on-device if catalogs grow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class _Node:
+    feature: int = -1
+    threshold: float = 0.0
+    left: Optional["_Node"] = None
+    right: Optional["_Node"] = None
+    prediction: int = 0
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.left is None
+
+
+def _gini(counts: np.ndarray) -> float:
+    total = counts.sum()
+    if total == 0:
+        return 0.0
+    p = counts / total
+    return float(1.0 - (p * p).sum())
+
+
+def _best_split(x, y, n_classes, feature_subset, min_leaf):
+    best = (None, None, np.inf)
+    n = len(y)
+    parent_counts = np.bincount(y, minlength=n_classes)
+    for f in feature_subset:
+        vals = x[:, f]
+        for t in np.unique(vals)[:-1]:
+            mask = vals <= t
+            nl = mask.sum()
+            if nl < min_leaf or n - nl < min_leaf:
+                continue
+            lc = np.bincount(y[mask], minlength=n_classes)
+            rc = parent_counts - lc
+            score = (nl * _gini(lc) + (n - nl) * _gini(rc)) / n
+            if score < best[2]:
+                best = (f, float(t), score)
+    return best
+
+
+def _grow(x, y, n_classes, max_depth, min_leaf, n_sub, rng) -> _Node:
+    node = _Node(prediction=int(np.bincount(y, minlength=n_classes).argmax()))
+    if max_depth <= 0 or len(np.unique(y)) == 1 or len(y) < 2 * min_leaf:
+        return node
+    n_feat = x.shape[1]
+    subset = rng.choice(n_feat, size=min(n_sub, n_feat), replace=False)
+    f, t, score = _best_split(x, y, n_classes, subset, min_leaf)
+    if f is None and len(subset) < n_feat:
+        # the sampled subset had no usable split (e.g. already-exhausted
+        # features); fall back to the full set before giving up
+        f, t, score = _best_split(x, y, n_classes, range(n_feat), min_leaf)
+    if f is None:
+        return node
+    mask = x[:, f] <= t
+    node.feature, node.threshold = f, t
+    node.left = _grow(x[mask], y[mask], n_classes, max_depth - 1, min_leaf, n_sub, rng)
+    node.right = _grow(x[~mask], y[~mask], n_classes, max_depth - 1, min_leaf, n_sub, rng)
+    return node
+
+
+def _predict_one(node: _Node, row: np.ndarray) -> int:
+    while not node.is_leaf:
+        node = node.left if row[node.feature] <= node.threshold else node.right
+    return node.prediction
+
+
+@dataclass
+class RandomForestModel:
+    trees: list[_Node] = field(default_factory=list)
+    n_classes: int = 2
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """(B, D) -> (B,) majority-vote labels."""
+        x = np.atleast_2d(x)
+        votes = np.zeros((len(x), self.n_classes), np.int64)
+        for tree in self.trees:
+            for i, row in enumerate(x):
+                votes[i, _predict_one(tree, row)] += 1
+        return votes.argmax(axis=1)
+
+
+def random_forest_train(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    num_trees: int = 10,
+    max_depth: int = 5,
+    min_leaf: int = 1,
+    feature_subset: str = "auto",
+    seed: int = 0,
+) -> RandomForestModel:
+    """Reference RandomForest.trainClassifier parameter shape
+    (numTrees/maxDepth/featureSubsetStrategy)."""
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int64)
+    rng = np.random.default_rng(seed)
+    n_feat = x.shape[1]
+    n_sub = (
+        max(1, int(np.sqrt(n_feat)))
+        if feature_subset == "auto"
+        else n_feat
+    )
+    trees = []
+    for _ in range(num_trees):
+        idx = rng.integers(0, len(y), size=len(y))  # bootstrap
+        trees.append(
+            _grow(x[idx], y[idx], n_classes, max_depth, min_leaf, n_sub, rng)
+        )
+    return RandomForestModel(trees=trees, n_classes=n_classes)

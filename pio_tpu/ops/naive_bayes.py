@@ -1,0 +1,164 @@
+"""Naive Bayes kernels.
+
+Two variants, replacing the reference's two NB paths:
+ * `CategoricalNB` — string-categorical features, replacing
+   e2/.../engine/CategoricalNaiveBayes.scala:6-176 (combineByKey
+   log-likelihoods -> here: one one-hot scatter + vectorized log ops);
+ * `MultinomialNB` — count/one-hot vectors, replacing MLlib NaiveBayes as
+   used by the classification template
+   (examples/scala-parallel-classification/.../NaiveBayesAlgorithm.scala:15-27).
+
+Scoring is a single (B,D)x(D,L) matmul + argmax on the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pio_tpu.data.bimap import BiMap
+
+
+# ---------------------------------------------------------------------------
+# multinomial NB over vectors
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class MultinomialNBModel:
+    log_prior: jax.Array      # (L,)
+    log_theta: jax.Array      # (L, D)
+
+    def tree_flatten(self):
+        return (self.log_prior, self.log_theta), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def multinomial_nb_train(
+    x: np.ndarray, y: np.ndarray, n_classes: int, smoothing: float = 1.0
+) -> MultinomialNBModel:
+    """x: (N, D) non-negative counts; y: (N,) int labels."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.int32)
+    one_hot = jax.nn.one_hot(y, n_classes, dtype=jnp.float32)  # (N, L)
+    class_count = one_hot.sum(axis=0)                          # (L,)
+    feat_count = one_hot.T @ x                                 # (L, D)
+    log_prior = jnp.log(class_count + smoothing) - jnp.log(
+        class_count.sum() + smoothing * n_classes
+    )
+    smoothed = feat_count + smoothing
+    log_theta = jnp.log(smoothed) - jnp.log(
+        smoothed.sum(axis=1, keepdims=True)
+    )
+    return MultinomialNBModel(log_prior, log_theta)
+
+
+@jax.jit
+def multinomial_nb_scores(model: MultinomialNBModel, x) -> jax.Array:
+    """(B, D) -> (B, L) joint log-likelihoods."""
+    return x @ model.log_theta.T + model.log_prior[None, :]
+
+
+def multinomial_nb_predict(model: MultinomialNBModel, x: np.ndarray) -> np.ndarray:
+    return np.asarray(
+        jnp.argmax(multinomial_nb_scores(model, jnp.asarray(x, jnp.float32)), axis=1)
+    )
+
+
+# ---------------------------------------------------------------------------
+# categorical NB over string features (e2 parity)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CategoricalNBModel:
+    """Reference CategoricalNaiveBayes.Model: priors + per-position
+    log-likelihoods, with a smoothed floor for unseen categories."""
+
+    labels: BiMap                     # label -> index
+    categories: list[BiMap]           # per position: value -> index
+    log_prior: np.ndarray             # (L,)
+    log_likelihood: np.ndarray        # (L, P, Cmax)
+    log_floor: np.ndarray             # (L, P) score for unseen values
+
+    def _encode(self, features: Sequence[str]) -> tuple[np.ndarray, np.ndarray]:
+        idx = np.zeros(len(features), np.int32)
+        seen = np.zeros(len(features), bool)
+        for p, v in enumerate(features):
+            j = self.categories[p].get(v, -1) if p < len(self.categories) else -1
+            if j is not None and j >= 0:
+                idx[p] = j
+                seen[p] = True
+        return idx, seen
+
+    def log_score(self, features: Sequence[str], label: str) -> float | None:
+        """Reference Model.logScore: None when the label is unknown; unseen
+        feature values use the smoothed floor."""
+        if label not in self.labels:
+            return None
+        li = self.labels[label]
+        idx, seen = self._encode(features)
+        pos = np.arange(len(features))
+        ll = np.where(
+            seen, self.log_likelihood[li, pos, idx], self.log_floor[li, pos]
+        )
+        return float(self.log_prior[li] + ll.sum())
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Reference Model.predict: argmax over labels."""
+        idx, seen = self._encode(features)
+        pos = np.arange(len(features))
+        ll = np.where(
+            seen[None, :],
+            self.log_likelihood[:, pos, idx],
+            self.log_floor[:, pos],
+        ).sum(axis=1)
+        scores = self.log_prior + ll
+        return self.labels.inverse()[int(np.argmax(scores))]
+
+
+def categorical_nb_train(
+    labeled_points: Sequence[tuple[str, Sequence[str]]],
+    smoothing: float = 1.0,
+) -> CategoricalNBModel:
+    """labeled_points: [(label, [feature values...])] — the reference's
+    LabeledPoint shape (CategoricalNaiveBayes.scala LabeledPoint)."""
+    if not labeled_points:
+        raise ValueError("categorical_nb_train needs at least one point")
+    n_pos = len(labeled_points[0][1])
+    for lbl, feats in labeled_points:
+        if len(feats) != n_pos:
+            raise ValueError("all points must have the same feature count")
+    labels = BiMap.string_int(lbl for lbl, _ in labeled_points)
+    categories = [
+        BiMap.string_int(f[p] for _, f in labeled_points)
+        for p in range(n_pos)
+    ]
+    L = len(labels)
+    cmax = max((len(c) for c in categories), default=1)
+    counts = np.zeros((L, n_pos, cmax), np.float64)
+    label_counts = np.zeros(L, np.float64)
+    for lbl, feats in labeled_points:
+        li = labels[lbl]
+        label_counts[li] += 1
+        for p, v in enumerate(feats):
+            counts[li, p, categories[p][v]] += 1
+    log_prior = np.log(label_counts) - np.log(label_counts.sum())
+    denom = label_counts[:, None, None] + smoothing * np.array(
+        [len(c) for c in categories]
+    )[None, :, None]
+    log_likelihood = np.log(counts + smoothing) - np.log(denom)
+    log_floor = (np.log(smoothing) - np.log(denom))[:, :, 0]
+    return CategoricalNBModel(
+        labels=labels,
+        categories=categories,
+        log_prior=log_prior,
+        log_likelihood=log_likelihood,
+        log_floor=log_floor,
+    )

@@ -94,9 +94,9 @@ def run_evaluation_class(
     return run_evaluation(
         engine=engine,
         metric=metric,
-        engine_params_list=list(generator_class.engine_params_list),
+        engine_params_list=generator_class.params_list(),
         storage=storage,
-        other_metrics=list(evaluation_class.metrics),
+        other_metrics=evaluation_class.other_metrics(),
         evaluation_class=evaluation_class.__name__,
         params_generator_class=generator_class.__name__,
         **kwargs,

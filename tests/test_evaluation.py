@@ -108,12 +108,16 @@ def test_sum_stdev_zero_metrics():
 
 def test_precision_recall_at_k():
     data = [(None, [
+        # tp=1 of min(k=2, |actual|=2) -> 0.5
         ({}, {"itemScores": [{"item": "a", "score": 1}, {"item": "b", "score": 0.5}]},
          ["a", "c"]),
-        ({}, {"itemScores": []}, ["a"]),  # no predictions -> excluded
+        # actuals but NO predictions -> scores 0, not excluded (no gaming
+        # the metric by under-predicting)
+        ({}, {"itemScores": []}, ["a"]),
+        # no actuals -> excluded entirely
+        ({}, {"itemScores": [{"item": "z", "score": 1}]}, []),
     ])]
-    assert PrecisionAtK(2).calculate(None, data) == pytest.approx(0.5)
-    # recall: q1 = 1/2; q2 has actuals but no predictions -> 0; mean = 0.25
+    assert PrecisionAtK(2).calculate(None, data) == pytest.approx(0.25)
     assert RecallAtK(2).calculate(None, data) == pytest.approx(0.25)
     assert PrecisionAtK(2).header == "Precision@2"
 

@@ -1,0 +1,142 @@
+"""Kernel tests: naive bayes (both variants), markov chain, vectorizer,
+random forest, cosine similarity (reference e2 fixtures: NaiveBayesFixture,
+MarkovChainFixture, BinaryVectorizerFixture)."""
+
+import numpy as np
+import pytest
+
+from pio_tpu.e2.engine import (
+    BinaryVectorizer,
+    categorical_nb_train,
+    markov_chain_train,
+)
+from pio_tpu.ops.forest import random_forest_train
+from pio_tpu.ops.naive_bayes import (
+    multinomial_nb_predict,
+    multinomial_nb_train,
+)
+from pio_tpu.ops.similarity import cosine_topk, mean_vector
+import jax.numpy as jnp
+
+
+# -- categorical NB (reference CategoricalNaiveBayesTest) -------------------
+
+POINTS = [
+    ("spam", ["free", "win", "now"]),
+    ("spam", ["free", "cash", "now"]),
+    ("spam", ["win", "cash", "prize"]),
+    ("ham", ["meeting", "tomorrow", "now"]),
+    ("ham", ["lunch", "tomorrow", "noon"]),
+]
+
+
+def test_categorical_nb_predict_and_logscore():
+    model = categorical_nb_train(POINTS)
+    assert model.predict(["free", "win", "now"]) == "spam"
+    assert model.predict(["meeting", "tomorrow", "noon"]) == "ham"
+    s_spam = model.log_score(["free", "win", "now"], "spam")
+    s_ham = model.log_score(["free", "win", "now"], "ham")
+    assert s_spam > s_ham
+    assert model.log_score(["free", "win", "now"], "nolabel") is None
+    # unseen feature value: still scores (smoothed floor), no crash
+    assert model.log_score(["UNSEEN", "win", "now"], "spam") is not None
+
+
+def test_categorical_nb_validations():
+    with pytest.raises(ValueError):
+        categorical_nb_train([])
+    with pytest.raises(ValueError):
+        categorical_nb_train([("a", ["x"]), ("b", ["x", "y"])])
+
+
+# -- multinomial NB ---------------------------------------------------------
+
+def test_multinomial_nb_separates_clusters():
+    rng = np.random.default_rng(0)
+    n = 200
+    x = np.zeros((n, 4), np.float32)
+    y = np.zeros(n, np.int64)
+    for i in range(n):
+        c = i % 2
+        y[i] = c
+        # class 0 heavy on dims 0-1, class 1 on dims 2-3
+        base = [3, 3, 0.2, 0.2] if c == 0 else [0.2, 0.2, 3, 3]
+        x[i] = rng.poisson(base)
+    model = multinomial_nb_train(x, y, n_classes=2, smoothing=1.0)
+    preds = multinomial_nb_predict(model, x)
+    assert (preds == y).mean() > 0.95
+
+
+# -- markov chain (reference MarkovChainTest) -------------------------------
+
+def test_markov_chain():
+    transitions = [(0, 1), (0, 1), (0, 2), (1, 2), (2, 0)]
+    model = markov_chain_train(transitions, n_states=3, top_n=2)
+    probs = model.transition_probs(0)
+    assert probs[1] == pytest.approx(2 / 3)
+    assert probs[2] == pytest.approx(1 / 3)
+    assert model.predict(0) == 1
+    assert model.predict(1) == 2
+    # unseen state
+    model2 = markov_chain_train([(0, 1)], n_states=3)
+    assert model2.predict(2) is None
+
+
+def test_markov_top_n_trim():
+    transitions = [(0, j) for j in range(1, 6) for _ in range(j)]
+    model = markov_chain_train(transitions, n_states=6, top_n=2)
+    probs = model.transition_probs(0)
+    assert set(probs) == {5, 4}  # only the two most likely targets kept
+
+
+# -- binary vectorizer (reference BinaryVectorizerTest) ---------------------
+
+def test_binary_vectorizer():
+    maps = [
+        {"gender": "m", "edu": "college"},
+        {"gender": "f", "edu": "hs"},
+    ]
+    vec = BinaryVectorizer.fit(maps, ["gender", "edu"])
+    assert vec.n_features == 4
+    v = vec.transform({"gender": "f", "edu": "college"})
+    assert v.sum() == 2
+    assert v[vec.index[("gender", "f")]] == 1
+    assert v[vec.index[("edu", "college")]] == 1
+    # unseen value ignored
+    v2 = vec.transform({"gender": "x"})
+    assert v2.sum() == 0
+    batch = vec.transform_batch(maps)
+    assert batch.shape == (2, 4)
+
+
+# -- random forest ----------------------------------------------------------
+
+def test_random_forest_learns_xor():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 2, size=(300, 2)).astype(np.float32)
+    y = (x[:, 0].astype(int) ^ x[:, 1].astype(int)).astype(np.int64)
+    model = random_forest_train(x, y, n_classes=2, num_trees=15, max_depth=4)
+    preds = model.predict(x)
+    assert (preds == y).mean() > 0.95  # XOR: beyond any linear model
+
+
+# -- cosine similarity ------------------------------------------------------
+
+def test_cosine_topk_and_mean_vector():
+    m = jnp.array([
+        [1.0, 0.0],
+        [0.9, 0.1],
+        [0.0, 1.0],
+        [-1.0, 0.0],
+    ])
+    scores, idx = cosine_topk(m, jnp.array([[1.0, 0.0]]), 2)
+    assert np.asarray(idx)[0].tolist() == [0, 1]
+    assert np.asarray(scores)[0][0] == pytest.approx(1.0, abs=1e-5)
+    qv = mean_vector(m, np.array([0, 2]))
+    assert np.asarray(qv)[0] == pytest.approx([0.5, 0.5])
+
+
+def test_cosine_topk_k_clamps():
+    m = jnp.eye(3)
+    scores, idx = cosine_topk(m, jnp.ones((1, 3)), 99)
+    assert idx.shape == (1, 3)

@@ -1,0 +1,288 @@
+"""End-to-end tests for the classification, similarproduct, and ecommerce
+engine templates (the reference's examples/ engine behaviors)."""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller import EngineParams
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.dao import App
+from pio_tpu.workflow.context import create_workflow_context
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def _set(entity_type, entity_id, props, minute=0):
+    return Event(
+        event="$set", entity_type=entity_type, entity_id=entity_id,
+        properties=DataMap(props), event_time=T0 + timedelta(minutes=minute),
+    )
+
+
+def _ev(name, uid, iid, minute=0):
+    return Event(
+        event=name, entity_type="user", entity_id=uid,
+        target_entity_type="item", target_entity_id=iid,
+        event_time=T0 + timedelta(minutes=minute),
+    )
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def classification_storage(memory_storage):
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "clsapp"))
+    ev = memory_storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    for i in range(120):
+        # plan correlates with gender+education
+        gender = "m" if rng.random() < 0.5 else "f"
+        edu = rng.choice(["hs", "college"])
+        age = float(rng.integers(20, 60))
+        plan = ("premium"
+                if (gender == "m" and edu == "college") or age > 50
+                else "basic")
+        ev.insert(_set("user", f"u{i}", {
+            "gender": gender, "education": edu, "age": age, "plan": plan,
+        }), app_id)
+    return memory_storage
+
+
+def test_classification_engine_nb_and_rf(classification_storage):
+    from pio_tpu.models.classification import (
+        ClassificationEngine, DataSourceParams, NaiveBayesParams,
+        RandomForestParams,
+    )
+
+    engine = ClassificationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(
+            app_name="clsapp", attributes=("gender", "education", "age"))),
+        algorithms=[("naive", NaiveBayesParams(lambda_=1.0)),
+                    ("randomforest", RandomForestParams(num_trees=8))],
+    )
+    ctx = create_workflow_context(classification_storage, use_mesh=False)
+    nb_model, rf_model = engine.train(ctx, ep)
+    algos = engine._doers(ep)[2]
+    q = {"gender": "m", "education": "college", "age": 30.0}
+    assert algos[0].predict(nb_model, q)["label"] == "premium"
+    assert algos[1].predict(rf_model, q)["label"] == "premium"
+    q2 = {"gender": "f", "education": "hs", "age": 25.0}
+    assert algos[1].predict(rf_model, q2)["label"] == "basic"
+
+
+def test_classification_eval_accuracy(classification_storage):
+    from pio_tpu.controller import AverageMetric, MetricEvaluator
+    from pio_tpu.models.classification import (
+        ClassificationEngine, DataSourceParams, NaiveBayesParams,
+    )
+
+    class Accuracy(AverageMetric):
+        def calculate_one(self, q, p, a):
+            return 1.0 if p["label"] == a else 0.0
+
+    engine = ClassificationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(
+            app_name="clsapp", attributes=("gender", "education", "age"),
+            eval_k=3)),
+        algorithms=[("naive", NaiveBayesParams())],
+    )
+    ctx = create_workflow_context(classification_storage, use_mesh=False)
+    result = MetricEvaluator(Accuracy()).evaluate_base(ctx, engine, [ep])
+    assert result.best_score.score > 0.7
+
+
+def test_classification_empty_app(memory_storage):
+    from pio_tpu.models.classification import (
+        ClassificationEngine, DataSourceParams,
+    )
+
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "empty"))
+    memory_storage.get_events().init(app_id)
+    engine = ClassificationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="empty")),
+        algorithms=[("naive", None)],
+    )
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    with pytest.raises(ValueError, match="empty"):
+        engine.train(ctx, ep)
+
+
+# ---------------------------------------------------------------------------
+# similarproduct
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def similar_storage(memory_storage):
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "simapp"))
+    ev = memory_storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(1)
+    m = 0
+    # items 0-9 cluster A, 10-19 cluster B; users view within their cluster
+    for u in range(30):
+        cluster = u % 2
+        for i in range(20):
+            in_cluster = (i < 10) == (cluster == 0)
+            if rng.random() < (0.7 if in_cluster else 0.05):
+                ev.insert(_ev("view", f"u{u}", f"i{i}", m), app_id)
+                m += 1
+    for i in range(20):
+        ev.insert(_set("item", f"i{i}",
+                       {"categories": ["catA" if i < 10 else "catB"]}), app_id)
+    return memory_storage
+
+
+def make_sim_engine():
+    from pio_tpu.models.similarproduct import (
+        ALSAlgorithmParams, DataSourceParams, SimilarProductEngine,
+    )
+
+    engine = SimilarProductEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="simapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=8, num_iterations=8, lambda_=0.05, alpha=10.0, chunk=1024))],
+    )
+    return engine, ep
+
+
+def test_similarproduct_clusters(similar_storage):
+    engine, ep = make_sim_engine()
+    ctx = create_workflow_context(similar_storage, use_mesh=False)
+    (model,) = engine.train(ctx, ep)
+    algo = engine._doers(ep)[2][0]
+    r = algo.predict(model, {"items": ["i0", "i1"], "num": 5})
+    items = [s["item"] for s in r["itemScores"]]
+    assert len(items) == 5
+    assert "i0" not in items and "i1" not in items  # query items excluded
+    in_a = sum(1 for it in items if int(it[1:]) < 10)
+    assert in_a >= 4, items
+    # scores sorted
+    scores = [s["score"] for s in r["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_similarproduct_filters(similar_storage):
+    engine, ep = make_sim_engine()
+    ctx = create_workflow_context(similar_storage, use_mesh=False)
+    (model,) = engine.train(ctx, ep)
+    algo = engine._doers(ep)[2][0]
+    r = algo.predict(model, {"items": ["i0"], "num": 5,
+                             "categories": ["catB"]})
+    assert all(int(s["item"][1:]) >= 10 for s in r["itemScores"])
+    r = algo.predict(model, {"items": ["i0"], "num": 3,
+                             "whiteList": ["i2", "i3"]})
+    assert {s["item"] for s in r["itemScores"]} <= {"i2", "i3"}
+    r = algo.predict(model, {"items": ["i0"], "num": 5, "blackList": ["i2"]})
+    assert all(s["item"] != "i2" for s in r["itemScores"])
+    assert algo.predict(model, {"items": ["nope"], "num": 3}) == {
+        "itemScores": []}
+
+
+# ---------------------------------------------------------------------------
+# ecommerce
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def ecommerce_storage(memory_storage):
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "shopapp"))
+    ev = memory_storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(2)
+    m = 0
+    for u in range(30):
+        cluster = u % 2
+        for i in range(20):
+            in_cluster = (i < 10) == (cluster == 0)
+            if rng.random() < (0.6 if in_cluster else 0.05):
+                ev.insert(_ev("view", f"u{u}", f"i{i}", m), app_id)
+                m += 1
+                if rng.random() < 0.3:
+                    ev.insert(_ev("buy", f"u{u}", f"i{i}", m), app_id)
+                    m += 1
+    for i in range(20):
+        ev.insert(_set("item", f"i{i}",
+                       {"categories": ["catA" if i < 10 else "catB"]}), app_id)
+    return memory_storage
+
+
+def make_ecomm(storage):
+    from pio_tpu.models.ecommerce import (
+        DataSourceParams, ECommAlgorithmParams, ECommerceEngine,
+    )
+
+    engine = ECommerceEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="shopapp")),
+        algorithms=[("ecomm", ECommAlgorithmParams(
+            app_name="shopapp", rank=8, num_iterations=8, lambda_=0.05,
+            alpha=10.0, chunk=1024))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    models = engine.train(ctx, ep)
+    # serve path: a fresh doer + prepare_model_for_deploy binds the
+    # serve-time event store (what load_models does at deploy)
+    algo = engine._doers(ep)[2][0]
+    model = algo.prepare_model_for_deploy(ctx, models[0])
+    return engine, ep, ctx, model, algo
+
+
+def test_ecommerce_excludes_seen_items(ecommerce_storage):
+    engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
+    app_id = ecommerce_storage.get_metadata_apps().get_by_name("shopapp").id
+    seen = {
+        e.target_entity_id
+        for e in ecommerce_storage.get_events().find(
+            app_id, entity_type="user", entity_id="u0",
+            event_names=["view", "buy"], limit=-1)
+    }
+    r = algo.predict(model, {"user": "u0", "num": 8})
+    items = {s["item"] for s in r["itemScores"]}
+    assert items and not (items & seen), (items, seen)
+
+
+def test_ecommerce_unavailable_constraint(ecommerce_storage):
+    engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
+    before = [s["item"] for s in
+              algo.predict(model, {"user": "u1", "num": 5})["itemScores"]]
+    assert before
+    # operator marks the top recommendation unavailable
+    app_id = ecommerce_storage.get_metadata_apps().get_by_name("shopapp").id
+    ecommerce_storage.get_events().insert(
+        _set("constraint", "unavailableItems", {"items": [before[0]]},
+             minute=9999), app_id)
+    after = [s["item"] for s in
+             algo.predict(model, {"user": "u1", "num": 5})["itemScores"]]
+    assert before[0] not in after
+
+
+def test_ecommerce_cold_start_recent_views(ecommerce_storage):
+    engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
+    # brand-new user with two catB views -> recommendations from catB side
+    app_id = ecommerce_storage.get_metadata_apps().get_by_name("shopapp").id
+    ecommerce_storage.get_events().insert(
+        _ev("view", "newbie", "i15", 9000), app_id)
+    ecommerce_storage.get_events().insert(
+        _ev("view", "newbie", "i16", 9001), app_id)
+    r = algo.predict(model, {"user": "newbie", "num": 5})
+    items = [s["item"] for s in r["itemScores"]]
+    assert items, "cold-start user with recent views must get recommendations"
+    in_b = sum(1 for it in items if int(it[1:]) >= 10)
+    assert in_b >= 3, items
+    # totally unknown user with no events -> empty
+    assert algo.predict(model, {"user": "ghost", "num": 5}) == {
+        "itemScores": []}
+
+
+def test_ecommerce_category_filter(ecommerce_storage):
+    engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
+    r = algo.predict(model, {"user": "u2", "num": 5, "categories": ["catB"]})
+    assert all(int(s["item"][1:]) >= 10 for s in r["itemScores"])
