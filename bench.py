@@ -35,39 +35,53 @@ CHUNK = 8192
 
 CPU_NNZ = 100_000 if SMALL else 400_000
 CPU_ITERS = 1
+# CPU proxy problem: same rank and same ratings-per-user density, scaled
+# down uniformly so the per-sweep cost structure matches the TPU run
+_CPU_SCALE = max(1, NNZ // CPU_NNZ)
+CPU_N_USERS = max(64, N_USERS // _CPU_SCALE)
+CPU_N_ITEMS = max(32, N_ITEMS // _CPU_SCALE)
 
 
-def synth(nnz: int, seed=0):
+def synth(nnz: int, n_users: int = None, n_items: int = None, seed=0):
+    n_users = n_users or N_USERS
+    n_items = n_items or N_ITEMS
     rng = np.random.default_rng(seed)
     # zipf-ish popularity for realism in the gather/scatter patterns
-    users = (rng.zipf(1.2, nnz) % N_USERS).astype(np.int64)
-    items = (rng.zipf(1.2, nnz) % N_ITEMS).astype(np.int64)
+    users = (rng.zipf(1.2, nnz) % n_users).astype(np.int64)
+    items = (rng.zipf(1.2, nnz) % n_items).astype(np.int64)
     vals = rng.integers(1, 6, nnz).astype(np.float32)
     return users, items, vals
 
 
-def run_als(users, items, vals, iters: int) -> float:
-    """-> wall seconds for `iters` sweeps (post-compile)."""
+def run_als(users, items, vals, iters: int,
+            n_users: int = None, n_items: int = None,
+            rank: int = None, chunk: int = None) -> float:
+    """-> wall seconds for `iters` sweeps, compile excluded (the warm-up
+    runs the exact same program: iterations is a static scan length)."""
     import jax
 
     from pio_tpu.ops.als import ALSParams, als_train
 
-    def go(n_iter):
-        p = ALSParams(rank=RANK, iterations=n_iter, reg=0.05, alpha=10.0,
-                      implicit=True, chunk=CHUNK)
-        model = als_train(users, items, vals, N_USERS, N_ITEMS, p)
+    n_users = n_users or N_USERS
+    n_items = n_items or N_ITEMS
+
+    def go():
+        p = ALSParams(rank=rank or RANK, iterations=iters, reg=0.05,
+                      alpha=10.0, implicit=True, chunk=chunk or CHUNK)
+        model = als_train(users, items, vals, n_users, n_items, p)
         jax.block_until_ready(model.user_factors)
         return model
 
-    go(1)  # compile both 1-iter and n-iter? scan length differs -> compile n
+    go()  # compile (identical program: same static iterations)
     t0 = time.monotonic()
-    go(iters)
+    go()
     dt = time.monotonic() - t0
     return dt
 
 
 def cpu_baseline_cmd() -> float:
-    """Measure the same kernel on one CPU device in a subprocess; returns
+    """Measure the same kernel on one CPU device in a subprocess — on the
+    SAME problem dims/rank as the TPU run (scaled-down nnz) — returns
     ratings/sec."""
     code = f"""
 import os, time, json, sys
@@ -76,8 +90,9 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
 from bench import synth, run_als
-users, items, vals = synth({CPU_NNZ})
-dt = run_als(users, items, vals, {CPU_ITERS})
+users, items, vals = synth({CPU_NNZ}, n_users={CPU_N_USERS}, n_items={CPU_N_ITEMS})
+dt = run_als(users, items, vals, {CPU_ITERS}, n_users={CPU_N_USERS},
+             n_items={CPU_N_ITEMS}, rank={RANK}, chunk={CHUNK})
 print(json.dumps({{"rate": {CPU_NNZ} * {CPU_ITERS} / dt}}))
 """
     try:

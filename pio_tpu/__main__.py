@@ -1,0 +1,5 @@
+import sys
+
+from pio_tpu.tools.cli import main
+
+sys.exit(main())

@@ -222,31 +222,58 @@ class ECommAlgorithm(PAlgorithm):
         exclude |= self._unavailable_items()
         white = set(query.get("whiteList") or ()) or None
         categories = set(query.get("categories") or ()) or None
+        from pio_tpu.models.similarproduct import _candidate_ids
 
+        candidates = _candidate_ids(
+            model.items, model.item_categories, white, categories, exclude
+        )
         n_items = model.factors.item_factors.shape[0]
-        k = min(num + len(exclude) + 32, n_items)
-        if user in model.users:
-            uidx = model.users.index_of(user)
-            scores, idx = als.recommend_topk(
-                model.factors, np.array([model.users.index_of(user)]), k
-            )
-            scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
-        else:
+
+        known_user = user in model.users
+        if not known_user:
             qv = self._recent_item_vector(model, user)
             if qv is None:
                 return {"itemScores": []}
-            scores, idx = cosine_topk(model.factors.item_factors, qv, k)
-            scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
 
+        if candidates is not None:
+            # selective filters: score the candidate set directly (reference
+            # isCandidateItem filters before ranking, ALSAlgorithm.scala)
+            if not candidates:
+                return {"itemScores": []}
+            cidx = model.items.encode(candidates)
+            if known_user:
+                uidx = model.users.index_of(user)
+                scores = np.asarray(als.predict_pairs(
+                    model.factors,
+                    np.full(len(cidx), uidx, dtype=np.int32), cidx,
+                ))
+            else:
+                from pio_tpu.ops.similarity import normalize_rows
+                import jax.numpy as jnp
+
+                cvecs = model.factors.item_factors[jnp.asarray(cidx)]
+                scores = np.asarray(
+                    normalize_rows(qv) @ normalize_rows(cvecs).T
+                )[0]
+            order = np.argsort(-scores)[:num]
+            return {"itemScores": [
+                {"item": candidates[i], "score": float(scores[i])}
+                for i in order
+            ]}
+
+        k = min(num + len(exclude), n_items)
+        if known_user:
+            uidx = model.users.index_of(user)
+            scores, idx = als.recommend_topk(
+                model.factors, np.array([uidx]), k
+            )
+        else:
+            scores, idx = cosine_topk(model.factors.item_factors, qv, k)
+        scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
         out = []
         for item, s in zip(model.items.decode(idx), scores):
             if item in exclude:
                 continue
-            if white is not None and item not in white:
-                continue
-            if categories is not None:
-                if not (set(model.item_categories.get(item, ())) & categories):
-                    continue
             out.append({"item": item, "score": float(s)})
             if len(out) >= num:
                 break

@@ -32,6 +32,26 @@ from pio_tpu.data.eventstore import Interactions, to_interactions
 from pio_tpu.ops import als
 from pio_tpu.ops.similarity import cosine_topk, mean_vector
 
+import jax.numpy as jnp
+
+
+def _candidate_ids(items_index, item_categories, white, categories, exclude):
+    """When selective filters apply, the candidate set to rank within; None
+    when no selective filter is present (use the fast top-k path)."""
+    if white is None and categories is None:
+        return None
+    ids = list(white) if white is not None else list(items_index.bimap.keys())
+    out = []
+    for i in ids:
+        if i in exclude or i not in items_index:
+            continue
+        if categories is not None and not (
+            set(item_categories.get(i, ())) & categories
+        ):
+            continue
+        out.append(i)
+    return out
+
 
 @dataclass(frozen=True)
 class DataSourceParams(Params):
@@ -144,20 +164,33 @@ class ALSSimilarityAlgorithm(PAlgorithm):
         exclude = set(items) | set(query.get("blackList") or ())
         white = set(query.get("whiteList") or ()) or None
         categories = set(query.get("categories") or ()) or None
-        # over-fetch to survive filtering
-        k = min(num + len(exclude) + 32, model.item_factors.shape[0])
+        candidates = _candidate_ids(
+            model.items, model.item_categories, white, categories, exclude
+        )
+        if candidates is not None:
+            # selective filters: rank WITHIN the candidate set (reference
+            # ALSAlgorithm.scala filters candidates before its cosine loop)
+            if not candidates:
+                return {"itemScores": []}
+            cidx = model.items.encode(candidates)
+            from pio_tpu.ops.similarity import normalize_rows
+
+            cvecs = model.item_factors[jnp.asarray(cidx)]
+            scores = np.asarray(
+                normalize_rows(qv) @ normalize_rows(cvecs).T
+            )[0]
+            order = np.argsort(-scores)[:num]
+            return {"itemScores": [
+                {"item": candidates[i], "score": float(scores[i])}
+                for i in order
+            ]}
+        k = min(num + len(exclude), model.item_factors.shape[0])
         scores, idx = cosine_topk(model.item_factors, qv, k)
         scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
         out = []
         for i, s in zip(model.items.decode(idx), scores):
             if i in exclude:
                 continue
-            if white is not None and i not in white:
-                continue
-            if categories is not None:
-                item_cats = set(model.item_categories.get(i, ()))
-                if not (item_cats & categories):
-                    continue
             out.append({"item": i, "score": float(s)})
             if len(out) >= num:
                 break

@@ -185,21 +185,28 @@ def train_two_tower(
 
 
 def param_shardings_for_opt(opt_state, params, p_shard, mesh: Mesh):
-    """Optimizer state mirrors param shapes: reuse the param shardings for
-    matching leaves, replicate scalars (adam's count etc.)."""
-    flat_params, _ = jax.tree_util.tree_flatten(params)
-    shapes = {id(l): s for l, s in zip(
-        flat_params, jax.tree_util.tree_leaves(p_shard))}
+    """Optimizer state shardings: adam's mu/nu are pytrees with exactly the
+    params' structure, so any subtree structurally identical to `params`
+    gets the params' sharding tree verbatim; everything else (count and
+    other scalars) is replicated. Structural matching avoids the shape-
+    collision hazard of matching leaves by shape."""
+    params_struct = jax.tree_util.tree_structure(params)
+    replicated = NamedSharding(mesh, P())
 
-    def for_leaf(leaf):
-        if hasattr(leaf, "shape") and leaf.ndim >= 1:
-            # match by shape against param shardings
-            for pl, ps in zip(flat_params, jax.tree_util.tree_leaves(p_shard)):
-                if hasattr(pl, "shape") and pl.shape == leaf.shape:
-                    return ps
-        return NamedSharding(mesh, P())
+    def is_params_like(node):
+        if node is opt_state:
+            return False
+        try:
+            return jax.tree_util.tree_structure(node) == params_struct
+        except Exception:  # noqa: BLE001 - non-pytree leaves
+            return False
 
-    return jax.tree_util.tree_map(for_leaf, opt_state)
+    def handle(node):
+        if is_params_like(node):
+            return p_shard
+        return jax.tree_util.tree_map(lambda _: replicated, node)
+
+    return jax.tree_util.tree_map(handle, opt_state, is_leaf=is_params_like)
 
 
 # ---------------------------------------------------------------------------

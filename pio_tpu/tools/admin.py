@@ -1,0 +1,86 @@
+"""Admin server — REST wrapper over app/key commands.
+
+Reference tools/.../admin/AdminAPI.scala:35-156 + CommandClient.scala on
+:7071: /, /cmd/app (list/create/delete), /cmd/app/<name>/data.
+"""
+
+from __future__ import annotations
+
+from pio_tpu.data.dao import AccessKey, App
+from pio_tpu.data.storage import Storage, get_storage
+from pio_tpu.server.http import HttpApp, HttpServer, Request
+
+
+def build_admin_app(storage: Storage | None = None) -> HttpApp:
+    storage = storage or get_storage()
+    app = HttpApp("admin")
+
+    @app.route("GET", r"/")
+    def root(req: Request):
+        return 200, {"status": "alive"}
+
+    @app.route("GET", r"/cmd/app")
+    def list_apps(req: Request):
+        apps = storage.get_metadata_apps().get_all()
+        return 200, {
+            "status": 1,
+            "apps": [
+                {"name": a.name, "id": a.id, "description": a.description}
+                for a in sorted(apps, key=lambda a: a.id)
+            ],
+        }
+
+    @app.route("POST", r"/cmd/app")
+    def create_app(req: Request):
+        body = req.json() or {}
+        name = body.get("name", "")
+        if not name:
+            return 400, {"message": "app name is required"}
+        apps_dao = storage.get_metadata_apps()
+        app_id = apps_dao.insert(App(0, name, body.get("description")))
+        if app_id is None:
+            return 409, {"message": f"App {name} already exists."}
+        storage.get_events().init(app_id)
+        key = storage.get_metadata_access_keys().insert(AccessKey("", app_id, ()))
+        return 200, {
+            "status": 1,
+            "message": f"App {name} created.",
+            "id": app_id,
+            "name": name,
+            "accessKey": key,
+        }
+
+    @app.route("DELETE", r"/cmd/app/([^/]+)")
+    def delete_app(req: Request):
+        name = req.path_args[0]
+        apps_dao = storage.get_metadata_apps()
+        a = apps_dao.get_by_name(name)
+        if a is None:
+            return 404, {"message": f"App {name} does not exist."}
+        keys = storage.get_metadata_access_keys()
+        for k in keys.get_by_appid(a.id):
+            keys.delete(k.key)
+        for ch in storage.get_metadata_channels().get_by_appid(a.id):
+            storage.get_events().remove(a.id, ch.id)
+            storage.get_metadata_channels().delete(ch.id)
+        storage.get_events().remove(a.id)
+        apps_dao.delete(a.id)
+        return 200, {"status": 1, "message": f"App {name} deleted."}
+
+    @app.route("DELETE", r"/cmd/app/([^/]+)/data")
+    def delete_app_data(req: Request):
+        name = req.path_args[0]
+        a = storage.get_metadata_apps().get_by_name(name)
+        if a is None:
+            return 404, {"message": f"App {name} does not exist."}
+        storage.get_events().remove(a.id)
+        storage.get_events().init(a.id)
+        return 200, {"status": 1, "message": f"App {name} data deleted."}
+
+    return app
+
+
+def create_admin_server(
+    storage: Storage | None = None, ip: str = "127.0.0.1", port: int = 7071
+) -> HttpServer:
+    return HttpServer(build_admin_app(storage), host=ip, port=port)

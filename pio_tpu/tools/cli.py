@@ -1,0 +1,619 @@
+"""`pio` command-line interface.
+
+Verb parity with reference tools/.../console/Console.scala:186-677:
+  version status
+  app {new,list,show,delete,data-delete,channel-new,channel-delete}
+  accesskey {new,list,delete}
+  build train deploy undeploy eval
+  eventserver adminserver dashboard
+  export import template-new
+
+Differences by design (single-controller runtime, SURVEY.md section 7): no
+spark-submit hop — train/eval/deploy run in-process on the JAX mesh; `build`
+is a syntax check of the engine dir instead of an sbt assembly.
+
+Run as `python -m pio_tpu.tools.cli <verb>` (or `python -m pio_tpu`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import urllib.request
+
+from pio_tpu import __version__
+from pio_tpu.data.dao import AccessKey, App, Channel
+from pio_tpu.data.storage import get_storage
+
+
+def _fail(msg: str) -> int:
+    print(f"[ERROR] {msg}", file=sys.stderr)
+    return 1
+
+
+def _load_variant(engine_dir: str) -> dict:
+    path = os.path.join(engine_dir, "engine.json")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found. Run inside an engine directory or pass "
+            "--engine-dir."
+        )
+    with open(path) as f:
+        return json.load(f)
+
+
+def _load_factory(class_path: str):
+    """'pkg.module.ClassName' -> class (reference WorkflowUtils.getEngine
+    reflective load)."""
+    module_name, _, cls_name = class_path.rpartition(".")
+    if not module_name:
+        raise ValueError(f"invalid class path {class_path!r}")
+    mod = importlib.import_module(module_name)
+    return getattr(mod, cls_name)
+
+
+def _engine_from_variant(variant: dict):
+    factory = _load_factory(variant["engineFactory"])
+    engine = factory.apply()
+    return engine, engine.engine_params_from_variant(variant)
+
+
+def _engine_ids(variant: dict, engine_dir: str) -> tuple[str, str, str]:
+    engine_id = variant.get("id") or os.path.basename(
+        os.path.abspath(engine_dir)
+    )
+    return engine_id, variant.get("engineVersion", "1"), "default"
+
+
+# ---------------------------------------------------------------------------
+# verbs
+# ---------------------------------------------------------------------------
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """Environment doctor (reference Console.status:1035-1107)."""
+    import jax
+
+    print(f"pio-tpu {__version__}")
+    print(f"Python {sys.version.split()[0]}, jax {jax.__version__}")
+    devices = jax.devices()
+    print(f"devices: {len(devices)} x {devices[0].platform}"
+          f" ({devices[0].device_kind})")
+    storage = get_storage()
+    print("storage sources:")
+    for name, spec in storage.sources.items():
+        print(f"  {name}: type={spec.type} {spec.properties}")
+    print("repositories:")
+    for repo, src in storage.repositories.items():
+        print(f"  {repo} -> {src}")
+    errors = storage.verify_all()
+    if errors:
+        for e in errors:
+            print(f"  [ERROR] {e}")
+        return 1
+    print("(sanity check passed)")
+    return 0
+
+
+def cmd_app(args) -> int:
+    storage = get_storage()
+    apps = storage.get_metadata_apps()
+    keys = storage.get_metadata_access_keys()
+    channels = storage.get_metadata_channels()
+    sub = args.subcommand
+    if sub == "new":
+        app_id = apps.insert(App(args.id or 0, args.name, args.description))
+        if app_id is None:
+            return _fail(f"App {args.name} already exists.")
+        storage.get_events().init(app_id)
+        key = keys.insert(AccessKey(args.access_key or "", app_id, ()))
+        print(f"App '{args.name}' created (id {app_id}).")
+        print(f"Access key: {key}")
+        return 0
+    if sub == "list":
+        for a in sorted(apps.get_all(), key=lambda a: a.id):
+            ks = keys.get_by_appid(a.id)
+            print(f"{a.id:>6}  {a.name:<24} keys={len(ks)}")
+        return 0
+    if sub == "show":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            return _fail(f"App {args.name} does not exist.")
+        print(f"App: {a.name} (id {a.id})")
+        print(f"Description: {a.description or ''}")
+        for k in keys.get_by_appid(a.id):
+            events = ",".join(k.events) or "(all)"
+            print(f"  key {k.key} events={events}")
+        for c in channels.get_by_appid(a.id):
+            print(f"  channel {c.id}: {c.name}")
+        return 0
+    if sub == "delete":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            return _fail(f"App {args.name} does not exist.")
+        for k in keys.get_by_appid(a.id):
+            keys.delete(k.key)
+        for c in channels.get_by_appid(a.id):
+            storage.get_events().remove(a.id, c.id)
+            channels.delete(c.id)
+        storage.get_events().remove(a.id)
+        apps.delete(a.id)
+        print(f"App '{args.name}' deleted.")
+        return 0
+    if sub == "data-delete":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            return _fail(f"App {args.name} does not exist.")
+        if args.channel:
+            ch = next((c for c in channels.get_by_appid(a.id)
+                       if c.name == args.channel), None)
+            if ch is None:
+                return _fail(f"Channel {args.channel} does not exist.")
+            storage.get_events().remove(a.id, ch.id)
+            storage.get_events().init(a.id, ch.id)
+        else:
+            storage.get_events().remove(a.id)
+            storage.get_events().init(a.id)
+        print(f"Data of app '{args.name}' deleted.")
+        return 0
+    if sub == "channel-new":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            return _fail(f"App {args.name} does not exist.")
+        if not Channel.is_valid_name(args.channel):
+            return _fail(
+                f"Channel name {args.channel} is invalid "
+                "(1-16 alphanumeric/dash characters)."
+            )
+        cid = channels.insert(Channel(0, args.channel, a.id))
+        if cid is None:
+            return _fail(f"Channel {args.channel} could not be created.")
+        storage.get_events().init(a.id, cid)
+        print(f"Channel '{args.channel}' (id {cid}) created for app "
+              f"'{args.name}'.")
+        return 0
+    if sub == "channel-delete":
+        a = apps.get_by_name(args.name)
+        if a is None:
+            return _fail(f"App {args.name} does not exist.")
+        ch = next((c for c in channels.get_by_appid(a.id)
+                   if c.name == args.channel), None)
+        if ch is None:
+            return _fail(f"Channel {args.channel} does not exist.")
+        storage.get_events().remove(a.id, ch.id)
+        channels.delete(ch.id)
+        print(f"Channel '{args.channel}' deleted.")
+        return 0
+    return _fail(f"unknown app subcommand {sub}")
+
+
+def cmd_accesskey(args) -> int:
+    storage = get_storage()
+    keys = storage.get_metadata_access_keys()
+    if args.subcommand == "new":
+        a = storage.get_metadata_apps().get_by_name(args.app_name)
+        if a is None:
+            return _fail(f"App {args.app_name} does not exist.")
+        key = keys.insert(
+            AccessKey("", a.id, tuple(args.event or ()))
+        )
+        print(f"Access key: {key}")
+        return 0
+    if args.subcommand == "list":
+        for k in keys.get_all():
+            if args.app_name:
+                a = storage.get_metadata_apps().get_by_name(args.app_name)
+                if a is None or k.appid != a.id:
+                    continue
+            events = ",".join(k.events) or "(all)"
+            print(f"{k.key} app={k.appid} events={events}")
+        return 0
+    if args.subcommand == "delete":
+        keys.delete(args.key)
+        print(f"Access key {args.key} deleted.")
+        return 0
+    return _fail(f"unknown accesskey subcommand {args.subcommand}")
+
+
+def cmd_build(args) -> int:
+    """Check the engine dir: engine.json parses + factory imports
+    (replaces the reference's sbt package, Console.compile:933-997)."""
+    variant = _load_variant(args.engine_dir)
+    engine, ep = _engine_from_variant(variant)
+    print(f"Engine factory {variant['engineFactory']} loads; "
+          f"{len(ep.algorithms)} algorithm(s) configured.")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.train import run_train
+
+    variant = _load_variant(args.engine_dir)
+    engine, ep = _engine_from_variant(variant)
+    engine_id, engine_version, engine_variant = _engine_ids(
+        variant, args.engine_dir
+    )
+    from pio_tpu.controller.base import TrainingInterruption
+
+    storage = get_storage()
+    ctx = create_workflow_context(storage, use_mesh=not args.no_mesh)
+    try:
+        instance_id = run_train(
+            engine, ep, storage,
+            engine_id=engine_id, engine_version=engine_version,
+            engine_variant=engine_variant,
+            engine_factory=variant["engineFactory"],
+            batch=args.batch or "",
+            ctx=ctx,
+            stop_after_read=args.stop_after_read,
+            stop_after_prepare=args.stop_after_prepare,
+        )
+    except TrainingInterruption as e:
+        # controlled debug stop (reference --stop-after-read/-prepare)
+        print(f"Training interrupted: {e}")
+        return 0
+    print(f"Training completed. Engine instance: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from pio_tpu.workflow.evaluate import run_evaluation_class
+
+    evaluation = _load_factory(args.evaluation_class)
+    generator = _load_factory(args.params_generator_class)
+    instance_id, result = run_evaluation_class(
+        evaluation, generator, get_storage(),
+        output_path=args.output or None,
+    )
+    print(f"Evaluation completed. Instance: {instance_id}")
+    print(f"Best score: [{result.best_score.score}]")
+    print(f"Best params: {result.best_engine_params.to_json()}")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    variant = _load_variant(args.engine_dir)
+    engine, ep = _engine_from_variant(variant)
+    engine_id, engine_version, engine_variant = _engine_ids(
+        variant, args.engine_dir
+    )
+    storage = get_storage()
+    ctx = create_workflow_context(storage, use_mesh=not args.no_mesh)
+    config = ServingConfig(
+        ip=args.ip, port=args.port,
+        engine_id=engine_id, engine_version=engine_version,
+        engine_variant=engine_variant,
+        feedback=args.feedback,
+        feedback_app_name=args.feedback_app or "",
+        server_key=args.server_key or os.environ.get("PIO_SERVER_KEY", ""),
+        warm_query=json.loads(args.warm_query) if args.warm_query else None,
+    )
+    http, qs = create_query_server(
+        engine, ep, storage, config, ctx=ctx,
+        instance_id=args.engine_instance_id,
+    )
+    print(f"Engine instance {qs.instance.id} deployed on "
+          f"http://{args.ip}:{http.port}")
+    import threading
+
+    def watch_stop():
+        qs._stop_requested.wait()
+        http._server.shutdown()
+
+    threading.Thread(target=watch_stop, daemon=True).start()
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    print("Server stopped.")
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    """POST /stop to a running deploy server (reference Console.undeploy)."""
+    url = f"http://{args.ip}:{args.port}/stop"
+    key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
+    if key:
+        url += f"?accessKey={key}"
+    try:
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            print(resp.read().decode())
+        return 0
+    except Exception as e:  # noqa: BLE001
+        return _fail(f"undeploy failed: {e}")
+
+
+def cmd_eventserver(args) -> int:
+    from pio_tpu.server.eventserver import EventServerConfig, create_event_server
+
+    srv = create_event_server(
+        get_storage(),
+        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats),
+    )
+    print(f"Event Server on http://{args.ip}:{srv.port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from pio_tpu.tools.admin import create_admin_server
+
+    srv = create_admin_server(get_storage(), ip=args.ip, port=args.port)
+    print(f"Admin Server on http://{args.ip}:{srv.port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from pio_tpu.tools.dashboard import create_dashboard
+
+    srv = create_dashboard(get_storage(), ip=args.ip, port=args.port)
+    print(f"Dashboard on http://{args.ip}:{srv.port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_export(args) -> int:
+    from pio_tpu.tools.export_import import export_events
+
+    storage = get_storage()
+    channel_id = None
+    if args.channel:
+        a = storage.get_metadata_apps().get(args.appid)
+        if a is None:
+            return _fail(f"App id {args.appid} does not exist.")
+        ch = next((c for c in storage.get_metadata_channels()
+                   .get_by_appid(a.id) if c.name == args.channel), None)
+        if ch is None:
+            return _fail(f"Channel {args.channel} does not exist.")
+        channel_id = ch.id
+    with open(args.output, "w") as f:
+        n = export_events(storage, args.appid, f, channel_id=channel_id)
+    print(f"Exported {n} events to {args.output}")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from pio_tpu.tools.export_import import import_events
+
+    with open(args.input) as f:
+        ok, failed = import_events(get_storage(), args.appid, f)
+    print(f"Imported {ok} events ({failed} failed).")
+    return 0 if failed == 0 else 1
+
+
+_TEMPLATE_ENGINE_PY = '''\
+"""Custom engine template — edit the DASE classes below.
+
+Generated by `pio template new`. The factory name in engine.json points at
+MyEngine; implement read_training/train/predict for your data.
+"""
+
+from dataclasses import dataclass
+
+from pio_tpu.controller import (
+    DataSource, EngineFactory, Engine, FirstServing, IdentityPreparator,
+    LAlgorithm, Params,
+)
+
+
+@dataclass(frozen=True)
+class MyDataSourceParams(Params):
+    app_name: str = ""
+
+
+class MyDataSource(DataSource):
+    params_class = MyDataSourceParams
+
+    def __init__(self, params: MyDataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx):
+        return ctx.event_store.find(app_name=self.params.app_name)
+
+
+class MyAlgorithm(LAlgorithm):
+    def train(self, ctx, events):
+        return {"n_events": len(events)}
+
+    def predict(self, model, query):
+        return {"nEvents": model["n_events"]}
+
+
+class MyEngine(EngineFactory):
+    @classmethod
+    def apply(cls):
+        return Engine(MyDataSource, IdentityPreparator, MyAlgorithm,
+                      FirstServing)
+'''
+
+
+def cmd_template(args) -> int:
+    """Scaffold a new engine directory (reference console/Template.scala —
+    minus the network gallery: templates generate locally)."""
+    if args.subcommand != "new":
+        return _fail("only 'template new <dir>' is supported")
+    target = args.directory
+    if os.path.exists(target) and os.listdir(target):
+        return _fail(f"directory {target} exists and is not empty")
+    os.makedirs(target, exist_ok=True)
+    name = os.path.basename(os.path.abspath(target))
+    with open(os.path.join(target, "engine.json"), "w") as f:
+        json.dump({
+            "id": name,
+            "description": f"{name} engine",
+            "engineFactory": "engine.MyEngine",
+            "datasource": {"params": {"app_name": "YOUR_APP"}},
+            "algorithms": [{"name": "", "params": {}}],
+        }, f, indent=2)
+    with open(os.path.join(target, "engine.py"), "w") as f:
+        f.write(_TEMPLATE_ENGINE_PY)
+    with open(os.path.join(target, "README.md"), "w") as f:
+        f.write(
+            f"# {name}\n\nEdit engine.py, then:\n\n"
+            "    python -m pio_tpu.tools.cli build\n"
+            "    python -m pio_tpu.tools.cli train\n"
+            "    python -m pio_tpu.tools.cli deploy --port 8000\n"
+        )
+    print(f"Engine template created at {target}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="pio-tpu command line interface"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    pa = sub.add_parser("app")
+    pas = pa.add_subparsers(dest="subcommand", required=True)
+    x = pas.add_parser("new")
+    x.add_argument("name")
+    x.add_argument("--id", type=int, default=0)
+    x.add_argument("--description")
+    x.add_argument("--access-key", default="")
+    pas.add_parser("list")
+    x = pas.add_parser("show")
+    x.add_argument("name")
+    x = pas.add_parser("delete")
+    x.add_argument("name")
+    x = pas.add_parser("data-delete")
+    x.add_argument("name")
+    x.add_argument("--channel")
+    x = pas.add_parser("channel-new")
+    x.add_argument("name")
+    x.add_argument("channel")
+    x = pas.add_parser("channel-delete")
+    x.add_argument("name")
+    x.add_argument("channel")
+    pa.set_defaults(fn=cmd_app)
+
+    pk = sub.add_parser("accesskey")
+    pks = pk.add_subparsers(dest="subcommand", required=True)
+    x = pks.add_parser("new")
+    x.add_argument("app_name")
+    x.add_argument("--event", action="append")
+    x = pks.add_parser("list")
+    x.add_argument("app_name", nargs="?")
+    x = pks.add_parser("delete")
+    x.add_argument("key")
+    pk.set_defaults(fn=cmd_accesskey)
+
+    def engine_dir_arg(q):
+        q.add_argument("--engine-dir", default=".")
+
+    x = sub.add_parser("build")
+    engine_dir_arg(x)
+    x.set_defaults(fn=cmd_build)
+
+    x = sub.add_parser("train")
+    engine_dir_arg(x)
+    x.add_argument("--batch", default="")
+    x.add_argument("--no-mesh", action="store_true")
+    x.add_argument("--stop-after-read", action="store_true")
+    x.add_argument("--stop-after-prepare", action="store_true")
+    x.set_defaults(fn=cmd_train)
+
+    x = sub.add_parser("eval")
+    x.add_argument("evaluation_class")
+    x.add_argument("params_generator_class")
+    x.add_argument("--output", default="best.json")
+    x.set_defaults(fn=cmd_eval)
+
+    x = sub.add_parser("deploy")
+    engine_dir_arg(x)
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--engine-instance-id")
+    x.add_argument("--feedback", action="store_true")
+    x.add_argument("--feedback-app")
+    x.add_argument("--server-key")
+    x.add_argument("--warm-query")
+    x.add_argument("--no-mesh", action="store_true")
+    x.set_defaults(fn=cmd_deploy)
+
+    x = sub.add_parser("undeploy")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000)
+    x.add_argument("--server-key")
+    x.set_defaults(fn=cmd_undeploy)
+
+    x = sub.add_parser("eventserver")
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=7070)
+    x.add_argument("--stats", action="store_true")
+    x.set_defaults(fn=cmd_eventserver)
+
+    x = sub.add_parser("adminserver")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=7071)
+    x.set_defaults(fn=cmd_adminserver)
+
+    x = sub.add_parser("dashboard")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=9000)
+    x.set_defaults(fn=cmd_dashboard)
+
+    x = sub.add_parser("export")
+    x.add_argument("--appid", type=int, required=True)
+    x.add_argument("--output", required=True)
+    x.add_argument("--channel")
+    x.set_defaults(fn=cmd_export)
+
+    x = sub.add_parser("import")
+    x.add_argument("--appid", type=int, required=True)
+    x.add_argument("--input", required=True)
+    x.set_defaults(fn=cmd_import)
+
+    x = sub.add_parser("template")
+    xs = x.add_subparsers(dest="subcommand", required=True)
+    t = xs.add_parser("new")
+    t.add_argument("directory")
+    x.set_defaults(fn=cmd_template)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    # engine dirs put engine.py on the path (factory "engine.MyEngine")
+    if "" not in sys.path and "." not in sys.path:
+        sys.path.insert(0, os.getcwd())
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        return _fail(str(e))
+    except (ValueError, KeyError) as e:
+        return _fail(f"{type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""Dashboard — browse completed evaluation instances.
+
+Reference tools/.../dashboard/Dashboard.scala:59-156 (+ Twirl
+index.scala.html) on :9000: an HTML list of completed evaluations with
+links to each instance's detailed HTML report.
+"""
+
+from __future__ import annotations
+
+import html
+
+from pio_tpu.data.storage import Storage, get_storage
+from pio_tpu.server.http import HttpApp, HttpServer, Request
+from pio_tpu.utils.time import format_time
+
+
+def build_dashboard_app(storage: Storage | None = None) -> HttpApp:
+    storage = storage or get_storage()
+    app = HttpApp("dashboard")
+
+    @app.route("GET", r"/")
+    def index(req: Request):
+        instances = storage.get_metadata_evaluation_instances().get_completed()
+        rows = "".join(
+            "<tr>"
+            f"<td><a href='/engine_instances/{html.escape(i.id)}"
+            f"/evaluator_results.html'>{html.escape(i.id)}</a></td>"
+            f"<td>{html.escape(i.evaluation_class)}</td>"
+            f"<td>{html.escape(i.engine_params_generator_class)}</td>"
+            f"<td>{html.escape(format_time(i.start_time))}</td>"
+            f"<td>{html.escape(format_time(i.end_time))}</td>"
+            f"<td><pre>{html.escape(i.evaluator_results)}</pre></td>"
+            "</tr>"
+            for i in instances
+        )
+        page = (
+            "<!doctype html><html><head><title>pio-tpu dashboard</title>"
+            "</head><body><h1>Completed evaluations</h1>"
+            "<table border='1'><tr><th>ID</th><th>Evaluation</th>"
+            "<th>Params generator</th><th>Start</th><th>End</th>"
+            "<th>Result</th></tr>"
+            f"{rows}</table></body></html>"
+        )
+        return 200, page
+
+    @app.route("GET", r"/engine_instances/([^/]+)/evaluator_results\.html")
+    def results_html(req: Request):
+        i = storage.get_metadata_evaluation_instances().get(req.path_args[0])
+        if i is None:
+            return 404, {"message": "Not Found"}
+        return 200, (
+            "<!doctype html><html><body>"
+            + (i.evaluator_results_html or "<p>(no results)</p>")
+            + "</body></html>"
+        )
+
+    @app.route("GET", r"/engine_instances/([^/]+)/evaluator_results\.json")
+    def results_json(req: Request):
+        i = storage.get_metadata_evaluation_instances().get(req.path_args[0])
+        if i is None:
+            return 404, {"message": "Not Found"}
+        import json
+
+        return 200, json.loads(i.evaluator_results_json or "{}")
+
+    return app
+
+
+def create_dashboard(
+    storage: Storage | None = None, ip: str = "127.0.0.1", port: int = 9000
+) -> HttpServer:
+    return HttpServer(build_dashboard_app(storage), host=ip, port=port)

@@ -1,0 +1,53 @@
+"""Event export/import — JSON-lines files <-> event store.
+
+Reference tools/.../export/EventsToFile.scala (PEvents -> JSON/Parquet) and
+imprt/FileToEvents.scala (JSON lines -> PEvents.write). JSON-lines format
+matches the Event Server wire format, so exports replay through
+`pio import` or the batch API.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import TextIO
+
+from pio_tpu.data.event import Event, validate_event
+from pio_tpu.data.storage import Storage
+
+
+def export_events(
+    storage: Storage,
+    app_id: int,
+    out: TextIO,
+    channel_id: int | None = None,
+) -> int:
+    """Write all events of an app/channel as JSON lines; returns count."""
+    n = 0
+    for event in storage.get_events().find(app_id, channel_id=channel_id, limit=-1):
+        out.write(json.dumps(event.to_api_dict(), sort_keys=True) + "\n")
+        n += 1
+    return n
+
+
+def import_events(
+    storage: Storage,
+    app_id: int,
+    infile: TextIO,
+    channel_id: int | None = None,
+) -> tuple[int, int]:
+    """Read JSON lines into the event store; returns (imported, failed)."""
+    dao = storage.get_events()
+    dao.init(app_id, channel_id)
+    ok = failed = 0
+    for line in infile:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            event = Event.from_api_dict(json.loads(line))
+            validate_event(event)
+            dao.insert(event, app_id, channel_id)
+            ok += 1
+        except Exception:  # noqa: BLE001 - count+continue like the reference
+            failed += 1
+    return ok, failed

@@ -1,0 +1,226 @@
+"""CLI + admin + dashboard + export/import tests (reference Console specs +
+AdminAPISpec)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pio_tpu.data.storage import set_storage
+from pio_tpu.tools.cli import main
+
+
+@pytest.fixture()
+def cli(memory_storage, capsys):
+    """Route the CLI's process-global storage at the test's memory storage."""
+    set_storage(memory_storage)
+    yield lambda *argv: (main(list(argv)), capsys.readouterr())
+    set_storage(None)
+
+
+def test_version_and_status(cli):
+    code, out = cli("version")
+    assert code == 0 and out.out.strip()
+    code, out = cli("status")
+    assert code == 0
+    assert "sanity check passed" in out.out
+
+
+def test_app_lifecycle(cli):
+    code, out = cli("app", "new", "myapp", "--description", "d")
+    assert code == 0 and "Access key:" in out.out
+    code, out = cli("app", "new", "myapp")
+    assert code == 1  # duplicate
+    code, out = cli("app", "list")
+    assert "myapp" in out.out
+    code, out = cli("app", "show", "myapp")
+    assert "channel" not in out.out.lower() or True
+    code, out = cli("app", "channel-new", "myapp", "mobile")
+    assert code == 0
+    code, out = cli("app", "channel-new", "myapp", "bad name!")
+    assert code == 1
+    code, out = cli("app", "show", "myapp")
+    assert "mobile" in out.out
+    code, out = cli("app", "data-delete", "myapp")
+    assert code == 0
+    code, out = cli("app", "channel-delete", "myapp", "mobile")
+    assert code == 0
+    code, out = cli("app", "delete", "myapp")
+    assert code == 0
+    code, out = cli("app", "show", "myapp")
+    assert code == 1
+
+
+def test_accesskey_lifecycle(cli):
+    cli("app", "new", "keyapp")
+    code, out = cli("accesskey", "new", "keyapp", "--event", "rate")
+    assert code == 0
+    key = out.out.strip().split()[-1]
+    code, out = cli("accesskey", "list", "keyapp")
+    assert key in out.out and "rate" in out.out
+    code, out = cli("accesskey", "delete", key)
+    assert code == 0
+    code, out = cli("accesskey", "new", "ghost")
+    assert code == 1
+
+
+def test_build_train_deploy_roundtrip(cli, memory_storage, tmp_path):
+    import numpy as np
+    from datetime import datetime, timedelta, timezone
+    from pio_tpu.data import DataMap, Event
+
+    cli("app", "new", "mlapp")
+    app_id = memory_storage.get_metadata_apps().get_by_name("mlapp").id
+    ev = memory_storage.get_events()
+    rng = np.random.default_rng(0)
+    T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    m = 0
+    for u in range(16):
+        for i in range(10):
+            if rng.random() < (0.8 if (u % 2) == (i % 2) else 0.1):
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5 if (u % 2) == (i % 2) else 1}),
+                    event_time=T0 + timedelta(minutes=m)), app_id)
+                m += 1
+
+    engine_dir = tmp_path / "eng"
+    engine_dir.mkdir()
+    (engine_dir / "engine.json").write_text(json.dumps({
+        "id": "clirec",
+        "engineFactory": "pio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "mlapp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "num_iterations": 4, "lambda_": 0.05, "chunk": 1024}}],
+    }))
+
+    code, out = cli("build", "--engine-dir", str(engine_dir))
+    assert code == 0 and "loads" in out.out
+
+    code, out = cli("train", "--engine-dir", str(engine_dir), "--no-mesh")
+    assert code == 0 and "Training completed" in out.out
+    instances = memory_storage.get_metadata_engine_instances()
+    assert instances.get_latest_completed("clirec", "1", "default")
+
+    # interruption flags: controlled stop, exit 0
+    code, out = cli("train", "--engine-dir", str(engine_dir), "--no-mesh",
+                    "--stop-after-read")
+    assert code == 0 and "interrupted" in out.out.lower()
+
+
+def test_build_missing_engine_json(cli, tmp_path):
+    code, out = cli("build", "--engine-dir", str(tmp_path))
+    assert code == 1 and "engine.json" in out.err
+
+
+def test_template_new(cli, tmp_path):
+    target = tmp_path / "myengine"
+    code, out = cli("template", "new", str(target))
+    assert code == 0
+    assert (target / "engine.json").exists()
+    assert (target / "engine.py").exists()
+    variant = json.loads((target / "engine.json").read_text())
+    assert variant["engineFactory"] == "engine.MyEngine"
+    # refuses to overwrite
+    code, out = cli("template", "new", str(target))
+    assert code == 1
+
+
+def test_export_import(cli, memory_storage, tmp_path):
+    from pio_tpu.data import DataMap, Event
+
+    cli("app", "new", "exapp")
+    app_id = memory_storage.get_metadata_apps().get_by_name("exapp").id
+    ev = memory_storage.get_events()
+    for i in range(5):
+        ev.insert(Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                        target_entity_type="item", target_entity_id="i1",
+                        properties=DataMap({"rating": i})), app_id)
+    out_file = tmp_path / "events.jsonl"
+    code, out = cli("export", "--appid", str(app_id),
+                    "--output", str(out_file))
+    assert code == 0 and "Exported 5" in out.out
+
+    cli("app", "new", "imapp")
+    app2 = memory_storage.get_metadata_apps().get_by_name("imapp").id
+    code, out = cli("import", "--appid", str(app2), "--input", str(out_file))
+    assert code == 0 and "Imported 5" in out.out
+    assert len(list(ev.find(app2, limit=-1))) == 5
+
+    # corrupt line counts as failure but doesn't abort
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"event": "x", "entityType": "u", "entityId": "1"}\nnot json\n')
+    cli("app", "new", "badapp")
+    app3 = memory_storage.get_metadata_apps().get_by_name("badapp").id
+    code, out = cli("import", "--appid", str(app3), "--input", str(bad))
+    assert code == 1 and "Imported 1 events (1 failed)" in out.out
+
+
+def test_admin_server(memory_storage):
+    from pio_tpu.tools.admin import create_admin_server
+
+    srv = create_admin_server(memory_storage, ip="127.0.0.1", port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def call(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(base + path, data=data, method=method)
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read().decode() or "{}")
+
+        status, body = call("POST", "/cmd/app", {"name": "adminapp"})
+        assert status == 200 and body["accessKey"]
+        status, body = call("POST", "/cmd/app", {"name": "adminapp"})
+        assert status == 409
+        status, body = call("GET", "/cmd/app")
+        assert [a["name"] for a in body["apps"]] == ["adminapp"]
+        status, body = call("DELETE", "/cmd/app/adminapp/data")
+        assert status == 200
+        status, body = call("DELETE", "/cmd/app/adminapp")
+        assert status == 200
+        status, body = call("GET", "/cmd/app")
+        assert body["apps"] == []
+        status, _ = call("DELETE", "/cmd/app/ghost")
+        assert status == 404
+    finally:
+        srv.stop()
+
+
+def test_dashboard(memory_storage):
+    import urllib.error
+    from datetime import datetime, timezone
+    from pio_tpu.data.dao import EvaluationInstance
+    from pio_tpu.tools.dashboard import create_dashboard
+
+    dao = memory_storage.get_metadata_evaluation_instances()
+    iid = dao.insert(EvaluationInstance(
+        id="", status="EVALCOMPLETED",
+        start_time=datetime(2026, 1, 1, tzinfo=timezone.utc),
+        end_time=datetime(2026, 1, 1, tzinfo=timezone.utc),
+        evaluation_class="MyEval", evaluator_results="[0.9] {...}",
+        evaluator_results_html="<h2>Metric</h2><table></table>",
+        evaluator_results_json='{"bestScore": 0.9}',
+    ))
+    srv = create_dashboard(memory_storage, ip="127.0.0.1", port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        page = urllib.request.urlopen(base + "/").read().decode()
+        assert "MyEval" in page and iid in page
+        detail = urllib.request.urlopen(
+            base + f"/engine_instances/{iid}/evaluator_results.html"
+        ).read().decode()
+        assert "<table>" in detail
+        j = json.loads(urllib.request.urlopen(
+            base + f"/engine_instances/{iid}/evaluator_results.json"
+        ).read().decode())
+        assert j["bestScore"] == 0.9
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                base + "/engine_instances/nope/evaluator_results.html")
+    finally:
+        srv.stop()

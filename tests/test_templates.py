@@ -181,6 +181,12 @@ def test_similarproduct_filters(similar_storage):
     r = algo.predict(model, {"items": ["i0"], "num": 3,
                              "whiteList": ["i2", "i3"]})
     assert {s["item"] for s in r["itemScores"]} <= {"i2", "i3"}
+    # selective whitelist ranks WITHIN candidates: both slots fill even when
+    # the candidates are nowhere near the global top-k (i19 is cross-cluster)
+    r = algo.predict(model, {"items": ["i0"], "num": 2,
+                             "whiteList": ["i19", "i17"]})
+    assert len(r["itemScores"]) == 2
+    assert {s["item"] for s in r["itemScores"]} == {"i19", "i17"}
     r = algo.predict(model, {"items": ["i0"], "num": 5, "blackList": ["i2"]})
     assert all(s["item"] != "i2" for s in r["itemScores"])
     assert algo.predict(model, {"items": ["nope"], "num": 3}) == {
@@ -286,3 +292,15 @@ def test_ecommerce_category_filter(ecommerce_storage):
     engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
     r = algo.predict(model, {"user": "u2", "num": 5, "categories": ["catB"]})
     assert all(int(s["item"][1:]) >= 10 for s in r["itemScores"])
+    # u2 (cluster A user) asking for catB: candidates are all cross-cluster,
+    # i.e. globally low-ranked — the filter-then-rank path must still fill
+    # (minus any catB items u2 has seen, which stay excluded)
+    seen = {
+        e.target_entity_id
+        for e in ecommerce_storage.get_events().find(
+            ecommerce_storage.get_metadata_apps().get_by_name("shopapp").id,
+            entity_type="user", entity_id="u2",
+            event_names=["view", "buy"], limit=-1)
+    }
+    expected = min(5, 10 - sum(1 for s in seen if int(s[1:]) >= 10))
+    assert len(r["itemScores"]) == expected
