@@ -32,6 +32,11 @@ from pio_tpu.controller.base import (
 )
 from pio_tpu.controller.engine import Engine, EngineFactory
 from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.models.filtering import (
+    candidate_ids,
+    invert_categories,
+    rank_candidates,
+)
 from pio_tpu.ops import als
 from pio_tpu.ops.similarity import cosine_topk, mean_vector
 
@@ -111,6 +116,12 @@ class ECommerceModel:
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(children[0], *aux)
+
+    def cat_index(self) -> dict:
+        """category -> [item ids], built lazily once per model."""
+        if not hasattr(self, "_cat_index"):
+            self._cat_index = invert_categories(self.item_categories)
+        return self._cat_index
 
 
 class ECommAlgorithm(PAlgorithm):
@@ -222,10 +233,9 @@ class ECommAlgorithm(PAlgorithm):
         exclude |= self._unavailable_items()
         white = set(query.get("whiteList") or ()) or None
         categories = set(query.get("categories") or ()) or None
-        from pio_tpu.models.similarproduct import _candidate_ids
-
-        candidates = _candidate_ids(
-            model.items, model.item_categories, white, categories, exclude
+        candidates = candidate_ids(
+            model.items, model.item_categories, white, categories, exclude,
+            cat_index=model.cat_index,
         )
         n_items = model.factors.item_factors.shape[0]
 
@@ -237,28 +247,21 @@ class ECommAlgorithm(PAlgorithm):
 
         if candidates is not None:
             # selective filters: score the candidate set directly (reference
-            # isCandidateItem filters before ranking, ALSAlgorithm.scala)
+            # isCandidateItem filters before ranking, ALSAlgorithm.scala);
+            # one bucketed gather+matmul+top_k — no per-size recompiles
             if not candidates:
                 return {"itemScores": []}
             cidx = model.items.encode(candidates)
             if known_user:
                 uidx = model.users.index_of(user)
-                scores = np.asarray(als.predict_pairs(
-                    model.factors,
-                    np.full(len(cidx), uidx, dtype=np.int32), cidx,
-                ))
-            else:
-                from pio_tpu.ops.similarity import normalize_rows
-                import jax.numpy as jnp
-
-                cvecs = model.factors.item_factors[jnp.asarray(cidx)]
-                scores = np.asarray(
-                    normalize_rows(qv) @ normalize_rows(cvecs).T
-                )[0]
-            order = np.argsort(-scores)[:num]
+                qv = model.factors.user_factors[uidx]
+            pos, scores = rank_candidates(
+                model.factors.item_factors, qv, cidx, num,
+                normalize=not known_user,
+            )
             return {"itemScores": [
-                {"item": candidates[i], "score": float(scores[i])}
-                for i in order
+                {"item": candidates[p], "score": float(s)}
+                for p, s in zip(pos, scores)
             ]}
 
         k = min(num + len(exclude), n_items)

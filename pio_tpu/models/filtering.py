@@ -1,0 +1,124 @@
+"""Shared serve-time candidate filtering + ranking for the recommender
+templates (similarproduct, ecommerce).
+
+Parity target: the reference templates' isCandidateItem / whiteList /
+blackList / categories filtering before their cosine/score loops
+(examples/scala-parallel-ecommercerecommendation/train-with-rate-event/src/
+main/scala/ALSAlgorithm.scala:148-341, examples/scala-parallel-similarproduct
+ALSAlgorithm.scala). TPU-native: the candidate set is selected on host
+(id-space work), then scored in ONE bucketed gather+matmul+top_k on device —
+candidate counts are padded to powers of two so serving never recompiles per
+query shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pio_tpu.ops.similarity import normalize_rows
+
+
+def invert_categories(item_categories: dict) -> dict:
+    """item id -> categories  =>  category -> [item ids]. Built once per
+    model (cached by callers) so category-filtered queries select candidates
+    in O(matching items), not O(catalog)."""
+    inv: dict = {}
+    for iid, cats in item_categories.items():
+        for c in cats:
+            inv.setdefault(c, []).append(iid)
+    return inv
+
+
+def candidate_ids(
+    items_index,
+    item_categories: dict,
+    white,
+    categories,
+    exclude,
+    cat_index: dict | None = None,
+):
+    """The candidate id list to rank within when selective filters apply;
+    None when no selective filter is present (callers then use the
+    full-catalog top-k path).
+
+    items_index: EntityIdIndex; white/categories: sets or None; exclude: set;
+    cat_index: invert_categories() result, or a zero-arg callable returning
+    it (resolved only when a category filter is actually present, so
+    filterless queries never pay the O(catalog) inversion). Used when
+    categories is set and white is not, making selection cost O(matching
+    items) not O(catalog).
+    """
+    if white is None and categories is None:
+        return None
+    if white is not None:
+        ids = white
+    else:
+        if callable(cat_index):
+            cat_index = cat_index()
+        if cat_index is None:
+            cat_index = invert_categories(item_categories)
+        ids = set()
+        for c in categories:
+            ids.update(cat_index.get(c, ()))
+        categories = None  # already applied via the index
+    out = []
+    # sorted: candidate order (and so top-k tie-breaks) must not depend on
+    # per-process string-hash order — evals and serving stay reproducible
+    for i in sorted(ids):
+        if i in exclude or i not in items_index:
+            continue
+        if categories is not None and not (
+            set(item_categories.get(i, ())) & categories
+        ):
+            continue
+        out.append(i)
+    return out
+
+
+@partial(jax.jit, static_argnames=("normalize", "k"))
+def _rank_jit(item_factors, qv, cidx, valid, normalize: bool, k: int):
+    vecs = item_factors[cidx]  # (C, d) gather
+    q = qv.reshape(1, -1)
+    if normalize:
+        vecs = normalize_rows(vecs)
+        q = normalize_rows(q)
+    scores = (vecs @ q.T)[:, 0]
+    scores = jnp.where(valid, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def rank_candidates(
+    item_factors,
+    qv,
+    cidx: np.ndarray,
+    num: int,
+    normalize: bool = False,
+):
+    """Score candidate rows `cidx` of item_factors against query vector `qv`
+    and return (positions_into_cidx, scores) for the top `num`, best first.
+
+    The candidate count and k are padded/bucketed to powers of two before
+    jit, so distinct per-query candidate sizes share a small, bounded set of
+    compiled programs (same convention as ops.similarity.cosine_topk /
+    ops.als.recommend_topk).
+    """
+    cidx = np.asarray(cidx, dtype=np.int32)
+    n = len(cidx)
+    if n == 0:
+        return np.array([], np.int64), np.array([], np.float32)
+    bucket = 1 << (n - 1).bit_length()
+    pad = bucket - n
+    cidx_p = np.concatenate([cidx, np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+    k = min(num, n)
+    kb = min(bucket, 1 << (k - 1).bit_length())
+    scores, pos = _rank_jit(
+        item_factors, jnp.asarray(qv), cidx_p, valid, normalize, kb
+    )
+    scores, pos = np.asarray(scores)[:k], np.asarray(pos)[:k]
+    keep = pos < n  # drop any padding rows that slipped into top-k
+    return pos[keep], scores[keep]

@@ -29,28 +29,13 @@ from pio_tpu.controller.base import (
 from pio_tpu.controller.engine import Engine, EngineFactory
 from pio_tpu.data.bimap import EntityIdIndex
 from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.models.filtering import (
+    candidate_ids,
+    invert_categories,
+    rank_candidates,
+)
 from pio_tpu.ops import als
 from pio_tpu.ops.similarity import cosine_topk, mean_vector
-
-import jax.numpy as jnp
-
-
-def _candidate_ids(items_index, item_categories, white, categories, exclude):
-    """When selective filters apply, the candidate set to rank within; None
-    when no selective filter is present (use the fast top-k path)."""
-    if white is None and categories is None:
-        return None
-    ids = list(white) if white is not None else list(items_index.bimap.keys())
-    out = []
-    for i in ids:
-        if i in exclude or i not in items_index:
-            continue
-        if categories is not None and not (
-            set(item_categories.get(i, ())) & categories
-        ):
-            continue
-        out.append(i)
-    return out
 
 
 @dataclass(frozen=True)
@@ -120,6 +105,12 @@ class SimilarProductModel:
     def tree_unflatten(cls, aux, children):
         return cls(children[0], *aux)
 
+    def cat_index(self) -> dict:
+        """category -> [item ids], built lazily once per model."""
+        if not hasattr(self, "_cat_index"):
+            self._cat_index = invert_categories(self.item_categories)
+        return self._cat_index
+
 
 class ALSSimilarityAlgorithm(PAlgorithm):
     params_class = ALSAlgorithmParams
@@ -164,25 +155,23 @@ class ALSSimilarityAlgorithm(PAlgorithm):
         exclude = set(items) | set(query.get("blackList") or ())
         white = set(query.get("whiteList") or ()) or None
         categories = set(query.get("categories") or ()) or None
-        candidates = _candidate_ids(
-            model.items, model.item_categories, white, categories, exclude
+        candidates = candidate_ids(
+            model.items, model.item_categories, white, categories, exclude,
+            cat_index=model.cat_index,
         )
         if candidates is not None:
             # selective filters: rank WITHIN the candidate set (reference
-            # ALSAlgorithm.scala filters candidates before its cosine loop)
+            # ALSAlgorithm.scala filters candidates before its cosine loop);
+            # scoring is one bucketed gather+matmul+top_k on device
             if not candidates:
                 return {"itemScores": []}
             cidx = model.items.encode(candidates)
-            from pio_tpu.ops.similarity import normalize_rows
-
-            cvecs = model.item_factors[jnp.asarray(cidx)]
-            scores = np.asarray(
-                normalize_rows(qv) @ normalize_rows(cvecs).T
-            )[0]
-            order = np.argsort(-scores)[:num]
+            pos, scores = rank_candidates(
+                model.item_factors, qv, cidx, num, normalize=True
+            )
             return {"itemScores": [
-                {"item": candidates[i], "score": float(scores[i])}
-                for i in order
+                {"item": candidates[p], "score": float(s)}
+                for p, s in zip(pos, scores)
             ]}
         k = min(num + len(exclude), model.item_factors.shape[0])
         scores, idx = cosine_topk(model.item_factors, qv, k)

@@ -6,9 +6,9 @@ Reference tools/.../admin/AdminAPI.scala:35-156 + CommandClient.scala on
 
 from __future__ import annotations
 
-from pio_tpu.data.dao import AccessKey, App
 from pio_tpu.data.storage import Storage, get_storage
 from pio_tpu.server.http import HttpApp, HttpServer, Request
+from pio_tpu.tools import appops
 
 
 def build_admin_app(storage: Storage | None = None) -> HttpApp:
@@ -36,12 +36,10 @@ def build_admin_app(storage: Storage | None = None) -> HttpApp:
         name = body.get("name", "")
         if not name:
             return 400, {"message": "app name is required"}
-        apps_dao = storage.get_metadata_apps()
-        app_id = apps_dao.insert(App(0, name, body.get("description")))
-        if app_id is None:
+        created = appops.create_app(storage, name, body.get("description"))
+        if created is None:
             return 409, {"message": f"App {name} already exists."}
-        storage.get_events().init(app_id)
-        key = storage.get_metadata_access_keys().insert(AccessKey("", app_id, ()))
+        app_id, key = created
         return 200, {
             "status": 1,
             "message": f"App {name} created.",
@@ -53,18 +51,10 @@ def build_admin_app(storage: Storage | None = None) -> HttpApp:
     @app.route("DELETE", r"/cmd/app/([^/]+)")
     def delete_app(req: Request):
         name = req.path_args[0]
-        apps_dao = storage.get_metadata_apps()
-        a = apps_dao.get_by_name(name)
+        a = storage.get_metadata_apps().get_by_name(name)
         if a is None:
             return 404, {"message": f"App {name} does not exist."}
-        keys = storage.get_metadata_access_keys()
-        for k in keys.get_by_appid(a.id):
-            keys.delete(k.key)
-        for ch in storage.get_metadata_channels().get_by_appid(a.id):
-            storage.get_events().remove(a.id, ch.id)
-            storage.get_metadata_channels().delete(ch.id)
-        storage.get_events().remove(a.id)
-        apps_dao.delete(a.id)
+        appops.delete_app(storage, a)
         return 200, {"status": 1, "message": f"App {name} deleted."}
 
     @app.route("DELETE", r"/cmd/app/([^/]+)/data")
@@ -73,8 +63,7 @@ def build_admin_app(storage: Storage | None = None) -> HttpApp:
         a = storage.get_metadata_apps().get_by_name(name)
         if a is None:
             return 404, {"message": f"App {name} does not exist."}
-        storage.get_events().remove(a.id)
-        storage.get_events().init(a.id)
+        appops.delete_app_data(storage, a)
         return 200, {"status": 1, "message": f"App {name} data deleted."}
 
     return app

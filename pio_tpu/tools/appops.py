@@ -1,0 +1,52 @@
+"""App create/delete orchestration shared by the CLI and the admin server.
+
+Parity target: reference tools/.../console/App.scala (create: app + default
+event namespace + first access key; delete: cascading key/channel/event
+cleanup) and admin/CommandClient.scala, which both drive the same sequence.
+"""
+
+from __future__ import annotations
+
+from pio_tpu.data.dao import AccessKey, App
+from pio_tpu.data.storage import Storage
+
+
+def create_app(
+    storage: Storage,
+    name: str,
+    description: str | None = None,
+    app_id: int = 0,
+    access_key: str = "",
+) -> tuple[int, str] | None:
+    """Create an app, init its event namespace, mint its first access key.
+    Returns (app_id, key), or None if the name is taken."""
+    new_id = storage.get_metadata_apps().insert(App(app_id, name, description))
+    if new_id is None:
+        return None
+    storage.get_events().init(new_id)
+    key = storage.get_metadata_access_keys().insert(
+        AccessKey(access_key, new_id, ())
+    )
+    return new_id, key
+
+
+def delete_app(storage: Storage, app: App) -> None:
+    """Cascading delete: access keys, per-channel event data + channels,
+    default-channel event data, then the app record."""
+    keys = storage.get_metadata_access_keys()
+    channels = storage.get_metadata_channels()
+    for k in keys.get_by_appid(app.id):
+        keys.delete(k.key)
+    for ch in channels.get_by_appid(app.id):
+        storage.get_events().remove(app.id, ch.id)
+        channels.delete(ch.id)
+    storage.get_events().remove(app.id)
+    storage.get_metadata_apps().delete(app.id)
+
+
+def delete_app_data(
+    storage: Storage, app: App, channel_id: int | None = None
+) -> None:
+    """Wipe and re-init event data for one channel (or the default)."""
+    storage.get_events().remove(app.id, channel_id)
+    storage.get_events().init(app.id, channel_id)

@@ -25,8 +25,9 @@ import sys
 import urllib.request
 
 from pio_tpu import __version__
-from pio_tpu.data.dao import AccessKey, App, Channel
+from pio_tpu.data.dao import AccessKey, Channel
 from pio_tpu.data.storage import get_storage
+from pio_tpu.tools import appops
 
 
 def _fail(msg: str) -> int:
@@ -109,11 +110,13 @@ def cmd_app(args) -> int:
     channels = storage.get_metadata_channels()
     sub = args.subcommand
     if sub == "new":
-        app_id = apps.insert(App(args.id or 0, args.name, args.description))
-        if app_id is None:
+        created = appops.create_app(
+            storage, args.name, args.description,
+            app_id=args.id or 0, access_key=args.access_key or "",
+        )
+        if created is None:
             return _fail(f"App {args.name} already exists.")
-        storage.get_events().init(app_id)
-        key = keys.insert(AccessKey(args.access_key or "", app_id, ()))
+        app_id, key = created
         print(f"App '{args.name}' created (id {app_id}).")
         print(f"Access key: {key}")
         return 0
@@ -138,29 +141,21 @@ def cmd_app(args) -> int:
         a = apps.get_by_name(args.name)
         if a is None:
             return _fail(f"App {args.name} does not exist.")
-        for k in keys.get_by_appid(a.id):
-            keys.delete(k.key)
-        for c in channels.get_by_appid(a.id):
-            storage.get_events().remove(a.id, c.id)
-            channels.delete(c.id)
-        storage.get_events().remove(a.id)
-        apps.delete(a.id)
+        appops.delete_app(storage, a)
         print(f"App '{args.name}' deleted.")
         return 0
     if sub == "data-delete":
         a = apps.get_by_name(args.name)
         if a is None:
             return _fail(f"App {args.name} does not exist.")
+        channel_id = None
         if args.channel:
             ch = next((c for c in channels.get_by_appid(a.id)
                        if c.name == args.channel), None)
             if ch is None:
                 return _fail(f"Channel {args.channel} does not exist.")
-            storage.get_events().remove(a.id, ch.id)
-            storage.get_events().init(a.id, ch.id)
-        else:
-            storage.get_events().remove(a.id)
-            storage.get_events().init(a.id)
+            channel_id = ch.id
+        appops.delete_app_data(storage, a, channel_id)
         print(f"Data of app '{args.name}' deleted.")
         return 0
     if sub == "channel-new":
@@ -207,11 +202,15 @@ def cmd_accesskey(args) -> int:
         print(f"Access key: {key}")
         return 0
     if args.subcommand == "list":
+        app_filter = None
+        if args.app_name:
+            a = storage.get_metadata_apps().get_by_name(args.app_name)
+            if a is None:
+                return _fail(f"App {args.app_name} does not exist.")
+            app_filter = a.id
         for k in keys.get_all():
-            if args.app_name:
-                a = storage.get_metadata_apps().get_by_name(args.app_name)
-                if a is None or k.appid != a.id:
-                    continue
+            if app_filter is not None and k.appid != app_filter:
+                continue
             events = ",".join(k.events) or "(all)"
             print(f"{k.key} app={k.appid} events={events}")
         return 0
@@ -378,11 +377,11 @@ def cmd_export(args) -> int:
     from pio_tpu.tools.export_import import export_events
 
     storage = get_storage()
+    a = storage.get_metadata_apps().get(args.appid)
+    if a is None:
+        return _fail(f"App id {args.appid} does not exist.")
     channel_id = None
     if args.channel:
-        a = storage.get_metadata_apps().get(args.appid)
-        if a is None:
-            return _fail(f"App id {args.appid} does not exist.")
         ch = next((c for c in storage.get_metadata_channels()
                    .get_by_appid(a.id) if c.name == args.channel), None)
         if ch is None:
@@ -455,8 +454,10 @@ def cmd_template(args) -> int:
     if args.subcommand != "new":
         return _fail("only 'template new <dir>' is supported")
     target = args.directory
-    if os.path.exists(target) and os.listdir(target):
-        return _fail(f"directory {target} exists and is not empty")
+    if os.path.exists(target) and (
+        not os.path.isdir(target) or os.listdir(target)
+    ):
+        return _fail(f"{target} exists and is not an empty directory")
     os.makedirs(target, exist_ok=True)
     name = os.path.basename(os.path.abspath(target))
     with open(os.path.join(target, "engine.json"), "w") as f:

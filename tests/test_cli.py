@@ -34,7 +34,7 @@ def test_app_lifecycle(cli):
     code, out = cli("app", "list")
     assert "myapp" in out.out
     code, out = cli("app", "show", "myapp")
-    assert "channel" not in out.out.lower() or True
+    assert code == 0 and "channel" not in out.out.lower()
     code, out = cli("app", "channel-new", "myapp", "mobile")
     assert code == 0
     code, out = cli("app", "channel-new", "myapp", "bad name!")
