@@ -1,0 +1,415 @@
+"""Sequential (session-based) recommendation template — self-attentive
+next-item prediction over user event histories.
+
+Net-new model family beyond the reference's capability set (the reference
+has no sequence models: SURVEY.md section 5 "Long-context / sequence
+parallelism: absent"); it is the framework's long-context showcase and the
+engine that exercises ops/attention.py end to end:
+
+ * training: causal transformer over time-ordered per-user item sequences
+   (next-item cross-entropy, embedding-tied output head);
+ * parallelism: one shard_map'd SPMD train step over the mesh — batch on
+   the "data" axis, sequence on the "seq" axis with `ring_attention`
+   rotating k/v shards over ICI, gradients psum'd across both axes.
+   The same code path runs single-device (both axes size 1);
+ * serving: encode the user's recent history (live event-store read, like
+   the ecommerce template's cold-start path) with the Pallas
+   `flash_attention` kernel, then the standard top-k matmul.
+
+Event-data contract matches the other templates: user->item events with
+event times (e.g. view/buy), sequences are the per-user time-ordered item
+ids (same fold order as the reference's LEventAggregator time ordering).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pio_tpu.controller.base import (
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    PAlgorithm,
+    Params,
+)
+from pio_tpu.controller.engine import Engine, EngineFactory
+from pio_tpu.data.bimap import EntityIdIndex
+from pio_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+    ring_attention,
+)
+from pio_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+
+PAD = 0  # item index 0 is reserved as padding; real items start at 1
+
+
+@dataclass(frozen=True)
+class SequenceParams(Params):
+    max_len: int = 64          # sequence length (pad/truncate buckets)
+    embed_dim: int = 64
+    num_heads: int = 2
+    num_layers: int = 2
+    ffn_dim: int = 128
+    dropout: float = 0.0       # kept 0 in-graph; eval-mode determinism
+    learning_rate: float = 1e-3
+    batch_size: int = 128
+    steps: int = 300
+    seed: int = 0
+    attention: str = "auto"    # "auto" | "reference" | "ring"
+    unseen_only: bool = True   # serve-time: drop items already in history
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block with a pluggable attention fn."""
+
+    num_heads: int
+    head_dim: int
+    ffn_dim: int
+
+    @nn.compact
+    def __call__(self, x, attn_fn):
+        b, s, e = x.shape
+        h, d = self.num_heads, self.head_dim
+        y = nn.LayerNorm()(x)
+        qkv = nn.Dense(3 * h * d, use_bias=False)(y).reshape(b, s, 3, h, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = attn_fn(q, k, v)                            # (b, s, h, d)
+        x = x + nn.Dense(e, use_bias=False)(o.reshape(b, s, h * d))
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(self.ffn_dim)(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(e)(y)
+        return x
+
+
+class SeqEncoder(nn.Module):
+    """Item-id sequence -> per-position hidden states; logits are tied to
+    the item embedding table (SASRec-style)."""
+
+    vocab: int                 # includes PAD at index 0
+    max_len: int               # GLOBAL max sequence length (for positions)
+    embed_dim: int
+    num_heads: int
+    num_layers: int
+    ffn_dim: int
+
+    @nn.compact
+    def __call__(self, ids, attn_fn, pos_offset=0):
+        emb = self.param(
+            "item_emb", nn.initializers.normal(0.02),
+            (self.vocab, self.embed_dim),
+        )
+        pos = self.param(
+            "pos_emb", nn.initializers.normal(0.02),
+            (self.max_len, self.embed_dim),
+        )
+        s = ids.shape[1]
+        x = emb[ids] * np.sqrt(self.embed_dim)
+        x = x + jax.lax.dynamic_slice_in_dim(pos, pos_offset, s, axis=0)[None]
+        head_dim = self.embed_dim // self.num_heads
+        for _ in range(self.num_layers):
+            x = Block(self.num_heads, head_dim, self.ffn_dim)(x, attn_fn)
+        x = nn.LayerNorm()(x)
+        logits = x @ emb.T                              # weight-tied head
+        return x, logits
+
+
+def build_sequences(events, max_len: int):
+    """Time-ordered per-user item sequences from user->item events.
+
+    Returns (seqs int32 (N, max_len) right-aligned & PAD-left-padded,
+    users EntityIdIndex over sequence owners, items EntityIdIndex with ids
+    offset by 1 for PAD). Users with < 2 interactions are dropped (no
+    next-item target exists)."""
+    by_user: dict[str, list[tuple[Any, str]]] = {}
+    item_ids: dict[str, None] = {}
+    for e in events:
+        if not e.target_entity_id:
+            continue
+        by_user.setdefault(e.entity_id, []).append(
+            (e.event_time, e.target_entity_id)
+        )
+        item_ids.setdefault(e.target_entity_id, None)
+    items = EntityIdIndex(item_ids.keys())
+    users, rows = [], []
+    for uid, evs in by_user.items():
+        if len(evs) < 2:
+            continue
+        evs.sort(key=lambda t: t[0])
+        seq = [items.index_of(i) + 1 for _, i in evs][-max_len:]  # +1: PAD=0
+        rows.append(np.pad(seq, (max_len - len(seq), 0)))
+        users.append(uid)
+    if not rows:
+        raise ValueError("no user has >= 2 interactions; cannot train")
+    return (
+        np.stack(rows).astype(np.int32),
+        EntityIdIndex(users),
+        items,
+    )
+
+
+@dataclass
+class SequenceData:
+    seqs: np.ndarray            # (N, max_len) int32, PAD-left
+    users: EntityIdIndex
+    items: EntityIdIndex
+
+    def sanity_check(self):
+        assert self.seqs.ndim == 2 and self.seqs.shape[0] > 0
+
+
+def make_encoder(n_items: int, p: SequenceParams) -> SeqEncoder:
+    # +16 position headroom: the train step right-pads the sequence so it
+    # splits evenly over the seq mesh axis (up to n_seq-1 extra positions)
+    return SeqEncoder(
+        vocab=n_items + 1, max_len=p.max_len + 16, embed_dim=p.embed_dim,
+        num_heads=p.num_heads, num_layers=p.num_layers, ffn_dim=p.ffn_dim,
+    )
+
+
+def train_sequence_model(
+    data: SequenceData, p: SequenceParams, mesh: Mesh | None = None
+):
+    """SPMD train loop: dp x sp shard_map step (see module docstring).
+
+    Returns (params, encoder)."""
+    encoder = make_encoder(len(data.items), p)
+    optimizer = optax.adam(p.learning_rate)
+
+    seqs = data.seqs
+    inp_all, tgt_all = seqs[:, :-1], seqs[:, 1:]
+    s_global = inp_all.shape[1]
+
+    # once the sequence is sharded, attention MUST be ring — a local-only
+    # attention would silently drop cross-shard interactions
+    use_ring = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
+    if use_ring and p.attention == "reference":
+        raise ValueError(
+            "attention='reference' cannot run with the sequence sharded "
+            "over the mesh seq axis; use 'auto'/'ring' or a seq=1 mesh"
+        )
+    if not use_ring and p.attention == "ring":
+        raise ValueError(
+            "attention='ring' requires a mesh with a seq axis > 1"
+        )
+
+    params = encoder.init(
+        jax.random.PRNGKey(p.seed),
+        jnp.zeros((1, s_global), jnp.int32),
+        partial(attention_reference, causal=True),
+    )["params"]
+    opt_state = optimizer.init(params)
+
+    if mesh is not None:
+        n_data = mesh.shape[DATA_AXIS]
+        n_seq = mesh.shape.get(SEQ_AXIS, 1)
+        # sequence length must split evenly over the seq axis
+        if s_global % n_seq:
+            pad = n_seq - s_global % n_seq
+            inp_all = np.pad(inp_all, ((0, 0), (0, pad)))
+            tgt_all = np.pad(tgt_all, ((0, 0), (0, pad)))
+            s_global += pad
+        s_local = s_global // n_seq
+
+        def local_loss(params, inp, tgt, pos_offset):
+            if use_ring:
+                attn = partial(
+                    ring_attention, axis_name=SEQ_AXIS, causal=True,
+                )
+            else:
+                attn = partial(attention_reference, causal=True)
+            _, logits = encoder.apply(
+                {"params": params}, inp, attn, pos_offset=pos_offset
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+            mask = (tgt != PAD).astype(jnp.float32)
+            loss_sum = jax.lax.psum(
+                jnp.sum(ce * mask), (DATA_AXIS, SEQ_AXIS)
+            )
+            count = jax.lax.psum(jnp.sum(mask), (DATA_AXIS, SEQ_AXIS))
+            return loss_sum / jnp.maximum(count, 1.0)
+
+        @partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(
+                P(), P(),
+                P(DATA_AXIS, SEQ_AXIS), P(DATA_AXIS, SEQ_AXIS),
+            ),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        def step(params, opt_state, inp, tgt):
+            pos_offset = jax.lax.axis_index(SEQ_AXIS) * s_local
+            loss, grads = jax.value_and_grad(local_loss)(
+                params, inp, tgt, pos_offset
+            )
+            # local grads cover local tokens only; sum across dp and sp
+            grads = jax.lax.psum(grads, (DATA_AXIS, SEQ_AXIS))
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        step = jax.jit(step)
+        batch_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+        batch = max(n_data, p.batch_size - p.batch_size % n_data)
+    else:
+        n_data = 1
+        attn = partial(attention_reference, causal=True)
+
+        def loss_fn(params, inp, tgt):
+            _, logits = encoder.apply({"params": params}, inp, attn)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+            mask = (tgt != PAD).astype(jnp.float32)
+            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        @jax.jit
+        def step(params, opt_state, inp, tgt):
+            loss, grads = jax.value_and_grad(loss_fn)(params, inp, tgt)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        batch = p.batch_size
+
+    rng = np.random.default_rng(p.seed)
+    n = inp_all.shape[0]
+    # the sampled batch must split evenly over the data mesh axis
+    size = min(batch, max(8, n))
+    size = max(n_data, size - size % n_data)
+    loss = None
+    for _ in range(p.steps):
+        idx = rng.integers(0, n, size=size)
+        inp = jnp.asarray(inp_all[idx])
+        tgt = jnp.asarray(tgt_all[idx])
+        if mesh is not None:
+            inp = jax.device_put(inp, batch_sharding)
+            tgt = jax.device_put(tgt, batch_sharding)
+        params, opt_state, loss = step(params, opt_state, inp, tgt)
+    return jax.device_get(params), encoder, float(loss)
+
+
+# ---------------------------------------------------------------------------
+# DASE wrapper
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SequenceDataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple[str, ...] = ("view", "buy")
+    max_len: int = 64
+
+
+class SequenceDataSource(DataSource):
+    params_class = SequenceDataSourceParams
+
+    def __init__(self, params: SequenceDataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx) -> SequenceData:
+        events = ctx.event_store.find(
+            app_name=self.params.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.event_names),
+        )
+        seqs, users, items = build_sequences(events, self.params.max_len)
+        return SequenceData(seqs, users, items)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SequenceModel:
+    params: dict
+    seqs: np.ndarray           # training-time sequences for serve lookup
+    users: EntityIdIndex
+    items: EntityIdIndex
+    config: SequenceParams
+
+    def tree_flatten(self):
+        return (self.params,), (self.seqs, self.users, self.items, self.config)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+class SequenceAlgorithm(PAlgorithm):
+    params_class = SequenceParams
+
+    def __init__(self, params: SequenceParams = SequenceParams()):
+        self.params = params
+
+    def train(self, ctx, data: SequenceData) -> SequenceModel:
+        data.sanity_check()
+        mesh = (
+            ctx.mesh
+            if ctx and ctx.mesh is not None and ctx.mesh.devices.size > 1
+            else None
+        )
+        params, _, _ = train_sequence_model(data, self.params, mesh)
+        return SequenceModel(
+            params=params, seqs=data.seqs, users=data.users,
+            items=data.items, config=self.params,
+        )
+
+    def _score_last(self, model: SequenceModel, seq_row: np.ndarray):
+        """Forward one (1, S) sequence; return next-item scores (vocab,)
+        from the tied head at the last position. Serving path: Pallas flash
+        attention on TPU, reference on CPU."""
+        p = model.config
+        encoder = make_encoder(len(model.items), p)
+        on_cpu = jax.devices()[0].platform == "cpu"
+        attn = partial(
+            attention_reference if on_cpu else flash_attention, causal=True,
+        )
+        _, logits = encoder.apply(
+            {"params": model.params}, jnp.asarray(seq_row[None, :]), attn,
+        )
+        return logits[0, -1]
+
+    def predict(self, model: SequenceModel, query: dict) -> dict:
+        user = query.get("user", "")
+        num = int(query.get("num", 10))
+        if user not in model.users:
+            return {"itemScores": []}
+        row = model.seqs[model.users.index_of(user)]
+        scores = np.array(self._score_last(model, row))  # writable copy
+        scores[PAD] = -np.inf
+        seen = (
+            set(int(i) for i in row if i != PAD)
+            if model.config.unseen_only else set()
+        )
+        black = {
+            model.items.index_of(b) + 1
+            for b in (query.get("blackList") or ())
+            if b in model.items
+        }
+        for i in seen | black:
+            scores[i] = -np.inf
+        order = np.argsort(-scores)[:num]
+        return {"itemScores": [
+            {"item": model.items.decode([i - 1])[0], "score": float(scores[i])}
+            for i in order if np.isfinite(scores[i])
+        ]}
+
+
+class SequenceEngine(EngineFactory):
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            SequenceDataSource,
+            IdentityPreparator,
+            {"sasrec": SequenceAlgorithm},
+            FirstServing,
+        )

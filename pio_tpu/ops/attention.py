@@ -1,0 +1,325 @@
+"""Attention kernels: Pallas flash attention + sequence-parallel variants.
+
+The reference has no sequence models at all (SURVEY.md section 5
+"Long-context / sequence parallelism: absent"), so this module is the
+framework's net-new long-context capability, built TPU-first:
+
+ * `flash_attention` — blockwise attention with online softmax as a Pallas
+   TPU kernel: q blocks stream through VMEM, k/v live in VMEM per
+   (batch, head) program, the (block_q, block_k) score tile hits the MXU,
+   and softmax renormalization state (m, l) stays in registers/VMEM so the
+   (S, S) score matrix is never materialized in HBM.
+ * `ring_attention` — sequence parallelism over a mesh axis: each device
+   holds a contiguous sequence shard of q/k/v; k/v shards rotate around the
+   ring via `jax.lax.ppermute` (ICI neighbor exchange) while each device
+   accumulates its q-shard's online softmax. Compute for step i overlaps
+   the DMA of step i+1's shard (XLA pipelines the ppermute); memory per
+   device is O(S/n), enabling sequences n x longer than one chip's HBM.
+ * `ulysses_attention` — the all-to-all alternative: resharding
+   (B, S/n, H, D) -> (B, S, H/n, D) with `lax.all_to_all`, full attention
+   per head group, then the inverse all-to-all. Two collectives total;
+   preferable when H >= n_seq and the mesh axis rides fast ICI.
+
+All three compute the same math as `attention_reference` (tested against
+it); masks are additive-big-negative with explicit zeroing so fully-masked
+rows (causal prefixes) produce zeros, not NaNs.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = False, scale: float | None = None):
+    """Plain softmax attention; the correctness oracle for the kernels.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D) -> (B, Sq, H, D).
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (single device)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, q_block_offset: bool):
+    """One (batch*head, q-block) program: stream k/v blocks from VMEM,
+    maintain online-softmax state (m, l) as values."""
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    bq, d = q.shape
+    sk = k_ref.shape[1]
+    nk = sk // block_k
+
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    if q_block_offset:
+        q_pos = q_pos + pl.program_id(1) * bq
+
+    def body(j, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T                                # (bq, bk) on the MXU
+        if causal:
+            k_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+                + j * block_k
+            )
+            keep = q_pos >= k_pos                      # (bq, bk)
+            s = jnp.where(keep, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * alpha + p @ v_blk
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, nk, body, (o0, m0, l0))
+    o = o / jnp.maximum(l, 1e-30)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _pad_len(n: int, block: int) -> int:
+    return (block - n % block) % block
+
+
+def flash_attention(
+    q, k, v,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Blockwise (flash) attention as a Pallas TPU kernel.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D) -> (B, Sq, H, D). Sequences are
+    padded to the block size internally; padded key positions are excluded
+    via the k-length mask only when padding exists. `interpret=True` runs
+    the kernel in interpreter mode (used on CPU in tests; auto-detected
+    when None).
+    """
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    block_q = min(block_q, max(sq, 8))
+    block_k = min(block_k, max(sk, 8))
+    pad_q, pad_k = _pad_len(sq, block_q), _pad_len(sk, block_k)
+    if pad_k and not causal:
+        # non-causal ragged keys: padded positions would contribute
+        # exp(0)=1 softmax mass, so they need a length mask; these shapes
+        # are serving-time small, so use the masked reference path. (On the
+        # causal path padded keys sit at positions >= sq and are already
+        # masked for every real query row.)
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        return _flash_padded_fallback(q, k, v, sk, scale)
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    sqp, skp = sq + pad_q, sk + pad_k
+
+    # (B, S, H, D) -> (B*H, S, D): one program per (batch, head, q block)
+    def bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(-1, x.shape[1], d)
+
+    qt, kt, vt = bhsd(q), bhsd(k), bhsd(v)
+    nq = sqp // block_q
+
+    kernel = partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale,
+        q_block_offset=True,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(qt.shape[0], nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, skp, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, skp, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    out = out.reshape(b, h, sqp, d).transpose(0, 2, 1, 3)
+    return out[:, :sq]
+
+
+def _flash_padded_fallback(q, k, v, real_sk: int, scale: float):
+    """Non-causal attention with right-padded keys: mask via the reference
+    path (the shapes here are serving-time small)."""
+    sq = q.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    keep = (jnp.arange(k.shape[1]) < real_sk)[None, None, None, :]
+    s = jnp.where(keep, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)[:, :sq]
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+
+def _block_attn_stats(q, k, v, scale, q_offset, k_offset, causal):
+    """Un-normalized blockwise attention + softmax stats for one k/v shard.
+
+    q: (B, Sq, H, D) local queries at global offset q_offset;
+    k/v: (B, Sk, H, D) the currently-held shard at global offset k_offset.
+    Returns (o, m, l): o = sum_j exp(s_j - m) v_j  (B, Sq, H, D),
+    m = rowmax (B, H, Sq, 1), l = sum exp (B, H, Sq, 1).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        keep = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(keep[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                  # (B,H,Sq,1)
+    m_safe = jnp.maximum(m, NEG_INF)  # rows fully masked stay at NEG_INF
+    p = jnp.exp(s - m_safe)
+    if causal:
+        p = jnp.where(keep[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, m_safe, l
+
+
+def ring_attention(
+    q, k, v,
+    axis_name: str,
+    causal: bool = False,
+    scale: float | None = None,
+):
+    """Sequence-parallel attention; call INSIDE shard_map/pjit with q/k/v
+    sharded on their sequence axis over `axis_name`.
+
+    Each device starts with its own k/v shard and rotates the shards one
+    neighbor per step with `lax.ppermute` (n-1 ICI hops total), folding each
+    visiting shard into its q-shard's online softmax (same m/l accumulation
+    as the flash kernel, across devices instead of VMEM blocks). The k/v
+    rotation for step i+1 overlaps step i's matmuls — XLA schedules the
+    ppermute DMA concurrently with compute on TPU.
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    # axis size is static mesh structure — safe to use for Python loops
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_offset = my * s_local
+    # the shard held at step i originated at device (my - i) % n
+    k_offsets = jnp.mod(my - jnp.arange(n), n) * s_local
+
+    def step(carry, k_offset):
+        o, m, l, k_cur, v_cur = carry
+        o_i, m_i, l_i = _block_attn_stats(
+            q, k_cur, v_cur, scale, q_offset, k_offset, causal
+        )
+        m_new = jnp.maximum(m, m_i)
+        a_prev = jnp.exp(m - m_new)
+        a_i = jnp.exp(m_i - m_new)
+        l_new = l * a_prev + l_i * a_i
+        o_new = (
+            o * a_prev.transpose(0, 2, 1, 3)
+            + o_i * a_i.transpose(0, 2, 1, 3)
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    b, sq, h, d = q.shape
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    # scan (not fori_loop) so the whole ring is reverse-differentiable —
+    # the sequence model trains through this
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v), k_offsets)
+    o = o / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-30)
+    return o.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name: str,
+                           causal: bool = False):
+    """Host-facing wrapper: shard (B, S, H, D) on the sequence axis over
+    `axis_name` and run ring_attention under shard_map. Batch stays
+    replicated across the seq axis here; compose with a data axis via the
+    caller's outer shard_map/pjit (see models/sequence.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def run(ql, kl, vl):
+        return ring_attention(ql, kl, vl, axis_name, causal=causal)
+
+    sharding = NamedSharding(mesh, spec)
+    return run(
+        jax.device_put(q, sharding),
+        jax.device_put(k, sharding),
+        jax.device_put(v, sharding),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ulysses-style all-to-all sequence parallelism
+# ---------------------------------------------------------------------------
+
+def ulysses_attention(
+    q, k, v,
+    axis_name: str,
+    causal: bool = False,
+    scale: float | None = None,
+):
+    """All-to-all sequence parallelism; call INSIDE shard_map with q/k/v
+    sequence-sharded over `axis_name` and H divisible by the axis size.
+
+    all_to_all flips the sharded dim from sequence to heads (each device
+    gets the FULL sequence for H/n heads), full attention runs locally,
+    and a second all_to_all flips back. Two collectives per layer vs the
+    ring's n-1 hops — the better trade when heads are plentiful.
+    """
+    n = jax.lax.axis_size(axis_name)  # noqa: F841 — documents the contract
+    # (B, S/n, H, D) -> (B, S, H/n, D)
+    qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    o = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # (B, S, H/n, D) -> (B, S/n, H, D)
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True).astype(q.dtype)

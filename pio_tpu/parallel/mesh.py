@@ -22,25 +22,30 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Mesh shape: data-parallel x model-parallel. -1 = use all remaining."""
+    """Mesh shape: data-parallel x sequence-parallel x model-parallel.
+    -1 = use all remaining. The seq axis carries ring/all-to-all sequence
+    parallelism (ops/attention.py); it is 1 for the non-sequence templates."""
 
     data: int = -1
+    seq: int = 1
     model: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int]:
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
         model = self.model if self.model > 0 else 1
-        data = self.data if self.data > 0 else n_devices // model
-        if data * model > n_devices:
+        seq = self.seq if self.seq > 0 else 1
+        data = self.data if self.data > 0 else n_devices // (model * seq)
+        if data * seq * model > n_devices:
             raise ValueError(
-                f"mesh {data}x{model} needs {data * model} devices, "
-                f"have {n_devices}"
+                f"mesh {data}x{seq}x{model} needs {data * seq * model} "
+                f"devices, have {n_devices}"
             )
-        return data, model
+        return data, seq, model
 
 
 def create_mesh(
@@ -48,9 +53,11 @@ def create_mesh(
 ) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     config = config or MeshConfig()
-    data, model = config.resolve(len(devices))
-    dev_array = np.array(devices[: data * model]).reshape(data, model)
-    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+    data, seq, model = config.resolve(len(devices))
+    dev_array = np.array(devices[: data * seq * model]).reshape(
+        data, seq, model
+    )
+    return Mesh(dev_array, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,99 @@
+"""Sequence-recommendation template tests: sequence building from events,
+SPMD (dp x sp ring-attention) training equivalence, and DASE serving."""
+
+import numpy as np
+import pytest
+
+from pio_tpu.models.sequence import (
+    PAD,
+    SequenceAlgorithm,
+    SequenceData,
+    SequenceModel,
+    SequenceParams,
+    build_sequences,
+    train_sequence_model,
+)
+from pio_tpu.parallel.mesh import MeshConfig, create_mesh
+
+
+class _Ev:
+    def __init__(self, u, i, t):
+        self.entity_id = u
+        self.target_entity_id = i
+        self.event_time = t
+
+
+def _cyclic_events(n_users=40, steps=8, n_items=12):
+    return [
+        _Ev(f"u{u}", f"i{(u % 3 + t) % n_items}", t)
+        for u in range(n_users)
+        for t in range(steps)
+    ]
+
+
+@pytest.fixture(scope="module")
+def trained():
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=16)
+    data = SequenceData(seqs, users, items)
+    p = SequenceParams(
+        max_len=16, embed_dim=32, num_heads=2, num_layers=2, ffn_dim=64,
+        steps=200, batch_size=32,
+    )
+    mesh = create_mesh(MeshConfig(data=2, seq=4, model=1))
+    params, _, loss = train_sequence_model(data, p, mesh)
+    model = SequenceModel(
+        params=params, seqs=seqs, users=users, items=items, config=p
+    )
+    return model, loss
+
+
+def test_build_sequences_time_order_and_padding():
+    events = [
+        _Ev("u", "b", 2), _Ev("u", "a", 1), _Ev("u", "c", 3),
+        _Ev("solo", "a", 1),  # dropped: < 2 interactions
+    ]
+    seqs, users, items = build_sequences(events, max_len=5)
+    assert "solo" not in users
+    row = seqs[users.index_of("u")]
+    assert list(row[:2]) == [PAD, PAD]  # left padding
+    assert [items.decode([i - 1])[0] for i in row[2:]] == ["a", "b", "c"]
+
+
+def test_build_sequences_truncates_to_recent():
+    events = [_Ev("u", f"i{t}", t) for t in range(10)]
+    seqs, users, items = build_sequences(events, max_len=4)
+    row = seqs[users.index_of("u")]
+    assert [items.decode([i - 1])[0] for i in row] == [
+        "i6", "i7", "i8", "i9"
+    ]
+
+
+def test_sp_training_matches_single_device():
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=16)
+    data = SequenceData(seqs, users, items)
+    p = SequenceParams(
+        max_len=16, embed_dim=32, num_heads=2, num_layers=1, ffn_dim=64,
+        steps=30, batch_size=32,
+    )
+    _, _, loss_single = train_sequence_model(data, p, None)
+    mesh = create_mesh(MeshConfig(data=2, seq=4, model=1))
+    _, _, loss_sharded = train_sequence_model(data, p, mesh)
+    # same data order, same init: dp x sp(ring) must match single-device
+    assert abs(loss_single - loss_sharded) < 1e-3
+
+
+def test_learns_and_serves_next_item(trained):
+    model, loss = trained
+    assert loss < 1.0  # the cyclic pattern is learnable
+    algo = SequenceAlgorithm(model.config)
+    out = algo.predict(model, {"user": "u0", "num": 3})
+    # u0 saw i0..i7; the cycle's next item is i8
+    assert out["itemScores"][0]["item"] == "i8"
+
+
+def test_serving_respects_blacklist_and_unknown_user(trained):
+    model, _ = trained
+    algo = SequenceAlgorithm(model.config)
+    out = algo.predict(model, {"user": "u0", "num": 3, "blackList": ["i8"]})
+    assert all(s["item"] != "i8" for s in out["itemScores"])
+    assert algo.predict(model, {"user": "nobody"}) == {"itemScores": []}
