@@ -1,0 +1,658 @@
+// Native append-only event log: the TPU build's high-throughput event store.
+//
+// Role in the framework (see SURVEY.md §2): the reference's event store is
+// HBase with rowkey = MD5(entity)+time+uuid scanned via TableInputFormat
+// (reference data/.../storage/hbase/HBEventsUtil.scala:74-412,
+// HBPEvents.scala). Here the same job — durable ingest + fast filtered bulk
+// reads for training — is a single-writer append-only log per
+// (app, channel) namespace:
+//
+//   file = "PIOEVLG1" header, then records of [u32 len][u32 crc32][payload].
+//   payload layout (little-endian, packed by the Python wrapper):
+//     i64 event_time_ms, i16 event_tz_min,
+//     i64 creation_time_ms, i16 creation_tz_min,
+//     u64 hash(event), u64 hash(entity_type), u64 hash(entity_id),
+//     u64 hash(target_entity_type) | 0, u64 hash(target_entity_id) | 0,
+//     u64 hash(event_id), u8 flags (bit0 has_target, bit1 has_prid),
+//     then length-prefixed strings (u16 len + bytes):
+//       event, entity_type, entity_id, target_entity_type, target_entity_id,
+//       event_id, pr_id, tags_json,
+//     then u32 props_len + properties JSON.
+//
+// Scans mmap the file and prefilter on the 64-bit FNV-1a hashes; the Python
+// layer re-verifies matches exactly after decoding, so hash collisions can
+// only cost a wasted decode, never a wrong result. `el_columnarize` is the
+// training fast path: one pass that filters, resolves entity-id strings to
+// dense codes via an open-addressing string dict, extracts a numeric value
+// from the properties JSON, and dedups — replacing the reference's
+// HBase-scan RDD + per-event JVM decode with a single C++ sweep whose output
+// arrays are ready for jax.device_put.
+//
+// Crash safety: a torn tail write is detected on open (length walk) and at
+// read time (crc), and the log is logically truncated to the last whole
+// record. Deletes are tombstones kept by the Python layer and passed into
+// scans for exclusion (the log itself is immutable).
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'I', 'O', 'E', 'V', 'L', 'G', '1'};
+constexpr uint64_t kHeaderSize = 8;
+
+// ---------------------------------------------------------------------------
+// crc32 (IEEE, table-driven) — matches Python's zlib.crc32
+// ---------------------------------------------------------------------------
+
+uint32_t crc_table[256];
+bool crc_init_done = []() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  return true;
+}();
+
+uint32_t crc32_of(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// 64-bit FNV-1a — mirrored in the Python wrapper (pio_tpu/native/eventlog.py)
+uint64_t fnv1a(const uint8_t* s, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < n; i++) {
+    h ^= s[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+template <typename T>
+T load_le(const uint8_t* p) {
+  T v;
+  memcpy(&v, p, sizeof(T));
+  return v;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+struct Log {
+  int fd = -1;
+  uint64_t end = kHeaderSize;  // logical end: after last whole record
+  std::string path;
+};
+
+// Decoded view of one record's envelope (string fields point into the map).
+struct RecView {
+  int64_t time_ms;
+  int16_t tz_min;
+  int64_t ctime_ms;
+  int16_t ctz_min;
+  uint64_t h_event, h_etype, h_eid, h_tetype, h_teid, h_eventid;
+  uint8_t flags;
+  const uint8_t *event, *etype, *eid, *tetype, *teid, *event_id, *pr_id, *tags;
+  uint16_t l_event, l_etype, l_eid, l_tetype, l_teid, l_event_id, l_pr_id,
+      l_tags;
+  const uint8_t* props;
+  uint32_t l_props;
+};
+
+constexpr size_t kFixedPart = 8 + 2 + 8 + 2 + 6 * 8 + 1;  // 69 bytes
+
+bool parse_record(const uint8_t* p, uint32_t len, RecView* out) {
+  if (len < kFixedPart) return false;
+  const uint8_t* q = p;
+  out->time_ms = load_le<int64_t>(q); q += 8;
+  out->tz_min = load_le<int16_t>(q); q += 2;
+  out->ctime_ms = load_le<int64_t>(q); q += 8;
+  out->ctz_min = load_le<int16_t>(q); q += 2;
+  out->h_event = load_le<uint64_t>(q); q += 8;
+  out->h_etype = load_le<uint64_t>(q); q += 8;
+  out->h_eid = load_le<uint64_t>(q); q += 8;
+  out->h_tetype = load_le<uint64_t>(q); q += 8;
+  out->h_teid = load_le<uint64_t>(q); q += 8;
+  out->h_eventid = load_le<uint64_t>(q); q += 8;
+  out->flags = *q++;
+  const uint8_t* lim = p + len;
+  const uint8_t** strs[8] = {&out->event,   &out->etype, &out->eid,
+                             &out->tetype,  &out->teid,  &out->event_id,
+                             &out->pr_id,   &out->tags};
+  uint16_t* lens[8] = {&out->l_event,   &out->l_etype, &out->l_eid,
+                       &out->l_tetype,  &out->l_teid,  &out->l_event_id,
+                       &out->l_pr_id,   &out->l_tags};
+  for (int i = 0; i < 8; i++) {
+    if (q + 2 > lim) return false;
+    uint16_t l = load_le<uint16_t>(q); q += 2;
+    if (q + l > lim) return false;
+    *strs[i] = q;
+    *lens[i] = l;
+    q += l;
+  }
+  if (q + 4 > lim) return false;
+  out->l_props = load_le<uint32_t>(q); q += 4;
+  if (q + out->l_props > lim) return false;
+  out->props = q;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// scan filter
+// ---------------------------------------------------------------------------
+
+enum FilterFlags : uint32_t {
+  F_START = 1u << 0,
+  F_UNTIL = 1u << 1,
+  F_ETYPE = 1u << 2,
+  F_EID = 1u << 3,
+  F_EVENTS = 1u << 4,
+  F_TETYPE_EQ = 1u << 5,
+  F_TETYPE_ABSENT = 1u << 6,
+  F_TEID_EQ = 1u << 7,
+  F_TEID_ABSENT = 1u << 8,
+  F_EVENTID = 1u << 9,
+};
+
+struct Filter {
+  uint32_t flags = 0;
+  int64_t start_ms = 0, until_ms = 0;
+  uint64_t h_etype = 0, h_eid = 0, h_tetype = 0, h_teid = 0;
+  const uint64_t* h_events = nullptr;
+  uint32_t n_events = 0;
+  uint64_t h_eventid = 0;
+};
+
+bool matches(const RecView& r, const Filter& f) {
+  if ((f.flags & F_START) && r.time_ms < f.start_ms) return false;
+  if ((f.flags & F_UNTIL) && r.time_ms >= f.until_ms) return false;
+  if ((f.flags & F_ETYPE) && r.h_etype != f.h_etype) return false;
+  if ((f.flags & F_EID) && r.h_eid != f.h_eid) return false;
+  if (f.flags & F_EVENTS) {
+    bool hit = false;
+    for (uint32_t i = 0; i < f.n_events && !hit; i++)
+      hit = r.h_event == f.h_events[i];
+    if (!hit) return false;
+  }
+  bool has_target = r.flags & 1;
+  if ((f.flags & F_TETYPE_ABSENT) && has_target) return false;
+  if ((f.flags & F_TETYPE_EQ) && (!has_target || r.h_tetype != f.h_tetype))
+    return false;
+  if ((f.flags & F_TEID_ABSENT) && has_target) return false;
+  if ((f.flags & F_TEID_EQ) && (!has_target || r.h_teid != f.h_teid))
+    return false;
+  if ((f.flags & F_EVENTID) && r.h_eventid != f.h_eventid) return false;
+  return true;
+}
+
+// Tombstone set: exact event-id strings (len-prefixed blob from Python).
+struct Tombstones {
+  std::vector<std::pair<const uint8_t*, uint16_t>> ids;
+  bool contains(const uint8_t* s, uint16_t n) const {
+    for (auto& [p, l] : ids)
+      if (l == n && memcmp(p, s, n) == 0) return true;
+    return false;
+  }
+};
+
+Tombstones parse_tombstones(const uint8_t* blob, uint32_t blob_len) {
+  Tombstones t;
+  const uint8_t* q = blob;
+  const uint8_t* lim = blob + blob_len;
+  while (q + 2 <= lim) {
+    uint16_t l = load_le<uint16_t>(q);
+    q += 2;
+    if (q + l > lim) break;
+    t.ids.emplace_back(q, l);
+    q += l;
+  }
+  return t;
+}
+
+// Iterate whole records in [header, end); cb returns false to stop early.
+template <typename F>
+void for_each_record(const uint8_t* base, uint64_t end, F&& cb) {
+  uint64_t pos = kHeaderSize;
+  while (pos + 8 <= end) {
+    uint32_t len = load_le<uint32_t>(base + pos);
+    uint32_t crc = load_le<uint32_t>(base + pos + 4);
+    if (pos + 8 + len > end) break;
+    const uint8_t* payload = base + pos + 8;
+    if (crc32_of(payload, len) == crc) {
+      RecView r;
+      if (parse_record(payload, len, &r)) {
+        if (!cb(r, pos)) return;
+      }
+    }
+    pos += 8 + len;
+  }
+}
+
+struct MapView {
+  const uint8_t* base = nullptr;
+  size_t len = 0;
+  ~MapView() {
+    if (base) munmap(const_cast<uint8_t*>(base), len);
+  }
+};
+
+bool map_log(Log* lg, MapView* mv) {
+  if (lg->end <= kHeaderSize) {
+    mv->base = nullptr;
+    return true;  // empty log
+  }
+  void* m = mmap(nullptr, lg->end, PROT_READ, MAP_SHARED, lg->fd, 0);
+  if (m == MAP_FAILED) return false;
+  mv->base = static_cast<const uint8_t*>(m);
+  mv->len = lg->end;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// string -> dense code dict (open addressing, exact compare)
+// ---------------------------------------------------------------------------
+
+struct StringDict {
+  struct Slot {
+    uint64_t hash = 0;
+    uint64_t off = 0;  // into arena
+    uint32_t len = 0;
+    int32_t code = -1;
+  };
+  std::vector<Slot> slots;
+  std::string arena;
+  std::vector<std::pair<uint64_t, uint32_t>> by_code;  // (arena off, len)
+  size_t count = 0;
+
+  StringDict() : slots(1024) {}
+
+  void grow() {
+    std::vector<Slot> old;
+    old.swap(slots);
+    slots.assign(old.size() * 2, Slot{});
+    for (auto& s : old)
+      if (s.code >= 0) place(s);
+  }
+
+  void place(const Slot& s) {
+    size_t mask = slots.size() - 1;
+    size_t i = s.hash & mask;
+    while (slots[i].code >= 0) i = (i + 1) & mask;
+    slots[i] = s;
+  }
+
+  int32_t intern(const uint8_t* s, uint32_t n) {
+    uint64_t h = fnv1a(s, n);
+    size_t mask = slots.size() - 1;
+    size_t i = h & mask;
+    while (slots[i].code >= 0) {
+      if (slots[i].hash == h && slots[i].len == n &&
+          memcmp(arena.data() + slots[i].off, s, n) == 0)
+        return slots[i].code;
+      i = (i + 1) & mask;
+    }
+    Slot ns;
+    ns.hash = h;
+    ns.off = arena.size();
+    ns.len = n;
+    ns.code = static_cast<int32_t>(count++);
+    arena.append(reinterpret_cast<const char*>(s), n);
+    by_code.emplace_back(ns.off, n);
+    slots[i] = ns;
+    if (count * 10 > slots.size() * 7) grow();
+    return ns.code;
+  }
+
+  // Serialize string table as concat of (u32 len + bytes) in code order.
+  uint8_t* table(uint64_t* out_len) const {
+    uint64_t total = 0;
+    for (auto& [off, len] : by_code) total += 4 + len;
+    auto* out = static_cast<uint8_t*>(malloc(total ? total : 1));
+    uint8_t* q = out;
+    for (auto& [off, len] : by_code) {
+      memcpy(q, &len, 4);
+      q += 4;
+      memcpy(q, arena.data() + off, len);
+      q += len;
+    }
+    *out_len = total;
+    return out;
+  }
+};
+
+// Extract a numeric value for key at the TOP level of a JSON object.
+// Walks the object tracking depth and string escapes — nested objects can't
+// shadow, and quoted occurrences inside values are skipped. Accepts numbers
+// and numeric strings ("4.5"); booleans map to 1/0. Returns false if absent.
+bool json_top_level_number(const uint8_t* js, uint32_t n, const char* key,
+                           size_t key_len, double* out) {
+  uint32_t i = 0;
+  while (i < n && js[i] != '{') i++;
+  if (i >= n) return false;
+  i++;
+  int depth = 1;
+  while (i < n && depth > 0) {
+    uint8_t c = js[i];
+    if (c == '"') {
+      // string start: key candidate iff depth==1 and followed by ':'
+      uint32_t start = ++i;
+      while (i < n) {
+        if (js[i] == '\\') i += 2;
+        else if (js[i] == '"') break;
+        else i++;
+      }
+      if (i >= n) return false;
+      uint32_t slen = i - start;
+      i++;  // past closing quote
+      uint32_t j = i;
+      while (j < n && (js[j] == ' ' || js[j] == '\t' || js[j] == '\n')) j++;
+      bool is_key = j < n && js[j] == ':';
+      if (is_key && depth == 1 && slen == key_len &&
+          memcmp(js + start, key, key_len) == 0) {
+        j++;
+        while (j < n && (js[j] == ' ' || js[j] == '\t' || js[j] == '\n')) j++;
+        if (j >= n) return false;
+        if (js[j] == '"') j++;  // numeric string
+        if (js[j] == 't') { *out = 1.0; return true; }
+        if (js[j] == 'f') { *out = 0.0; return true; }
+        char buf[64];
+        uint32_t k = 0;
+        while (j < n && k < 63 &&
+               (isdigit(js[j]) || js[j] == '-' || js[j] == '+' ||
+                js[j] == '.' || js[j] == 'e' || js[j] == 'E'))
+          buf[k++] = js[j++];
+        if (k == 0) return false;
+        buf[k] = 0;
+        char* endp = nullptr;
+        double v = strtod(buf, &endp);
+        if (endp == buf) return false;
+        *out = v;
+        return true;
+      }
+      if (is_key) i = j + 1;
+    } else if (c == '{' || c == '[') {
+      depth++;
+      i++;
+    } else if (c == '}' || c == ']') {
+      depth--;
+      i++;
+    } else {
+      i++;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* el_open(const char* path, int create) {
+  int flags = O_RDWR | (create ? O_CREAT : 0);
+  int fd = open(path, flags, 0644);
+  if (fd < 0) return nullptr;
+  auto* lg = new Log;
+  lg->fd = fd;
+  lg->path = path;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    delete lg;
+    return nullptr;
+  }
+  if (st.st_size == 0) {
+    if (pwrite(fd, kMagic, 8, 0) != 8) {
+      close(fd);
+      delete lg;
+      return nullptr;
+    }
+    lg->end = kHeaderSize;
+    return lg;
+  }
+  char magic[8];
+  if (st.st_size < 8 || pread(fd, magic, 8, 0) != 8 ||
+      memcmp(magic, kMagic, 8) != 0) {
+    close(fd);
+    delete lg;
+    return nullptr;
+  }
+  // length-walk to the last whole record (detects torn tail writes)
+  uint64_t pos = kHeaderSize;
+  uint64_t size = static_cast<uint64_t>(st.st_size);
+  while (pos + 8 <= size) {
+    uint8_t hdr[8];
+    if (pread(fd, hdr, 8, pos) != 8) break;
+    uint32_t len = load_le<uint32_t>(hdr);
+    if (pos + 8 + len > size) break;
+    pos += 8 + len;
+  }
+  lg->end = pos;
+  return lg;
+}
+
+void el_close(void* h) {
+  auto* lg = static_cast<Log*>(h);
+  if (!lg) return;
+  close(lg->fd);
+  delete lg;
+}
+
+int el_flush(void* h) {
+  auto* lg = static_cast<Log*>(h);
+  return fdatasync(lg->fd) == 0 ? 0 : -1;
+}
+
+// Append one payload; returns record offset, or -1.
+int64_t el_append(void* h, const uint8_t* payload, uint32_t len) {
+  auto* lg = static_cast<Log*>(h);
+  std::vector<uint8_t> frame(8 + len);
+  uint32_t crc = crc32_of(payload, len);
+  memcpy(frame.data(), &len, 4);
+  memcpy(frame.data() + 4, &crc, 4);
+  memcpy(frame.data() + 8, payload, len);
+  ssize_t w = pwrite(lg->fd, frame.data(), frame.size(), lg->end);
+  if (w != static_cast<ssize_t>(frame.size())) return -1;
+  int64_t off = static_cast<int64_t>(lg->end);
+  lg->end += frame.size();
+  return off;
+}
+
+void el_stats(void* h, uint64_t* end, uint64_t* n_records) {
+  auto* lg = static_cast<Log*>(h);
+  *end = lg->end;
+  uint64_t n = 0;
+  MapView mv;
+  if (map_log(lg, &mv) && mv.base)
+    for_each_record(mv.base, lg->end, [&](const RecView&, uint64_t) {
+      n++;
+      return true;
+    });
+  *n_records = n;
+}
+
+uint64_t el_hash(const uint8_t* s, uint32_t len) { return fnv1a(s, len); }
+
+void el_free(void* p) { free(p); }
+
+// Scan matching records; returns count, fills *out_offsets (malloc'd, free
+// with el_free) with file offsets of matches in file order. -1 on error.
+int64_t el_scan(void* h, uint32_t flags, int64_t start_ms, int64_t until_ms,
+                uint64_t h_etype, uint64_t h_eid, const uint64_t* h_events,
+                uint32_t n_events, uint64_t h_tetype, uint64_t h_teid,
+                uint64_t h_eventid, const uint8_t* tomb_blob,
+                uint32_t tomb_len, uint64_t** out_offsets) {
+  auto* lg = static_cast<Log*>(h);
+  Filter f{flags,    start_ms, until_ms, h_etype,  h_eid,
+           h_tetype, h_teid,   h_events, n_events, h_eventid};
+  Tombstones tombs = parse_tombstones(tomb_blob, tomb_len);
+  std::vector<uint64_t> offs;
+  MapView mv;
+  if (!map_log(lg, &mv)) return -1;
+  if (mv.base)
+    for_each_record(mv.base, lg->end, [&](const RecView& r, uint64_t pos) {
+      if (matches(r, f) &&
+          (tombs.ids.empty() || !tombs.contains(r.event_id, r.l_event_id)))
+        offs.push_back(pos);
+      return true;
+    });
+  auto* out = static_cast<uint64_t*>(
+      malloc(offs.empty() ? 1 : offs.size() * sizeof(uint64_t)));
+  memcpy(out, offs.data(), offs.size() * sizeof(uint64_t));
+  *out_offsets = out;
+  return static_cast<int64_t>(offs.size());
+}
+
+// Copy the payload at `offset` into a malloc'd buffer (free with el_free).
+int el_read(void* h, uint64_t offset, uint8_t** out, uint32_t* out_len) {
+  auto* lg = static_cast<Log*>(h);
+  if (offset + 8 > lg->end) return -1;
+  uint8_t hdr[8];
+  if (pread(lg->fd, hdr, 8, offset) != 8) return -1;
+  uint32_t len = load_le<uint32_t>(hdr);
+  uint32_t crc = load_le<uint32_t>(hdr + 4);
+  if (offset + 8 + len > lg->end) return -1;
+  auto* buf = static_cast<uint8_t*>(malloc(len ? len : 1));
+  if (pread(lg->fd, buf, len, offset + 8) != static_cast<ssize_t>(len) ||
+      crc32_of(buf, len) != crc) {
+    free(buf);
+    return -1;
+  }
+  *out = buf;
+  *out_len = len;
+  return 0;
+}
+
+// Training fast path: filter + dictionary-encode (entity_id, target_entity_id)
+// + numeric value from properties[value_key] (default_value when absent) +
+// dedup, in one sweep. dedup: 0 = none, 1 = last-by-event-time, 2 = sum.
+// h_value_event != 0 restricts key extraction to records with that event
+// name (others take default_value) — the recommendation template's
+// "rate events carry ratings, buy events are implicit" rule.
+// Records without a target entity are skipped (interactions need both ends).
+// Outputs are malloc'd; free each with el_free. Returns row count or -1.
+int64_t el_columnarize(
+    void* h, uint32_t flags, int64_t start_ms, int64_t until_ms,
+    uint64_t h_etype, const uint64_t* h_events, uint32_t n_events,
+    uint64_t h_tetype, const char* value_key, float default_value,
+    uint64_t h_value_event,
+    const uint8_t* tomb_blob, uint32_t tomb_len, int dedup,
+    uint32_t** user_codes, uint32_t** item_codes, float** values,
+    int64_t** times, uint8_t** user_table, uint64_t* user_table_len,
+    uint32_t* n_users, uint8_t** item_table, uint64_t* item_table_len,
+    uint32_t* n_items) {
+  auto* lg = static_cast<Log*>(h);
+  Filter f;
+  f.flags = flags;
+  f.start_ms = start_ms;
+  f.until_ms = until_ms;
+  f.h_etype = h_etype;
+  f.h_events = h_events;
+  f.n_events = n_events;
+  f.h_tetype = h_tetype;
+  Tombstones tombs = parse_tombstones(tomb_blob, tomb_len);
+  size_t klen = value_key ? strlen(value_key) : 0;
+
+  StringDict users, items;
+  std::vector<uint32_t> ucodes, icodes;
+  std::vector<float> vals;
+  std::vector<int64_t> ts;
+  // dedup table keyed by (user_code, item_code)
+  struct Cell {
+    uint64_t key;
+    int32_t row;  // into output vectors
+    int64_t best_t;
+    bool used = false;
+  };
+  std::vector<Cell> cells(dedup ? 4096 : 0);
+  size_t ncells = 0;
+
+  auto cell_find = [&](uint64_t key) -> Cell* {
+    size_t mask = cells.size() - 1;
+    size_t i = (key * 0x9E3779B97F4A7C15ull) & mask;
+    while (cells[i].used && cells[i].key != key) i = (i + 1) & mask;
+    return &cells[i];
+  };
+  auto cell_grow = [&]() {
+    std::vector<Cell> old;
+    old.swap(cells);
+    cells.assign(old.size() * 2, Cell{});
+    for (auto& c : old)
+      if (c.used) *cell_find(c.key) = c;
+  };
+
+  MapView mv;
+  if (!map_log(lg, &mv)) return -1;
+  if (mv.base)
+    for_each_record(mv.base, lg->end, [&](const RecView& r, uint64_t) {
+      if (!(r.flags & 1)) return true;  // no target entity
+      if (!matches(r, f)) return true;
+      if (!tombs.ids.empty() && tombs.contains(r.event_id, r.l_event_id))
+        return true;
+      double v = default_value;
+      if (klen && (!h_value_event || r.h_event == h_value_event))
+        json_top_level_number(r.props, r.l_props, value_key, klen, &v);
+      uint32_t uc = static_cast<uint32_t>(users.intern(r.eid, r.l_eid));
+      uint32_t ic = static_cast<uint32_t>(items.intern(r.teid, r.l_teid));
+      if (!dedup) {
+        ucodes.push_back(uc);
+        icodes.push_back(ic);
+        vals.push_back(static_cast<float>(v));
+        ts.push_back(r.time_ms);
+        return true;
+      }
+      uint64_t key = (static_cast<uint64_t>(uc) << 32) | ic;
+      Cell* c = cell_find(key);
+      if (!c->used) {
+        c->used = true;
+        c->key = key;
+        c->row = static_cast<int32_t>(ucodes.size());
+        c->best_t = r.time_ms;
+        ucodes.push_back(uc);
+        icodes.push_back(ic);
+        vals.push_back(static_cast<float>(v));
+        ts.push_back(r.time_ms);
+        if (++ncells * 10 > cells.size() * 7) cell_grow();
+      } else if (dedup == 2) {  // sum
+        vals[c->row] += static_cast<float>(v);
+        if (r.time_ms > ts[c->row]) ts[c->row] = r.time_ms;
+      } else if (r.time_ms >= c->best_t) {  // last-by-event-time
+        c->best_t = r.time_ms;
+        vals[c->row] = static_cast<float>(v);
+        ts[c->row] = r.time_ms;
+      }
+      return true;
+    });
+
+  size_t n = ucodes.size();
+  auto copy_out = [](auto& vec, auto** out) {
+    using T = typename std::remove_reference<decltype(vec)>::type::value_type;
+    *out = static_cast<T*>(malloc(vec.empty() ? 1 : vec.size() * sizeof(T)));
+    memcpy(*out, vec.data(), vec.size() * sizeof(T));
+  };
+  copy_out(ucodes, user_codes);
+  copy_out(icodes, item_codes);
+  copy_out(vals, values);
+  copy_out(ts, times);
+  *user_table = users.table(user_table_len);
+  *item_table = items.table(item_table_len);
+  *n_users = static_cast<uint32_t>(users.count);
+  *n_items = static_cast<uint32_t>(items.count);
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

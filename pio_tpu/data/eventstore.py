@@ -94,6 +94,71 @@ class EventStore:
             required=required,
         )
 
+    def interactions(
+        self,
+        app_name: str,
+        channel_name: str | None = None,
+        entity_type: str | None = "user",
+        target_entity_type=...,
+        event_names: Sequence[str] | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        value_key: str | None = "rating",
+        default_value: float = 1.0,
+        value_event: str | None = None,
+        dedup: str = "last",
+    ) -> Interactions:
+        """Training read straight to COO interactions.
+
+        When the events DAO is the native log backend this is one C++ sweep
+        (filter + dict-encode + value extract + dedup, no per-event Python);
+        otherwise it falls back to find + to_interactions. `value_key` reads
+        a numeric property (None = always default_value); `value_event`
+        restricts that read to one event name (others take default_value) —
+        the reference recommendation template's rate-vs-buy rule.
+        """
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        dao = self._dao()
+        if hasattr(dao, "columnarize"):
+            cols = dao.columnarize(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                value_key=value_key,
+                default_value=default_value,
+                dedup=dedup,
+                value_event=value_event,
+            )
+            return Interactions(
+                user_idx=cols.user_idx.astype(np.int32),
+                item_idx=cols.item_idx.astype(np.int32),
+                values=cols.values,
+                users=EntityIdIndex(cols.users),
+                items=EntityIdIndex(cols.items),
+            )
+
+        def value_fn(e: Event) -> float:
+            if value_key is not None and (
+                value_event is None or e.event == value_event
+            ):
+                return float(e.properties.get_or_else(value_key, default_value))
+            return default_value
+
+        events = self.find(
+            app_name=app_name,
+            channel_name=channel_name,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names,
+        )
+        return to_interactions(events, value_fn=value_fn, dedup=dedup)
+
     def find_by_entity(
         self,
         app_name: str,

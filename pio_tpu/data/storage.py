@@ -86,6 +86,9 @@ _BACKEND_REGISTRY: dict[str, str] = {
     "sqlite": "pio_tpu.data.backends.sqlite:SqliteBackend",
     "jdbc": "pio_tpu.data.backends.sqlite:SqliteBackend",  # operational alias
     "localfs": "pio_tpu.data.backends.localfs:LocalFSBackend",
+    # native C++ append-only log (the HBase-analog event store)
+    "eventlog": "pio_tpu.data.backends.eventlog:EventLogBackend",
+    "hbase": "pio_tpu.data.backends.eventlog:EventLogBackend",  # operational alias
 }
 
 

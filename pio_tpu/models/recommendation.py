@@ -25,7 +25,7 @@ from pio_tpu.controller.base import (
 )
 from pio_tpu.controller.engine import Engine, EngineFactory
 from pio_tpu.data.bimap import EntityIdIndex
-from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.data.eventstore import Interactions
 from pio_tpu.ops import als
 
 
@@ -51,20 +51,21 @@ class RecommendationDataSource(DataSource):
 
     def _read(self, ctx) -> Interactions:
         p = self.params
-        events = ctx.event_store.find(
+        # EventStore.interactions: one native C++ sweep on the eventlog
+        # backend, find + to_interactions on the others — same semantics
+        # (rate events carry properties.rating, everything else maps to the
+        # fixed implicit value).
+        return ctx.event_store.interactions(
             app_name=p.app_name,
             channel_name=p.channel_name,
             entity_type="user",
             target_entity_type="item",
             event_names=list(p.event_names),
+            value_key="rating",
+            default_value=p.implicit_value,
+            value_event=p.rating_event,
+            dedup="last",
         )
-
-        def value_fn(e):
-            if e.event == p.rating_event:
-                return float(e.properties.get_or_else("rating", p.implicit_value))
-            return p.implicit_value
-
-        return to_interactions(events, value_fn=value_fn)
 
     def read_training(self, ctx) -> Interactions:
         return self._read(ctx)

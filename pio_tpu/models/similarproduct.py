@@ -28,7 +28,7 @@ from pio_tpu.controller.base import (
 )
 from pio_tpu.controller.engine import Engine, EngineFactory
 from pio_tpu.data.bimap import EntityIdIndex
-from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.data.eventstore import Interactions
 from pio_tpu.models.filtering import (
     candidate_ids,
     invert_categories,
@@ -61,13 +61,15 @@ class SimilarProductDataSource(DataSource):
 
     def read_training(self, ctx) -> SimilarProductData:
         p = self.params
-        events = ctx.event_store.find(
+        inter = ctx.event_store.interactions(
             app_name=p.app_name,
             entity_type="user",
             target_entity_type="item",
             event_names=list(p.event_names),
+            value_key=None,
+            default_value=1.0,
+            dedup="sum",
         )
-        inter = to_interactions(events, value_fn=lambda e: 1.0, dedup="sum")
         item_props = ctx.event_store.aggregate_properties(
             app_name=p.app_name, entity_type="item"
         )

@@ -38,7 +38,7 @@ from pio_tpu.controller.base import (
     Params,
 )
 from pio_tpu.controller.engine import Engine, EngineFactory
-from pio_tpu.data.eventstore import Interactions, to_interactions
+from pio_tpu.data.eventstore import Interactions
 from pio_tpu.ops.similarity import cosine_topk
 from pio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
@@ -226,13 +226,15 @@ class TwoTowerDataSource(DataSource):
         self.params = params
 
     def read_training(self, ctx) -> Interactions:
-        events = ctx.event_store.find(
+        return ctx.event_store.interactions(
             app_name=self.params.app_name,
             entity_type="user",
             target_entity_type="item",
             event_names=list(self.params.event_names),
+            value_key=None,
+            default_value=1.0,
+            dedup="sum",
         )
-        return to_interactions(events, value_fn=lambda e: 1.0, dedup="sum")
 
 
 @jax.tree_util.register_pytree_node_class
