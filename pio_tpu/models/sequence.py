@@ -68,6 +68,9 @@ class SequenceParams(Params):
     seed: int = 0
     attention: str = "auto"    # "auto" | "reference" | "ring"
     unseen_only: bool = True   # serve-time: drop items already in history
+    # serve-time live history read (empty app_name = training snapshot only)
+    app_name: str = ""
+    event_names: tuple[str, ...] = ("view", "buy")
 
 
 class Block(nn.Module):
@@ -169,11 +172,18 @@ class SequenceData:
         assert self.seqs.ndim == 2 and self.seqs.shape[0] > 0
 
 
+POS_HEADROOM = 16
+
+
 def make_encoder(n_items: int, p: SequenceParams) -> SeqEncoder:
-    # +16 position headroom: the train step right-pads the sequence so it
-    # splits evenly over the seq mesh axis (up to n_seq-1 extra positions)
+    # Position-table headroom: the train step right-pads the sequence so it
+    # splits evenly over the seq mesh axis (up to n_seq-1 extra positions).
+    # The table size must be a pure function of the params — serving
+    # re-creates the encoder without knowing the training mesh — so the
+    # headroom is fixed and train_sequence_model validates the pad fits.
     return SeqEncoder(
-        vocab=n_items + 1, max_len=p.max_len + 16, embed_dim=p.embed_dim,
+        vocab=n_items + 1, max_len=p.max_len + POS_HEADROOM,
+        embed_dim=p.embed_dim,
         num_heads=p.num_heads, num_layers=p.num_layers, ffn_dim=p.ffn_dim,
     )
 
@@ -183,7 +193,7 @@ def train_sequence_model(
 ):
     """SPMD train loop: dp x sp shard_map step (see module docstring).
 
-    Returns (params, encoder)."""
+    Returns (params, encoder, final loss)."""
     encoder = make_encoder(len(data.items), p)
     optimizer = optax.adam(p.learning_rate)
 
@@ -217,6 +227,12 @@ def train_sequence_model(
         # sequence length must split evenly over the seq axis
         if s_global % n_seq:
             pad = n_seq - s_global % n_seq
+            if s_global + pad > p.max_len + POS_HEADROOM:
+                raise ValueError(
+                    f"seq-axis padding ({pad}) overflows the position table "
+                    f"({p.max_len} + {POS_HEADROOM} headroom); raise max_len "
+                    f"or use a smaller seq mesh axis (n_seq={n_seq})"
+                )
             inp_all = np.pad(inp_all, ((0, 0), (0, pad)))
             tgt_all = np.pad(tgt_all, ((0, 0), (0, pad)))
             s_global += pad
@@ -337,11 +353,13 @@ class SequenceModel:
     config: SequenceParams
 
     def tree_flatten(self):
-        return (self.params,), (self.seqs, self.users, self.items, self.config)
+        # seqs is a leaf (arrays in aux_data would make the treedef
+        # unhashable and break jit/device_put over the model)
+        return (self.params, self.seqs), (self.users, self.items, self.config)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], *aux)
+        return cls(children[0], children[1], *aux)
 
 
 class SequenceAlgorithm(PAlgorithm):
@@ -349,6 +367,7 @@ class SequenceAlgorithm(PAlgorithm):
 
     def __init__(self, params: SequenceParams = SequenceParams()):
         self.params = params
+        self._event_store = None
 
     def train(self, ctx, data: SequenceData) -> SequenceModel:
         data.sanity_check()
@@ -358,32 +377,75 @@ class SequenceAlgorithm(PAlgorithm):
             else None
         )
         params, _, _ = train_sequence_model(data, self.params, mesh)
+        if ctx is not None:
+            self._event_store = getattr(ctx, "event_store", None)
         return SequenceModel(
             params=params, seqs=data.seqs, users=data.users,
             items=data.items, config=self.params,
         )
 
+    def prepare_model_for_deploy(self, ctx, model: SequenceModel):
+        self._event_store = ctx.event_store
+        return model
+
+    def _live_history(self, model: SequenceModel, user: str):
+        """The user's recent item sequence from a live event-store read
+        (the ecommerce template's serve-time pattern) — catches events that
+        happened after training and users unseen at training time. Returns
+        a PAD-left (max_len,) int32 row, or None when unavailable."""
+        p = model.config
+        if not p.app_name or self._event_store is None:
+            return None
+        try:
+            events = self._event_store.find_by_entity(
+                app_name=p.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(p.event_names),
+                target_entity_type="item",
+                limit=p.max_len,
+                latest=True,
+            )
+        except Exception:  # noqa: BLE001 - storage outage must not kill serving
+            return None
+        seq = [
+            model.items.index_of(e.target_entity_id) + 1
+            for e in reversed(events)  # newest-first -> time order
+            if e.target_entity_id in model.items
+        ][-p.max_len:]
+        if not seq:
+            return None
+        return np.pad(
+            np.asarray(seq, np.int32), (p.max_len - len(seq), 0)
+        )
+
     def _score_last(self, model: SequenceModel, seq_row: np.ndarray):
-        """Forward one (1, S) sequence; return next-item scores (vocab,)
-        from the tied head at the last position. Serving path: Pallas flash
-        attention on TPU, reference on CPU."""
+        """Forward the last max_len-1 items of one history row; return
+        next-item scores (vocab,) from the tied head at the final position.
+        Training consumes inputs of length max_len-1 (positions
+        0..max_len-2), so serving must too — feeding all max_len items
+        would read the never-trained last position row. Serving path:
+        Pallas flash attention on TPU, reference on CPU."""
         p = model.config
         encoder = make_encoder(len(model.items), p)
         on_cpu = jax.devices()[0].platform == "cpu"
         attn = partial(
             attention_reference if on_cpu else flash_attention, causal=True,
         )
+        inp = seq_row[-(p.max_len - 1):]
         _, logits = encoder.apply(
-            {"params": model.params}, jnp.asarray(seq_row[None, :]), attn,
+            {"params": model.params}, jnp.asarray(inp[None, :]), attn,
         )
         return logits[0, -1]
 
     def predict(self, model: SequenceModel, query: dict) -> dict:
         user = query.get("user", "")
         num = int(query.get("num", 10))
-        if user not in model.users:
-            return {"itemScores": []}
-        row = model.seqs[model.users.index_of(user)]
+        row = self._live_history(model, user)
+        if row is None:
+            if user not in model.users:
+                return {"itemScores": []}
+            row = model.seqs[model.users.index_of(user)]
         scores = np.array(self._score_last(model, row))  # writable copy
         scores[PAD] = -np.inf
         seen = (

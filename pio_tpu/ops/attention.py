@@ -57,9 +57,10 @@ def attention_reference(q, k, v, causal: bool = False, scale: float | None = Non
 # ---------------------------------------------------------------------------
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_block_offset: bool):
+                  scale: float, q_block_offset: bool, kv_len: int | None):
     """One (batch*head, q-block) program: stream k/v blocks from VMEM,
-    maintain online-softmax state (m, l) as values."""
+    maintain online-softmax state (m, l) as values. kv_len masks
+    right-padded key positions (None = no key padding)."""
     q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
     bq, d = q.shape
     sk = k_ref.shape[1]
@@ -74,26 +75,40 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = q @ k_blk.T                                # (bq, bk) on the MXU
+        keep = None
+        k_pos = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+            + j * block_k
+        )
         if causal:
-            k_pos = (
-                jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-                + j * block_k
-            )
             keep = q_pos >= k_pos                      # (bq, bk)
+        if kv_len is not None:
+            pad_keep = k_pos < kv_len
+            keep = pad_keep if keep is None else keep & pad_keep
+        if keep is not None:
             s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if keep is not None:
             p = jnp.where(keep, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         o_new = o * alpha + p @ v_blk
         return o_new, m_new, l_new
 
+    if causal and q_block_offset:
+        # skip k blocks entirely above the diagonal: this q block's highest
+        # position is (pid+1)*bq - 1, so blocks starting past it are fully
+        # masked and contribute nothing
+        hi = jnp.minimum(
+            nk, ((pl.program_id(1) + 1) * bq + block_k - 1) // block_k
+        )
+    else:
+        hi = nk
     o0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, nk, body, (o0, m0, l0))
+    o, m, l = jax.lax.fori_loop(0, hi, body, (o0, m0, l0))
     o = o / jnp.maximum(l, 1e-30)
     o_ref[0] = o.astype(o_ref.dtype)
 
@@ -114,9 +129,10 @@ def flash_attention(
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D) -> (B, Sq, H, D). Sequences are
     padded to the block size internally; padded key positions are excluded
-    via the k-length mask only when padding exists. `interpret=True` runs
-    the kernel in interpreter mode (used on CPU in tests; auto-detected
-    when None).
+    in-kernel via a key-length mask (applied only when padding exists, for
+    both the causal and non-causal paths). Causal programs skip k blocks
+    entirely above the diagonal. `interpret=True` runs the kernel in
+    interpreter mode (used on CPU in tests; auto-detected when None).
     """
     from jax.experimental import pallas as pl
 
@@ -129,15 +145,6 @@ def flash_attention(
     block_q = min(block_q, max(sq, 8))
     block_k = min(block_k, max(sk, 8))
     pad_q, pad_k = _pad_len(sq, block_q), _pad_len(sk, block_k)
-    if pad_k and not causal:
-        # non-causal ragged keys: padded positions would contribute
-        # exp(0)=1 softmax mass, so they need a length mask; these shapes
-        # are serving-time small, so use the masked reference path. (On the
-        # causal path padded keys sit at positions >= sq and are already
-        # masked for every real query row.)
-        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
-        return _flash_padded_fallback(q, k, v, sk, scale)
     if pad_q:
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
     if pad_k:
@@ -154,7 +161,7 @@ def flash_attention(
 
     kernel = partial(
         _flash_kernel, block_k=block_k, causal=causal, scale=scale,
-        q_block_offset=True,
+        q_block_offset=True, kv_len=sk if pad_k else None,
     )
     out = pl.pallas_call(
         kernel,
@@ -170,17 +177,6 @@ def flash_attention(
     )(qt, kt, vt)
     out = out.reshape(b, h, sqp, d).transpose(0, 2, 1, 3)
     return out[:, :sq]
-
-
-def _flash_padded_fallback(q, k, v, real_sk: int, scale: float):
-    """Non-causal attention with right-padded keys: mask via the reference
-    path (the shapes here are serving-time small)."""
-    sq = q.shape[1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    keep = (jnp.arange(k.shape[1]) < real_sk)[None, None, None, :]
-    s = jnp.where(keep, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)[:, :sq]
 
 
 # ---------------------------------------------------------------------------

@@ -54,6 +54,27 @@ def test_flash_ragged_noncausal(qkv):
     np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
+def test_flash_ragged_causal_short_keys(qkv):
+    # sq > sk with key padding: query rows past sk must NOT attend the
+    # padded zero-keys (regression: the causal path used to skip the
+    # key-length mask)
+    q, k, v = qkv
+    out = flash_attention(
+        q[:, :50], k[:, :37], v[:, :37], causal=True, block_q=16, block_k=16
+    )
+    ref = attention_reference(q[:, :50], k[:, :37], v[:, :37], causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_flash_ragged_causal_long_keys(qkv):
+    q, k, v = qkv
+    out = flash_attention(
+        q[:, :23], k[:, :50], v[:, :50], causal=True, block_q=16, block_k=16
+    )
+    ref = attention_reference(q[:, :23], k[:, :50], v[:, :50], causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
 def test_flash_causal_fully_masked_rows_are_finite():
     # a single-query block whose causal row sees only itself must not NaN
     rng = np.random.default_rng(1)

@@ -1,6 +1,7 @@
 """Sequence-recommendation template tests: sequence building from events,
 SPMD (dp x sp ring-attention) training equivalence, and DASE serving."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -97,3 +98,46 @@ def test_serving_respects_blacklist_and_unknown_user(trained):
     out = algo.predict(model, {"user": "u0", "num": 3, "blackList": ["i8"]})
     assert all(s["item"] != "i8" for s in out["itemScores"])
     assert algo.predict(model, {"user": "nobody"}) == {"itemScores": []}
+
+
+def test_model_treedef_is_hashable(trained):
+    """Arrays must live in pytree children, not aux (device_put/jit over the
+    model would otherwise raise on the unhashable treedef)."""
+    model, _ = trained
+    leaves, treedef = jax.tree_util.tree_flatten(model)
+    assert hash(treedef) == hash(jax.tree_util.tree_flatten(model)[1])
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert np.array_equal(rebuilt.seqs, model.seqs)
+
+
+def test_serving_reads_live_history(trained):
+    """predict() must prefer a live event-store history over the training
+    snapshot: a user unseen at training time with live events gets real
+    recommendations (the documented cold-start behavior)."""
+    from dataclasses import replace as dc_replace
+
+    model, _ = trained
+    cfg = dc_replace(model.config, app_name="liveapp")
+    live_model = SequenceModel(
+        params=model.params, seqs=model.seqs, users=model.users,
+        items=model.items, config=cfg,
+    )
+
+    class FakeStore:
+        def find_by_entity(self, app_name, entity_type, entity_id, **kw):
+            assert app_name == "liveapp" and entity_id == "fresh-user"
+            # newest-first (latest=True contract): history i4,i3,...,i0
+            return [_Ev("fresh-user", f"i{t}", t) for t in reversed(range(5))]
+
+    algo = SequenceAlgorithm(cfg)
+    algo._event_store = FakeStore()
+    out = algo.predict(live_model, {"user": "fresh-user", "num": 3})
+    # i0..i4 in time order -> cycle's next item is i5
+    assert out["itemScores"][0]["item"] == "i5"
+    # and the store outage fallback: broken store + unknown user -> empty
+    class Broken:
+        def find_by_entity(self, *a, **kw):
+            raise RuntimeError("db down")
+
+    algo._event_store = Broken()
+    assert algo.predict(live_model, {"user": "nobody"}) == {"itemScores": []}
