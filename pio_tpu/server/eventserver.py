@@ -40,6 +40,8 @@ class EventServerConfig:
     ip: str = "0.0.0.0"
     port: int = 7070
     stats: bool = False
+    certfile: str | None = None   # TLS cert (PEM); with keyfile -> HTTPS
+    keyfile: str | None = None
 
 
 class AuthError(Exception):
@@ -290,6 +292,11 @@ def create_event_server(
     config: EventServerConfig | None = None,
     plugin_context: PluginContext | None = None,
 ) -> HttpServer:
+    from pio_tpu.server.security import server_ssl_context
+
     config = config or EventServerConfig()
     app = build_event_app(storage, config, plugin_context)
-    return HttpServer(app, host=config.ip, port=config.port)
+    return HttpServer(
+        app, host=config.ip, port=config.port,
+        ssl_context=server_ssl_context(config.certfile, config.keyfile),
+    )

@@ -80,9 +80,15 @@ class HttpApp:
 
 
 class HttpServer:
-    """Threaded HTTP server wrapping an HttpApp; bind/serve/shutdown."""
+    """Threaded HTTP server wrapping an HttpApp; bind/serve/shutdown.
 
-    def __init__(self, app: HttpApp, host: str = "127.0.0.1", port: int = 0):
+    Pass `ssl_context` (see server/security.py) to serve HTTPS — the
+    counterpart of the reference deploy server's JKS-keystore TLS
+    (common/.../SSLConfiguration.scala:10-60, CreateServer.scala:316-321).
+    """
+
+    def __init__(self, app: HttpApp, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self.app = app
         outer = self
 
@@ -131,6 +137,11 @@ class HttpServer:
             do_GET = do_POST = do_DELETE = do_PUT = _handle
 
         self._server = ThreadingHTTPServer((host, port), _Handler)
+        if ssl_context is not None:
+            self._server.socket = ssl_context.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self.tls = ssl_context is not None
         self.host = host
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None

@@ -70,6 +70,12 @@ def build_admin_app(storage: Storage | None = None) -> HttpApp:
 
 
 def create_admin_server(
-    storage: Storage | None = None, ip: str = "127.0.0.1", port: int = 7071
+    storage: Storage | None = None, ip: str = "127.0.0.1", port: int = 7071,
+    certfile: str | None = None, keyfile: str | None = None,
 ) -> HttpServer:
-    return HttpServer(build_admin_app(storage), host=ip, port=port)
+    from pio_tpu.server.security import server_ssl_context
+
+    return HttpServer(
+        build_admin_app(storage), host=ip, port=port,
+        ssl_context=server_ssl_context(certfile, keyfile),
+    )

@@ -297,13 +297,15 @@ def cmd_deploy(args) -> int:
         feedback_app_name=args.feedback_app or "",
         server_key=args.server_key or os.environ.get("PIO_SERVER_KEY", ""),
         warm_query=json.loads(args.warm_query) if args.warm_query else None,
+        certfile=args.cert, keyfile=args.key,
     )
     http, qs = create_query_server(
         engine, ep, storage, config, ctx=ctx,
         instance_id=args.engine_instance_id,
     )
+    scheme = "https" if http.tls else "http"
     print(f"Engine instance {qs.instance.id} deployed on "
-          f"http://{args.ip}:{http.port}")
+          f"{scheme}://{args.ip}:{http.port}")
     import threading
 
     def watch_stop():
@@ -339,9 +341,11 @@ def cmd_eventserver(args) -> int:
 
     srv = create_event_server(
         get_storage(),
-        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats),
+        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats,
+                          certfile=args.cert, keyfile=args.key),
     )
-    print(f"Event Server on http://{args.ip}:{srv.port}")
+    scheme = "https" if srv.tls else "http"
+    print(f"Event Server on {scheme}://{args.ip}:{srv.port}")
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -559,6 +563,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--server-key")
     x.add_argument("--warm-query")
     x.add_argument("--no-mesh", action="store_true")
+    x.add_argument("--cert", help="TLS certificate (PEM) -> serve HTTPS")
+    x.add_argument("--key", help="TLS private key (PEM)")
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")
@@ -571,6 +577,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ip", default="0.0.0.0")
     x.add_argument("--port", type=int, default=7070)
     x.add_argument("--stats", action="store_true")
+    x.add_argument("--cert", help="TLS certificate (PEM) -> serve HTTPS")
+    x.add_argument("--key", help="TLS private key (PEM)")
     x.set_defaults(fn=cmd_eventserver)
 
     x = sub.add_parser("adminserver")

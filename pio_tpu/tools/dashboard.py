@@ -67,6 +67,12 @@ def build_dashboard_app(storage: Storage | None = None) -> HttpApp:
 
 
 def create_dashboard(
-    storage: Storage | None = None, ip: str = "127.0.0.1", port: int = 9000
+    storage: Storage | None = None, ip: str = "127.0.0.1", port: int = 9000,
+    certfile: str | None = None, keyfile: str | None = None,
 ) -> HttpServer:
-    return HttpServer(build_dashboard_app(storage), host=ip, port=port)
+    from pio_tpu.server.security import server_ssl_context
+
+    return HttpServer(
+        build_dashboard_app(storage), host=ip, port=port,
+        ssl_context=server_ssl_context(certfile, keyfile),
+    )

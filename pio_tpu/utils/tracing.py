@@ -1,0 +1,162 @@
+"""Tracing and profiling: per-request latency histograms + device profiler.
+
+The reference's only serving observability is a rolling average in the
+server actor (CreateServer.scala:420-422,605-612) and hourly ingest counters
+(api/Stats.scala); SURVEY.md §5 calls for real tracing in the TPU build.
+This module provides:
+
+ * `LatencyHistogram` — all-time count/avg/last plus windowed quantiles
+   (p50/p90/p95/p99) over a bounded reservoir of recent samples;
+ * `Tracer` — named span histograms (`with tracer.span("predict"): ...`),
+   one histogram per pipeline stage, thread-safe, cheap enough for the
+   serve hot path (a monotonic clock read + a ring-buffer store);
+ * device profiling — start/stop wrappers around `jax.profiler` so a
+   running deploy server can capture an XLA trace on demand (the TPU
+   answer to the Spark UI), plus `annotate` for op-level trace labels.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class LatencyHistogram:
+    """Bounded-reservoir latency recorder.
+
+    All-time aggregates (count, mean, last) never lose data; quantiles are
+    computed over the most recent `capacity` samples (a ring buffer), which
+    is the operationally useful window for serving dashboards.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._ring: list[float] = []
+        self._pos = 0
+        self.count = 0
+        self.total = 0.0
+        self.last = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.last = seconds
+            self.min = min(self.min, seconds)
+            self.max = max(self.max, seconds)
+            if len(self._ring) < self.capacity:
+                self._ring.append(seconds)
+            else:
+                self._ring[self._pos] = seconds
+                self._pos = (self._pos + 1) % self.capacity
+
+    def quantiles(self, qs=(0.5, 0.9, 0.95, 0.99)) -> dict[str, float]:
+        with self._lock:
+            window = sorted(self._ring)
+        if not window:
+            return {f"p{int(q * 100)}": 0.0 for q in qs}
+        n = len(window)
+        return {
+            f"p{int(q * 100)}": window[min(n - 1, int(q * (n - 1) + 0.5))]
+            for q in qs
+        }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total, last = self.count, self.total, self.last
+            mn, mx = self.min, self.max
+        out = {
+            "count": count,
+            "avg": total / count if count else 0.0,
+            "last": last,
+            "min": 0.0 if mn == float("inf") else mn,
+            "max": mx,
+        }
+        out.update(self.quantiles())
+        return out
+
+
+class Tracer:
+    """Named span histograms for a request pipeline."""
+
+    def __init__(self):
+        self._spans: dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+
+    def histogram(self, name: str) -> LatencyHistogram:
+        with self._lock:
+            h = self._spans.get(name)
+            if h is None:
+                h = self._spans[name] = LatencyHistogram()
+            return h
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.histogram(name).record(time.monotonic() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        self.histogram(name).record(seconds)
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            names = list(self._spans)
+        return {n: self._spans[n].snapshot() for n in names}
+
+
+# ---------------------------------------------------------------------------
+# device profiling (jax.profiler)
+# ---------------------------------------------------------------------------
+
+_profile_lock = threading.Lock()
+_profile_dir: str | None = None
+
+
+def start_device_profile(logdir: str) -> bool:
+    """Start a jax.profiler trace capturing XLA/TPU activity into `logdir`
+    (view with TensorBoard / xprof). Returns False if already running."""
+    import jax
+
+    global _profile_dir
+    with _profile_lock:
+        if _profile_dir is not None:
+            return False
+        jax.profiler.start_trace(logdir)
+        _profile_dir = logdir
+        return True
+
+
+def stop_device_profile() -> str | None:
+    """Stop the running trace; returns its logdir (None if none running)."""
+    import jax
+
+    global _profile_dir
+    with _profile_lock:
+        if _profile_dir is None:
+            return None
+        logdir, _profile_dir = _profile_dir, None
+        jax.profiler.stop_trace()
+        return logdir
+
+
+@contextmanager
+def device_profile(logdir: str):
+    start_device_profile(logdir)
+    try:
+        yield
+    finally:
+        stop_device_profile()
+
+
+def annotate(name: str):
+    """Label a region in the device trace (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -32,6 +32,7 @@ from pio_tpu.data.storage import Storage
 from pio_tpu.server.http import HttpApp, HttpServer, Request
 from pio_tpu.server.plugins import PluginContext
 from pio_tpu.utils.time import format_time, utcnow
+from pio_tpu.utils.tracing import Tracer
 from pio_tpu.workflow.context import WorkflowContext, create_workflow_context
 from pio_tpu.workflow.train import load_models
 
@@ -50,6 +51,8 @@ class ServingConfig:
     access_key: str = ""          # access key used for feedback inserts
     server_key: str = ""          # guards /stop and /reload (KeyAuthentication)
     warm_query: dict | None = None  # sample query to jit-warm at startup
+    certfile: str | None = None   # TLS cert (PEM); with keyfile -> HTTPS
+    keyfile: str | None = None
 
 
 class QueryServer:
@@ -73,10 +76,9 @@ class QueryServer:
         self.ctx = ctx or create_workflow_context(storage)
         self.plugins = plugin_context or PluginContext()
         self._lock = threading.RLock()
-        # latency bookkeeping (reference CreateServer.scala:420-422)
-        self.request_count = 0
-        self.avg_serving_sec = 0.0
-        self.last_serving_sec = 0.0
+        # per-stage latency histograms (replaces the reference's rolling
+        # average, CreateServer.scala:420-422; SURVEY.md §5 real tracing)
+        self.tracer = Tracer()
         self.start_time = utcnow()
         self._stop_requested = threading.Event()
         self._load(instance_id)
@@ -128,15 +130,19 @@ class QueryServer:
     # -- query path (reference CreateServer.scala:492-615) ------------------
     def query(self, q: dict, record: bool = True) -> Any:
         t0 = time.monotonic()
-        supplemented = self.serving.supplement(q)
+        tr = self.tracer
+        with tr.span("supplement"):
+            supplemented = self.serving.supplement(q)
         with self._lock:
             models = self.models
             instance_id = self.instance.id
-        predictions = [
-            algo.predict(model, supplemented)
-            for algo, model in zip(self.algorithms, models)
-        ]
-        prediction = self.serving.serve(q, predictions)
+        with tr.span("predict"):
+            predictions = [
+                algo.predict(model, supplemented)
+                for algo, model in zip(self.algorithms, models)
+            ]
+        with tr.span("serve"):
+            prediction = self.serving.serve(q, predictions)
         if record and self.config.feedback:
             prediction = self._feedback(q, prediction, instance_id)
         for blocker in self.plugins.output_blockers:
@@ -144,13 +150,7 @@ class QueryServer:
                 q, prediction, {"engineInstanceId": instance_id}
             )
         if record:
-            dt = time.monotonic() - t0
-            with self._lock:
-                self.last_serving_sec = dt
-                self.avg_serving_sec = (
-                    self.avg_serving_sec * self.request_count + dt
-                ) / (self.request_count + 1)
-                self.request_count += 1
+            tr.record("query", time.monotonic() - t0)
         return prediction
 
     def _feedback(self, query: dict, prediction: Any, instance_id: str):
@@ -196,6 +196,19 @@ class QueryServer:
         return prediction
 
     # -- status -------------------------------------------------------------
+    @property
+    def request_count(self) -> int:
+        return self.tracer.histogram("query").count
+
+    @property
+    def avg_serving_sec(self) -> float:
+        h = self.tracer.histogram("query")
+        return h.total / h.count if h.count else 0.0
+
+    @property
+    def last_serving_sec(self) -> float:
+        return self.tracer.histogram("query").last
+
     def status(self) -> dict:
         with self._lock:
             return {
@@ -212,6 +225,14 @@ class QueryServer:
                 "avgServingSec": round(self.avg_serving_sec, 6),
                 "lastServingSec": round(self.last_serving_sec, 6),
             }
+
+    def metrics(self) -> dict:
+        """Per-stage latency histograms (p50/p90/p95/p99 over the recent
+        window, all-time count/avg) — the serving observability surface."""
+        return {
+            "startTime": format_time(self.start_time),
+            "spans": self.tracer.snapshot(),
+        }
 
 
 def build_serving_app(server: QueryServer) -> HttpApp:
@@ -255,6 +276,34 @@ def build_serving_app(server: QueryServer) -> HttpApp:
         server._stop_requested.set()
         return 200, {"message": "Shutting down."}
 
+    @app.route("GET", r"/metrics\.json")
+    def metrics(req: Request):
+        return 200, server.metrics()
+
+    @app.route("POST", r"/profile/start")
+    def profile_start(req: Request):
+        """Capture a device (XLA/TPU) trace while serving — the TPU
+        equivalent of attaching the Spark UI. Guarded like /stop."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        from pio_tpu.utils.tracing import start_device_profile
+
+        logdir = req.params.get("logdir", "/tmp/pio_tpu_profile")
+        if not start_device_profile(logdir):
+            return 409, {"message": "profile already running"}
+        return 200, {"message": "profiling", "logdir": logdir}
+
+    @app.route("POST", r"/profile/stop")
+    def profile_stop(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        from pio_tpu.utils.tracing import stop_device_profile
+
+        logdir = stop_device_profile()
+        if logdir is None:
+            return 409, {"message": "no profile running"}
+        return 200, {"message": "profile written", "logdir": logdir}
+
     @app.route("GET", r"/plugins\.json")
     def plugins_list(req: Request):
         return 200, {
@@ -288,5 +337,10 @@ def create_query_server(
         engine, engine_params, storage, config,
         ctx=ctx, plugin_context=plugin_context, instance_id=instance_id,
     )
-    http = HttpServer(build_serving_app(qs), host=config.ip, port=config.port)
+    from pio_tpu.server.security import server_ssl_context
+
+    http = HttpServer(
+        build_serving_app(qs), host=config.ip, port=config.port,
+        ssl_context=server_ssl_context(config.certfile, config.keyfile),
+    )
     return http, qs

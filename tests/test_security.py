@@ -1,0 +1,80 @@
+"""TLS for the HTTP servers (reference SSLConfiguration.scala parity):
+self-signed cert generation, HTTPS event server round-trip, config errors."""
+
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from pio_tpu.data.dao import AccessKey, App
+from pio_tpu.server.eventserver import EventServerConfig, create_event_server
+from pio_tpu.server.security import (
+    TLSConfigError,
+    generate_self_signed,
+    resolve_cert_paths,
+    server_ssl_context,
+)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    return generate_self_signed(str(d))
+
+
+def test_resolve_requires_both(tmp_path, certs):
+    cert, key = certs
+    assert resolve_cert_paths(None, None) is None
+    with pytest.raises(TLSConfigError):
+        resolve_cert_paths(cert, None)
+    with pytest.raises(TLSConfigError):
+        resolve_cert_paths(cert, str(tmp_path / "missing.key"))
+    assert resolve_cert_paths(cert, key) == (cert, key)
+
+
+def test_env_var_configuration(certs, monkeypatch):
+    cert, key = certs
+    monkeypatch.setenv("PIO_TPU_SERVER_CERT", cert)
+    monkeypatch.setenv("PIO_TPU_SERVER_KEY_FILE", key)
+    assert resolve_cert_paths() == (cert, key)
+    assert server_ssl_context() is not None
+
+
+def test_https_event_server_roundtrip(memory_storage, certs):
+    cert, key = certs
+    apps = memory_storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "tlsapp"))
+    memory_storage.get_metadata_access_keys().insert(AccessKey("KEY", app_id))
+    memory_storage.get_events().init(app_id)
+
+    srv = create_event_server(
+        memory_storage,
+        EventServerConfig(ip="127.0.0.1", port=0, certfile=cert, keyfile=key),
+    ).start()
+    try:
+        assert srv.tls
+        client_ctx = ssl.create_default_context(cafile=cert)
+        client_ctx.check_hostname = False  # CN=localhost, we dial 127.0.0.1
+        url = f"https://127.0.0.1:{srv.port}/events.json?accessKey=KEY"
+        body = json.dumps({
+            "event": "rate", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 5},
+        }).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, context=client_ctx) as resp:
+            assert resp.status == 201
+            eid = json.loads(resp.read())["eventId"]
+        assert memory_storage.get_events().get(eid, app_id) is not None
+        # plain HTTP against the TLS port must fail
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/events.json?accessKey=KEY",
+                timeout=5,
+            )
+    finally:
+        srv.stop()

@@ -114,6 +114,14 @@ def test_query_and_status(deployed):
     assert st["requestCount"] == 1
     assert st["lastServingSec"] > 0
     assert st["engineInstance"]["engineId"] == "rec"
+    # per-stage tracing surface
+    status, m = call(http.port, "GET", "/metrics.json")
+    assert status == 200
+    spans = m["spans"]
+    assert spans["query"]["count"] == 1
+    for stage in ("supplement", "predict", "serve"):
+        assert spans[stage]["count"] >= 1
+        assert spans[stage]["p50"] >= 0.0
 
 
 def test_output_plugin_applied(deployed):

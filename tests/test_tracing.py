@@ -1,0 +1,56 @@
+"""Tracing subsystem: histogram math, tracer spans, and the serving
+/metrics.json surface (SURVEY.md §5: real tracing replaces the reference's
+rolling average)."""
+
+import threading
+
+from pio_tpu.utils.tracing import LatencyHistogram, Tracer
+
+
+def test_histogram_quantiles_and_aggregates():
+    h = LatencyHistogram(capacity=1000)
+    for i in range(1, 101):          # 1..100 ms
+        h.record(i / 1000)
+    s = h.snapshot()
+    assert s["count"] == 100
+    assert abs(s["avg"] - 0.0505) < 1e-9
+    assert s["last"] == 0.1
+    assert s["min"] == 0.001 and s["max"] == 0.1
+    assert abs(s["p50"] - 0.050) < 0.002
+    assert abs(s["p99"] - 0.099) < 0.002
+
+
+def test_histogram_window_bounded_but_count_total():
+    h = LatencyHistogram(capacity=10)
+    for i in range(100):
+        h.record(float(i))
+    s = h.snapshot()
+    assert s["count"] == 100          # all-time count survives eviction
+    assert s["p50"] >= 90.0           # window holds only the newest samples
+
+
+def test_tracer_spans_and_threads():
+    tr = Tracer()
+    with tr.span("stage"):
+        pass
+
+    def worker():
+        for _ in range(100):
+            tr.record("stage", 0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tr.snapshot()["stage"]["count"] == 801
+
+
+def test_span_records_on_exception():
+    tr = Tracer()
+    try:
+        with tr.span("boom"):
+            raise ValueError()
+    except ValueError:
+        pass
+    assert tr.histogram("boom").count == 1
