@@ -71,6 +71,9 @@ class SequenceParams(Params):
     # serve-time live history read (empty app_name = training snapshot only)
     app_name: str = ""
     event_names: tuple[str, ...] = ("view", "buy")
+    # mid-train step checkpoints (workflow/orbax_ckpt.py); "" = off
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 100
 
 
 class Block(nn.Module):
@@ -189,10 +192,13 @@ def make_encoder(n_items: int, p: SequenceParams) -> SeqEncoder:
 
 
 def train_sequence_model(
-    data: SequenceData, p: SequenceParams, mesh: Mesh | None = None
+    data: SequenceData, p: SequenceParams, mesh: Mesh | None = None,
+    checkpoint=None,
 ):
     """SPMD train loop: dp x sp shard_map step (see module docstring).
 
+    `checkpoint` is a StepCheckpointer (or None): saves every save_every
+    steps, resumes from the latest step with an identical batch stream.
     Returns (params, encoder, final loss)."""
     encoder = make_encoder(len(data.items), p)
     optimizer = optax.adam(p.learning_rate)
@@ -298,20 +304,26 @@ def train_sequence_model(
 
         batch = p.batch_size
 
-    rng = np.random.default_rng(p.seed)
+    from pio_tpu.workflow.orbax_ckpt import resume_or_init
+
+    params, opt_state, start_step = resume_or_init(checkpoint, params, opt_state)
+
     n = inp_all.shape[0]
     # the sampled batch must split evenly over the data mesh axis
     size = min(batch, max(8, n))
     size = max(n_data, size - size % n_data)
     loss = None
-    for _ in range(p.steps):
-        idx = rng.integers(0, n, size=size)
+    for step_i in range(start_step, p.steps):
+        # (seed, step)-keyed sampling: identical stream fresh or resumed
+        idx = np.random.default_rng((p.seed, step_i)).integers(0, n, size=size)
         inp = jnp.asarray(inp_all[idx])
         tgt = jnp.asarray(tgt_all[idx])
         if mesh is not None:
             inp = jax.device_put(inp, batch_sharding)
             tgt = jax.device_put(tgt, batch_sharding)
         params, opt_state, loss = step(params, opt_state, inp, tgt)
+        if checkpoint is not None:
+            checkpoint.maybe_save(step_i, params, opt_state)
     return jax.device_get(params), encoder, float(loss)
 
 
@@ -376,7 +388,24 @@ class SequenceAlgorithm(PAlgorithm):
             if ctx and ctx.mesh is not None and ctx.mesh.devices.size > 1
             else None
         )
-        params, _, _ = train_sequence_model(data, self.params, mesh)
+        ckpt = None
+        if self.params.checkpoint_dir:
+            from pio_tpu.workflow.orbax_ckpt import (
+                StepCheckpointConfig,
+                StepCheckpointer,
+            )
+
+            ckpt = StepCheckpointer(StepCheckpointConfig(
+                self.params.checkpoint_dir,
+                save_every=self.params.checkpoint_every,
+            ))
+        try:
+            params, _, _ = train_sequence_model(
+                data, self.params, mesh, checkpoint=ckpt
+            )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         if ctx is not None:
             self._event_store = getattr(ctx, "event_store", None)
         return SequenceModel(

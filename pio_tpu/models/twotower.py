@@ -74,6 +74,9 @@ class TwoTowerParams(Params):
     batch_size: int = 1024
     steps: int = 200
     seed: int = 0
+    # mid-train step checkpoints (workflow/orbax_ckpt.py); "" = off
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 100
 
 
 def param_shardings(params_tree, mesh: Mesh):
@@ -139,15 +142,23 @@ def train_two_tower(
     inter: Interactions,
     p: TwoTowerParams,
     mesh: Mesh | None = None,
+    checkpoint=None,
 ) -> tuple[dict, jax.Array, Any]:
     """-> (params, item_embeddings matrix, towers). Sharded over the mesh
-    when given; single-device jit otherwise."""
+    when given; single-device jit otherwise. `checkpoint` is a
+    StepCheckpointer (or None): training saves every save_every steps and
+    resumes from the latest saved step with an identical batch stream
+    (sampling is keyed by (seed, step))."""
     optimizer = optax.adam(p.learning_rate)
     train_step, towers = make_train_step(
         inter.n_users, inter.n_items, p, optimizer
     )
     params = init_params(inter.n_users, inter.n_items, p)
     opt_state = optimizer.init(params)
+
+    from pio_tpu.workflow.orbax_ckpt import resume_or_init
+
+    params, opt_state, start_step = resume_or_init(checkpoint, params, opt_state)
 
     batch = min(p.batch_size, max(8, len(inter)))
     if mesh is not None:
@@ -166,17 +177,20 @@ def train_two_tower(
     else:
         step = jax.jit(train_step)
 
-    rng = np.random.default_rng(p.seed)
     n = len(inter)
     loss = None
-    for _ in range(p.steps):
-        idx = rng.integers(0, n, size=batch)
+    for step_i in range(start_step, p.steps):
+        # (seed, step)-keyed sampling: the stream is identical whether the
+        # run is fresh or resumed from a checkpoint
+        idx = np.random.default_rng((p.seed, step_i)).integers(0, n, size=batch)
         u = jnp.asarray(inter.user_idx[idx], jnp.int32)
         i = jnp.asarray(inter.item_idx[idx], jnp.int32)
         if mesh is not None:
             u = jax.device_put(u, batch_sharding)
             i = jax.device_put(i, batch_sharding)
         params, opt_state, loss = step(params, opt_state, u, i)
+        if checkpoint is not None:
+            checkpoint.maybe_save(step_i, params, opt_state)
 
     # materialize all item embeddings for serving
     item_ids = jnp.arange(inter.n_items, dtype=jnp.int32)
@@ -265,7 +279,24 @@ class TwoTowerAlgorithm(PAlgorithm):
     def train(self, ctx, inter: Interactions) -> TwoTowerModel:
         inter.sanity_check()
         mesh = ctx.mesh if ctx and ctx.mesh and ctx.mesh.devices.size > 1 else None
-        params, item_emb, _ = train_two_tower(inter, self.params, mesh)
+        ckpt = None
+        if self.params.checkpoint_dir:
+            from pio_tpu.workflow.orbax_ckpt import (
+                StepCheckpointConfig,
+                StepCheckpointer,
+            )
+
+            ckpt = StepCheckpointer(StepCheckpointConfig(
+                self.params.checkpoint_dir,
+                save_every=self.params.checkpoint_every,
+            ))
+        try:
+            params, item_emb, _ = train_two_tower(
+                inter, self.params, mesh, checkpoint=ckpt
+            )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         return TwoTowerModel(
             params=params, item_embeddings=item_emb,
             users=inter.users, items=inter.items, config=self.params,
