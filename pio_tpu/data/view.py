@@ -1,0 +1,135 @@
+"""Deprecated batch-view API (compat layer).
+
+Parity with the reference's pre-0.9.2 event view kept for backward
+compatibility (data/.../view/LBatchView.scala:94-200, PBatchView.scala):
+`EventSeq` filtering + per-entity time-ordered folds, and `BatchView` as the
+app-scoped snapshot. New code should use EventStore / EventsDAO directly
+(this module emits DeprecationWarning exactly as the reference annotates
+@deprecated) — it exists so reference engine code has a 1:1 target.
+
+The L/P split collapses here: the reference's PBatchView differed only in
+returning RDDs; our columnar training path (EventStore.interactions) plays
+that role.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Iterable, TypeVar
+
+from pio_tpu.data.datamap import DataMap
+from pio_tpu.data.event import Event
+from pio_tpu.data.storage import Storage, get_storage
+
+T = TypeVar("T")
+
+
+class EventSeq:
+    """Filterable event list with per-entity ordered folds
+    (reference EventSeq, LBatchView.scala:105-131)."""
+
+    def __init__(self, events: Iterable[Event]):
+        self.events = list(events)
+
+    def filter(
+        self,
+        event: str | None = None,
+        entity_type: str | None = None,
+        start_time=None,
+        until_time=None,
+        predicate: Callable[[Event], bool] | None = None,
+    ) -> "EventSeq":
+        """Keyword filters AND together (reference ViewPredicates)."""
+        def keep(e: Event) -> bool:
+            if event is not None and e.event != event:
+                return False
+            if entity_type is not None and e.entity_type != entity_type:
+                return False
+            if start_time is not None and e.event_time < start_time:
+                return False
+            if until_time is not None and e.event_time >= until_time:
+                return False
+            if predicate is not None and not predicate(e):
+                return False
+            return True
+
+        return EventSeq(e for e in self.events if keep(e))
+
+    def aggregate_by_entity_ordered(
+        self, init: T, op: Callable[[T, Event], T]
+    ) -> dict[str, T]:
+        """Per-entityId fold over events in eventTime order
+        (reference aggregateByEntityOrdered, LBatchView.scala:121-131)."""
+        groups = self.group_by_entity_ordered()
+        return {
+            eid: _fold(evs, init, op) for eid, evs in groups.items()
+        }
+
+    def group_by_entity_ordered(self) -> dict[str, list[Event]]:
+        groups: dict[str, list[Event]] = {}
+        for e in sorted(self.events, key=lambda e: e.event_time):
+            groups.setdefault(e.entity_id, []).append(e)
+        return groups
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self):
+        return len(self.events)
+
+
+def _fold(events: list[Event], init: T, op: Callable[[T, Event], T]) -> T:
+    acc = init
+    for e in events:
+        acc = op(acc, e)
+    return acc
+
+
+class BatchView:
+    """App-scoped event snapshot (reference LBatchView.scala:134-200).
+
+    Deprecated — use EventStore (pio_tpu.data.eventstore) for new code.
+    """
+
+    def __init__(
+        self,
+        app_id: int,
+        start_time=None,
+        until_time=None,
+        channel_id: int | None = None,
+        storage: Storage | None = None,
+    ):
+        warnings.warn(
+            "BatchView is deprecated (kept for reference parity); use "
+            "pio_tpu.data.eventstore.EventStore instead",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        self.app_id = app_id
+        storage = storage or get_storage()
+        self._events = EventSeq(
+            storage.get_events().find(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                limit=-1,
+            )
+        )
+
+    @property
+    def events(self) -> EventSeq:
+        return self._events
+
+    def aggregate_properties(self, entity_type: str) -> dict[str, DataMap]:
+        """$set/$unset/$delete fold per entity -> DataMap (reference
+        LBatchView.aggregateProperties via ViewAggregators' DataMap
+        aggregator; same semantics as the LEventAggregator path)."""
+        from pio_tpu.data.aggregator import aggregate_properties
+
+        special = self._events.filter(
+            entity_type=entity_type,
+            predicate=lambda e: e.event in ("$set", "$unset", "$delete"),
+        )
+        # PropertyMap IS-A DataMap (aggregated props + update times)
+        return dict(aggregate_properties(special))

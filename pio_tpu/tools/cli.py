@@ -452,6 +452,31 @@ class MyEngine(EngineFactory):
 '''
 
 
+def cmd_upgrade(args) -> int:
+    """Migrate events + app metadata between storage backends (the
+    reference's `pio upgrade` generalized: any source -> any target)."""
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.tools.migrate import migrate_events
+
+    def load_env(path: str) -> dict:
+        with open(path) as f:
+            return json.load(f)
+
+    src = Storage(env=load_env(args.from_env))
+    dst = Storage(env=load_env(args.to_env))
+    try:
+        report = migrate_events(
+            src, dst,
+            app_ids=[args.appid] if args.appid is not None else None,
+            copy_metadata=not args.no_metadata,
+        )
+    finally:
+        src.close()
+        dst.close()
+    print(report.one_liner())
+    return 0
+
+
 def cmd_template(args) -> int:
     """Scaffold a new engine directory (reference console/Template.scala —
     minus the network gallery: templates generate locally)."""
@@ -601,6 +626,15 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--appid", type=int, required=True)
     x.add_argument("--input", required=True)
     x.set_defaults(fn=cmd_import)
+
+    x = sub.add_parser("upgrade")
+    x.add_argument("--from-env", required=True,
+                   help="JSON file of PIO_STORAGE_* vars for the source")
+    x.add_argument("--to-env", required=True,
+                   help="JSON file of PIO_STORAGE_* vars for the target")
+    x.add_argument("--appid", type=int)
+    x.add_argument("--no-metadata", action="store_true")
+    x.set_defaults(fn=cmd_upgrade)
 
     x = sub.add_parser("template")
     xs = x.add_subparsers(dest="subcommand", required=True)

@@ -1,0 +1,94 @@
+"""Storage migration — copy apps/events between storage backends.
+
+The reference ships `pio upgrade` with HBase 0.8->0.9 format migration tools
+(data/.../storage/hbase/upgrade/, Console.scala upgrade verb); the TPU
+build's equivalent is backend-generic: read every event from one configured
+storage and write it into another (e.g. sqlite -> the native eventlog, or
+dev memory -> durable sqlite), preserving event ids, times, and channels.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from pio_tpu.data.storage import Storage
+
+log = logging.getLogger("pio_tpu.tools")
+
+
+@dataclass
+class MigrationReport:
+    apps: int = 0
+    channels: int = 0
+    access_keys: int = 0
+    events: int = 0
+
+    def one_liner(self) -> str:
+        return (
+            f"migrated {self.apps} apps, {self.channels} channels, "
+            f"{self.access_keys} access keys, {self.events} events"
+        )
+
+
+def migrate_events(
+    src: Storage,
+    dst: Storage,
+    app_ids: list[int] | None = None,
+    copy_metadata: bool = True,
+    batch_size: int = 1000,
+) -> MigrationReport:
+    """Copy events (and by default app/channel/key metadata) src -> dst.
+
+    Events keep their ids, so re-running is idempotent on id-keyed backends
+    and the eventlog backend dedups nothing — migrate into an empty target.
+    """
+    report = MigrationReport()
+    src_apps = src.get_metadata_apps()
+    apps = [
+        a for a in src_apps.get_all()
+        if app_ids is None or a.id in app_ids
+    ]
+    src_events = src.get_events()
+    dst_events = dst.get_events()
+
+    for app in apps:
+        if copy_metadata:
+            dst_apps = dst.get_metadata_apps()
+            if dst_apps.get(app.id) is None:
+                dst_apps.insert(app)
+                report.apps += 1
+            for key in src.get_metadata_access_keys().get_by_appid(app.id):
+                if dst.get_metadata_access_keys().get(key.key) is None:
+                    dst.get_metadata_access_keys().insert(key)
+                    report.access_keys += 1
+
+        channels = src.get_metadata_channels().get_by_appid(app.id)
+        if copy_metadata:
+            dst_channels = dst.get_metadata_channels()
+            existing = {c.id for c in dst_channels.get_by_appid(app.id)}
+            for ch in channels:
+                if ch.id not in existing:
+                    dst_channels.insert(ch)
+                    report.channels += 1
+
+        for channel_id in [None] + [c.id for c in channels]:
+            try:
+                events = src_events.find(
+                    app_id=app.id, channel_id=channel_id, limit=-1
+                )
+            except Exception:  # noqa: BLE001 - namespace may not exist
+                continue
+            dst_events.init(app.id, channel_id)
+            batch = []
+            for e in events:
+                batch.append(e)
+                if len(batch) >= batch_size:
+                    dst_events.insert_batch(batch, app.id, channel_id)
+                    report.events += len(batch)
+                    batch = []
+            if batch:
+                dst_events.insert_batch(batch, app.id, channel_id)
+                report.events += len(batch)
+        log.info("migrated app %s (%s)", app.id, app.name)
+    return report

@@ -46,7 +46,12 @@ def create_workflow_context(
     params: dict | None = None,
 ) -> WorkflowContext:
     """Reference WorkflowContext.scala: conf -> SparkContext; here conf ->
-    Mesh over available devices (all of them by default)."""
+    Mesh over available devices (all of them by default). When
+    PIO_TPU_COORDINATOR is set, the multi-host runtime is joined first so
+    the mesh spans every host's devices (parallel/distributed.py)."""
+    from pio_tpu.parallel.distributed import initialize_distributed
+
+    initialize_distributed()  # no-op unless configured; must precede mesh
     storage = storage or get_storage()
     mesh = None
     if use_mesh:

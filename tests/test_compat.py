@@ -1,0 +1,154 @@
+"""Compat + ops-tool layers: deprecated batch views (reference
+LBatchView.scala), FakeWorkflow (FakeWorkflow.scala), and the storage
+migration behind `pio upgrade`."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from pio_tpu.data.dao import AccessKey, App, Channel
+from pio_tpu.data.datamap import DataMap
+from pio_tpu.data.event import Event
+from pio_tpu.data.storage import Storage
+
+UTC = timezone.utc
+T0 = datetime(2026, 1, 1, tzinfo=UTC)
+
+
+def _seed(storage, app_name="viewapp"):
+    app_id = storage.get_metadata_apps().insert(App(0, app_name))
+    ev = storage.get_events()
+    ev.init(app_id)
+    events = [
+        Event(event="$set", entity_type="item", entity_id="i1",
+              properties=DataMap({"color": "red", "size": 1}),
+              event_time=T0, event_id="e1"),
+        Event(event="$set", entity_type="item", entity_id="i1",
+              properties=DataMap({"size": 2}),
+              event_time=T0 + timedelta(minutes=1), event_id="e2"),
+        Event(event="$unset", entity_type="item", entity_id="i1",
+              properties=DataMap({"color": None}),
+              event_time=T0 + timedelta(minutes=2), event_id="e3"),
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=T0 + timedelta(minutes=3), event_id="e4"),
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i2",
+              event_time=T0 + timedelta(minutes=4), event_id="e5"),
+    ]
+    ev.insert_batch(events, app_id)
+    return app_id
+
+
+class TestBatchView:
+    def test_deprecation_and_filters(self, memory_storage):
+        from pio_tpu.data.view import BatchView
+
+        app_id = _seed(memory_storage)
+        with pytest.warns(DeprecationWarning):
+            view = BatchView(app_id, storage=memory_storage)
+        assert len(view.events) == 5
+        views = view.events.filter(event="view")
+        assert len(views) == 2
+        windowed = view.events.filter(
+            start_time=T0 + timedelta(minutes=1),
+            until_time=T0 + timedelta(minutes=3),
+        )
+        assert {e.event_id for e in windowed} == {"e2", "e3"}
+
+    def test_aggregate_properties_fold(self, memory_storage):
+        from pio_tpu.data.view import BatchView
+
+        app_id = _seed(memory_storage)
+        with pytest.warns(DeprecationWarning):
+            view = BatchView(app_id, storage=memory_storage)
+        props = view.aggregate_properties("item")
+        assert props["i1"].get("size") == 2                     # later $set wins
+        assert props["i1"].get_or_else("color", None) is None   # $unset removed
+
+    def test_entity_ordered_fold(self, memory_storage):
+        from pio_tpu.data.view import BatchView
+
+        app_id = _seed(memory_storage)
+        with pytest.warns(DeprecationWarning):
+            view = BatchView(app_id, storage=memory_storage)
+        counts = view.events.filter(event="view").aggregate_by_entity_ordered(
+            0, lambda acc, e: acc + 1
+        )
+        assert counts == {"u1": 2}
+
+
+class TestFakeWorkflow:
+    def test_fn_runs_through_evaluation_lifecycle(self, memory_storage):
+        from pio_tpu.workflow.fake import fake_run
+
+        ran = []
+
+        def fn(ctx):
+            ran.append(ctx)
+
+        instance_id = fake_run(fn, memory_storage)
+        assert len(ran) == 1 and ran[0] is not None
+        inst = memory_storage.get_metadata_evaluation_instances().get(instance_id)
+        assert inst.status == "EVALCOMPLETED"
+        assert inst.evaluation_class == "FakeRun"
+
+    def test_failure_marks_instance_failed(self, memory_storage):
+        from pio_tpu.workflow.fake import fake_run
+
+        def boom(ctx):
+            raise RuntimeError("injected")
+
+        with pytest.raises(RuntimeError):
+            fake_run(boom, memory_storage)
+        insts = memory_storage.get_metadata_evaluation_instances().get_all()
+        assert any(i.status == "EVALFAILED" for i in insts)
+
+
+class TestMigration:
+    def test_memory_to_eventlog_roundtrip(self, memory_storage, tmp_path):
+        from pio_tpu.tools.migrate import migrate_events
+
+        app_id = _seed(memory_storage, "migapp")
+        memory_storage.get_metadata_access_keys().insert(
+            AccessKey("MIGKEY", app_id)
+        )
+        cid = memory_storage.get_metadata_channels().insert(
+            Channel(0, "mobile", app_id)
+        )
+        memory_storage.get_events().init(app_id, cid)
+        memory_storage.get_events().insert(
+            Event(event="buy", entity_type="user", entity_id="u9",
+                  target_entity_type="item", target_entity_id="i9",
+                  event_id="chan-ev"),
+            app_id, cid,
+        )
+
+        dst = Storage(env={
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "el"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        try:
+            report = migrate_events(memory_storage, dst)
+            assert report.events == 6
+            assert report.apps == 1 and report.channels == 1
+            assert report.access_keys == 1
+            migrated = list(dst.get_events().find(app_id, limit=-1))
+            src_all = list(
+                memory_storage.get_events().find(app_id, limit=-1)
+            )
+            assert sorted(e.event_id for e in migrated) == sorted(
+                e.event_id for e in src_all
+            )
+            # channel events land in the channel namespace
+            chan = list(dst.get_events().find(app_id, cid, limit=-1))
+            assert [e.event_id for e in chan] == ["chan-ev"]
+            # events round-trip exactly (ids, times, properties)
+            assert dst.get_events().get("e1", app_id) == \
+                memory_storage.get_events().get("e1", app_id)
+        finally:
+            dst.close()
