@@ -231,11 +231,20 @@ class _SqlChannels(d.ChannelsDAO):
     def insert(self, channel: d.Channel):
         if not d.Channel.is_valid_name(channel.name):
             return None
-        cur = self.b._exec(
-            "INSERT INTO channels (name, appid) VALUES (?,?)",
-            (channel.name, channel.appid),
-        )
-        return cur.lastrowid
+        try:
+            if channel.id > 0:
+                self.b._exec(
+                    "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
+                    (channel.id, channel.name, channel.appid),
+                )
+                return channel.id
+            cur = self.b._exec(
+                "INSERT INTO channels (name, appid) VALUES (?,?)",
+                (channel.name, channel.appid),
+            )
+            return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
 
     def get(self, channel_id):
         rows = self.b._query(
@@ -479,8 +488,12 @@ class _SqlEvents(d.EventsDAO):
     def insert(self, event: Event, app_id, channel_id=None):
         self._check_ns(app_id, channel_id)
         eid = event.event_id or new_event_id()
+        # OR REPLACE: re-inserting an explicit event id upserts, matching the
+        # memory backend and the reference's HBase Put-by-rowkey semantics
+        # (hbase/HBEventsUtil.scala:144) — and making migration re-runs
+        # idempotent.
         self.b._exec(
-            "INSERT INTO events (id, app_id, channel_id, event, entity_type, "
+            "INSERT OR REPLACE INTO events (id, app_id, channel_id, event, entity_type, "
             "entity_id, target_entity_type, target_entity_id, properties, "
             "event_time, event_time_ms, tags, pr_id, creation_time) "
             "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",

@@ -153,9 +153,14 @@ class AccessKeysDAO(abc.ABC):
 
     @staticmethod
     def generate_key() -> str:
-        """64-char URL-safe random key (reference AccessKeys.scala:65)."""
+        """64-char URL-safe random key (reference AccessKeys.scala:65).
+
+        First char is alphanumeric so the key is never mistaken for a CLI flag.
+        """
+        rng = random.SystemRandom()
         alphabet = string.ascii_letters + string.digits + "-_"
-        return "".join(random.SystemRandom().choice(alphabet) for _ in range(64))
+        head = rng.choice(string.ascii_letters + string.digits)
+        return head + "".join(rng.choice(alphabet) for _ in range(63))
 
 
 class ChannelsDAO(abc.ABC):

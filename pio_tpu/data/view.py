@@ -14,6 +14,7 @@ that role.
 
 from __future__ import annotations
 
+import copy
 import warnings
 from typing import Callable, Iterable, TypeVar
 
@@ -59,10 +60,17 @@ class EventSeq:
         self, init: T, op: Callable[[T, Event], T]
     ) -> dict[str, T]:
         """Per-entityId fold over events in eventTime order
-        (reference aggregateByEntityOrdered, LBatchView.scala:121-131)."""
+        (reference aggregateByEntityOrdered, LBatchView.scala:121-131).
+
+        `init` is deep-copied per entity so a mutable accumulator (list/
+        dict) updated in place cannot leak state across entities — the
+        Scala reference's value semantics make this hazard impossible;
+        Python needs the copy.
+        """
         groups = self.group_by_entity_ordered()
         return {
-            eid: _fold(evs, init, op) for eid, evs in groups.items()
+            eid: _fold(evs, copy.deepcopy(init), op)
+            for eid, evs in groups.items()
         }
 
     def group_by_entity_ordered(self) -> dict[str, list[Event]]:

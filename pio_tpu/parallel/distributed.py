@@ -42,10 +42,20 @@ def distributed_env() -> dict | None:
     addr = os.environ.get("PIO_TPU_COORDINATOR")
     if not addr:
         return None
+    nproc = os.environ.get("PIO_TPU_NUM_PROCESSES")
+    pid = os.environ.get("PIO_TPU_PROCESS_ID")
+    if nproc is None or pid is None:
+        # A coordinator with no process count/index means every host would
+        # form its own 1-process "cluster" — fail fast instead.
+        raise ValueError(
+            "PIO_TPU_COORDINATOR is set but "
+            "PIO_TPU_NUM_PROCESSES/PIO_TPU_PROCESS_ID are not; all three "
+            "are required for a multi-host job"
+        )
     return {
         "coordinator_address": addr,
-        "num_processes": int(os.environ.get("PIO_TPU_NUM_PROCESSES", "1")),
-        "process_id": int(os.environ.get("PIO_TPU_PROCESS_ID", "0")),
+        "num_processes": int(nproc),
+        "process_id": int(pid),
     }
 
 
@@ -63,7 +73,10 @@ def initialize_distributed(
     global _initialized
     if _initialized:
         return False
-    env = distributed_env() or {}
+    if None not in (coordinator_address, num_processes, process_id):
+        env = {}  # fully specified explicitly; env vars are irrelevant
+    else:
+        env = distributed_env() or {}
     kwargs = {
         "coordinator_address": coordinator_address
         or env.get("coordinator_address"),

@@ -12,7 +12,8 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass
 
-from pio_tpu.data.storage import Storage
+from pio_tpu.data.dao import Channel
+from pio_tpu.data.storage import Storage, StorageError
 
 log = logging.getLogger("pio_tpu.tools")
 
@@ -55,40 +56,85 @@ def migrate_events(
     for app in apps:
         if copy_metadata:
             dst_apps = dst.get_metadata_apps()
-            if dst_apps.get(app.id) is None:
-                dst_apps.insert(app)
+            existing_app = dst_apps.get(app.id)
+            if existing_app is None:
+                if dst_apps.insert(app) is None:
+                    raise StorageError(
+                        f"cannot migrate app {app.id} ({app.name!r}): "
+                        "target has a conflicting app with the same name"
+                    )
                 report.apps += 1
+            elif existing_app.name != app.name:
+                raise StorageError(
+                    f"target app id {app.id} is {existing_app.name!r}, "
+                    f"source is {app.name!r}; refusing to merge"
+                )
+            dst_keys = dst.get_metadata_access_keys()
             for key in src.get_metadata_access_keys().get_by_appid(app.id):
-                if dst.get_metadata_access_keys().get(key.key) is None:
-                    dst.get_metadata_access_keys().insert(key)
+                existing_key = dst_keys.get(key.key)
+                if existing_key is None:
+                    if dst_keys.insert(key) is None:
+                        raise StorageError(
+                            f"cannot migrate access key for app {app.id}"
+                        )
                     report.access_keys += 1
+                elif existing_key.appid != key.appid:
+                    # clients authenticating with this key on the target
+                    # would write into a DIFFERENT app — refuse
+                    raise StorageError(
+                        f"access key of app {app.id} already exists on the "
+                        f"target bound to app {existing_key.appid}"
+                    )
 
+        # Channel ids may differ on the target (same-name match, or a fresh
+        # id when the source id is already taken), so build a src->dst
+        # channel-id map from insert return values and copy events under
+        # the TARGET ids.
         channels = src.get_metadata_channels().get_by_appid(app.id)
-        if copy_metadata:
-            dst_channels = dst.get_metadata_channels()
-            existing = {c.id for c in dst_channels.get_by_appid(app.id)}
-            for ch in channels:
-                if ch.id not in existing:
-                    dst_channels.insert(ch)
-                    report.channels += 1
+        dst_channels = dst.get_metadata_channels()
+        existing_by_name = {
+            c.name: c.id for c in dst_channels.get_by_appid(app.id)
+        }
+        channel_map: dict[int, int] = {}
+        for ch in channels:
+            if ch.name in existing_by_name:
+                channel_map[ch.id] = existing_by_name[ch.name]
+                continue
+            if not copy_metadata:
+                channel_map[ch.id] = ch.id
+                continue
+            new_id = dst_channels.insert(ch)
+            if new_id is None:
+                # source id taken by an unrelated channel: take a fresh id
+                # and rely on the remap below
+                new_id = dst_channels.insert(Channel(0, ch.name, ch.appid))
+            if new_id is None:
+                raise StorageError(
+                    f"cannot migrate channel {ch.name!r} of app {app.id}"
+                )
+            channel_map[ch.id] = new_id
+            report.channels += 1
 
-        for channel_id in [None] + [c.id for c in channels]:
+        namespaces = [(None, None)] + [
+            (c.id, channel_map[c.id]) for c in channels
+        ]
+        for src_cid, dst_cid in namespaces:
             try:
                 events = src_events.find(
-                    app_id=app.id, channel_id=channel_id, limit=-1
+                    app_id=app.id, channel_id=src_cid, limit=-1
                 )
-            except Exception:  # noqa: BLE001 - namespace may not exist
-                continue
-            dst_events.init(app.id, channel_id)
+            except StorageError:
+                continue  # namespace never initialized on the source
+            dst_events.init(app.id, dst_cid)
             batch = []
             for e in events:
                 batch.append(e)
                 if len(batch) >= batch_size:
-                    dst_events.insert_batch(batch, app.id, channel_id)
+                    dst_events.insert_batch(batch, app.id, dst_cid)
                     report.events += len(batch)
                     batch = []
             if batch:
-                dst_events.insert_batch(batch, app.id, channel_id)
+                dst_events.insert_batch(batch, app.id, dst_cid)
                 report.events += len(batch)
         log.info("migrated app %s (%s)", app.id, app.name)
     return report

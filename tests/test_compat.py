@@ -77,6 +77,17 @@ class TestBatchView:
         )
         assert counts == {"u1": 2}
 
+    def test_mutable_init_does_not_leak_across_entities(self, memory_storage):
+        from pio_tpu.data.view import EventSeq
+
+        app_id = _seed(memory_storage)
+        events = list(memory_storage.get_events().find(app_id, limit=-1))
+        per_entity = EventSeq(events).aggregate_by_entity_ordered(
+            [], lambda acc, e: (acc.append(e.event), acc)[1]
+        )
+        assert per_entity["u1"] == ["view", "view"]
+        assert per_entity["i1"] == ["$set", "$set", "$unset"]
+
 
 class TestFakeWorkflow:
     def test_fn_runs_through_evaluation_lifecycle(self, memory_storage):
@@ -150,5 +161,110 @@ class TestMigration:
             # events round-trip exactly (ids, times, properties)
             assert dst.get_events().get("e1", app_id) == \
                 memory_storage.get_events().get("e1", app_id)
+        finally:
+            dst.close()
+
+    def test_channel_id_remap_to_sqlite(self, memory_storage, tmp_path):
+        """A target backend that assigns its own channel ids must still
+        receive channel events under the TARGET id (was: orphaned)."""
+        from pio_tpu.tools.migrate import migrate_events
+
+        app_id = _seed(memory_storage, "remapapp")
+        # burn a channel id so the source channel id is > 1
+        other_app = memory_storage.get_metadata_apps().insert(App(0, "oth"))
+        memory_storage.get_metadata_channels().insert(
+            Channel(0, "burned", other_app)
+        )
+        cid = memory_storage.get_metadata_channels().insert(
+            Channel(0, "mobile", app_id)
+        )
+        assert cid > 1
+        memory_storage.get_events().init(app_id, cid)
+        memory_storage.get_events().insert(
+            Event(event="buy", entity_type="user", entity_id="u9",
+                  event_id="chan-ev"),
+            app_id, cid,
+        )
+
+        dst = Storage(env={
+            "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / "dst.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        })
+        try:
+            migrate_events(memory_storage, dst, app_ids=[app_id])
+            dst_channels = dst.get_metadata_channels().get_by_appid(app_id)
+            assert [c.name for c in dst_channels] == ["mobile"]
+            dst_cid = dst_channels[0].id
+            chan = list(dst.get_events().find(app_id, dst_cid, limit=-1))
+            assert [e.event_id for e in chan] == ["chan-ev"]
+        finally:
+            dst.close()
+
+    def test_rerun_is_idempotent_on_sqlite(self, memory_storage, tmp_path):
+        from pio_tpu.tools.migrate import migrate_events
+
+        app_id = _seed(memory_storage, "rerunapp")
+        dst = Storage(env={
+            "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / "rerun.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        })
+        try:
+            first = migrate_events(memory_storage, dst, app_ids=[app_id])
+            second = migrate_events(memory_storage, dst, app_ids=[app_id])
+            assert second.apps == 0 and second.access_keys == 0
+            # events re-upsert by id: no duplicates, no crash
+            assert second.events == first.events
+            assert len(list(dst.get_events().find(app_id, limit=-1))) == \
+                first.events
+        finally:
+            dst.close()
+
+    def test_key_bound_to_other_app_fails_fast(self, memory_storage):
+        from pio_tpu.data.storage import StorageError
+        from pio_tpu.tools.migrate import migrate_events
+
+        app_id = _seed(memory_storage, "keyapp2")
+        memory_storage.get_metadata_access_keys().insert(
+            AccessKey("SHARED", app_id)
+        )
+        dst = Storage(env={
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        try:
+            # target mirrors the source app at id 1, but SHARED is bound
+            # to a different app there
+            dst.get_metadata_apps().insert(App(0, "keyapp2"))
+            other = dst.get_metadata_apps().insert(App(0, "other"))
+            dst.get_metadata_access_keys().insert(AccessKey("SHARED", other))
+            with pytest.raises(StorageError, match="bound to app"):
+                migrate_events(memory_storage, dst, app_ids=[app_id])
+        finally:
+            dst.close()
+
+    def test_metadata_conflict_fails_fast(self, memory_storage, tmp_path):
+        from pio_tpu.data.storage import StorageError
+        from pio_tpu.tools.migrate import migrate_events
+
+        app_id = _seed(memory_storage, "conflictapp")
+        dst = Storage(env={
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        try:
+            # same id, different name on the target
+            dst.get_metadata_apps().insert(App(app_id, "other-name"))
+            with pytest.raises(StorageError):
+                migrate_events(memory_storage, dst, app_ids=[app_id])
         finally:
             dst.close()

@@ -7,6 +7,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 from pio_tpu.parallel.distributed import (
     distributed_env,
     initialize_distributed,
@@ -35,6 +37,14 @@ def test_env_parsing(monkeypatch):
         "num_processes": 4,
         "process_id": 2,
     }
+
+
+def test_partial_env_fails_fast(monkeypatch):
+    monkeypatch.setenv("PIO_TPU_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.delenv("PIO_TPU_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("PIO_TPU_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="PIO_TPU_NUM_PROCESSES"):
+        distributed_env()
 
 
 def test_real_coordinator_single_process():
