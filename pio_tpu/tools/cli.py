@@ -103,6 +103,45 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_run(args) -> int:
+    """Run a user script in the workflow environment (reference
+    Console.scala `run` verb: arbitrary main class on the configured
+    cluster; here: in-process with storage + mesh config active)."""
+    import runpy
+
+    script = args.script
+    if not os.path.exists(script):
+        return _fail(f"script {script} not found")
+    get_storage()  # fail fast on storage misconfiguration
+    sys.argv = [script] + list(args.args or [])
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)) or ".")
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+def cmd_shell(args) -> int:
+    """Interactive REPL with the storage + event store preloaded
+    (reference bin/pio-shell: spark-shell with the assembly on the
+    classpath)."""
+    import code
+
+    from pio_tpu.data.eventstore import EventStore
+
+    storage = get_storage()
+    ns = {
+        "storage": storage,
+        "events": storage.get_events(),
+        "apps": storage.get_metadata_apps(),
+        "event_store": EventStore(storage),
+    }
+    banner = (
+        f"pio-tpu {__version__} shell\n"
+        "preloaded: storage, events, apps, event_store"
+    )
+    code.interact(banner=banner, local=ns)
+    return 0
+
+
 def cmd_app(args) -> int:
     storage = get_storage()
     apps = storage.get_metadata_apps()
@@ -522,6 +561,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
     sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    x = sub.add_parser("run")
+    x.add_argument("script")
+    x.add_argument("args", nargs="*")
+    x.set_defaults(fn=cmd_run)
+
+    sub.add_parser("shell").set_defaults(fn=cmd_shell)
 
     pa = sub.add_parser("app")
     pas = pa.add_subparsers(dest="subcommand", required=True)

@@ -103,6 +103,19 @@ def test_sharded_implicit_nondivisible_matches():
     np.testing.assert_allclose(s1, s2, rtol=2e-2, atol=2e-2)
 
 
+def test_nnz_bucketing_is_inert():
+    """Padding COO to a chunk multiple (compile reuse) must not change the
+    result: sentinels carry invalid ids on BOTH sides (was: pad entries
+    looked like ratings of item 0)."""
+    users, items, vals, nu, ni = synthetic(n_users=50, n_items=30, seed=3)
+    base = als_train(users, items, vals, nu, ni,
+                     ALSParams(rank=4, iterations=5, reg=0.1, chunk=1))
+    padded = als_train(users, items, vals, nu, ni,
+                       ALSParams(rank=4, iterations=5, reg=0.1, chunk=4096))
+    assert abs(rmse(base, users, items, vals)
+               - rmse(padded, users, items, vals)) < 1e-5
+
+
 def test_predict_pairs_shapes():
     users, items, vals, nu, ni = synthetic(n_users=10, n_items=8)
     model = als_train(users, items, vals, nu, ni,

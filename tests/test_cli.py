@@ -26,6 +26,25 @@ def test_version_and_status(cli):
     assert "sanity check passed" in out.out
 
 
+def test_run_script(cli, tmp_path):
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import sys\n"
+        "from pio_tpu.data.storage import get_storage\n"
+        "s = get_storage()\n"
+        "s.get_metadata_apps()  # storage reachable\n"
+        "print('ran with', sys.argv[1])\n"
+    )
+    code, out = cli("run", str(script), "arg1")
+    assert code == 0
+    assert "ran with arg1" in out.out
+
+
+def test_run_missing_script(cli, tmp_path):
+    code, out = cli("run", str(tmp_path / "nope.py"))
+    assert code == 1
+
+
 def test_app_lifecycle(cli):
     code, out = cli("app", "new", "myapp", "--description", "d")
     assert code == 0 and "Access key:" in out.out
