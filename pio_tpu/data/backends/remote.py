@@ -1,0 +1,354 @@
+"""`remote` storage backend — client for the storage server.
+
+Mounts a storage server (server/storageserver.py) running on another host
+as a full local DAO set, giving multi-host jobs and split deployments one
+shared store. Counterpart of the reference pointing its JDBC/HBase/ES
+backends at a networked database (jdbc/StorageClient.scala,
+hbase/StorageClient.scala); the locator config is the same env-var shape:
+
+    PIO_STORAGE_SOURCES_SHARED_TYPE=remote
+    PIO_STORAGE_SOURCES_SHARED_URL=http://storage-host:7072
+    PIO_STORAGE_SOURCES_SHARED_KEY=<server key, optional>
+    PIO_STORAGE_SOURCES_SHARED_TIMEOUT=30       (seconds, optional)
+    PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=SHARED
+    ...
+
+Transport: POST /rpc, JSON codecs shared with the server
+(data/backends/wire.py). Failures surface as StorageError with the server's
+message; connection errors mention the URL so `pio status` output is
+actionable.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from datetime import datetime
+from typing import Iterator, Sequence
+
+from pio_tpu.data import dao as d
+from pio_tpu.data.backends import wire as w
+from pio_tpu.data.event import Event
+from pio_tpu.data.storage import Backend, StorageError
+
+
+class RemoteBackend(Backend):
+    def __init__(self, config):
+        super().__init__(config)
+        url = config.properties.get("URL", "http://127.0.0.1:7072")
+        self._url = url.rstrip("/")
+        self._key = config.properties.get("KEY", "")
+        self._timeout = float(config.properties.get("TIMEOUT", "30"))
+        verify = config.properties.get("VERIFY_TLS", "true").lower()
+        self._ssl_ctx = None
+        if self._url.startswith("https") and verify in ("false", "0", "no"):
+            self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
+
+    # -- transport ----------------------------------------------------------
+    def call(self, family: str, method: str, kwargs: dict):
+        url = f"{self._url}/rpc"
+        if self._key:
+            url += "?" + urllib.parse.urlencode({"accessKey": self._key})
+        body = json.dumps(
+            {"family": family, "method": method, "kwargs": kwargs}
+        ).encode()
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self._timeout, context=self._ssl_ctx
+            ) as resp:
+                payload = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode() or "{}")
+            except json.JSONDecodeError:
+                payload = {}
+            msg = payload.get("message", str(e))
+            raise StorageError(
+                f"storage server {self._url}: {family}.{method}: {msg}"
+            ) from e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise StorageError(
+                f"storage server {self._url} unreachable: {e}"
+            ) from e
+        return payload.get("result")
+
+    def close(self):
+        pass
+
+    # -- DAO factories ------------------------------------------------------
+    def apps(self):
+        return _RemoteApps(self)
+
+    def access_keys(self):
+        return _RemoteAccessKeys(self)
+
+    def channels(self):
+        return _RemoteChannels(self)
+
+    def engine_instances(self):
+        return _RemoteEngineInstances(self)
+
+    def engine_manifests(self):
+        return _RemoteEngineManifests(self)
+
+    def evaluation_instances(self):
+        return _RemoteEvaluationInstances(self)
+
+    def models(self):
+        return _RemoteModels(self)
+
+    def events(self):
+        return _RemoteEvents(self)
+
+
+class _Remote:
+    family = ""
+
+    def __init__(self, b: RemoteBackend):
+        self.b = b
+
+    def call(self, method: str, **kwargs):
+        return self.b.call(self.family, method, kwargs)
+
+
+class _RemoteApps(_Remote, d.AppsDAO):
+    family = "apps"
+
+    def insert(self, app):
+        return self.call("insert", app=w.app_to_wire(app))
+
+    def get(self, app_id):
+        r = self.call("get", app_id=app_id)
+        return w.app_from_wire(r) if r else None
+
+    def get_by_name(self, name):
+        r = self.call("get_by_name", name=name)
+        return w.app_from_wire(r) if r else None
+
+    def get_all(self):
+        return [w.app_from_wire(x) for x in self.call("get_all")]
+
+    def update(self, app):
+        self.call("update", app=w.app_to_wire(app))
+
+    def delete(self, app_id):
+        self.call("delete", app_id=app_id)
+
+
+class _RemoteAccessKeys(_Remote, d.AccessKeysDAO):
+    family = "access_keys"
+
+    def insert(self, k):
+        return self.call("insert", access_key=w.access_key_to_wire(k))
+
+    def get(self, key):
+        r = self.call("get", key=key)
+        return w.access_key_from_wire(r) if r else None
+
+    def get_all(self):
+        return [w.access_key_from_wire(x) for x in self.call("get_all")]
+
+    def get_by_appid(self, appid):
+        return [
+            w.access_key_from_wire(x)
+            for x in self.call("get_by_appid", appid=appid)
+        ]
+
+    def update(self, k):
+        self.call("update", access_key=w.access_key_to_wire(k))
+
+    def delete(self, key):
+        self.call("delete", key=key)
+
+
+class _RemoteChannels(_Remote, d.ChannelsDAO):
+    family = "channels"
+
+    def insert(self, channel):
+        return self.call("insert", channel=w.channel_to_wire(channel))
+
+    def get(self, channel_id):
+        r = self.call("get", channel_id=channel_id)
+        return w.channel_from_wire(r) if r else None
+
+    def get_by_appid(self, appid):
+        return [
+            w.channel_from_wire(x)
+            for x in self.call("get_by_appid", appid=appid)
+        ]
+
+    def delete(self, channel_id):
+        self.call("delete", channel_id=channel_id)
+
+
+class _RemoteEngineInstances(_Remote, d.EngineInstancesDAO):
+    family = "engine_instances"
+
+    def insert(self, i):
+        return self.call("insert", instance=w.engine_instance_to_wire(i))
+
+    def get(self, instance_id):
+        r = self.call("get", instance_id=instance_id)
+        return w.engine_instance_from_wire(r) if r else None
+
+    def get_all(self):
+        return [
+            w.engine_instance_from_wire(x) for x in self.call("get_all")
+        ]
+
+    def update(self, i):
+        self.call("update", instance=w.engine_instance_to_wire(i))
+
+    def delete(self, instance_id):
+        self.call("delete", instance_id=instance_id)
+
+
+class _RemoteEngineManifests(_Remote, d.EngineManifestsDAO):
+    family = "engine_manifests"
+
+    def insert(self, m):
+        self.call("insert", manifest=w.engine_manifest_to_wire(m))
+
+    def get(self, manifest_id, version):
+        r = self.call("get", manifest_id=manifest_id, version=version)
+        return w.engine_manifest_from_wire(r) if r else None
+
+    def get_all(self):
+        return [
+            w.engine_manifest_from_wire(x) for x in self.call("get_all")
+        ]
+
+    def update(self, m, upsert=False):
+        self.call("update", manifest=w.engine_manifest_to_wire(m),
+                  upsert=upsert)
+
+    def delete(self, manifest_id, version):
+        self.call("delete", manifest_id=manifest_id, version=version)
+
+
+class _RemoteEvaluationInstances(_Remote, d.EvaluationInstancesDAO):
+    family = "evaluation_instances"
+
+    def insert(self, i):
+        return self.call("insert", instance=w.evaluation_instance_to_wire(i))
+
+    def get(self, instance_id):
+        r = self.call("get", instance_id=instance_id)
+        return w.evaluation_instance_from_wire(r) if r else None
+
+    def get_all(self):
+        return [
+            w.evaluation_instance_from_wire(x) for x in self.call("get_all")
+        ]
+
+    def update(self, i):
+        self.call("update", instance=w.evaluation_instance_to_wire(i))
+
+    def delete(self, instance_id):
+        self.call("delete", instance_id=instance_id)
+
+
+class _RemoteModels(_Remote, d.ModelsDAO):
+    family = "models"
+
+    def insert(self, m):
+        self.call("insert", model=w.model_to_wire(m))
+
+    def get(self, model_id):
+        r = self.call("get", model_id=model_id)
+        return w.model_from_wire(r) if r else None
+
+    def delete(self, model_id):
+        self.call("delete", model_id=model_id)
+
+
+class _RemoteEvents(_Remote, d.EventsDAO):
+    family = "events"
+
+    def init(self, app_id, channel_id=None):
+        return bool(self.call("init", app_id=app_id, channel_id=channel_id))
+
+    def remove(self, app_id, channel_id=None):
+        return bool(self.call("remove", app_id=app_id, channel_id=channel_id))
+
+    def close(self):
+        pass
+
+    def insert(self, event: Event, app_id, channel_id=None):
+        return self.call(
+            "insert", event=w.event_to_wire(event), app_id=app_id,
+            channel_id=channel_id,
+        )
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        # one round trip for the whole batch (the server loops locally)
+        return self.call(
+            "insert_batch", events=[w.event_to_wire(e) for e in events],
+            app_id=app_id, channel_id=channel_id,
+        )
+
+    def get(self, event_id, app_id, channel_id=None):
+        r = self.call(
+            "get", event_id=event_id, app_id=app_id, channel_id=channel_id
+        )
+        return w.event_from_wire(r) if r else None
+
+    def delete(self, event_id, app_id, channel_id=None):
+        return bool(self.call(
+            "delete", event_id=event_id, app_id=app_id, channel_id=channel_id
+        ))
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        query = w.find_kwargs_to_wire(
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit, reversed=reversed,
+        )
+        rows = self.call(
+            "find", app_id=app_id, channel_id=channel_id, query=query
+        )
+        return iter(w.event_from_wire(r) for r in rows)
+
+    def aggregate_properties(
+        self, app_id, entity_type, channel_id=None, start_time=None,
+        until_time=None, required=None,
+    ):
+        # server-side fold: one round trip instead of shipping every
+        # $set/$unset/$delete event over the wire
+        kw = {"app_id": app_id, "entity_type": entity_type,
+              "channel_id": channel_id}
+        if start_time is not None:
+            kw["startTime"] = w._dt(start_time)
+        if until_time is not None:
+            kw["untilTime"] = w._dt(until_time)
+        if required is not None:
+            kw["required"] = list(required)
+        out = self.call("aggregate_properties", **kw)
+        return {
+            eid: w.property_map_from_wire(p) for eid, p in out.items()
+        }

@@ -54,11 +54,13 @@ CREATE TABLE IF NOT EXISTS event_namespaces (
   app_id INTEGER NOT NULL, channel_id INTEGER,
   PRIMARY KEY (app_id, channel_id));
 CREATE TABLE IF NOT EXISTS events (
-  id TEXT PRIMARY KEY, app_id INTEGER NOT NULL, channel_id INTEGER,
+  id TEXT NOT NULL, app_id INTEGER NOT NULL, channel_id INTEGER,
   event TEXT NOT NULL, entity_type TEXT NOT NULL, entity_id TEXT NOT NULL,
   target_entity_type TEXT, target_entity_id TEXT, properties TEXT,
   event_time TEXT NOT NULL, event_time_ms INTEGER NOT NULL, tags TEXT,
   pr_id TEXT, creation_time TEXT NOT NULL);
+CREATE UNIQUE INDEX IF NOT EXISTS idx_events_ns_id
+  ON events (app_id, IFNULL(channel_id, -1), id);
 CREATE INDEX IF NOT EXISTS idx_events_app_time
   ON events (app_id, channel_id, event_time_ms);
 CREATE INDEX IF NOT EXISTS idx_events_entity
@@ -79,8 +81,41 @@ class SqliteBackend(Backend):
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._lock = threading.RLock()
         with self._lock:
+            self._migrate_events_pk()
             self._conn.executescript(_SCHEMA)
             self._conn.commit()
+
+    def _migrate_events_pk(self):
+        """Rebuild pre-round-2 events tables whose PK was the global event id.
+
+        The old `id TEXT PRIMARY KEY` let an insert in one (app, channel)
+        namespace silently replace another namespace's event with the same
+        client-supplied id. Uniqueness is now per-namespace
+        (app_id, channel_id, id) — matching the memory backend's per-namespace
+        dicts and the reference's table-per-app layout
+        (data/.../storage/hbase/HBEventsUtil.scala tableName), where a
+        Put-by-rowkey can never cross namespaces.
+        """
+        row = self._conn.execute(
+            "SELECT sql FROM sqlite_master WHERE type='table' AND name='events'"
+        ).fetchone()
+        if not row or "id TEXT PRIMARY KEY" not in (row[0] or ""):
+            return
+        self._conn.executescript(
+            """
+            ALTER TABLE events RENAME TO events_v1;
+            CREATE TABLE events (
+              id TEXT NOT NULL, app_id INTEGER NOT NULL, channel_id INTEGER,
+              event TEXT NOT NULL, entity_type TEXT NOT NULL,
+              entity_id TEXT NOT NULL, target_entity_type TEXT,
+              target_entity_id TEXT, properties TEXT, event_time TEXT NOT NULL,
+              event_time_ms INTEGER NOT NULL, tags TEXT, pr_id TEXT,
+              creation_time TEXT NOT NULL);
+            INSERT INTO events SELECT * FROM events_v1;
+            DROP TABLE events_v1;
+            """
+        )
+        self._conn.commit()
 
     def close(self):
         with self._lock:
@@ -488,8 +523,10 @@ class _SqlEvents(d.EventsDAO):
     def insert(self, event: Event, app_id, channel_id=None):
         self._check_ns(app_id, channel_id)
         eid = event.event_id or new_event_id()
-        # OR REPLACE: re-inserting an explicit event id upserts, matching the
-        # memory backend and the reference's HBase Put-by-rowkey semantics
+        # OR REPLACE against the per-namespace unique index
+        # (app_id, channel_id, id): re-inserting an explicit event id upserts
+        # within its own namespace only, matching the memory backend and the
+        # reference's HBase Put-by-rowkey semantics
         # (hbase/HBEventsUtil.scala:144) — and making migration re-runs
         # idempotent.
         self.b._exec(

@@ -89,6 +89,8 @@ _BACKEND_REGISTRY: dict[str, str] = {
     # native C++ append-only log (the HBase-analog event store)
     "eventlog": "pio_tpu.data.backends.eventlog:EventLogBackend",
     "hbase": "pio_tpu.data.backends.eventlog:EventLogBackend",  # operational alias
+    # networked client for the storage server (multi-host shared store)
+    "remote": "pio_tpu.data.backends.remote:RemoteBackend",
 }
 
 

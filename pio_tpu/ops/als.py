@@ -51,15 +51,20 @@ class ALSParams:
     alpha: float = 1.0        # implicit confidence scale
     implicit: bool = False
     seed: int = 3
-    chunk: int = 65536        # retained for API compat; slot layout supersedes it
+    chunk: int = 65536        # nnz bucketing quantum: ratings are padded to a
+                              # multiple of this so retrains with slightly
+                              # different data sizes reuse the compiled program
     width: int = 128          # ratings per slot (= MXU contraction width)
     chunk_slots: int = 8192   # slots per accumulation step (bounds gather temp)
-    cg_iters: int = -1        # -1: auto (min(2*rank,40)); 0: direct Cholesky
+    cg_iters: int = -1        # -1: auto (max(2*rank,40)); 0: direct Cholesky
 
     def resolved_cg_iters(self) -> int:
         # 2x the k-dim Krylov bound: CG in f32 with Jacobi preconditioning
-        # needs the extra iterations to reach direct-solve quality
-        return min(2 * self.rank, 40) if self.cg_iters < 0 else self.cg_iters
+        # needs the extra iterations to reach direct-solve quality. The count
+        # scales WITH rank — a fixed cap below the rank-k Krylov bound would
+        # quietly under-converge high-rank trains (MLlib templates commonly
+        # use rank 50-100); the small floor just covers degenerate ranks.
+        return max(2 * self.rank, 8) if self.cg_iters < 0 else self.cg_iters
 
 
 @jax.tree_util.register_pytree_node_class
@@ -292,8 +297,13 @@ def als_train(
     n_users: int,
     n_items: int,
     params: ALSParams,
+    init: ALSModel | None = None,
 ) -> ALSModel:
-    """Train on one device (or one logical device under jit)."""
+    """Train on one device (or one logical device under jit).
+
+    `init` warm-starts from an existing model (e.g. to continue sweeps or to
+    record a per-sweep metric trajectory by calling with iterations=1 in a
+    loop — the compiled program is reused across such calls)."""
     u = np.ascontiguousarray(user_idx, dtype=np.int32)
     i = np.ascontiguousarray(item_idx, dtype=np.int32)
     v = np.ascontiguousarray(values, dtype=np.float32)
@@ -307,10 +317,13 @@ def als_train(
         i = np.concatenate([i, np.full(pad, n_items, np.int32)])
         v = np.concatenate([v, np.zeros(pad, np.float32)])
 
-    key = jax.random.PRNGKey(params.seed)
-    ku, ki = jax.random.split(key)
-    user0 = init_factors(n_users, params.rank, ku)
-    item0 = init_factors(n_items, params.rank, ki)
+    if init is not None:
+        user0, item0 = init.user_factors, init.item_factors
+    else:
+        key = jax.random.PRNGKey(params.seed)
+        ku, ki = jax.random.split(key)
+        user0 = init_factors(n_users, params.rank, ku)
+        item0 = init_factors(n_items, params.rank, ki)
     users, items = _train_jit(
         u, i, v, n_users, n_items, params, user0, item0
     )

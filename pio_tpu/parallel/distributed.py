@@ -44,19 +44,15 @@ def distributed_env() -> dict | None:
         return None
     nproc = os.environ.get("PIO_TPU_NUM_PROCESSES")
     pid = os.environ.get("PIO_TPU_PROCESS_ID")
-    if nproc is None or pid is None:
-        # A coordinator with no process count/index means every host would
-        # form its own 1-process "cluster" — fail fast instead.
-        raise ValueError(
-            "PIO_TPU_COORDINATOR is set but "
-            "PIO_TPU_NUM_PROCESSES/PIO_TPU_PROCESS_ID are not; all three "
-            "are required for a multi-host job"
-        )
-    return {
-        "coordinator_address": addr,
-        "num_processes": int(nproc),
-        "process_id": int(pid),
-    }
+    env = {"coordinator_address": addr}
+    # Completeness is validated on the MERGED args+env config inside
+    # initialize_distributed — a launcher may legitimately pass
+    # num_processes/process_id as arguments with only the coordinator in env.
+    if nproc is not None:
+        env["num_processes"] = int(nproc)
+    if pid is not None:
+        env["process_id"] = int(pid)
+    return env
 
 
 def initialize_distributed(
@@ -87,6 +83,15 @@ def initialize_distributed(
     if kwargs["coordinator_address"] is None:
         # not configured: single-host (or TPU-pod auto-detect at first use)
         return False
+    if kwargs["num_processes"] is None or kwargs["process_id"] is None:
+        # A coordinator with no process count/index means every host would
+        # form its own 1-process "cluster" — fail fast on the merged config.
+        raise ValueError(
+            "a coordinator address is configured but num_processes/"
+            "process_id are not (set PIO_TPU_NUM_PROCESSES/"
+            "PIO_TPU_PROCESS_ID or pass them as arguments); all three are "
+            "required for a multi-host job"
+        )
     jax.distributed.initialize(**kwargs)
     _initialized = True
     log.info(

@@ -1,0 +1,237 @@
+"""Storage server — the networked, multi-host-shareable storage backend.
+
+Exposes the FULL DAO surface (events + metadata + models) of any local
+backend over HTTP so that every host in a multi-host training job — and any
+number of event servers, deploy servers, and CLIs on other machines — share
+ONE store. This fills the role of the reference's networked backends
+(JDBC/Postgres `data/.../storage/jdbc/JDBCLEvents.scala:106`, HBase
+`hbase/HBEventsUtil.scala:74-142`, Elasticsearch metadata): this image has
+no database server or drivers, so instead of speaking someone else's wire
+protocol the framework ships its own storage service — one process owns the
+(sqlite/eventlog/memory) store and everyone else mounts it via the `remote`
+backend (data/backends/remote.py).
+
+Protocol: POST /rpc with {"family", "method", "kwargs"} — an explicit
+allowlisted method table per DAO family (no reflective dispatch), JSON wire
+codecs from data/backends/wire.py. GET /health for liveness. Optional
+server key (?accessKey=) + TLS, same as the other three servers.
+
+Run: `pio storageserver --port 7072` (tools/cli.py), or in-process via
+create_storage_server for tests.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from pio_tpu.data import dao as daomod
+from pio_tpu.data.backends import wire as w
+from pio_tpu.data.storage import Storage, StorageError, get_storage
+from pio_tpu.server.http import HttpApp, HttpServer, Request
+
+log = logging.getLogger("pio_tpu.storageserver")
+
+
+@dataclass
+class StorageServerConfig:
+    # Loopback by default: this server exposes the FULL DAO surface
+    # (including access keys and model blobs), so a non-loopback bind
+    # requires a server_key (enforced in create_storage_server).
+    ip: str = "127.0.0.1"
+    port: int = 7072
+    server_key: str = ""          # shared secret required on every call
+    certfile: str | None = None
+    keyfile: str | None = None
+
+
+def _opt(conv, v):
+    return conv(v) if v is not None else None
+
+
+# family -> method -> handler(dao, kwargs) -> jsonable result.
+# Explicit table: adding a DAO method to the protocol is a deliberate act.
+_METHODS = {
+    "apps": {
+        "insert": lambda dao, kw: dao.insert(w.app_from_wire(kw["app"])),
+        "get": lambda dao, kw: _opt(w.app_to_wire, dao.get(kw["app_id"])),
+        "get_by_name": lambda dao, kw: _opt(
+            w.app_to_wire, dao.get_by_name(kw["name"])),
+        "get_all": lambda dao, kw: [w.app_to_wire(a) for a in dao.get_all()],
+        "update": lambda dao, kw: dao.update(w.app_from_wire(kw["app"])),
+        "delete": lambda dao, kw: dao.delete(kw["app_id"]),
+    },
+    "access_keys": {
+        "insert": lambda dao, kw: dao.insert(
+            w.access_key_from_wire(kw["access_key"])),
+        "get": lambda dao, kw: _opt(
+            w.access_key_to_wire, dao.get(kw["key"])),
+        "get_all": lambda dao, kw: [
+            w.access_key_to_wire(k) for k in dao.get_all()],
+        "get_by_appid": lambda dao, kw: [
+            w.access_key_to_wire(k) for k in dao.get_by_appid(kw["appid"])],
+        "update": lambda dao, kw: dao.update(
+            w.access_key_from_wire(kw["access_key"])),
+        "delete": lambda dao, kw: dao.delete(kw["key"]),
+    },
+    "channels": {
+        "insert": lambda dao, kw: dao.insert(
+            w.channel_from_wire(kw["channel"])),
+        "get": lambda dao, kw: _opt(
+            w.channel_to_wire, dao.get(kw["channel_id"])),
+        "get_by_appid": lambda dao, kw: [
+            w.channel_to_wire(c) for c in dao.get_by_appid(kw["appid"])],
+        "delete": lambda dao, kw: dao.delete(kw["channel_id"]),
+    },
+    "engine_instances": {
+        "insert": lambda dao, kw: dao.insert(
+            w.engine_instance_from_wire(kw["instance"])),
+        "get": lambda dao, kw: _opt(
+            w.engine_instance_to_wire, dao.get(kw["instance_id"])),
+        "get_all": lambda dao, kw: [
+            w.engine_instance_to_wire(i) for i in dao.get_all()],
+        "update": lambda dao, kw: dao.update(
+            w.engine_instance_from_wire(kw["instance"])),
+        "delete": lambda dao, kw: dao.delete(kw["instance_id"]),
+    },
+    "engine_manifests": {
+        "insert": lambda dao, kw: dao.insert(
+            w.engine_manifest_from_wire(kw["manifest"])),
+        "get": lambda dao, kw: _opt(
+            w.engine_manifest_to_wire,
+            dao.get(kw["manifest_id"], kw["version"])),
+        "get_all": lambda dao, kw: [
+            w.engine_manifest_to_wire(m) for m in dao.get_all()],
+        "update": lambda dao, kw: dao.update(
+            w.engine_manifest_from_wire(kw["manifest"]),
+            upsert=bool(kw.get("upsert", False))),
+        "delete": lambda dao, kw: dao.delete(kw["manifest_id"], kw["version"]),
+    },
+    "evaluation_instances": {
+        "insert": lambda dao, kw: dao.insert(
+            w.evaluation_instance_from_wire(kw["instance"])),
+        "get": lambda dao, kw: _opt(
+            w.evaluation_instance_to_wire, dao.get(kw["instance_id"])),
+        "get_all": lambda dao, kw: [
+            w.evaluation_instance_to_wire(i) for i in dao.get_all()],
+        "update": lambda dao, kw: dao.update(
+            w.evaluation_instance_from_wire(kw["instance"])),
+        "delete": lambda dao, kw: dao.delete(kw["instance_id"]),
+    },
+    "models": {
+        "insert": lambda dao, kw: dao.insert(w.model_from_wire(kw["model"])),
+        "get": lambda dao, kw: _opt(w.model_to_wire, dao.get(kw["model_id"])),
+        "delete": lambda dao, kw: dao.delete(kw["model_id"]),
+    },
+    "events": {
+        "init": lambda dao, kw: dao.init(kw["app_id"], kw.get("channel_id")),
+        "remove": lambda dao, kw: dao.remove(
+            kw["app_id"], kw.get("channel_id")),
+        "insert": lambda dao, kw: dao.insert(
+            w.event_from_wire(kw["event"]), kw["app_id"],
+            kw.get("channel_id")),
+        "insert_batch": lambda dao, kw: dao.insert_batch(
+            [w.event_from_wire(e) for e in kw["events"]], kw["app_id"],
+            kw.get("channel_id")),
+        "get": lambda dao, kw: _opt(
+            w.event_to_wire,
+            dao.get(kw["event_id"], kw["app_id"], kw.get("channel_id"))),
+        "delete": lambda dao, kw: dao.delete(
+            kw["event_id"], kw["app_id"], kw.get("channel_id")),
+        "find": lambda dao, kw: [
+            w.event_to_wire(e) for e in dao.find(
+                kw["app_id"], kw.get("channel_id"),
+                **w.find_kwargs_from_wire(kw.get("query", {})))],
+        "aggregate_properties": lambda dao, kw: {
+            eid: w.property_map_to_wire(p)
+            for eid, p in dao.aggregate_properties(
+                kw["app_id"], kw["entity_type"], kw.get("channel_id"),
+                start_time=w._undt(kw.get("startTime")),
+                until_time=w._undt(kw.get("untilTime")),
+                required=kw.get("required"),
+            ).items()},
+    },
+}
+
+
+def _dao_for(storage: Storage, family: str):
+    getters = {
+        "apps": storage.get_metadata_apps,
+        "access_keys": storage.get_metadata_access_keys,
+        "channels": storage.get_metadata_channels,
+        "engine_instances": storage.get_metadata_engine_instances,
+        "engine_manifests": storage.get_metadata_engine_manifests,
+        "evaluation_instances": storage.get_metadata_evaluation_instances,
+        "models": storage.get_model_data_models,
+        "events": storage.get_events,
+    }
+    if family not in getters:
+        return None
+    return getters[family]()
+
+
+def build_storage_app(
+    storage: Storage | None = None,
+    config: StorageServerConfig | None = None,
+) -> HttpApp:
+    storage = storage or get_storage()
+    config = config or StorageServerConfig()
+    app = HttpApp("storage")
+
+    @app.route("GET", r"/health")
+    def health(req: Request):
+        errors = storage.verify_all()
+        status = 200 if not errors else 503
+        return status, {"status": "ok" if not errors else "degraded",
+                        "errors": errors}
+
+    @app.route("POST", r"/rpc")
+    def rpc(req: Request):
+        if config.server_key and (
+            req.params.get("accessKey", "") != config.server_key
+        ):
+            return 401, {"message": "Invalid accessKey."}
+        body = req.json()
+        if not isinstance(body, dict):
+            return 400, {"message": "body must be a JSON object"}
+        family = body.get("family")
+        method = body.get("method")
+        kwargs = body.get("kwargs") or {}
+        table = _METHODS.get(family)
+        if table is None:
+            return 404, {"message": f"unknown DAO family {family!r}"}
+        fn = table.get(method)
+        if fn is None:
+            return 404, {"message": f"unknown method {family}.{method}"}
+        dao = _dao_for(storage, family)
+        try:
+            result = fn(dao, kwargs)
+        except StorageError as e:
+            return 409, {"message": str(e), "error": "StorageError"}
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"message": f"{type(e).__name__}: {e}",
+                         "error": type(e).__name__}
+        return 200, {"result": result}
+
+    return app
+
+
+def create_storage_server(
+    storage: Storage | None = None,
+    config: StorageServerConfig | None = None,
+) -> HttpServer:
+    from pio_tpu.server.security import server_ssl_context
+
+    config = config or StorageServerConfig()
+    if not config.server_key and config.ip not in ("127.0.0.1", "::1",
+                                                   "localhost"):
+        raise ValueError(
+            "storage server on a non-loopback address requires a server_key "
+            "— it exposes the full DAO surface (access keys, model blobs, "
+            "events) to every host that can reach it"
+        )
+    app = build_storage_app(storage, config)
+    return HttpServer(
+        app, host=config.ip, port=config.port,
+        ssl_context=server_ssl_context(config.certfile, config.keyfile),
+    )

@@ -113,9 +113,13 @@ def cmd_run(args) -> int:
     if not os.path.exists(script):
         return _fail(f"script {script} not found")
     get_storage()  # fail fast on storage misconfiguration
+    saved_argv, saved_path = sys.argv, list(sys.path)
     sys.argv = [script] + list(args.args or [])
     sys.path.insert(0, os.path.dirname(os.path.abspath(script)) or ".")
-    runpy.run_path(script, run_name="__main__")
+    try:
+        runpy.run_path(script, run_name="__main__")
+    finally:
+        sys.argv, sys.path[:] = saved_argv, saved_path
     return 0
 
 
@@ -392,6 +396,30 @@ def cmd_eventserver(args) -> int:
     return 0
 
 
+def cmd_storageserver(args) -> int:
+    """Serve this host's configured storage to other hosts (the networked
+    shared store; reference analogue: pointing every host's PIO_STORAGE_*
+    at one Postgres/HBase — here one host owns the store and the rest mount
+    it with the `remote` backend)."""
+    from pio_tpu.server.storageserver import (
+        StorageServerConfig, create_storage_server,
+    )
+
+    srv = create_storage_server(
+        get_storage(),
+        StorageServerConfig(ip=args.ip, port=args.port,
+                            server_key=args.server_key or "",
+                            certfile=args.cert, keyfile=args.key),
+    )
+    scheme = "https" if srv.tls else "http"
+    print(f"Storage Server on {scheme}://{args.ip}:{srv.port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_adminserver(args) -> int:
     from pio_tpu.tools.admin import create_admin_server
 
@@ -651,6 +679,16 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--cert", help="TLS certificate (PEM) -> serve HTTPS")
     x.add_argument("--key", help="TLS private key (PEM)")
     x.set_defaults(fn=cmd_eventserver)
+
+    x = sub.add_parser("storageserver")
+    # loopback default: a non-loopback bind requires --server-key (the RPC
+    # surface includes access keys and model blobs)
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=7072)
+    x.add_argument("--server-key", help="shared secret required on every call")
+    x.add_argument("--cert", help="TLS certificate (PEM) -> serve HTTPS")
+    x.add_argument("--key", help="TLS private key (PEM)")
+    x.set_defaults(fn=cmd_storageserver)
 
     x = sub.add_parser("adminserver")
     x.add_argument("--ip", default="127.0.0.1")

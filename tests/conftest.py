@@ -50,9 +50,43 @@ def sqlite_storage(tmp_path):
     s.close()
 
 
-@pytest.fixture(params=["memory", "sqlite"])
-def any_storage(request, memory_storage, sqlite_storage):
-    """Parameterized over backends, mirroring the reference's LEventsSpec /
-    PEventsSpec pattern of running one spec body against every backend
-    (LEventsSpec.scala:22-75)."""
-    return memory_storage if request.param == "memory" else sqlite_storage
+@pytest.fixture()
+def remote_storage(tmp_path):
+    """A Storage mounted over the wire: storage server (sqlite under it) on
+    a live socket + `remote` client backend — the networked multi-host
+    store, exercised by the same spec bodies as the local backends."""
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.server.storageserver import (
+        StorageServerConfig, create_storage_server,
+    )
+
+    backing = Storage(env={
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "shared.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    })
+    server = create_storage_server(
+        backing, StorageServerConfig(ip="127.0.0.1", port=0))
+    server.start()
+    client = Storage(env={
+        "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{server.port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+    })
+    yield client
+    server.stop()
+    backing.close()
+
+
+@pytest.fixture(params=["memory", "sqlite", "remote"])
+def any_storage(request):
+    """Parameterized over backends — including the networked remote backend
+    — mirroring the reference's LEventsSpec / PEventsSpec pattern of running
+    one spec body against every backend (LEventsSpec.scala:22-75). Lazy
+    lookup so only the selected backend is constructed (the remote param
+    boots a live HTTP server)."""
+    return request.getfixturevalue(request.param + "_storage")

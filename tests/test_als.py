@@ -103,6 +103,44 @@ def test_sharded_implicit_nondivisible_matches():
     np.testing.assert_allclose(s1, s2, rtol=2e-2, atol=2e-2)
 
 
+def test_high_rank_cg_matches_cholesky():
+    """Rank 64 (the BASELINE.md bench rank, and the MLlib-template range
+    50-100): the auto CG solve must reach direct-Cholesky quality — the
+    round-1 cap of min(2*rank, 40) sat below the rank-k Krylov bound and
+    quietly under-converged exactly here."""
+    users, items, vals, nu, ni = synthetic(
+        n_users=300, n_items=200, rank=8, density=0.4)
+    p_cg = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096)
+    assert p_cg.resolved_cg_iters() >= 2 * 64
+    p_direct = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096,
+                         cg_iters=0)
+    m_cg = als_train(users, items, vals, nu, ni, p_cg)
+    m_direct = als_train(users, items, vals, nu, ni, p_direct)
+    e_cg = rmse(m_cg, users, items, vals)
+    e_direct = rmse(m_direct, users, items, vals)
+    # equal-quality contract: CG within 2% relative of the exact solve
+    assert e_cg < e_direct * 1.02 + 1e-4, (e_cg, e_direct)
+
+
+def test_high_rank_cg_matches_cholesky_implicit():
+    rng = np.random.default_rng(5)
+    nu, ni = 250, 150
+    users = rng.integers(0, nu, 6000)
+    items = rng.integers(0, ni, 6000)
+    vals = rng.integers(1, 6, 6000).astype(np.float32)
+    kw = dict(rank=64, iterations=4, reg=0.05, alpha=10.0, implicit=True,
+              chunk=4096)
+    m_cg = als_train(users, items, vals, nu, ni, ALSParams(**kw))
+    m_direct = als_train(users, items, vals, nu, ni,
+                         ALSParams(**kw, cg_iters=0))
+    # factors from equal-quality solves produce near-identical preference
+    # scores; compare predicted scores on the observed pairs
+    s_cg = np.asarray(predict_pairs(m_cg, users, items))
+    s_direct = np.asarray(predict_pairs(m_direct, users, items))
+    denom = float(np.abs(s_direct).mean()) + 1e-9
+    assert float(np.abs(s_cg - s_direct).mean()) / denom < 0.05
+
+
 def test_nnz_bucketing_is_inert():
     """Padding COO to a chunk multiple (compile reuse) must not change the
     result: sentinels carry invalid ids on BOTH sides (was: pad entries

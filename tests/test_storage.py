@@ -185,6 +185,76 @@ def test_events_namespace_isolation(any_storage):
     assert list(ev.find(1, channel_id=5, limit=-1)) == []
 
 
+def test_events_same_id_across_namespaces(any_storage):
+    # Round-1 advisor repro: a client-supplied event id that exists in a
+    # DIFFERENT (app, channel) namespace must not be touched by an insert —
+    # uniqueness is per-namespace, as in the reference's table-per-app layout
+    # (hbase/HBEventsUtil.scala tableName).
+    import dataclasses
+
+    ev = any_storage.get_events()
+    ev.init(1)
+    ev.init(2)
+    ev.init(1, channel_id=7)
+    e1 = dataclasses.replace(_rate("u1", "i1", 0, 5.0), event_id="E1")
+    e2 = dataclasses.replace(_rate("u9", "i9", 1, 1.0), event_id="E1")
+    assert ev.insert(e1, 1) == "E1"
+    assert ev.insert(e2, 2) == "E1"          # other app, same id
+    assert ev.insert(e2, 1, channel_id=7) == "E1"  # other channel, same id
+    assert ev.get("E1", 1).entity_id == "u1"  # app1's event survived
+    assert ev.get("E1", 2).entity_id == "u9"
+    assert ev.get("E1", 1, channel_id=7).entity_id == "u9"
+    # re-insert into the SAME namespace still upserts
+    e1b = dataclasses.replace(_rate("u1", "i1", 0, 2.0), event_id="E1")
+    assert ev.insert(e1b, 1) == "E1"
+    assert ev.get("E1", 1).properties.get("rating") == 2.0
+    assert len(list(ev.find(1, limit=-1))) == 1
+
+
+def test_sqlite_migrates_old_global_pk(tmp_path):
+    # Databases created before round 2 had `id TEXT PRIMARY KEY` on events;
+    # opening one must rebuild the table to per-namespace uniqueness without
+    # losing rows.
+    import sqlite3
+
+    from pio_tpu.data.storage import StorageClientConfig
+    from pio_tpu.data.backends.sqlite import SqliteBackend
+
+    path = str(tmp_path / "old.db")
+    conn = sqlite3.connect(path)
+    conn.executescript(
+        """
+        CREATE TABLE events (
+          id TEXT PRIMARY KEY, app_id INTEGER NOT NULL, channel_id INTEGER,
+          event TEXT NOT NULL, entity_type TEXT NOT NULL,
+          entity_id TEXT NOT NULL, target_entity_type TEXT,
+          target_entity_id TEXT, properties TEXT, event_time TEXT NOT NULL,
+          event_time_ms INTEGER NOT NULL, tags TEXT, pr_id TEXT,
+          creation_time TEXT NOT NULL);
+        CREATE TABLE event_namespaces (
+          app_id INTEGER NOT NULL, channel_id INTEGER,
+          PRIMARY KEY (app_id, channel_id));
+        INSERT INTO event_namespaces VALUES (1, NULL);
+        INSERT INTO events VALUES (
+          'E1', 1, NULL, 'rate', 'user', 'u1', 'item', 'i1', '{"rating": 4}',
+          '2020-01-01T00:00:00+00:00', 1577836800000, '[]', NULL,
+          '2020-01-01T00:00:00+00:00');
+        """
+    )
+    conn.commit()
+    conn.close()
+
+    b = SqliteBackend(StorageClientConfig(properties={"PATH": path}))
+    ev = b.events()
+    assert ev.get("E1", 1).entity_id == "u1"   # row survived migration
+    ev.init(2)
+    import dataclasses
+    assert ev.insert(
+        dataclasses.replace(_rate("u2", "i2", 0), event_id="E1"), 2) == "E1"
+    assert ev.get("E1", 1).entity_id == "u1"   # old namespace untouched
+    b.close()
+
+
 def test_events_uninitialized_namespace_raises(any_storage):
     ev = any_storage.get_events()
     with pytest.raises(StorageError):
