@@ -119,6 +119,10 @@ class EntityIdIndex:
     def id_of(self, index: int) -> str:
         return self._id_array[index]
 
+    def ids(self) -> list[str]:
+        """All entity ids in dense-index order."""
+        return list(self._id_array)
+
     def index_of(self, entity_id: str) -> int:
         return self.bimap[entity_id]
 

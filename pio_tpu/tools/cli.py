@@ -46,18 +46,26 @@ def _load_variant(engine_dir: str) -> dict:
         return json.load(f)
 
 
-def _load_factory(class_path: str):
+def _load_factory(class_path: str, engine_dir: str | None = None):
     """'pkg.module.ClassName' -> class (reference WorkflowUtils.getEngine
-    reflective load)."""
+    reflective load). With engine_dir, the directory joins sys.path first so
+    user-code engines (`engine.MyEngine` next to engine.json — the template
+    layout, reference examples/*/src/main/scala/Engine.scala) resolve."""
     module_name, _, cls_name = class_path.rpartition(".")
     if not module_name:
         raise ValueError(f"invalid class path {class_path!r}")
+    if engine_dir:
+        d = os.path.abspath(engine_dir)
+        if d not in sys.path:
+            # stays on sys.path: the user module may lazily import more of
+            # its directory at predict time, long after this returns
+            sys.path.insert(0, d)
     mod = importlib.import_module(module_name)
     return getattr(mod, cls_name)
 
 
-def _engine_from_variant(variant: dict):
-    factory = _load_factory(variant["engineFactory"])
+def _engine_from_variant(variant: dict, engine_dir: str | None = None):
+    factory = _load_factory(variant["engineFactory"], engine_dir)
     engine = factory.apply()
     return engine, engine.engine_params_from_variant(variant)
 
@@ -268,7 +276,7 @@ def cmd_build(args) -> int:
     """Check the engine dir: engine.json parses + factory imports
     (replaces the reference's sbt package, Console.compile:933-997)."""
     variant = _load_variant(args.engine_dir)
-    engine, ep = _engine_from_variant(variant)
+    engine, ep = _engine_from_variant(variant, args.engine_dir)
     print(f"Engine factory {variant['engineFactory']} loads; "
           f"{len(ep.algorithms)} algorithm(s) configured.")
     return 0
@@ -279,7 +287,7 @@ def cmd_train(args) -> int:
     from pio_tpu.workflow.train import run_train
 
     variant = _load_variant(args.engine_dir)
-    engine, ep = _engine_from_variant(variant)
+    engine, ep = _engine_from_variant(variant, args.engine_dir)
     engine_id, engine_version, engine_variant = _engine_ids(
         variant, args.engine_dir
     )
@@ -326,7 +334,7 @@ def cmd_deploy(args) -> int:
     from pio_tpu.workflow.serve import ServingConfig, create_query_server
 
     variant = _load_variant(args.engine_dir)
-    engine, ep = _engine_from_variant(variant)
+    engine, ep = _engine_from_variant(variant, args.engine_dir)
     engine_id, engine_version, engine_variant = _engine_ids(
         variant, args.engine_dir
     )
@@ -730,6 +738,22 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    # Platform override for CPU-only hosts / CI. Must use the config API:
+    # some deployments (including this project's own test image) pin
+    # JAX_PLATFORMS at interpreter startup, so the plain env var is
+    # snapshotted before user code runs.
+    platform = os.environ.get("PIO_TPU_PLATFORM")
+    n_cpu = os.environ.get("PIO_TPU_CPU_DEVICES")
+    if platform or n_cpu:
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        if n_cpu:
+            try:
+                jax.config.update("jax_num_cpu_devices", int(n_cpu))
+            except ValueError:
+                return _fail(f"PIO_TPU_CPU_DEVICES={n_cpu!r} is not an int")
     # engine dirs put engine.py on the path (factory "engine.MyEngine")
     if "" not in sys.path and "." not in sys.path:
         sys.path.insert(0, os.getcwd())

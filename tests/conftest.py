@@ -20,6 +20,11 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end tests (subprocess CLI)")
+
+
 @pytest.fixture()
 def memory_storage():
     """A fresh all-in-memory Storage (the reference's test-mode backends)."""
