@@ -1,0 +1,80 @@
+"""1M-event Parquet export/import round-trip throughput.
+
+Reference parity: tools/.../export/EventsToFile.scala:39 exports events as
+JSON or Parquet through Spark DataFrames; this measures the repo's columnar
+path (tools/export_import.py) at the same "millions of events" scale the
+reference targets, against the in-memory event store so the numbers are the
+serializer's, not a disk backend's.
+
+Run:  python eval/parquet_throughput.py   (writes PARQUET_THROUGHPUT.json
+next to this file)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pio_tpu.data.datamap import DataMap
+from pio_tpu.data.event import Event
+from pio_tpu.data.storage import Storage
+from pio_tpu.tools.export_import import (
+    export_events_parquet,
+    import_events_parquet,
+)
+
+N = 1_000_000
+
+
+def main() -> dict:
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    dao = storage.get_events()
+    dao.init(1)
+    events = [
+        Event(
+            event="rate", entity_type="user", entity_id=f"u{i % 5000}",
+            target_entity_type="item", target_entity_id=f"i{i % 2000}",
+            properties=DataMap({"rating": float(i % 5)}),
+        )
+        for i in range(N)
+    ]
+    dao.insert_batch(events, 1)
+
+    path = tempfile.mktemp(suffix=".parquet")
+    t0 = time.time()
+    n = export_events_parquet(storage, 1, path)
+    t1 = time.time()
+    size_mb = os.path.getsize(path) / 1e6
+    dao.init(2)
+    ok, failed = import_events_parquet(storage, 2, path)
+    t2 = time.time()
+    os.unlink(path)
+    assert n == N and ok == N and failed == 0
+
+    result = {
+        "events": N,
+        "export_events_per_sec": round(n / (t1 - t0)),
+        "import_events_per_sec": round(ok / (t2 - t1)),
+        "file_mb": round(size_mb, 1),
+        "export_s": round(t1 - t0, 1),
+        "import_s": round(t2 - t1, 1),
+    }
+    out = os.path.join(os.path.dirname(__file__), "PARQUET_THROUGHPUT.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

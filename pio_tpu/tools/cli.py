@@ -452,8 +452,14 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def _io_format(explicit: str | None, path: str) -> str:
+    if explicit:
+        return explicit
+    return "parquet" if path.endswith(".parquet") else "json"
+
+
 def cmd_export(args) -> int:
-    from pio_tpu.tools.export_import import export_events
+    from pio_tpu.tools.export_import import export_events, export_events_parquet
 
     storage = get_storage()
     a = storage.get_metadata_apps().get(args.appid)
@@ -466,17 +472,25 @@ def cmd_export(args) -> int:
         if ch is None:
             return _fail(f"Channel {args.channel} does not exist.")
         channel_id = ch.id
-    with open(args.output, "w") as f:
-        n = export_events(storage, args.appid, f, channel_id=channel_id)
+    if _io_format(getattr(args, "format", None), args.output) == "parquet":
+        n = export_events_parquet(
+            storage, args.appid, args.output, channel_id=channel_id
+        )
+    else:
+        with open(args.output, "w") as f:
+            n = export_events(storage, args.appid, f, channel_id=channel_id)
     print(f"Exported {n} events to {args.output}")
     return 0
 
 
 def cmd_import(args) -> int:
-    from pio_tpu.tools.export_import import import_events
+    from pio_tpu.tools.export_import import import_events, import_events_parquet
 
-    with open(args.input) as f:
-        ok, failed = import_events(get_storage(), args.appid, f)
+    if _io_format(getattr(args, "format", None), args.input) == "parquet":
+        ok, failed = import_events_parquet(get_storage(), args.appid, args.input)
+    else:
+        with open(args.input) as f:
+            ok, failed = import_events(get_storage(), args.appid, f)
     print(f"Imported {ok} events ({failed} failed).")
     return 0 if failed == 0 else 1
 
@@ -712,11 +726,15 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--appid", type=int, required=True)
     x.add_argument("--output", required=True)
     x.add_argument("--channel")
+    x.add_argument("--format", choices=["json", "parquet"],
+                   help="default: by --output extension (.parquet), else json")
     x.set_defaults(fn=cmd_export)
 
     x = sub.add_parser("import")
     x.add_argument("--appid", type=int, required=True)
     x.add_argument("--input", required=True)
+    x.add_argument("--format", choices=["json", "parquet"],
+                   help="default: by --input extension (.parquet), else json")
     x.set_defaults(fn=cmd_import)
 
     x = sub.add_parser("upgrade")

@@ -176,6 +176,54 @@ def test_export_import(cli, memory_storage, tmp_path):
     assert code == 1 and "Imported 1 events (1 failed)" in out.out
 
 
+def test_export_import_parquet(cli, memory_storage, tmp_path):
+    """Columnar round-trip (reference EventsToFile.scala:39 parquet format):
+    full field fidelity incl. properties/tags/times/prId, format inferred
+    from the .parquet extension, and a bulk round-trip for throughput
+    (the 1M-event measurement lives in eval/PARQUET_THROUGHPUT.json)."""
+    from datetime import datetime, timezone
+
+    from pio_tpu.data import DataMap, Event
+
+    T0 = datetime(2026, 2, 3, 4, 5, 6, tzinfo=timezone.utc)
+    cli("app", "new", "pqapp")
+    app_id = memory_storage.get_metadata_apps().get_by_name("pqapp").id
+    ev = memory_storage.get_events()
+    rich = Event(
+        event="buy", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i9",
+        properties=DataMap({"price": 3.5, "tags": ["a", "b"], "n": 2}),
+        event_time=T0, tags=("t1", "t2"), pr_id="pr-7",
+    )
+    rich_id = ev.insert(rich, app_id)
+    ev.insert(Event(event="view", entity_type="user", entity_id="u2"), app_id)
+    for i in range(100_00):
+        ev.insert(Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                        target_entity_type="item", target_entity_id="i1",
+                        properties=DataMap({"rating": i % 5})), app_id)
+
+    out_file = tmp_path / "events.parquet"
+    code, out = cli("export", "--appid", str(app_id),
+                    "--output", str(out_file))
+    assert code == 0 and "Exported 10002" in out.out
+
+    cli("app", "new", "pqapp2")
+    app2 = memory_storage.get_metadata_apps().get_by_name("pqapp2").id
+    code, out = cli("import", "--appid", str(app2), "--input", str(out_file))
+    assert code == 0 and "Imported 10002 events (0 failed)" in out.out
+
+    got = {e.entity_id: e for e in ev.find(app2, event_names=["buy", "view"],
+                                           limit=-1)}
+    r = got["u1"]
+    assert r.event == "buy" and r.target_entity_id == "i9"
+    assert dict(r.properties.fields) == {"price": 3.5, "tags": ["a", "b"], "n": 2}
+    assert r.event_time.astimezone(timezone.utc) == T0
+    assert r.tags == ("t1", "t2") and r.pr_id == "pr-7"
+    assert r.event_id == rich_id  # ids survive the round trip
+    bare = got["u2"]
+    assert bare.target_entity_type is None and not bare.properties.fields
+
+
 def test_admin_server(memory_storage):
     from pio_tpu.tools.admin import create_admin_server
 
