@@ -7,7 +7,8 @@ maps categorical attrs through value maps and requires a `plan` label);
 algorithms are MLlib NaiveBayes (NaiveBayesAlgorithm.scala:15-27) and
 RandomForest (add-algorithm/.../RandomForestAlgorithm.scala:28-43); query =
 attribute dict -> {"label": ...}. TPU-native: NB scoring is a single matmul
-(ops/naive_bayes.py); the forest stays host-side by design (ops/forest.py).
+(ops/naive_bayes.py); forest GROWTH is host-side histogram induction, its
+batched inference runs on-device (ops/forest.py).
 """
 
 from __future__ import annotations
@@ -211,6 +212,7 @@ class RandomForestParams(Params):
     num_trees: int = 10
     max_depth: int = 5
     feature_subset_strategy: str = "auto"
+    max_bins: int = 32  # MLlib Strategy.maxBins; 0 = exact threshold search
     seed: int = 0
 
 
@@ -235,6 +237,7 @@ class RandomForestAlgorithm(LAlgorithm):
             num_trees=self.params.num_trees,
             max_depth=self.params.max_depth,
             feature_subset=self.params.feature_subset_strategy,
+            max_bins=self.params.max_bins,
             seed=self.params.seed,
         )
         return RFClassifierModel(model, _schema_only(data))
@@ -248,7 +251,10 @@ class RandomForestAlgorithm(LAlgorithm):
         if not queries:
             return []
         x = np.stack([_query_vector(model.data_schema, q) for q in queries])
-        preds = model.forest.predict(x)
+        if len(x) >= 2048:  # big catalogs: jitted gather loop on device
+            preds = np.asarray(model.forest.predict_device(x))
+        else:
+            preds = model.forest.predict(x)
         inv = model.data_schema.labels.inverse()
         return [{"label": inv[int(i)]} for i in preds]
 
