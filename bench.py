@@ -258,18 +258,18 @@ def phase_serving() -> dict:
     ctx = create_workflow_context(storage, use_mesh=False)
     run_train(engine, ep, storage, engine_id="bench", ctx=ctx)
 
-    http, qs = create_query_server(
-        engine, ep, storage,
-        ServingConfig(ip="127.0.0.1", port=0, engine_id="bench",
-                      warm_query={"user": "u0", "num": 10}),
-        ctx=ctx,
-    )
-    http.start()
-    try:
-        port = http.port
-        n_req = 50 if SMALL else 400
+    def pcts(lat_s: list) -> dict:
+        lat_ms = sorted(x * 1e3 for x in lat_s)
+
+        def pct(p):
+            return lat_ms[min(len(lat_ms) - 1, int(p / 100 * len(lat_ms)))]
+
+        return {"p50_ms": round(pct(50), 3), "p90_ms": round(pct(90), 3),
+                "p99_ms": round(pct(99), 3)}
+
+    def measure_sequential(port, n_req, warmup=20):
         lat = []
-        for r in range(n_req + 20):
+        for r in range(n_req + warmup):
             q = json.dumps({"user": f"u{r % n_users}", "num": 10}).encode()
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}/queries.json", data=q,
@@ -277,22 +277,89 @@ def phase_serving() -> dict:
             t0 = time.monotonic()
             with urllib.request.urlopen(req, timeout=30) as resp:
                 resp.read()
-            if r >= 20:  # drop warmup tail
+            if r >= warmup:
                 lat.append(time.monotonic() - t0)
-        lat_ms = sorted(x * 1e3 for x in lat)
+        return {**pcts(lat), "qps": round(len(lat) / sum(lat), 1),
+                "n_requests": len(lat)}
 
-        def pct(p):
-            return lat_ms[min(len(lat_ms) - 1, int(p / 100 * len(lat_ms)))]
+    def measure_concurrent(port, n_req, workers=16):
+        """Keep-alive connection per worker, n_req total requests."""
+        import http.client
 
-        return {
-            "p50_ms": round(pct(50), 3),
-            "p90_ms": round(pct(90), 3),
-            "p99_ms": round(pct(99), 3),
-            "qps_sequential": round(len(lat) / sum(lat), 1),
-            "n_requests": len(lat_ms),
-        }
+        lat: list[float] = []
+        lock = threading.Lock()
+        per_worker = n_req // workers
+        t_start = time.monotonic()
+
+        def worker(w):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            mine = []
+            try:
+                for r in range(per_worker):
+                    q = json.dumps(
+                        {"user": f"u{(w * per_worker + r) % n_users}",
+                         "num": 10}).encode()
+                    t0 = time.monotonic()
+                    conn.request("POST", "/queries.json", body=q)
+                    conn.getresponse().read()
+                    mine.append(time.monotonic() - t0)
+            finally:
+                conn.close()
+            with lock:
+                lat.extend(mine)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t_start
+        return {**pcts(lat), "qps": round(len(lat) / wall, 1),
+                "n_requests": len(lat), "client_threads": workers}
+
+    def deploy(backend, batch_window_ms=0.0):
+        http, qs = create_query_server(
+            engine, ep, storage,
+            ServingConfig(ip="127.0.0.1", port=0, engine_id="bench",
+                          warm_query={"user": "u0", "num": 10},
+                          backend=backend, batch_window_ms=batch_window_ms),
+            ctx=ctx,
+        )
+        http.start()
+        return http, qs
+
+    import threading
+
+    n_seq = 50 if SMALL else 400
+    n_conc = 200 if SMALL else 2000
+
+    out: dict = {}
+    # production path (async transport): sequential latency = the BASELINE.md
+    # "p50 /queries.json" row
+    http, qs = deploy("async")
+    try:
+        out.update(measure_sequential(http.port, n_seq))
+        out["concurrent"] = {"async": measure_concurrent(http.port, n_conc)}
     finally:
         http.stop()
+        qs.close()
+    # before/after for the round-1 "serving throughput unproven" finding:
+    # threaded thread-per-connection vs async vs async+micro-batching
+    http, qs = deploy("threaded")
+    try:
+        out["concurrent"]["threaded"] = measure_concurrent(http.port, n_conc)
+    finally:
+        http.stop()
+        qs.close()
+    http, qs = deploy("async", batch_window_ms=2.0)
+    try:
+        out["concurrent"]["async_batched"] = measure_concurrent(
+            http.port, n_conc)
+    finally:
+        http.stop()
+        qs.close()
+    return out
 
 
 def phase_ingest() -> dict:

@@ -191,18 +191,23 @@ class ALSAlgorithm(PAlgorithm):
         return {"itemScores": out}
 
     def batch_predict(self, model: RecommendationModel, queries) -> list:
-        """Vectorized batch scoring for evaluation: one matmul for all
-        known-user queries (replaces the reference's per-query loop)."""
-        known = [
-            (i, model.users.index_of(q["user"]))
-            for i, q in enumerate(queries)
-            if q["user"] in model.users
-        ]
+        """Vectorized batch scoring (evaluation + the serving micro-batcher):
+        one top-k matmul for all plain known-user queries; queries carrying
+        white/black lists keep full per-query filter semantics via the
+        single-query path."""
         results: list[dict] = [{"itemScores": []} for _ in queries]
+        known = []
+        for i, q in enumerate(queries):
+            if q["user"] not in model.users:
+                continue
+            if q.get("whiteList") or q.get("blackList"):
+                results[i] = self.predict(model, q)
+            else:
+                known.append((i, model.users.index_of(q["user"])))
         if not known:
             return results
         rows = np.array([u for _, u in known], dtype=np.int32)
-        num = max(int(q.get("num", 10)) for q in queries)
+        num = max(int(queries[qi].get("num", 10)) for qi, _ in known)
         k = min(num, model.factors.item_factors.shape[0])
         scores, idx = als.recommend_topk(model.factors, rows, k)
         scores, idx = np.asarray(scores), np.asarray(idx)

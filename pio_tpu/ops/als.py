@@ -475,14 +475,22 @@ def recommend_topk(model: ALSModel, user_idx, k: int):
     """Top-k items for a batch of users: one (B,k)x(k,I) matmul + lax.top_k
     (the MXU path serving /queries.json).
 
-    k is bucketed to the next power of two before jit so per-query k values
-    (e.g. num + len(blackList)) don't each compile a fresh XLA program; the
-    exact-k trim happens on host."""
+    Both k AND the batch dim are bucketed to the next power of two before
+    jit, so per-query k values (e.g. num + len(blackList)) and the varying
+    batch sizes the serving micro-batcher produces compile O(log) XLA
+    programs instead of one per size; the exact trim happens on host."""
     n_items = model.item_factors.shape[0]
     k = max(1, min(int(k), n_items))
-    bucket = min(n_items, 1 << (k - 1).bit_length())
-    scores, idx = _topk_jit(model, user_idx, bucket)
-    return scores[:, :k], idx[:, :k]
+    k_bucket = min(n_items, 1 << (k - 1).bit_length())
+    user_idx = np.asarray(user_idx)
+    b = len(user_idx)
+    b_bucket = max(1, 1 << (b - 1).bit_length())
+    if b_bucket != b:
+        user_idx = np.concatenate(
+            [user_idx, np.zeros(b_bucket - b, user_idx.dtype)]
+        )
+    scores, idx = _topk_jit(model, user_idx, k_bucket)
+    return scores[:b, :k], idx[:b, :k]
 
 
 def rmse(model: ALSModel, user_idx, item_idx, values) -> float:

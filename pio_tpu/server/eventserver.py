@@ -26,7 +26,7 @@ from typing import Any
 from pio_tpu.data.dao import AccessKey, Channel
 from pio_tpu.data.event import Event, EventValidationError, validate_event
 from pio_tpu.data.storage import Storage, get_storage
-from pio_tpu.server.http import HttpApp, HttpServer, Request
+from pio_tpu.server.http import AsyncHttpServer, HttpApp, HttpServer, Request
 from pio_tpu.server.plugins import PluginContext, PluginRejection
 from pio_tpu.server.stats import Stats
 from pio_tpu.server.webhooks import ConnectorException, default_connectors
@@ -42,6 +42,7 @@ class EventServerConfig:
     stats: bool = False
     certfile: str | None = None   # TLS cert (PEM); with keyfile -> HTTPS
     keyfile: str | None = None
+    backend: str = "async"        # "async" (event loop) | "threaded"
 
 
 class AuthError(Exception):
@@ -291,12 +292,13 @@ def create_event_server(
     storage: Storage | None = None,
     config: EventServerConfig | None = None,
     plugin_context: PluginContext | None = None,
-) -> HttpServer:
+) -> HttpServer | AsyncHttpServer:
     from pio_tpu.server.security import server_ssl_context
 
     config = config or EventServerConfig()
     app = build_event_app(storage, config, plugin_context)
-    return HttpServer(
+    server_cls = AsyncHttpServer if config.backend == "async" else HttpServer
+    return server_cls(
         app, host=config.ip, port=config.port,
         ssl_context=server_ssl_context(config.certfile, config.keyfile),
     )

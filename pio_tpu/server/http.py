@@ -1,19 +1,26 @@
-"""Minimal threaded HTTP core shared by the event server, admin server,
-dashboard, and deploy server.
+"""HTTP core shared by the event server, admin server, dashboard, and
+deploy server: one regex route table (`HttpApp`), two interchangeable
+transports.
 
 Replaces the reference's spray/akka actor HTTP stack (EventServer.scala:219,
-CreateServer.scala:463) with a stdlib ThreadingHTTPServer + a regex route
-table. Deliberately dependency-free: the control plane is not the TPU hot
-path, and zero-install operation matters more than raw HTTP throughput here.
-Handlers return (status, json-serializable body).
+CreateServer.scala:463). `HttpServer` is a stdlib ThreadingHTTPServer —
+thread per connection, zero moving parts, fine for admin surfaces.
+`AsyncHttpServer` is the serving/ingest transport: an asyncio HTTP/1.1
+server (keep-alive, bounded worker pool for the sync handlers) that plays
+the role of spray's event-loop IO without akka — connection handling stays
+on the event loop, handler work is bounded instead of thread-per-request.
+Both are dependency-free stdlib. Handlers return (status,
+json-serializable body) either way.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import re
 import threading
 import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
@@ -79,6 +86,27 @@ class HttpApp:
         return 404, {"message": "Not Found"}
 
 
+def dispatch_safe(app: HttpApp, req: Request) -> tuple[int, Any]:
+    """Dispatch with the error policy both transports share."""
+    try:
+        return app.dispatch(req)
+    except json.JSONDecodeError:
+        return 400, {"message": "Invalid JSON body"}
+    except Exception as e:  # noqa: BLE001 - last-resort 500
+        return 500, {"message": f"{type(e).__name__}: {e}"}
+
+
+def encode_payload(payload: Any) -> tuple[bytes, str]:
+    """-> (body bytes, content-type). str/bytes pass through as HTML."""
+    if isinstance(payload, (bytes, str)):
+        data = payload.encode() if isinstance(payload, str) else payload
+        return data, "text/html; charset=utf-8"
+    return (
+        json.dumps(payload).encode("utf-8"),
+        "application/json; charset=utf-8",
+    )
+
+
 class HttpServer:
     """Threaded HTTP server wrapping an HttpApp; bind/serve/shutdown.
 
@@ -116,18 +144,8 @@ class HttpServer:
                     headers={k.lower(): v for k, v in self.headers.items()},
                     body=body,
                 )
-                try:
-                    status, payload = outer.app.dispatch(req)
-                except json.JSONDecodeError:
-                    status, payload = 400, {"message": "Invalid JSON body"}
-                except Exception as e:  # noqa: BLE001 - last-resort 500
-                    status, payload = 500, {"message": f"{type(e).__name__}: {e}"}
-                if isinstance(payload, (bytes, str)) :
-                    data = payload.encode() if isinstance(payload, str) else payload
-                    ctype = "text/html; charset=utf-8"
-                else:
-                    data = json.dumps(payload).encode("utf-8")
-                    ctype = "application/json; charset=utf-8"
+                status, payload = dispatch_safe(outer.app, req)
+                data, ctype = encode_payload(payload)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -157,8 +175,217 @@ class HttpServer:
     def serve_forever(self):
         self._server.serve_forever()
 
+    def wait(self):
+        """Block until the server (started with start()) shuts down."""
+        if self._thread:
+            self._thread.join()
+
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+
+_STATUS_TEXT = {
+    200: "OK", 400: "Bad Request", 401: "Unauthorized", 404: "Not Found",
+    405: "Method Not Allowed", 409: "Conflict", 413: "Payload Too Large",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+_MAX_HEADER = 64 * 1024
+_MAX_BODY = 64 * 1024 * 1024
+
+
+class AsyncHttpServer:
+    """asyncio HTTP/1.1 server over the same HttpApp (keep-alive, bounded
+    handler pool). Interface-compatible with HttpServer: start()/stop()/
+    serve_forever()/.port/.tls.
+
+    Connection handling (parse, keep-alive, write-back) runs on one event
+    loop; sync handlers run on a bounded ThreadPoolExecutor, so a burst of
+    slow requests queues instead of spawning unbounded threads — the role
+    spray's actor dispatcher plays for the reference's event server
+    (EventServer.scala:219)."""
+
+    def __init__(self, app: HttpApp, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None, workers: int = 16):
+        self.app = app
+        self.host = host
+        self.port = port          # rebound to the real port once listening
+        self.tls = ssl_context is not None
+        self._ssl = ssl_context
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix=f"{app.name}-worker"
+        )
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.Server | None = None
+        self._thread: threading.Thread | None = None
+        self._ready = threading.Event()
+        self._failed: BaseException | None = None
+        self._main_task: asyncio.Task | None = None
+        self._conns: set[asyncio.Task] = set()
+
+    # -- connection handling -------------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns.add(task)
+            task.add_done_callback(self._conns.discard)
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except asyncio.IncompleteReadError:
+                    return  # client closed between requests
+                except asyncio.LimitOverrunError:
+                    await self._respond(
+                        writer, 413, {"message": "headers too large"}, True
+                    )
+                    return
+                lines = head.decode("latin-1").split("\r\n")
+                try:
+                    method, target, version = lines[0].split(" ", 2)
+                except ValueError:
+                    await self._respond(
+                        writer, 400, {"message": "malformed request line"}, True
+                    )
+                    return
+                headers: dict[str, str] = {}
+                for line in lines[1:]:
+                    if not line:
+                        continue
+                    k, _, v = line.partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                try:
+                    length = int(headers.get("content-length") or 0)
+                except ValueError:
+                    await self._respond(
+                        writer, 400, {"message": "bad Content-Length"}, True
+                    )
+                    return
+                if length > _MAX_BODY:
+                    await self._respond(
+                        writer, 413, {"message": "body too large"}, True
+                    )
+                    return
+                try:
+                    body = await reader.readexactly(length) if length else b""
+                except asyncio.IncompleteReadError:
+                    return  # client closed mid-body
+                parsed = urllib.parse.urlparse(target)
+                req = Request(
+                    method=method.upper(),
+                    path=parsed.path,
+                    params={
+                        k: v[0]
+                        for k, v in urllib.parse.parse_qs(
+                            parsed.query, keep_blank_values=True
+                        ).items()
+                    },
+                    headers=headers,
+                    body=body,
+                )
+                status, payload = await asyncio.get_running_loop() \
+                    .run_in_executor(self._pool, dispatch_safe, self.app, req)
+                close = (
+                    headers.get("connection", "").lower() == "close"
+                    or version == "HTTP/1.0"
+                )
+                await self._respond(writer, status, payload, close)
+                if close:
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _respond(self, writer, status: int, payload: Any, close: bool):
+        data, ctype = encode_payload(payload)
+        writer.write(
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Status')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            f"Connection: {'close' if close else 'keep-alive'}\r\n"
+            "\r\n".encode("latin-1") + data
+        )
+        await writer.drain()
+
+    # -- lifecycle -----------------------------------------------------------
+    async def _amain(self):
+        self._main_task = asyncio.current_task()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port, ssl=self._ssl,
+            limit=_MAX_HEADER,
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._ready.set()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def _shutdown(self, grace_s: float = 2.0):
+        """Stop accepting, drain in-flight responses briefly, then cancel
+        lingering (idle keep-alive) connections and the accept loop."""
+        if self._server is not None:
+            self._server.close()
+        conns = {t for t in self._conns if not t.done()}
+        if conns:
+            await asyncio.wait(conns, timeout=grace_s)
+        for t in conns:
+            t.cancel()
+        if self._main_task is not None:
+            self._main_task.cancel()
+
+    def _run_loop(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._amain())
+        except asyncio.CancelledError:
+            pass
+        except BaseException as e:  # noqa: BLE001 - surface bind errors
+            self._failed = e
+            self._ready.set()
+        finally:
+            try:
+                self._loop.run_until_complete(
+                    self._loop.shutdown_asyncgens()
+                )
+            finally:
+                self._loop.close()
+
+    def start(self) -> "AsyncHttpServer":
+        self._thread = threading.Thread(
+            target=self._run_loop, name=f"{self.app.name}-asyncio", daemon=True
+        )
+        self._thread.start()
+        self._ready.wait(timeout=30)
+        if self._failed is not None:
+            raise self._failed
+        return self
+
+    def serve_forever(self):
+        self._run_loop()
+
+    def wait(self):
+        """Block until the server (started with start()) shuts down."""
+        if self._thread:
+            self._thread.join()
+
+    def stop(self):
+        loop = self._loop
+        if loop is None or not loop.is_running():
+            self._pool.shutdown(wait=False)
+            return
+        fut = asyncio.run_coroutine_threadsafe(self._shutdown(), loop)
+        try:
+            fut.result(timeout=15)
+        except Exception:  # noqa: BLE001 - loop may already be tearing down
+            pass
+        if self._thread:
+            self._thread.join(timeout=10)
+        self._pool.shutdown(wait=False)

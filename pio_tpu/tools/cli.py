@@ -349,11 +349,14 @@ def cmd_deploy(args) -> int:
         server_key=args.server_key or os.environ.get("PIO_SERVER_KEY", ""),
         warm_query=json.loads(args.warm_query) if args.warm_query else None,
         certfile=args.cert, keyfile=args.key,
+        backend=args.server_backend,
+        batch_window_ms=args.batch_window_ms,
     )
     http, qs = create_query_server(
         engine, ep, storage, config, ctx=ctx,
         instance_id=args.engine_instance_id,
     )
+    http.start()  # bind first: with --port 0 the real port is only known now
     scheme = "https" if http.tls else "http"
     print(f"Engine instance {qs.instance.id} deployed on "
           f"{scheme}://{args.ip}:{http.port}")
@@ -361,13 +364,14 @@ def cmd_deploy(args) -> int:
 
     def watch_stop():
         qs._stop_requested.wait()
-        http._server.shutdown()
+        http.stop()
 
     threading.Thread(target=watch_stop, daemon=True).start()
     try:
-        http.serve_forever()
+        http.wait()
     except KeyboardInterrupt:
-        pass
+        http.stop()
+    qs.close()
     print("Server stopped.")
     return 0
 
@@ -393,14 +397,16 @@ def cmd_eventserver(args) -> int:
     srv = create_event_server(
         get_storage(),
         EventServerConfig(ip=args.ip, port=args.port, stats=args.stats,
-                          certfile=args.cert, keyfile=args.key),
+                          certfile=args.cert, keyfile=args.key,
+                          backend=args.server_backend),
     )
+    srv.start()  # bind first: with --port 0 the real port is only known now
     scheme = "https" if srv.tls else "http"
     print(f"Event Server on {scheme}://{args.ip}:{srv.port}")
     try:
-        srv.serve_forever()
+        srv.wait()
     except KeyboardInterrupt:
-        pass
+        srv.stop()
     return 0
 
 
@@ -686,6 +692,11 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--no-mesh", action="store_true")
     x.add_argument("--cert", help="TLS certificate (PEM) -> serve HTTPS")
     x.add_argument("--key", help="TLS private key (PEM)")
+    x.add_argument("--server-backend", choices=["async", "threaded"],
+                   default="async")
+    x.add_argument("--batch-window-ms", type=float, default=0.0,
+                   help="coalesce concurrent queries into one device batch "
+                        "within this window (0 = off)")
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")
@@ -700,6 +711,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--stats", action="store_true")
     x.add_argument("--cert", help="TLS certificate (PEM) -> serve HTTPS")
     x.add_argument("--key", help="TLS private key (PEM)")
+    x.add_argument("--server-backend", choices=["async", "threaded"],
+                   default="async")
     x.set_defaults(fn=cmd_eventserver)
 
     x = sub.add_parser("storageserver")

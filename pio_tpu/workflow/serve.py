@@ -20,8 +20,10 @@ sample query so first-request latency is compile-free.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -29,7 +31,7 @@ from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.data.dao import AccessKey
 from pio_tpu.data.event import Event
 from pio_tpu.data.storage import Storage
-from pio_tpu.server.http import HttpApp, HttpServer, Request
+from pio_tpu.server.http import AsyncHttpServer, HttpApp, HttpServer, Request
 from pio_tpu.server.plugins import PluginContext
 from pio_tpu.utils.time import format_time, utcnow
 from pio_tpu.utils.tracing import Tracer
@@ -53,6 +55,13 @@ class ServingConfig:
     warm_query: dict | None = None  # sample query to jit-warm at startup
     certfile: str | None = None   # TLS cert (PEM); with keyfile -> HTTPS
     keyfile: str | None = None
+    backend: str = "async"        # HTTP transport: "async" | "threaded"
+    # dynamic micro-batching: concurrent /queries.json requests arriving
+    # within the window are executed as ONE batch_predict per algorithm —
+    # the TPU-native answer to CreateServer.scala:516's "TODO: Parallelize"
+    # (one big matmul beats many small ones on the MXU). 0 = off.
+    batch_window_ms: float = 0.0
+    batch_max: int = 64
 
 
 class QueryServer:
@@ -81,7 +90,14 @@ class QueryServer:
         self.tracer = Tracer()
         self.start_time = utcnow()
         self._stop_requested = threading.Event()
+        self._predict_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="predict"
+        )
         self._load(instance_id)
+        self.batcher = (
+            QueryBatcher(self, config.batch_window_ms / 1e3, config.batch_max)
+            if config.batch_window_ms > 0 else None
+        )
         self._warm()
 
     # -- model lifecycle ----------------------------------------------------
@@ -118,14 +134,35 @@ class QueryServer:
         self._load(None)
         return self.instance.id
 
+    def close(self) -> None:
+        """Release serving resources (predict pool, batcher thread). The
+        HTTP transport's stop() does not know about them."""
+        if self.batcher is not None:
+            self.batcher.close()
+        self._predict_pool.shutdown(wait=False)
+
     def _warm(self) -> None:
-        if self.config.warm_query is not None:
-            try:
-                # record=False: warm-up neither counts toward stats nor
-                # generates feedback events
-                self.query(dict(self.config.warm_query), record=False)
-            except Exception:  # noqa: BLE001 - warmup is best-effort
-                log.warning("warm query failed", exc_info=True)
+        if self.config.warm_query is None:
+            return
+        try:
+            # record=False: warm-up neither counts toward stats nor
+            # generates feedback events
+            self.query(dict(self.config.warm_query), record=False)
+        except Exception:  # noqa: BLE001 - warmup is best-effort
+            log.warning("warm query failed", exc_info=True)
+        if self.batcher is None:
+            return
+        try:
+            # compile every power-of-two batch bucket up front so the
+            # micro-batcher's varying batch sizes never pay jit in traffic
+            b = 1
+            while b <= self.config.batch_max:
+                self.query_batch(
+                    [dict(self.config.warm_query)] * b, record=False
+                )
+                b *= 2
+        except Exception:  # noqa: BLE001 - warmup is best-effort
+            log.warning("warm batch failed", exc_info=True)
 
     # -- query path (reference CreateServer.scala:492-615) ------------------
     def query(self, q: dict, record: bool = True) -> Any:
@@ -135,14 +172,58 @@ class QueryServer:
             supplemented = self.serving.supplement(q)
         with self._lock:
             models = self.models
+            algorithms = self.algorithms
             instance_id = self.instance.id
         with tr.span("predict"):
-            predictions = [
-                algo.predict(model, supplemented)
-                for algo, model in zip(self.algorithms, models)
-            ]
+            if len(algorithms) > 1:
+                # concurrent per-algo predict (the parallelization the
+                # reference left as TODO, CreateServer.scala:516); device
+                # dispatch releases the GIL so the algos genuinely overlap
+                futures = [
+                    self._predict_pool.submit(a.predict, m, supplemented)
+                    for a, m in zip(algorithms, models)
+                ]
+                predictions = [f.result() for f in futures]
+            else:
+                predictions = [algorithms[0].predict(models[0], supplemented)]
         with tr.span("serve"):
             prediction = self.serving.serve(q, predictions)
+        return self._postprocess(q, prediction, instance_id, record, t0)
+
+    def query_batch(self, queries: list[dict], record: bool = True) -> list:
+        """Serve several queries as one batch_predict per algorithm (the
+        micro-batching execution path; also the bulk path behind
+        /batch/queries.json)."""
+        t0 = time.monotonic()
+        tr = self.tracer
+        with tr.span("supplement"):
+            supplemented = [self.serving.supplement(q) for q in queries]
+        with self._lock:
+            models = self.models
+            algorithms = self.algorithms
+            instance_id = self.instance.id
+        with tr.span("predict"):
+            if len(algorithms) > 1:
+                futures = [
+                    self._predict_pool.submit(a.batch_predict, m, supplemented)
+                    for a, m in zip(algorithms, models)
+                ]
+                per_algo = [f.result() for f in futures]
+            else:
+                per_algo = [
+                    algorithms[0].batch_predict(models[0], supplemented)
+                ]
+        with tr.span("serve"):
+            predictions = [
+                self.serving.serve(q, [algo_out[i] for algo_out in per_algo])
+                for i, q in enumerate(queries)
+            ]
+        return [
+            self._postprocess(q, p, instance_id, record, t0)
+            for q, p in zip(queries, predictions)
+        ]
+
+    def _postprocess(self, q, prediction, instance_id, record, t0):
         if record and self.config.feedback:
             prediction = self._feedback(q, prediction, instance_id)
         for blocker in self.plugins.output_blockers:
@@ -150,7 +231,7 @@ class QueryServer:
                 q, prediction, {"engineInstanceId": instance_id}
             )
         if record:
-            tr.record("query", time.monotonic() - t0)
+            self.tracer.record("query", time.monotonic() - t0)
         return prediction
 
     def _feedback(self, query: dict, prediction: Any, instance_id: str):
@@ -235,6 +316,65 @@ class QueryServer:
         }
 
 
+class QueryBatcher:
+    """Dynamic micro-batching: requests enqueue and a single collector
+    thread drains up to `max_batch` of them within `window_s`, executing one
+    `query_batch` for the lot. One big top-k matmul replaces N small ones —
+    the MXU-friendly shape — at the cost of up to window_s added latency,
+    so it is off unless ServingConfig.batch_window_ms is set."""
+
+    def __init__(self, server: QueryServer, window_s: float, max_batch: int):
+        self.server = server
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._q: queue.Queue[tuple[dict, Future]] = queue.Queue()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="query-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def query(self, q: dict) -> Any:
+        fut: Future = Future()
+        self._q.put((q, fut))
+        return fut.result()
+
+    def _run(self):
+        while not self._closed:
+            try:
+                first = self._q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            queries = [q for q, _ in batch]
+            try:
+                results = self.server.query_batch(queries)
+                for (_, fut), res in zip(batch, results):
+                    fut.set_result(res)
+            except Exception:  # noqa: BLE001 - isolate the bad query
+                # one malformed query must not fail its batch-mates: retry
+                # each one alone so only the offender sees the error
+                for q, fut in batch:
+                    if fut.done():
+                        continue
+                    try:
+                        fut.set_result(self.server.query(q))
+                    except Exception as e:  # noqa: BLE001
+                        fut.set_exception(e)
+
+    def close(self):
+        self._closed = True
+
+
 def build_serving_app(server: QueryServer) -> HttpApp:
     app = HttpApp("serving")
     config = server.config
@@ -257,10 +397,31 @@ def build_serving_app(server: QueryServer) -> HttpApp:
         if not isinstance(q, dict):
             return 400, {"message": "query must be a JSON object"}
         try:
-            prediction = server.query(q)
+            if server.batcher is not None:
+                prediction = server.batcher.query(q)
+            else:
+                prediction = server.query(q)
         except KeyError as e:
             return 400, {"message": f"query missing field {e}"}
         return 200, prediction
+
+    @app.route("POST", r"/batch/queries\.json")
+    def batch_queries(req: Request):
+        """Bulk endpoint: a JSON array of queries answered by one
+        batch_predict per algorithm (no reference analogue; the event
+        server's /batch/events.json shape applied to serving)."""
+        try:
+            qs = req.json()
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"Invalid query batch: {e}"}
+        if not isinstance(qs, list) or not all(isinstance(q, dict) for q in qs):
+            return 400, {"message": "body must be a JSON array of objects"}
+        if not qs:
+            return 200, []
+        try:
+            return 200, server.query_batch(qs)
+        except KeyError as e:
+            return 400, {"message": f"query missing field {e}"}
 
     @app.route("GET", r"/reload")
     def reload(req: Request):
@@ -339,7 +500,8 @@ def create_query_server(
     )
     from pio_tpu.server.security import server_ssl_context
 
-    http = HttpServer(
+    server_cls = AsyncHttpServer if config.backend == "async" else HttpServer
+    http = server_cls(
         build_serving_app(qs), host=config.ip, port=config.port,
         ssl_context=server_ssl_context(config.certfile, config.keyfile),
     )

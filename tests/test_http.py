@@ -1,0 +1,97 @@
+"""HTTP transport tests: the threaded and asyncio servers must behave
+identically over the same HttpApp (routing, errors, keep-alive, limits)."""
+
+import http.client
+import json
+
+import pytest
+
+from pio_tpu.server.http import AsyncHttpServer, HttpApp, HttpServer, Request
+
+
+def make_app() -> HttpApp:
+    app = HttpApp("t")
+
+    @app.route("GET", r"/ping")
+    def ping(req: Request):
+        return 200, {"pong": True}
+
+    @app.route("POST", r"/echo")
+    def echo(req: Request):
+        return 200, {"body": req.json(), "params": req.params}
+
+    @app.route("GET", r"/boom")
+    def boom(req: Request):
+        raise RuntimeError("kapow")
+
+    @app.route("GET", r"/item/([^/]+)")
+    def item(req: Request):
+        return 200, {"id": req.path_args[0]}
+
+    return app
+
+
+@pytest.fixture(params=[HttpServer, AsyncHttpServer])
+def server(request):
+    srv = request.param(make_app(), host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+def test_routing_and_errors(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        conn.request("GET", "/ping")
+        r = conn.getresponse()
+        assert r.status == 200 and json.loads(r.read())["pong"] is True
+    finally:
+        conn.close()
+    # fresh connection for each to be fair to both transports
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        conn.request("GET", "/missing")
+        r = conn.getresponse()
+        assert r.status == 404
+        r.read()
+        conn.request("POST", "/ping")  # wrong method
+        r = conn.getresponse()
+        assert r.status == 405
+        r.read()
+        conn.request("GET", "/boom")
+        r = conn.getresponse()
+        assert r.status == 500 and "kapow" in r.read().decode()
+        conn.request("GET", "/item/abc42")
+        r = conn.getresponse()
+        assert json.loads(r.read())["id"] == "abc42"
+    finally:
+        conn.close()
+
+
+def test_keepalive_reuses_connection(server):
+    """Many requests over ONE connection (HTTP/1.1 keep-alive)."""
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        for i in range(20):
+            body = json.dumps({"i": i}).encode()
+            conn.request("POST", f"/echo?n={i}", body=body,
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            out = json.loads(r.read())
+            assert out["body"] == {"i": i} and out["params"]["n"] == str(i)
+    finally:
+        conn.close()
+
+
+def test_connection_close_honored(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        conn.request("GET", "/ping", headers={"Connection": "close"})
+        r = conn.getresponse()
+        assert r.status == 200
+        if isinstance(server, AsyncHttpServer):
+            # the async transport must advertise it will close; the stdlib
+            # handler closes without echoing the header (also acceptable)
+            assert r.getheader("Connection", "").lower() == "close"
+    finally:
+        conn.close()

@@ -99,6 +99,7 @@ def deployed(memory_storage):
     http.start()
     yield http, qs, memory_storage, engine, ep, ctx
     http.stop()
+    qs.close()
 
 
 def test_query_and_status(deployed):
@@ -191,6 +192,140 @@ def test_warm_query_resets_stats(deployed):
     # the warm query ran at startup but stats were reset
     status, st = call(http.port, "GET", "/")
     assert st["requestCount"] >= 0  # fixture tests may have queried already
+
+
+def test_batch_queries_endpoint(deployed):
+    http, qs, *_ = deployed
+    qs_list = [{"user": f"u{u}", "num": 3} for u in range(6)]
+    qs_list.append({"user": "u1", "num": 3, "blackList": ["i3"]})
+    status, body = call(http.port, "POST", "/batch/queries.json", qs_list)
+    assert status == 200 and len(body) == 7
+    # batch results must match the single-query path exactly (incl. the
+    # output plugin, which doubles scores, and the blackList filter)
+    for q, batched in zip(qs_list, body):
+        status, single = call(http.port, "POST", "/queries.json", q)
+        assert [s["item"] for s in batched["itemScores"]] == \
+            [s["item"] for s in single["itemScores"]]
+    assert all(s["item"] != "i3" for s in body[-1]["itemScores"])
+    status, body = call(http.port, "POST", "/batch/queries.json", [])
+    assert status == 200 and body == []
+    status, body = call(http.port, "POST", "/batch/queries.json",
+                        {"user": "u0"})
+    assert status == 400
+
+
+def test_micro_batching_coalesces(memory_storage):
+    """Concurrent /queries.json under batch_window_ms resolve through ONE
+    query_batch; results must equal the unbatched path's."""
+    import threading
+
+    engine, ep, ctx, _ = seed_and_train(memory_storage)
+    http, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      batch_window_ms=25.0, batch_max=16),
+        ctx=ctx,
+    )
+    http.start()
+    try:
+        assert qs.batcher is not None
+        calls = []
+        orig = qs.query_batch
+
+        def spy(queries, record=True):
+            calls.append(len(queries))
+            return orig(queries, record)
+
+        qs.query_batch = spy
+        results = {}
+
+        def hit(u):
+            status, body = call(http.port, "POST", "/queries.json",
+                                {"user": f"u{u}", "num": 3})
+            results[u] = (status, body)
+
+        threads = [threading.Thread(target=hit, args=(u,)) for u in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(status == 200 for status, _ in results.values())
+        # 8 concurrent requests must have ridden fewer than 8 batches
+        assert sum(calls) >= 8 and len(calls) < 8
+        for u, (_, body) in results.items():
+            direct = qs.query({"user": f"u{u}", "num": 3}, record=False)
+            assert [s["item"] for s in body["itemScores"]] == \
+                [s["item"] for s in direct["itemScores"]]
+
+        # a malformed query in a batch must fail alone, not its batch-mates
+        statuses = {}
+
+        def hit_raw(key, q):
+            statuses[key] = call(http.port, "POST", "/queries.json", q)
+
+        threads = [
+            threading.Thread(target=hit_raw, args=("bad", {"num": 3})),
+            threading.Thread(target=hit_raw,
+                             args=("good", {"user": "u1", "num": 3})),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert statuses["bad"][0] == 400
+        assert statuses["good"][0] == 200
+        assert statuses["good"][1]["itemScores"]
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_multi_algo_predicts_run_concurrently(memory_storage):
+    """With >1 algorithms the per-algo predicts overlap on the pool
+    (CreateServer.scala:516's TODO: Parallelize, done)."""
+    import threading
+
+    from pio_tpu.controller import (
+        Engine, EngineFactory, FirstServing, IdentityPreparator, LAlgorithm,
+    )
+    from pio_tpu.controller.base import DataSource
+
+    barrier = threading.Barrier(2, timeout=10)
+
+    class SlowAlgo(LAlgorithm):
+        def train(self, ctx, data):
+            return "m"
+
+        def predict(self, model, query):
+            # both predicts must be in flight at once to pass the barrier
+            barrier.wait()
+            return {"ok": True}
+
+    class NullSource(DataSource):
+        def read_training(self, ctx):
+            return None
+
+    class TwoAlgoEngine(EngineFactory):
+        @classmethod
+        def apply(cls):
+            return Engine(NullSource, IdentityPreparator,
+                          {"a": SlowAlgo, "b": SlowAlgo}, FirstServing)
+
+    engine = TwoAlgoEngine.apply()
+    ep = EngineParams(algorithms=[("a", None), ("b", None)])
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    run_train(engine, ep, memory_storage, engine_id="two", ctx=ctx)
+    http, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="two"),
+        ctx=ctx,
+    )
+    try:
+        out = qs.query({"q": 1}, record=False)  # deadlocks if sequential
+        assert out == {"ok": True}
+    finally:
+        http.stop()
+        qs.close()
 
 
 def test_deploy_without_completed_instance(memory_storage):
