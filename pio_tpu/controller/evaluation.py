@@ -230,10 +230,25 @@ class MetricEvaluator:
         metric: Metric,
         other_metrics: Sequence[Metric] = (),
         output_path: str | None = None,
+        workers: int = 1,
     ):
         self.metric = metric
         self.other_metrics = list(other_metrics)
         self.output_path = output_path
+        # workers > 1 runs the params grid on a thread pool — the reference
+        # runs it `.par` (MetricEvaluator.scala:169-178). Default sequential:
+        # deterministic FastEval cache behavior, and single-device training
+        # rarely overlaps anyway; tuning sweeps over many params opt in.
+        self.workers = workers
+
+    def _score_one(self, ctx, engine: Engine, ep: EngineParams) -> MetricScores:
+        eval_data_set = engine.eval(ctx, ep)
+        return MetricScores(
+            score=self.metric.calculate(ctx, eval_data_set),
+            other_scores=[
+                m.calculate(ctx, eval_data_set) for m in self.other_metrics
+            ],
+        )
 
     def evaluate_base(
         self,
@@ -243,17 +258,20 @@ class MetricEvaluator:
     ) -> MetricEvaluatorResult:
         if not engine_params_list:
             raise ValueError("engine_params_list must not be empty")
-        scores: list[tuple[EngineParams, MetricScores]] = []
-        for ep in engine_params_list:
-            eval_data_set = engine.eval(ctx, ep)
-            ms = MetricScores(
-                score=self.metric.calculate(ctx, eval_data_set),
-                other_scores=[
-                    m.calculate(ctx, eval_data_set)
-                    for m in self.other_metrics
-                ],
-            )
-            scores.append((ep, ms))
+        if self.workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=self.workers) as pool:
+                all_ms = list(pool.map(
+                    lambda ep: self._score_one(ctx, engine, ep),
+                    engine_params_list,
+                ))
+            scores = list(zip(engine_params_list, all_ms))
+        else:
+            scores = [
+                (ep, self._score_one(ctx, engine, ep))
+                for ep in engine_params_list
+            ]
 
         def sort_key(i: int):
             s = scores[i][1].score

@@ -107,6 +107,14 @@ def cmd_status(args) -> int:
         for e in errors:
             print(f"  [ERROR] {e}")
         return 1
+    from pio_tpu.tools.daemon import status_all
+
+    daemons = status_all(getattr(args, "pid_dir", None))
+    if daemons:
+        print("daemons:")
+        for name, info in daemons.items():
+            state = "up" if info["alive"] else "DOWN"
+            print(f"  {name}: {state} (pid {info['pid']})")
     print("(sanity check passed)")
     return 0
 
@@ -322,6 +330,7 @@ def cmd_eval(args) -> int:
     instance_id, result = run_evaluation_class(
         evaluation, generator, get_storage(),
         output_path=args.output or None,
+        workers=args.workers,
     )
     print(f"Evaluation completed. Instance: {instance_id}")
     print(f"Best score: [{result.best_score.score}]")
@@ -389,6 +398,22 @@ def cmd_undeploy(args) -> int:
         return 0
     except Exception as e:  # noqa: BLE001
         return _fail(f"undeploy failed: {e}")
+
+
+def cmd_start_all(args) -> int:
+    from pio_tpu.tools.daemon import default_pid_dir, start_all
+
+    if args.pid_dir is None:
+        args.pid_dir = default_pid_dir()
+    return start_all(args)
+
+
+def cmd_stop_all(args) -> int:
+    from pio_tpu.tools.daemon import default_pid_dir, stop_all
+
+    if args.pid_dir is None:
+        args.pid_dir = default_pid_dir()
+    return stop_all(args)
 
 
 def cmd_eventserver(args) -> int:
@@ -616,7 +641,11 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command", required=True)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
-    sub.add_parser("status").set_defaults(fn=cmd_status)
+    x = sub.add_parser("status")
+    x.add_argument("--pid-dir", default=None,
+                   help="where start-all wrote pidfiles (default "
+                        "$PIO_TPU_PID_DIR or ~/.pio_tpu/run)")
+    x.set_defaults(fn=cmd_status)
 
     x = sub.add_parser("run")
     x.add_argument("script")
@@ -678,6 +707,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("evaluation_class")
     x.add_argument("params_generator_class")
     x.add_argument("--output", default="best.json")
+    x.add_argument("--workers", type=int, default=1,
+                   help="params-grid parallelism (reference runs .par)")
     x.set_defaults(fn=cmd_eval)
 
     x = sub.add_parser("deploy")
@@ -714,6 +745,25 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--server-backend", choices=["async", "threaded"],
                    default="async")
     x.set_defaults(fn=cmd_eventserver)
+
+    x = sub.add_parser("start-all", help="daemon-start the full stack "
+                       "(reference bin/pio-start-all)")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--eventserver-port", type=int, default=7070)
+    x.add_argument("--adminserver-port", type=int, default=7071)
+    x.add_argument("--dashboard-port", type=int, default=9000)
+    x.add_argument("--with-storageserver", action="store_true")
+    x.add_argument("--storageserver-port", type=int, default=7072)
+    x.add_argument("--server-key",
+                   help="storage-server shared secret (required for a "
+                        "non-loopback --ip)")
+    x.add_argument("--pid-dir", default=None)
+    x.set_defaults(fn=cmd_start_all)
+
+    x = sub.add_parser("stop-all", help="stop everything start-all started "
+                       "(reference bin/pio-stop-all)")
+    x.add_argument("--pid-dir", default=None)
+    x.set_defaults(fn=cmd_stop_all)
 
     x = sub.add_parser("storageserver")
     # loopback default: a non-loopback bind requires --server-key (the RPC

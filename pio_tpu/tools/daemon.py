@@ -1,0 +1,213 @@
+"""One-command bring-up/teardown of the serving stack.
+
+Reference bin/pio-start-all / bin/pio-stop-all boot the storage services +
+Event Server with nohup and pkill them by name. Here each service is a
+detached `python -m pio_tpu.tools.cli <verb>` child (own session, log file,
+pidfile under --pid-dir), so `pio start-all` / `pio stop-all` manage the
+whole stack: event server, admin server, dashboard, and optionally the
+shared storage server (the HBase/Postgres stand-in other hosts mount via
+the `remote` backend).
+
+Storage configuration (PIO_STORAGE_*) is inherited from the calling
+environment, like the reference's conf/pio-env.sh sourcing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+
+def default_pid_dir() -> str:
+    return os.environ.get(
+        "PIO_TPU_PID_DIR", os.path.expanduser("~/.pio_tpu/run")
+    )
+
+
+@dataclass(frozen=True)
+class Service:
+    name: str
+    argv: list[str]          # cli args after `python -m pio_tpu.tools.cli`
+    port: int
+    health_path: str = "/"
+
+
+def stack_services(args) -> list[Service]:
+    services = []
+    if getattr(args, "with_storageserver", False):
+        argv = ["storageserver", "--ip", args.ip,
+                "--port", str(args.storageserver_port)]
+        if getattr(args, "server_key", None):
+            # required for non-loopback binds (storageserver refuses them
+            # keyless: the RPC surface includes access keys + model blobs)
+            argv += ["--server-key", args.server_key]
+        services.append(Service(
+            "storageserver", argv, args.storageserver_port, "/health",
+        ))
+    services.append(Service(
+        "eventserver",
+        ["eventserver", "--ip", args.ip, "--port", str(args.eventserver_port)],
+        args.eventserver_port,
+    ))
+    services.append(Service(
+        "adminserver",
+        ["adminserver", "--ip", args.ip, "--port", str(args.adminserver_port)],
+        args.adminserver_port,
+    ))
+    services.append(Service(
+        "dashboard",
+        ["dashboard", "--ip", args.ip, "--port", str(args.dashboard_port)],
+        args.dashboard_port,
+    ))
+    return services
+
+
+def _pidfile(pid_dir: str, name: str) -> str:
+    return os.path.join(pid_dir, f"{name}.pid")
+
+
+def _read_pid(path: str) -> int:
+    """0 = unreadable/corrupt (treated as stale everywhere)."""
+    try:
+        with open(path) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def _alive(pid: int) -> bool:
+    """True only if pid exists AND is one of our CLI daemons — guards the
+    pidfile against pid reuse (e.g. after a reboot) so stop-all never
+    signals an innocent process."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"pio_tpu" in f.read()
+    except OSError:
+        return True  # no /proc: fall back to existence only
+
+
+def _healthy(service: Service, ip: str, timeout_s: float = 20.0,
+             child: subprocess.Popen | None = None) -> bool:
+    host = "127.0.0.1" if ip in ("0.0.0.0", "") else ip
+    url = f"http://{host}:{service.port}{service.health_path}"
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if child is not None and child.poll() is not None:
+            return False  # died at startup: fail now, not after the timeout
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return True
+        except urllib.error.HTTPError:
+            return True  # listening; 4xx (e.g. auth) still means "up"
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.3)
+    return False
+
+
+def start_all(args) -> int:
+    pid_dir = os.path.expanduser(args.pid_dir)
+    os.makedirs(pid_dir, exist_ok=True)
+    started, failed = [], []
+    for svc in stack_services(args):
+        pf = _pidfile(pid_dir, svc.name)
+        if os.path.exists(pf):
+            old = _read_pid(pf)
+            if _alive(old):
+                print(f"{svc.name}: already running (pid {old})")
+                continue
+            os.unlink(pf)  # stale
+        log_path = os.path.join(pid_dir, f"{svc.name}.log")
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "pio_tpu.tools.cli", *svc.argv],
+                stdout=logf, stderr=logf, stdin=subprocess.DEVNULL,
+                start_new_session=True,   # survives this CLI exiting
+            )
+        with open(pf, "w") as f:
+            f.write(str(proc.pid))
+        if _healthy(svc, args.ip, child=proc):
+            print(f"{svc.name}: started (pid {proc.pid}, port {svc.port}, "
+                  f"log {log_path})")
+            started.append(svc.name)
+        else:
+            tail = ""
+            try:
+                with open(log_path, "rb") as lf:
+                    tail = lf.read()[-400:].decode(errors="replace").strip()
+            except OSError:
+                pass
+            print(f"{svc.name}: FAILED to come up on port {svc.port} "
+                  f"(see {log_path})"
+                  + (f"\n  {tail.splitlines()[-1]}" if tail else ""),
+                  file=sys.stderr)
+            os.unlink(pf)
+            failed.append(svc.name)
+    if failed:
+        return 1
+    if started:
+        print(f"Stack up: {', '.join(started)}. Stop with: pio stop-all")
+    return 0
+
+
+def stop_all(args) -> int:
+    pid_dir = os.path.expanduser(args.pid_dir)
+    if not os.path.isdir(pid_dir):
+        print("Nothing to stop.")
+        return 0
+    stopped = 0
+    for fn in sorted(os.listdir(pid_dir)):
+        if not fn.endswith(".pid"):
+            continue
+        name = fn[:-4]
+        pf = os.path.join(pid_dir, fn)
+        pid = _read_pid(pf)
+        if _alive(pid):
+            try:
+                # the child leads its own session (start_new_session): signal
+                # the group so any helpers it spawned go down with it
+                os.killpg(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            deadline = time.monotonic() + 10
+            while _alive(pid) and time.monotonic() < deadline:
+                time.sleep(0.2)
+            if _alive(pid):
+                try:
+                    os.killpg(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+            print(f"{name}: stopped (pid {pid})")
+            stopped += 1
+        else:
+            print(f"{name}: not running (stale pidfile removed)")
+        os.unlink(pf)
+    if not stopped:
+        print("Nothing to stop.")
+    return 0
+
+
+def status_all(pid_dir: str | None = None) -> dict:
+    """-> {service: {"pid": int, "alive": bool}} for `pio status`."""
+    out = {}
+    pid_dir = os.path.expanduser(pid_dir or default_pid_dir())
+    if not os.path.isdir(pid_dir):
+        return out
+    for fn in sorted(os.listdir(pid_dir)):
+        if fn.endswith(".pid"):
+            pid = _read_pid(os.path.join(pid_dir, fn))
+            out[fn[:-4]] = {"pid": pid, "alive": _alive(pid)}
+    return out

@@ -39,6 +39,7 @@ def run_evaluation(
     batch: str = "",
     output_path: str | None = None,
     ctx: WorkflowContext | None = None,
+    workers: int = 1,
 ) -> tuple[str, MetricEvaluatorResult]:
     """Returns (evaluation instance id, result)."""
     ctx = ctx or create_workflow_context(storage)
@@ -58,7 +59,8 @@ def run_evaluation(
     instance = instances.get(instance_id)
     try:
         evaluator = MetricEvaluator(
-            metric, other_metrics=other_metrics, output_path=output_path
+            metric, other_metrics=other_metrics, output_path=output_path,
+            workers=workers,
         )
         result = evaluator.evaluate_base(ctx, engine, engine_params_list)
         instances.update(

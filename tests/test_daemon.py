@@ -1,0 +1,94 @@
+"""start-all/stop-all daemon lifecycle (reference bin/pio-start-all /
+pio-stop-all): real detached processes, pidfiles, health checks, teardown."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_start_all_stop_all_roundtrip(tmp_path):
+    import urllib.request
+
+    pid_dir = tmp_path / "run"
+    db = tmp_path / "pio.db"
+    env = dict(
+        os.environ,
+        PIO_STORAGE_SOURCES_S_TYPE="sqlite",
+        PIO_STORAGE_SOURCES_S_PATH=str(db),
+        PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="S",
+        PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="S",
+        PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="S",
+    )
+    ports = {name: free_port()
+             for name in ("eventserver", "adminserver", "dashboard")}
+    argv = [
+        sys.executable, "-m", "pio_tpu.tools.cli", "start-all",
+        "--ip", "127.0.0.1",
+        "--eventserver-port", str(ports["eventserver"]),
+        "--adminserver-port", str(ports["adminserver"]),
+        "--dashboard-port", str(ports["dashboard"]),
+        "--pid-dir", str(pid_dir),
+    ]
+    out = subprocess.run(argv, capture_output=True, text=True, timeout=120,
+                         env=env, cwd="/root/repo")
+    try:
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "Stack up" in out.stdout
+        for name, port in ports.items():
+            pf = pid_dir / f"{name}.pid"
+            assert pf.exists()
+            pid = int(pf.read_text())
+            os.kill(pid, 0)  # alive
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=5
+            ) as resp:
+                assert resp.status == 200
+
+        # idempotent: second start-all reports already-running, starts nothing
+        out2 = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=60, env=env, cwd="/root/repo")
+        assert out2.returncode == 0
+        assert out2.stdout.count("already running") == 3
+    finally:
+        stop = subprocess.run(
+            [sys.executable, "-m", "pio_tpu.tools.cli", "stop-all",
+             "--pid-dir", str(pid_dir)],
+            capture_output=True, text=True, timeout=60, env=env,
+            cwd="/root/repo",
+        )
+    assert stop.returncode == 0, stop.stdout + stop.stderr
+    assert stop.stdout.count("stopped") == 3
+    assert not list(pid_dir.glob("*.pid"))
+    # ports released
+    deadline = time.monotonic() + 15
+    for name, port in ports.items():
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=2
+                ):
+                    time.sleep(0.3)
+                    continue
+            except Exception:
+                break
+        else:
+            pytest.fail(f"{name} still answering after stop-all")
+
+
+def test_stop_all_without_anything(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "pio_tpu.tools.cli", "stop-all",
+         "--pid-dir", str(tmp_path / "none")],
+        capture_output=True, text=True, timeout=30, cwd="/root/repo",
+    )
+    assert out.returncode == 0 and "Nothing to stop" in out.stdout

@@ -231,6 +231,31 @@ def test_metric_evaluator_writes_best_json(tmp_path):
     assert best["algorithmParamsList"][0]["params"]["w"] == 1.0
 
 
+def test_metric_evaluator_parallel_workers():
+    """workers>1 runs the params grid on a pool (reference
+    MetricEvaluator.scala:169-178 `.par`): same result, scaled wall-clock."""
+    import time
+
+    class SlowEngine:
+        def eval(self, ctx, ep):
+            time.sleep(0.25)
+            return make_engine().eval(ctx, ep)
+
+    params = grid([0.5, 1.0, 2.0, 4.0])
+    t0 = time.monotonic()
+    seq = MetricEvaluator(Err()).evaluate_base(None, SlowEngine(), params)
+    t_seq = time.monotonic() - t0
+    t0 = time.monotonic()
+    par = MetricEvaluator(Err(), workers=4).evaluate_base(
+        None, SlowEngine(), params
+    )
+    t_par = time.monotonic() - t0
+    assert par.best_idx == seq.best_idx == 1
+    assert [ms.score for _, ms in par.engine_params_scores] == \
+        [ms.score for _, ms in seq.engine_params_scores]
+    assert t_par < t_seq * 0.7  # 4 workers over 4x0.25s sleeps
+
+
 def test_metric_evaluator_other_metrics():
     engine = make_engine()
     result = MetricEvaluator(Err(), other_metrics=[ZeroMetric()]).evaluate_base(
