@@ -17,7 +17,9 @@ reference's FastEvalEngineTest asserts exact hit counts."""
 from __future__ import annotations
 
 import json
+import threading
 from collections import Counter
+from concurrent.futures import Future
 from typing import Any
 
 from pio_tpu.controller.base import params_to_dict
@@ -40,11 +42,35 @@ class FastEvalEngine(Engine):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self._ds_cache: dict[str, Any] = {}
-        self._prep_cache: dict[str, Any] = {}
-        self._algo_cache: dict[str, Any] = {}
+        # caches hold per-key Futures so a parallel params sweep
+        # (MetricEvaluator workers>1) computes each shared prefix ONCE:
+        # the first thread in owns the Future, later threads block on it
+        self._ds_cache: dict[str, Future] = {}
+        self._prep_cache: dict[str, Future] = {}
+        self._algo_cache: dict[str, Future] = {}
+        self._lock = threading.Lock()
         self.cache_hits = Counter()
         self.cache_misses = Counter()
+
+    def _memo(self, cache: dict[str, Future], stage: str, key: str, compute):
+        with self._lock:
+            fut = cache.get(key)
+            if fut is None:
+                fut = cache[key] = Future()
+                self.cache_misses[stage] += 1
+                owner = True
+            else:
+                self.cache_hits[stage] += 1
+                owner = False
+        if owner:
+            try:
+                fut.set_result(compute())
+            except BaseException as e:
+                with self._lock:
+                    cache.pop(key, None)  # a failed stage may be retried
+                fut.set_exception(e)
+                raise
+        return fut.result()
 
     @classmethod
     def from_engine(cls, engine: Engine) -> "FastEvalEngine":
@@ -58,31 +84,32 @@ class FastEvalEngine(Engine):
     # -- prefix stages (reference getDataSourceResult etc.,
     # FastEvalEngine.scala:50-264) ------------------------------------------
     def _datasource_result(self, ctx, engine_params: EngineParams):
-        k = _key(engine_params.datasource)
-        if k not in self._ds_cache:
-            self.cache_misses["datasource"] += 1
+        def compute():
             ds = self._stage(
-                self.datasource_classes, *engine_params.datasource, "datasource"
+                self.datasource_classes, *engine_params.datasource,
+                "datasource",
             )
-            self._ds_cache[k] = ds.read_eval(ctx)
-        else:
-            self.cache_hits["datasource"] += 1
-        return self._ds_cache[k]
+            return ds.read_eval(ctx)
+
+        return self._memo(
+            self._ds_cache, "datasource", _key(engine_params.datasource),
+            compute,
+        )
 
     def _preparator_result(self, ctx, engine_params: EngineParams):
-        k = _key(engine_params.datasource, engine_params.preparator)
-        if k not in self._prep_cache:
-            self.cache_misses["preparator"] += 1
+        def compute():
             prep = self._stage(
-                self.preparator_classes, *engine_params.preparator, "preparator"
+                self.preparator_classes, *engine_params.preparator,
+                "preparator",
             )
             folds = self._datasource_result(ctx, engine_params)
-            self._prep_cache[k] = [
-                (prep.prepare(ctx, td), ei, qa) for td, ei, qa in folds
-            ]
-        else:
-            self.cache_hits["preparator"] += 1
-        return self._prep_cache[k]
+            return [(prep.prepare(ctx, td), ei, qa) for td, ei, qa in folds]
+
+        return self._memo(
+            self._prep_cache, "preparator",
+            _key(engine_params.datasource, engine_params.preparator),
+            compute,
+        )
 
     def _algorithms_result(self, ctx, engine_params: EngineParams):
         """-> per fold: list over algos of batch predictions (aligned with
@@ -93,8 +120,8 @@ class FastEvalEngine(Engine):
             list(engine_params.algorithms or [("", None)]),
             engine_params.serving,  # supplement affects queries
         )
-        if k not in self._algo_cache:
-            self.cache_misses["algorithms"] += 1
+
+        def compute():
             algo_list = engine_params.algorithms or [("", None)]
             algos = [
                 self._stage(self.algorithm_classes, n, p, "algorithm")
@@ -113,10 +140,9 @@ class FastEvalEngine(Engine):
                     for a, m in zip(algos, models)
                 ]
                 out.append((per_algo, ei, qa))
-            self._algo_cache[k] = out
-        else:
-            self.cache_hits["algorithms"] += 1
-        return self._algo_cache[k]
+            return out
+
+        return self._memo(self._algo_cache, "algorithms", k, compute)
 
     # -- eval override (reference FastEvalEngine.scala:310-343) -------------
     def eval(self, ctx, engine_params: EngineParams):

@@ -526,52 +526,6 @@ def cmd_import(args) -> int:
     return 0 if failed == 0 else 1
 
 
-_TEMPLATE_ENGINE_PY = '''\
-"""Custom engine template — edit the DASE classes below.
-
-Generated by `pio template new`. The factory name in engine.json points at
-MyEngine; implement read_training/train/predict for your data.
-"""
-
-from dataclasses import dataclass
-
-from pio_tpu.controller import (
-    DataSource, EngineFactory, Engine, FirstServing, IdentityPreparator,
-    LAlgorithm, Params,
-)
-
-
-@dataclass(frozen=True)
-class MyDataSourceParams(Params):
-    app_name: str = ""
-
-
-class MyDataSource(DataSource):
-    params_class = MyDataSourceParams
-
-    def __init__(self, params: MyDataSourceParams):
-        self.params = params
-
-    def read_training(self, ctx):
-        return ctx.event_store.find(app_name=self.params.app_name)
-
-
-class MyAlgorithm(LAlgorithm):
-    def train(self, ctx, events):
-        return {"n_events": len(events)}
-
-    def predict(self, model, query):
-        return {"nEvents": model["n_events"]}
-
-
-class MyEngine(EngineFactory):
-    @classmethod
-    def apply(cls):
-        return Engine(MyDataSource, IdentityPreparator, MyAlgorithm,
-                      FirstServing)
-'''
-
-
 def cmd_upgrade(args) -> int:
     """Migrate events + app metadata between storage backends (the
     reference's `pio upgrade` generalized: any source -> any target)."""
@@ -598,10 +552,24 @@ def cmd_upgrade(args) -> int:
 
 
 def cmd_template(args) -> int:
-    """Scaffold a new engine directory (reference console/Template.scala —
-    minus the network gallery: templates generate locally)."""
+    """Scaffold a new engine directory from the template gallery
+    (reference console/Template.scala — the gallery is the local model zoo;
+    no network in this build)."""
+    from pio_tpu.tools.templates import TEMPLATES, readme_for
+
+    if args.subcommand == "list":
+        for spec in TEMPLATES.values():
+            print(f"{spec.name:16} {spec.description}")
+        return 0
     if args.subcommand != "new":
-        return _fail("only 'template new <dir>' is supported")
+        return _fail("use 'template new <dir> [--template NAME]' or "
+                     "'template list'")
+    spec = TEMPLATES.get(args.template)
+    if spec is None:
+        return _fail(
+            f"unknown template {args.template!r}; "
+            f"choose from: {', '.join(TEMPLATES)}"
+        )
     target = args.directory
     if os.path.exists(target) and (
         not os.path.isdir(target) or os.listdir(target)
@@ -609,24 +577,15 @@ def cmd_template(args) -> int:
         return _fail(f"{target} exists and is not an empty directory")
     os.makedirs(target, exist_ok=True)
     name = os.path.basename(os.path.abspath(target))
+    variant = dict(spec.engine_json, id=name)
     with open(os.path.join(target, "engine.json"), "w") as f:
-        json.dump({
-            "id": name,
-            "description": f"{name} engine",
-            "engineFactory": "engine.MyEngine",
-            "datasource": {"params": {"app_name": "YOUR_APP"}},
-            "algorithms": [{"name": "", "params": {}}],
-        }, f, indent=2)
-    with open(os.path.join(target, "engine.py"), "w") as f:
-        f.write(_TEMPLATE_ENGINE_PY)
+        json.dump(variant, f, indent=2)
+    if spec.engine_py is not None:
+        with open(os.path.join(target, "engine.py"), "w") as f:
+            f.write(spec.engine_py)
     with open(os.path.join(target, "README.md"), "w") as f:
-        f.write(
-            f"# {name}\n\nEdit engine.py, then:\n\n"
-            "    python -m pio_tpu.tools.cli build\n"
-            "    python -m pio_tpu.tools.cli train\n"
-            "    python -m pio_tpu.tools.cli deploy --port 8000\n"
-        )
-    print(f"Engine template created at {target}")
+        f.write(readme_for(spec, name))
+    print(f"Engine template '{spec.name}' created at {target}")
     return 0
 
 
@@ -813,6 +772,11 @@ def build_parser() -> argparse.ArgumentParser:
     xs = x.add_subparsers(dest="subcommand", required=True)
     t = xs.add_parser("new")
     t.add_argument("directory")
+    t.add_argument("--template", default="custom",
+                   help="engine shape (see `pio template list`)")
+    t.set_defaults(fn=cmd_template)
+    t = xs.add_parser("list")
+    t.set_defaults(fn=cmd_template)
     x.set_defaults(fn=cmd_template)
 
     return p

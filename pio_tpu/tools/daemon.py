@@ -115,6 +115,26 @@ def _healthy(service: Service, ip: str, timeout_s: float = 20.0,
     return False
 
 
+def _terminate(pid: int, grace_s: float = 10.0) -> None:
+    """SIGTERM the process group (children lead their own sessions), wait,
+    escalate to SIGKILL."""
+    try:
+        os.killpg(pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+    deadline = time.monotonic() + grace_s
+    while _alive(pid) and time.monotonic() < deadline:
+        time.sleep(0.2)
+    if _alive(pid):
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+
 def start_all(args) -> int:
     pid_dir = os.path.expanduser(args.pid_dir)
     os.makedirs(pid_dir, exist_ok=True)
@@ -151,6 +171,9 @@ def start_all(args) -> int:
                   f"(see {log_path})"
                   + (f"\n  {tail.splitlines()[-1]}" if tail else ""),
                   file=sys.stderr)
+            # a slow-to-bind child may still be alive: kill it before
+            # dropping the pidfile, or it becomes an unmanaged orphan
+            _terminate(proc.pid)
             os.unlink(pf)
             failed.append(svc.name)
     if failed:
@@ -173,23 +196,7 @@ def stop_all(args) -> int:
         pf = os.path.join(pid_dir, fn)
         pid = _read_pid(pf)
         if _alive(pid):
-            try:
-                # the child leads its own session (start_new_session): signal
-                # the group so any helpers it spawned go down with it
-                os.killpg(pid, signal.SIGTERM)
-            except (ProcessLookupError, PermissionError, OSError):
-                try:
-                    os.kill(pid, signal.SIGTERM)
-                except (ProcessLookupError, PermissionError):
-                    pass
-            deadline = time.monotonic() + 10
-            while _alive(pid) and time.monotonic() < deadline:
-                time.sleep(0.2)
-            if _alive(pid):
-                try:
-                    os.killpg(pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError, OSError):
-                    pass
+            _terminate(pid)
             print(f"{name}: stopped (pid {pid})")
             stopped += 1
         else:

@@ -146,6 +146,33 @@ def test_template_new(cli, tmp_path):
     assert code == 1
 
 
+def test_template_gallery_every_shape_builds(cli, tmp_path):
+    """`pio template list` + one scaffold per zoo shape, each passing
+    `pio build` untouched (reference console/Template.scala gallery,
+    offline: the gallery IS the zoo)."""
+    from pio_tpu.tools.templates import TEMPLATES
+
+    code, out = cli("template", "list")
+    assert code == 0
+    for name in ("recommendation", "classification", "similarproduct",
+                 "ecommerce", "twotower", "sequence", "custom"):
+        assert name in TEMPLATES and name in out.out
+
+    for name in TEMPLATES:
+        target = tmp_path / name
+        code, out = cli("template", "new", str(target), "--template", name)
+        assert code == 0, out.err
+        assert (target / "engine.json").exists()
+        assert (target / "README.md").exists()
+        code, out = cli("build", "--engine-dir", str(target))
+        assert code == 0, f"{name}: {out.err}"
+        assert "loads" in out.out
+
+    code, out = cli("template", "new", str(tmp_path / "x"),
+                    "--template", "nope")
+    assert code == 1 and "unknown template" in out.err
+
+
 def test_export_import(cli, memory_storage, tmp_path):
     from pio_tpu.data import DataMap, Event
 

@@ -256,6 +256,22 @@ def test_metric_evaluator_parallel_workers():
     assert t_par < t_seq * 0.7  # 4 workers over 4x0.25s sleeps
 
 
+def test_fasteval_parallel_workers_compute_shared_stage_once():
+    """The per-key Future memo: 4 threads racing the same datasource prefix
+    must run it exactly once (check-then-act race would recompute it)."""
+    reset_counts()
+    engine = make_engine(fast=True)
+    MetricEvaluator(Err(), workers=4).evaluate_base(
+        None, engine, grid([0.5, 1.0, 2.0, 4.0])
+    )
+    assert DS.read_count == 1
+    assert Prep.prepare_count == 2   # once per fold, single prefix
+    assert Algo.train_count == 8     # 4 algo params x 2 folds
+    assert engine.cache_misses["datasource"] == 1
+    assert engine.cache_misses["preparator"] == 1
+    assert engine.cache_misses["algorithms"] == 4
+
+
 def test_metric_evaluator_other_metrics():
     engine = make_engine()
     result = MetricEvaluator(Err(), other_metrics=[ZeroMetric()]).evaluate_base(
