@@ -62,6 +62,7 @@ class ServingConfig:
     # (one big matmul beats many small ones on the MXU). 0 = off.
     batch_window_ms: float = 0.0
     batch_max: int = 64
+    batch_pipeline: int = 4       # batches concurrently in flight
 
 
 class QueryServer:
@@ -95,7 +96,8 @@ class QueryServer:
         )
         self._load(instance_id)
         self.batcher = (
-            QueryBatcher(self, config.batch_window_ms / 1e3, config.batch_max)
+            QueryBatcher(self, config.batch_window_ms / 1e3, config.batch_max,
+                         pipeline_depth=config.batch_pipeline)
             if config.batch_window_ms > 0 else None
         )
         self._warm()
@@ -317,18 +319,25 @@ class QueryServer:
 
 
 class QueryBatcher:
-    """Dynamic micro-batching: requests enqueue and a single collector
-    thread drains up to `max_batch` of them within `window_s`, executing one
-    `query_batch` for the lot. One big top-k matmul replaces N small ones —
-    the MXU-friendly shape — at the cost of up to window_s added latency,
-    so it is off unless ServingConfig.batch_window_ms is set."""
+    """Dynamic micro-batching: requests enqueue, a collector thread drains
+    up to `max_batch` of them within `window_s`, and each batch executes as
+    one `query_batch` ON A POOL — so several batches stay in flight at once.
+    One big top-k matmul replaces N small ones (the MXU-friendly shape) and
+    the pipelining keeps throughput up even when a device dispatch is
+    round-trip-dominated (remote/tunneled TPU); cost is up to window_s
+    added latency, so it is off unless ServingConfig.batch_window_ms is
+    set."""
 
-    def __init__(self, server: QueryServer, window_s: float, max_batch: int):
+    def __init__(self, server: QueryServer, window_s: float, max_batch: int,
+                 pipeline_depth: int = 4):
         self.server = server
         self.window_s = window_s
         self.max_batch = max_batch
         self._q: queue.Queue[tuple[dict, Future]] = queue.Queue()
         self._closed = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=pipeline_depth, thread_name_prefix="batch-exec"
+        )
         self._thread = threading.Thread(
             target=self._run, name="query-batcher", daemon=True
         )
@@ -355,24 +364,37 @@ class QueryBatcher:
                     batch.append(self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
-            queries = [q for q, _ in batch]
+            # hand off and go straight back to collecting the next batch
             try:
-                results = self.server.query_batch(queries)
-                for (_, fut), res in zip(batch, results):
-                    fut.set_result(res)
-            except Exception:  # noqa: BLE001 - isolate the bad query
-                # one malformed query must not fail its batch-mates: retry
-                # each one alone so only the offender sees the error
-                for q, fut in batch:
-                    if fut.done():
-                        continue
-                    try:
-                        fut.set_result(self.server.query(q))
-                    except Exception as e:  # noqa: BLE001
+                self._pool.submit(self._execute, batch)
+            except RuntimeError as e:
+                # close() raced the collection: fail the batch's waiters
+                # rather than stranding them on never-set futures
+                for _, fut in batch:
+                    if not fut.done():
                         fut.set_exception(e)
+                return
+
+    def _execute(self, batch: list[tuple[dict, Future]]):
+        queries = [q for q, _ in batch]
+        try:
+            results = self.server.query_batch(queries)
+            for (_, fut), res in zip(batch, results):
+                fut.set_result(res)
+        except Exception:  # noqa: BLE001 - isolate the bad query
+            # one malformed query must not fail its batch-mates: retry
+            # each one alone so only the offender sees the error
+            for q, fut in batch:
+                if fut.done():
+                    continue
+                try:
+                    fut.set_result(self.server.query(q))
+                except Exception as e:  # noqa: BLE001
+                    fut.set_exception(e)
 
     def close(self):
         self._closed = True
+        self._pool.shutdown(wait=False)
 
 
 def build_serving_app(server: QueryServer) -> HttpApp:
