@@ -93,13 +93,18 @@ def als_flops_per_sweep(nnz: int, n_users: int, n_items: int, rank: int,
         product (via W-wide matmuls) per half  -> 2 * 2*nnz*k^2
       - rhs build: 2*nnz*k per half
       - Gram YtY/XtX: 2*n*k^2 for the opposing side per half
-      - CG: matvec 2*k^2 per entity per iteration
+      - solve: CG = matvec 2*k^2 per entity per iteration;
+               direct (cg_iters=0) = k^3/3 Cholesky + 2*k^2 triangular
+               solves per entity
     """
     k = rank
     build = 2 * (2 * nnz * k * k + 2 * nnz * k)
     gram = 2 * n_items * k * k + 2 * n_users * k * k
-    cg = 2 * (n_users + n_items) * cg_iters * k * k
-    return float(build + gram + cg)
+    if cg_iters > 0:
+        solve = 2 * (n_users + n_items) * cg_iters * k * k
+    else:
+        solve = (n_users + n_items) * (k * k * k / 3 + 2 * k * k)
+    return float(build + gram + solve)
 
 
 def synth(nnz: int, n_users: int = None, n_items: int = None, seed=0):

@@ -110,7 +110,8 @@ def test_high_rank_cg_matches_cholesky():
     quietly under-converged exactly here."""
     users, items, vals, nu, ni = synthetic(
         n_users=300, n_items=200, rank=8, density=0.4)
-    p_cg = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096)
+    p_cg = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096,
+                     cg_iters=-1)
     assert p_cg.resolved_cg_iters() >= 2 * 64
     p_direct = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096,
                          cg_iters=0)
@@ -130,7 +131,8 @@ def test_high_rank_cg_matches_cholesky_implicit():
     vals = rng.integers(1, 6, 6000).astype(np.float32)
     kw = dict(rank=64, iterations=4, reg=0.05, alpha=10.0, implicit=True,
               chunk=4096)
-    m_cg = als_train(users, items, vals, nu, ni, ALSParams(**kw))
+    m_cg = als_train(users, items, vals, nu, ni,
+                     ALSParams(**kw, cg_iters=-1))
     m_direct = als_train(users, items, vals, nu, ni,
                          ALSParams(**kw, cg_iters=0))
     # factors from equal-quality solves produce near-identical preference
