@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Reference implementation of the external-engine wire protocol.
+
+This file stands in for an engine written in ANY language — it uses only
+the standard library and speaks line-delimited JSON-RPC on stdio (the
+protocol documented in pio_tpu/controller/external.py, the framework's
+counterpart of the reference's Java controller API). Port this file to
+Java/Go/Rust and nothing on the framework side changes.
+
+The model itself is a popularity ranker with per-user seen-item filtering:
+deliberately simple, so the protocol — not the math — is the point.
+"""
+
+import json
+import sys
+from collections import Counter, defaultdict
+
+MODEL = None
+PROTOCOL = 1
+
+
+def handle_describe(params):
+    return {"name": "popularity-ranker", "protocol": PROTOCOL}
+
+
+def handle_train(params):
+    counts = Counter()
+    seen = defaultdict(list)
+    for ev in params["events"]:
+        item = ev.get("targetEntityId")
+        if not item:
+            continue
+        counts[item] += 1
+        seen[ev["entityId"]].append(item)
+    top = [item for item, _ in counts.most_common(
+        int(params.get("config", {}).get("top_n", 100)))]
+    return {"model": {"top": top,
+                      "counts": dict(counts),
+                      "seen": {u: sorted(set(s)) for u, s in seen.items()}}}
+
+
+def handle_load_model(params):
+    global MODEL
+    MODEL = params["model"]
+    MODEL["seen_sets"] = {u: set(s) for u, s in MODEL["seen"].items()}
+    return {}
+
+
+def _rank(query):
+    num = int(query.get("num", 10))
+    seen = MODEL["seen_sets"].get(query.get("user", ""), set())
+    out = []
+    for item in MODEL["top"]:
+        if item in seen:
+            continue
+        out.append({"item": item, "score": float(MODEL["counts"][item])})
+        if len(out) >= num:
+            break
+    return {"itemScores": out}
+
+
+def handle_predict(params):
+    if MODEL is None:
+        raise ValueError("no model loaded")
+    return {"prediction": _rank(params["query"])}
+
+
+def handle_predict_batch(params):
+    if MODEL is None:
+        raise ValueError("no model loaded")
+    return {"predictions": [_rank(q) for q in params["queries"]]}
+
+
+HANDLERS = {
+    "describe": handle_describe,
+    "train": handle_train,
+    "load_model": handle_load_model,
+    "predict": handle_predict,
+    "predict_batch": handle_predict_batch,
+}
+
+
+def main():
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        req = json.loads(line)
+        reply = {"id": req.get("id")}
+        try:
+            handler = HANDLERS.get(req.get("method"))
+            if handler is None:
+                raise ValueError(f"unknown method {req.get('method')!r}")
+            reply["result"] = handler(req.get("params") or {})
+        except Exception as e:  # noqa: BLE001 - report, keep serving
+            reply["error"] = {"message": f"{type(e).__name__}: {e}"}
+        sys.stdout.write(json.dumps(reply) + "\n")
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

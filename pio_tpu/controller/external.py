@@ -1,0 +1,286 @@
+"""External (any-language) engine bridge — the cross-language binding story.
+
+The reference ships a Java controller API (core/src/main/java/.../
+controller/java/*, e.g. LJavaAlgorithm) so engines can be written outside
+Scala. A Python framework's equivalent isn't a JVM shim but a PROCESS
+protocol: the engine is any executable speaking line-delimited JSON-RPC on
+stdio, and this module bridges it into the DASE pipeline. Train spawns the
+engine process, streams it the training events, and stores the opaque JSON
+model it returns in the regular model store; deploy re-spawns it, loads the
+model once, and proxies queries (a lock serializes the pipe — the child is
+free to be internally parallel).
+
+Wire protocol (one JSON object per line on stdin/stdout; stderr is logged):
+
+  -> {"id": 1, "method": "describe", "params": {}}
+  <- {"id": 1, "result": {"name": "...", "protocol": 1}}
+  -> {"id": 2, "method": "train",
+      "params": {"events": [<event wire dicts>], "config": {...}}}
+  <- {"id": 2, "result": {"model": <any json>}}
+  -> {"id": 3, "method": "load_model", "params": {"model": ..., "config": ...}}
+  <- {"id": 3, "result": {}}
+  -> {"id": 4, "method": "predict", "params": {"query": {...}}}
+  <- {"id": 4, "result": {"prediction": {...}}}
+  -> {"id": 5, "method": "predict_batch", "params": {"queries": [...]}}
+  <- {"id": 5, "result": {"predictions": [...]}}      (optional method)
+
+Errors: {"id": N, "error": {"message": "..."}}. An engine that doesn't
+implement predict_batch returns an error for it and the bridge falls back
+to per-query predicts. `examples/external-engine/` holds a stdlib-only
+reference implementation of the engine side.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from pio_tpu.controller.base import (
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    LAlgorithm,
+    Params,
+)
+from pio_tpu.controller.engine import Engine, EngineFactory
+
+log = logging.getLogger("pio_tpu.external")
+
+
+class ExternalEngineError(RuntimeError):
+    pass
+
+
+class ExternalProcess:
+    """One engine child process; request/response over stdio lines."""
+
+    def __init__(self, command: Sequence[str], cwd: str | None = None,
+                 timeout: float = 600.0):
+        if not command:
+            raise ExternalEngineError("external engine command is empty")
+        self.command = list(command)
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._next_id = 0
+        try:
+            self._proc = subprocess.Popen(
+                self.command, cwd=cwd,
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True, bufsize=1,
+            )
+        except OSError as e:
+            raise ExternalEngineError(
+                f"cannot spawn external engine {self.command}: {e}"
+            ) from e
+        # drain stderr on a thread so the child can't block on a full pipe
+        self._stderr_thread = threading.Thread(
+            target=self._drain_stderr, daemon=True
+        )
+        self._stderr_thread.start()
+
+    def _drain_stderr(self):
+        try:
+            for line in self._proc.stderr:
+                log.info("[external %s] %s", self.command[0], line.rstrip())
+        except ValueError:
+            pass  # pipe closed
+
+    def call(self, method: str, params: dict | None = None) -> Any:
+        with self._lock:
+            if self._proc.poll() is not None:
+                raise ExternalEngineError(
+                    f"external engine {self.command} exited with "
+                    f"rc={self._proc.returncode}"
+                )
+            self._next_id += 1
+            req_id = self._next_id
+            msg = json.dumps(
+                {"id": req_id, "method": method, "params": params or {}}
+            )
+            try:
+                self._proc.stdin.write(msg + "\n")
+                self._proc.stdin.flush()
+                line = self._proc.stdout.readline()
+            except (BrokenPipeError, OSError) as e:
+                raise ExternalEngineError(
+                    f"external engine {self.command} pipe broke during "
+                    f"{method}: {e}"
+                ) from e
+        if not line:
+            raise ExternalEngineError(
+                f"external engine {self.command} closed stdout during "
+                f"{method} (rc={self._proc.poll()})"
+            )
+        try:
+            resp = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ExternalEngineError(
+                f"external engine sent invalid JSON for {method}: "
+                f"{line[:200]!r}"
+            ) from e
+        if resp.get("id") != req_id:
+            raise ExternalEngineError(
+                f"external engine answered id {resp.get('id')} to request "
+                f"{req_id} ({method}); the protocol is strictly serial"
+            )
+        if "error" in resp:
+            raise ExternalEngineError(
+                f"{method}: {resp['error'].get('message', resp['error'])}"
+            )
+        return resp.get("result")
+
+    def close(self):
+        proc = self._proc
+        if proc.poll() is None:
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# DASE wrappers
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ExternalDataSourceParams(Params):
+    app_name: str = ""
+    event_names: tuple = ()
+
+
+class ExternalDataSource(DataSource):
+    """Reads the app's events and hands them to the external engine as wire
+    dicts (the Event Server's JSON shape, so any language's existing client
+    model applies)."""
+
+    params_class = ExternalDataSourceParams
+
+    def __init__(self, params: ExternalDataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx) -> list[dict]:
+        events = ctx.event_store.find(
+            app_name=self.params.app_name,
+            event_names=list(self.params.event_names) or None,
+        )
+        return [e.to_api_dict() for e in events]
+
+
+@dataclass(frozen=True)
+class ExternalAlgorithmParams(Params):
+    command: tuple = ()        # argv of the engine executable
+    config: dict = field(default_factory=dict)  # passed through verbatim
+    workdir: str = ""          # cwd for the child ("" = inherit)
+    timeout: float = 600.0
+
+
+class ExternalAlgorithm(LAlgorithm):
+    """Bridges train/predict to the engine process. The stored model is the
+    opaque JSON the engine returned from `train` plus enough to respawn it
+    at deploy."""
+
+    params_class = ExternalAlgorithmParams
+
+    def __init__(self, params: ExternalAlgorithmParams):
+        self.params = params
+        self._proc: ExternalProcess | None = None
+        self._loaded_key: int | None = None
+        self._proc_lock = threading.Lock()
+
+    def _spawn(self) -> ExternalProcess:
+        # the CLI absolutizes a relative workdir against the engine dir at
+        # load time (cli._absolutize_param_paths); one still relative here
+        # (programmatic construction) resolves against the process cwd
+        return ExternalProcess(
+            self.params.command, cwd=self.params.workdir or None,
+            timeout=self.params.timeout,
+        )
+
+    def train(self, ctx, events: list[dict]) -> dict:
+        proc = self._spawn()
+        try:
+            info = proc.call("describe") or {}
+            model = proc.call("train", {
+                "events": events, "config": dict(self.params.config),
+            })
+            if not isinstance(model, dict) or "model" not in model:
+                raise ExternalEngineError(
+                    "train must return {\"model\": <json>}"
+                )
+            return {
+                "engine": info.get("name", self.params.command[0]),
+                "model": model["model"],
+            }
+        finally:
+            proc.close()
+
+    def _serving_proc(self, model: dict) -> ExternalProcess:
+        """Keep one child alive across predicts; (re)load on model change
+        (reload hot-swap) or child death."""
+        with self._proc_lock:
+            key = id(model)
+            if self._proc is not None and (
+                self._loaded_key != key or self._proc._proc.poll() is not None
+            ):
+                self._proc.close()
+                self._proc = None
+            if self._proc is None:
+                self._proc = self._spawn()
+                self._proc.call("load_model", {
+                    "model": model["model"],
+                    "config": dict(self.params.config),
+                })
+                self._loaded_key = key
+            return self._proc
+
+    def predict(self, model: dict, query: dict) -> Any:
+        proc = self._serving_proc(model)
+        out = proc.call("predict", {"query": query}) or {}
+        return out.get("prediction")
+
+    def batch_predict(self, model: dict, queries) -> list:
+        proc = self._serving_proc(model)
+        try:
+            out = proc.call("predict_batch", {"queries": list(queries)}) or {}
+            preds = out.get("predictions")
+            if isinstance(preds, list) and len(preds) == len(queries):
+                return preds
+        except ExternalEngineError:
+            pass  # optional method: fall back to per-query
+        return [self.predict(model, q) for q in queries]
+
+    def close(self):
+        """Stop the serving child (hooked by QueryServer.close())."""
+        with self._proc_lock:
+            if self._proc is not None:
+                self._proc.close()
+                self._proc = None
+                self._loaded_key = None
+
+
+class ExternalEngine(EngineFactory):
+    """engine.json shape:
+
+        {"engineFactory": "pio_tpu.controller.external.ExternalEngine",
+         "datasource": {"params": {"app_name": "X"}},
+         "algorithms": [{"name": "external",
+                         "params": {"command": ["python3", "my_engine.py"],
+                                    "config": {...}}}]}
+    """
+
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            ExternalDataSource,
+            IdentityPreparator,
+            {"external": ExternalAlgorithm},
+            FirstServing,
+        )

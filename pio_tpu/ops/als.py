@@ -10,9 +10,11 @@ TPU formulation is built around three hardware facts measured on v5e:
    the per-row normal equations  (Y^T C Y + lambda I) x = Y^T C p  are
    accumulated as *batched matmuls* over fixed-width rating slots — MXU
    work with O(nnz*k) traffic;
- * batched triangular factorizations (Cholesky/LU) are scalar-sequential
-   and ~10x slower than Jacobi-preconditioned CG whose inner ops are all
-   batched matvecs, so the solver is CG, warm-started across sweeps;
+ * the solve is direct batched Cholesky by default: at MXU-sized ranks
+   the one k^3/3 factorization costs less than the ~2k batched matvecs a
+   converged CG needs (measured at rank 64, ML-20M shape on v5e: 50.8M
+   vs 44.8M ratings/s). Jacobi-preconditioned CG (cg_iters>0 or -1),
+   warm-started across sweeps, remains the memory-lean inexact option;
  * the host is slow relative to the chip (single-core sort of 20M ratings
    costs more than the whole train), so the slot layout itself is built
    ON DEVICE from the raw COO arrays: one stable `lax.sort` by row, then

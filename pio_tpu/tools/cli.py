@@ -67,7 +67,31 @@ def _load_factory(class_path: str, engine_dir: str | None = None):
 def _engine_from_variant(variant: dict, engine_dir: str | None = None):
     factory = _load_factory(variant["engineFactory"], engine_dir)
     engine = factory.apply()
-    return engine, engine.engine_params_from_variant(variant)
+    ep = engine.engine_params_from_variant(variant)
+    if engine_dir:
+        ep = _absolutize_param_paths(ep, engine_dir)
+    return engine, ep
+
+
+def _absolutize_param_paths(ep, engine_dir: str):
+    """Engine-dir-relative paths in params become absolute at load time, so
+    `pio train --engine-dir X` behaves the same from any cwd (currently:
+    the external-engine bridge's workdir)."""
+    import dataclasses
+
+    from pio_tpu.controller.external import ExternalAlgorithmParams
+
+    base = os.path.abspath(engine_dir)
+    algos, changed = [], False
+    for name, p in (ep.algorithms or []):
+        if isinstance(p, ExternalAlgorithmParams) and p.workdir \
+                and not os.path.isabs(p.workdir):
+            p = dataclasses.replace(
+                p, workdir=os.path.join(base, p.workdir)
+            )
+            changed = True
+        algos.append((name, p))
+    return dataclasses.replace(ep, algorithms=algos) if changed else ep
 
 
 def _engine_ids(variant: dict, engine_dir: str) -> tuple[str, str, str]:

@@ -137,11 +137,16 @@ class QueryServer:
         return self.instance.id
 
     def close(self) -> None:
-        """Release serving resources (predict pool, batcher thread). The
+        """Release serving resources (predict pool, batcher thread, and any
+        algorithm-held children such as external engine processes). The
         HTTP transport's stop() does not know about them."""
         if self.batcher is not None:
             self.batcher.close()
         self._predict_pool.shutdown(wait=False)
+        for algo in getattr(self, "algorithms", []):
+            close = getattr(algo, "close", None)
+            if callable(close):
+                close()
 
     def _warm(self) -> None:
         if self.config.warm_query is None:

@@ -25,7 +25,6 @@ from pio_tpu.data.datamap import DataMap
 from pio_tpu.data.dao import App
 from pio_tpu.data.event import Event
 from pio_tpu.data.storage import Storage
-from pio_tpu.tools.cli import _load_factory
 from pio_tpu.workflow.context import create_workflow_context
 from pio_tpu.workflow.serve import ServingConfig, create_query_server
 from pio_tpu.workflow.train import run_train
@@ -61,16 +60,17 @@ def _seed_ratings(storage, app_name, n_users=30, n_items=12):
 
 
 def _load_example(name):
-    """Resolve the example's factory the way the CLI does. The module is
-    always called `engine`, so any previously imported example is evicted
-    first (each CLI process only ever loads one engine)."""
+    """Resolve the example's factory the way the CLI does (including
+    engine-dir-relative path absolutization). The module is always called
+    `engine`, so any previously imported example is evicted first (each CLI
+    process only ever loads one engine)."""
+    from pio_tpu.tools.cli import _engine_from_variant
+
     sys.modules.pop("engine", None)
     d = os.path.join(EXAMPLES, name)
     with open(os.path.join(d, "engine.json")) as f:
         variant = json.load(f)
-    factory = _load_factory(variant["engineFactory"], d)
-    engine = factory.apply()
-    ep = engine.engine_params_from_variant(variant)
+    engine, ep = _engine_from_variant(variant, d)
     return engine, ep, variant
 
 
@@ -235,3 +235,60 @@ def test_cli_train_subprocess_from_example_dir(tmp_path):
     done = instances.get_latest_completed("custom-serving", "1", "default")
     assert done is not None
     storage.close()
+
+
+def test_external_engine_protocol(tmp_path):
+    """An engine implemented OUTSIDE the framework (stdio JSON protocol,
+    examples/external-engine) trains, persists its opaque model through the
+    regular model store, and serves /queries.json — the cross-language
+    binding story (reference Java controller API)."""
+    from pio_tpu.workflow.train import run_train as _run_train
+
+    storage = _storage(tmp_path)
+    _seed_ratings(storage, "MyApp")
+    engine, ep, variant = _load_example("external-engine")
+    ctx = create_workflow_context(storage, use_mesh=False)
+    _run_train(engine, ep, storage, engine_id="external-engine", ctx=ctx)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="external-engine"),
+        ctx=ctx,
+    )
+    http.start()
+    try:
+        out = _query(http.port, {"user": "u0", "num": 3})
+        assert len(out["itemScores"]) == 3
+        # popularity with seen-filtering: u0 rated the even items, so its
+        # recommendations are odd items only
+        assert all(int(s["item"][1:]) % 2 == 1 for s in out["itemScores"])
+        # scores are the popularity counts, descending
+        scores = [s["score"] for s in out["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+        # a user with no history gets the global top items
+        out2 = _query(http.port, {"user": "brand-new", "num": 2})
+        assert len(out2["itemScores"]) == 2
+
+        # the bulk path rides predict_batch on the engine process
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/batch/queries.json",
+            data=json.dumps([{"user": "u0", "num": 2},
+                             {"user": "u1", "num": 2}]).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            batch = json.loads(resp.read())
+        assert len(batch) == 2 and all(b["itemScores"] for b in batch)
+    finally:
+        http.stop()
+        qs.close()   # also stops the external serving child
+        storage.close()
+
+
+def test_external_engine_bad_command_fails_cleanly(tmp_path):
+    from pio_tpu.controller.external import (
+        ExternalAlgorithm, ExternalAlgorithmParams, ExternalEngineError,
+    )
+
+    algo = ExternalAlgorithm(ExternalAlgorithmParams(
+        command=("/nonexistent/engine-binary",)))
+    with pytest.raises(ExternalEngineError, match="cannot spawn"):
+        algo.train(None, [])
