@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import subprocess
 import threading
 from dataclasses import dataclass, field
@@ -76,11 +77,26 @@ class ExternalProcess:
             raise ExternalEngineError(
                 f"cannot spawn external engine {self.command}: {e}"
             ) from e
-        # drain stderr on a thread so the child can't block on a full pipe
+        # drain stderr on a thread so the child can't block on a full pipe;
+        # read stdout on a thread too, so call() can enforce its timeout
+        # (a blocking readline could never be interrupted)
+        self._out_q: queue.Queue[str] = queue.Queue()
+        self._stdout_thread = threading.Thread(
+            target=self._read_stdout, daemon=True
+        )
+        self._stdout_thread.start()
         self._stderr_thread = threading.Thread(
             target=self._drain_stderr, daemon=True
         )
         self._stderr_thread.start()
+
+    def _read_stdout(self):
+        try:
+            for line in self._proc.stdout:
+                self._out_q.put(line)
+        except ValueError:
+            pass  # pipe closed
+        self._out_q.put("")  # EOF sentinel
 
     def _drain_stderr(self):
         try:
@@ -91,7 +107,7 @@ class ExternalProcess:
 
     def call(self, method: str, params: dict | None = None) -> Any:
         with self._lock:
-            if self._proc.poll() is not None:
+            if self._proc.poll() is not None and self._out_q.empty():
                 raise ExternalEngineError(
                     f"external engine {self.command} exited with "
                     f"rc={self._proc.returncode}"
@@ -104,12 +120,19 @@ class ExternalProcess:
             try:
                 self._proc.stdin.write(msg + "\n")
                 self._proc.stdin.flush()
-                line = self._proc.stdout.readline()
             except (BrokenPipeError, OSError) as e:
                 raise ExternalEngineError(
                     f"external engine {self.command} pipe broke during "
                     f"{method}: {e}"
                 ) from e
+            try:
+                line = self._out_q.get(timeout=self.timeout)
+            except queue.Empty:
+                self._proc.kill()  # a hung engine would wedge the pipe
+                raise ExternalEngineError(
+                    f"external engine {self.command} did not answer "
+                    f"{method} within {self.timeout}s; killed"
+                ) from None
         if not line:
             raise ExternalEngineError(
                 f"external engine {self.command} closed stdout during "
@@ -181,6 +204,9 @@ class ExternalAlgorithmParams(Params):
     workdir: str = ""          # cwd for the child ("" = inherit)
     timeout: float = 600.0
 
+    # the engine loader absolutizes these against the engine directory
+    path_fields = ("workdir",)
+
 
 class ExternalAlgorithm(LAlgorithm):
     """Bridges train/predict to the engine process. The stored model is the
@@ -194,6 +220,7 @@ class ExternalAlgorithm(LAlgorithm):
         self._proc: ExternalProcess | None = None
         self._loaded_key: int | None = None
         self._proc_lock = threading.Lock()
+        self._batch_unsupported = False
 
     def _spawn(self) -> ExternalProcess:
         # the CLI absolutizes a relative workdir against the engine dir at
@@ -243,18 +270,36 @@ class ExternalAlgorithm(LAlgorithm):
 
     def predict(self, model: dict, query: dict) -> Any:
         proc = self._serving_proc(model)
-        out = proc.call("predict", {"query": query}) or {}
-        return out.get("prediction")
+        out = proc.call("predict", {"query": query})
+        if not isinstance(out, dict) or "prediction" not in out:
+            raise ExternalEngineError(
+                "predict must return {\"prediction\": <json>}; got "
+                f"{str(out)[:200]!r}"
+            )
+        return out["prediction"]
 
     def batch_predict(self, model: dict, queries) -> list:
         proc = self._serving_proc(model)
-        try:
-            out = proc.call("predict_batch", {"queries": list(queries)}) or {}
-            preds = out.get("predictions")
-            if isinstance(preds, list) and len(preds) == len(queries):
-                return preds
-        except ExternalEngineError:
-            pass  # optional method: fall back to per-query
+        if not self._batch_unsupported:
+            try:
+                out = proc.call(
+                    "predict_batch", {"queries": list(queries)}
+                ) or {}
+                preds = out.get("predictions")
+                if isinstance(preds, list) and len(preds) == len(queries):
+                    return preds
+                raise ExternalEngineError(
+                    "predict_batch must return {\"predictions\": [...]} "
+                    "matching the query count"
+                )
+            except ExternalEngineError as e:
+                # optional method: remember the refusal so the hot path
+                # doesn't pay a probe round-trip per batch
+                self._batch_unsupported = True
+                log.warning(
+                    "external engine predict_batch unavailable (%s); "
+                    "falling back to per-query predicts", e,
+                )
         return [self.predict(model, q) for q in queries]
 
     def close(self):

@@ -75,23 +75,45 @@ def _engine_from_variant(variant: dict, engine_dir: str | None = None):
 
 def _absolutize_param_paths(ep, engine_dir: str):
     """Engine-dir-relative paths in params become absolute at load time, so
-    `pio train --engine-dir X` behaves the same from any cwd (currently:
-    the external-engine bridge's workdir)."""
+    `pio train --engine-dir X` behaves the same from any cwd. Any Params
+    subclass opts in by declaring `path_fields = ("field", ...)` (e.g. the
+    external-engine bridge's workdir)."""
     import dataclasses
 
-    from pio_tpu.controller.external import ExternalAlgorithmParams
-
     base = os.path.abspath(engine_dir)
-    algos, changed = [], False
-    for name, p in (ep.algorithms or []):
-        if isinstance(p, ExternalAlgorithmParams) and p.workdir \
-                and not os.path.isabs(p.workdir):
-            p = dataclasses.replace(
-                p, workdir=os.path.join(base, p.workdir)
-            )
-            changed = True
-        algos.append((name, p))
-    return dataclasses.replace(ep, algorithms=algos) if changed else ep
+
+    def fix(p):
+        fields = getattr(p, "path_fields", ())
+        if not fields:
+            return p, False
+        updates = {
+            f: os.path.join(base, v)
+            for f in fields
+            if (v := getattr(p, f, "")) and not os.path.isabs(v)
+        }
+        return (dataclasses.replace(p, **updates), True) if updates \
+            else (p, False)
+
+    changed = False
+
+    def fix_stage(stage):
+        nonlocal changed
+        if stage is None:
+            return stage
+        name, p = stage
+        p2, did = fix(p) if p is not None else (p, False)
+        changed |= did
+        return (name, p2)
+
+    algos = [fix_stage(s) for s in (ep.algorithms or [])]
+    out = dataclasses.replace(
+        ep,
+        datasource=fix_stage(ep.datasource),
+        preparator=fix_stage(ep.preparator),
+        algorithms=algos,
+        serving=fix_stage(ep.serving),
+    )
+    return out if changed else ep
 
 
 def _engine_ids(variant: dict, engine_dir: str) -> tuple[str, str, str]:

@@ -126,6 +126,12 @@ class QueryServer:
                 self.storage, self.engine, self.engine_params, instance.id,
                 ctx=self.ctx,
             )
+            # hot-swap: retire the outgoing doers' resources (e.g. an
+            # external engine's child process) before replacing them
+            for algo in getattr(self, "algorithms", []):
+                close = getattr(algo, "close", None)
+                if callable(close):
+                    close()
             _, _, self.algorithms, self.serving = self.engine._doers(
                 self.engine_params
             )

@@ -143,6 +143,25 @@ def test_high_rank_cg_matches_cholesky_implicit():
     assert float(np.abs(s_cg - s_direct).mean()) / denom < 0.05
 
 
+def test_bf16_gather_matches_f32():
+    """The bf16 factor-gather option (halved HBM traffic) must track the
+    exact f32 build closely — scores within 1% relative."""
+    rng = np.random.default_rng(9)
+    nu, ni = 200, 120
+    users = rng.integers(0, nu, 5000)
+    items = rng.integers(0, ni, 5000)
+    vals = rng.integers(1, 6, 5000).astype(np.float32)
+    kw = dict(rank=16, iterations=5, reg=0.05, chunk=4096)
+    m32 = als_train(users, items, vals, nu, ni,
+                    ALSParams(**kw, bf16_gather=False))
+    m16 = als_train(users, items, vals, nu, ni,
+                    ALSParams(**kw, bf16_gather=True))
+    s32 = np.asarray(predict_pairs(m32, users, items))
+    s16 = np.asarray(predict_pairs(m16, users, items))
+    denom = float(np.abs(s32).mean()) + 1e-9
+    assert float(np.abs(s16 - s32).mean()) / denom < 0.01
+
+
 def test_nnz_bucketing_is_inert():
     """Padding COO to a chunk multiple (compile reuse) must not change the
     result: sentinels carry invalid ids on BOTH sides (was: pad entries

@@ -292,3 +292,18 @@ def test_external_engine_bad_command_fails_cleanly(tmp_path):
         command=("/nonexistent/engine-binary",)))
     with pytest.raises(ExternalEngineError, match="cannot spawn"):
         algo.train(None, [])
+
+
+def test_external_engine_hang_times_out(tmp_path):
+    """A wedged engine must not block train forever: the bridge enforces
+    its timeout and kills the child."""
+    from pio_tpu.controller.external import (
+        ExternalAlgorithm, ExternalAlgorithmParams, ExternalEngineError,
+    )
+
+    hang = tmp_path / "hang.py"
+    hang.write_text("import time\nwhile True: time.sleep(1)\n")
+    algo = ExternalAlgorithm(ExternalAlgorithmParams(
+        command=(sys.executable, str(hang)), timeout=2.0))
+    with pytest.raises(ExternalEngineError, match="did not answer"):
+        algo.train(None, [])
