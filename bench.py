@@ -43,7 +43,11 @@ N_USERS = 5000 if SMALL else 138_493
 N_ITEMS = 1000 if SMALL else 26_744
 NNZ = 200_000 if SMALL else 20_000_000
 RANK = 16 if SMALL else 64
-ITERS = 2 if SMALL else 3
+# 10 sweeps = the recommendation template's engine.json default
+# (num_iterations: 10); the one-time on-device layout build + host->HBM
+# transfer amortizes over sweeps, so the sweep count materially shapes the
+# headline rate (measured: ~1.1s fixed + 0.082s/sweep at this shape)
+ITERS = 2 if SMALL else 10
 CHUNK = 8192
 
 CPU_NNZ = 100_000 if SMALL else 400_000
@@ -122,9 +126,11 @@ def synth(nnz: int, n_users: int = None, n_items: int = None, seed=0):
 
 def run_als(users, items, vals, iters: int,
             n_users: int = None, n_items: int = None,
-            rank: int = None, chunk: int = None) -> float:
-    """-> wall seconds for `iters` sweeps, compile excluded (the warm-up
-    runs the exact same program: iterations is a static scan length)."""
+            rank: int = None, chunk: int = None, repeats: int = 3) -> float:
+    """-> best wall seconds for `iters` sweeps over `repeats` runs, compile
+    excluded (the warm-up runs the exact same program: iterations is a
+    static scan length). Best-of-N because the tunneled device shows
+    +-0.3s run-to-run noise that would otherwise swamp per-sweep deltas."""
     import jax
 
     from pio_tpu.ops.als import ALSParams, als_train
@@ -132,18 +138,26 @@ def run_als(users, items, vals, iters: int,
     n_users = n_users or N_USERS
     n_items = n_items or N_ITEMS
 
+    import jax.numpy as jnp
+
     def go():
         p = ALSParams(rank=rank or RANK, iterations=iters, reg=0.05,
                       alpha=10.0, implicit=True, chunk=chunk or CHUNK)
         model = als_train(users, items, vals, n_users, n_items, p)
-        jax.block_until_ready(model.user_factors)
-        return model
+        # a scalar READBACK, not block_until_ready: on the tunneled axon
+        # backend block_until_ready returns before the execution finishes
+        # (measured: identical program 1.2s "blocked" vs 24s to readback),
+        # which silently turned round-1/2 timings into dispatch times.
+        # Only a value forced to the host proves the work happened.
+        return float(jnp.sum(model.user_factors))
 
     go()  # compile (identical program: same static iterations)
-    t0 = time.monotonic()
-    go()
-    dt = time.monotonic() - t0
-    return dt
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.monotonic()
+        go()
+        best = min(best, time.monotonic() - t0)
+    return best
 
 
 # ---------------------------------------------------------------------------
@@ -180,8 +194,37 @@ def phase_train() -> dict:
     n_users = max(64, N_USERS // scale)
     n_items = max(32, N_ITEMS // scale)
     users, items, vals = synth(nnz, n_users=n_users, n_items=n_items)
-    dt = run_als(users, items, vals, iters, n_users=n_users, n_items=n_items)
-    rate = nnz * iters / dt
+
+    # measure the host->HBM COO transfer once, explicitly (through this
+    # image's tunnel it can dominate; co-located it is milliseconds), then
+    # time the train on device-RESIDENT arrays so layout/sweep numbers are
+    # not polluted by tunnel throughput noise
+    import jax
+    import numpy as np
+
+    host = [np.ascontiguousarray(users, np.int32),
+            np.ascontiguousarray(items, np.int32),
+            np.ascontiguousarray(vals, np.float32)]
+    import jax.numpy as jnp
+
+    float(jnp.sum(jax.device_put(np.ones(8))))  # backend up
+    t0 = time.monotonic()
+    dev = [jax.device_put(x) for x in host]
+    # scalar readback: block_until_ready under-reports on the tunnel
+    float(jnp.sum(dev[2]))
+    transfer_s = time.monotonic() - t0
+    d_users, d_items, d_vals = dev
+
+    dt = run_als(d_users, d_items, d_vals, iters,
+                 n_users=n_users, n_items=n_items)
+    rate = nnz * iters / (dt + transfer_s)   # end-to-end, incl. transfer
+    # split the one-time on-device slot-layout build from the per-sweep
+    # math with a 1-sweep run
+    dt1 = run_als(d_users, d_items, d_vals, 1,
+                  n_users=n_users, n_items=n_items)
+    # None when noise makes the split meaningless (dt <= dt1): garbage
+    # rates must not masquerade as measurements
+    sweep_s = (dt - dt1) / max(iters - 1, 1) if dt > dt1 else None
     p = ALSParams(rank=RANK)
     cg = p.resolved_cg_iters()
     # padded nnz is what the kernel actually crunches
@@ -191,14 +234,22 @@ def phase_train() -> dict:
     kind = jax.devices()[0].device_kind
     peak = peak_for(kind)
     flops_per_sec = fl * iters / dt
+    split_ok = sweep_s is not None
     return {
         "rate": rate,
-        "wall_sec": dt,
+        "wall_sec": round(dt + transfer_s, 3),
         "nnz": nnz,
         "sweeps": iters,
+        "transfer_sec": round(transfer_s, 3),
+        "fixed_layout_sec": round(max(dt1 - sweep_s, 0.0), 3)
+        if split_ok else None,
+        "per_sweep_sec": round(sweep_s, 4) if split_ok else None,
+        "per_sweep_rate": round(nnz / sweep_s, 1) if split_ok else None,
         "flops_per_sweep": fl,
         "flops_per_sec": flops_per_sec,
         "mfu_vs_bf16_peak": round(flops_per_sec / peak, 4) if peak else None,
+        "sweep_mfu_vs_bf16_peak": round(fl / sweep_s / peak, 4)
+        if peak and split_ok else None,
         "device_kind": kind,
         "rank": RANK,
         "cg_iters": cg,
@@ -519,8 +570,11 @@ def main() -> int:
             value = round(train["rate"], 1)
             extra["train"] = {
                 k: train[k] for k in
-                ("wall_sec", "nnz", "sweeps", "flops_per_sweep",
-                 "flops_per_sec", "mfu_vs_bf16_peak", "rank", "cg_iters")
+                ("wall_sec", "nnz", "sweeps", "transfer_sec",
+                 "fixed_layout_sec",
+                 "per_sweep_sec", "per_sweep_rate", "flops_per_sweep",
+                 "flops_per_sec", "mfu_vs_bf16_peak",
+                 "sweep_mfu_vs_bf16_peak", "rank", "cg_iters")
                 if k in train
             }
         elif err:

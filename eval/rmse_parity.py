@@ -73,10 +73,14 @@ def trajectory(users, items, vals, te_users, te_items, te_vals,
     model = None
     out = []
     train_sec = 0.0
+    import jax.numpy as jnp
+
     for s in range(SWEEPS):
         t0 = time.monotonic()
         model = als_train(users, items, vals, n_users, n_items, p, init=model)
-        jax.block_until_ready(model.user_factors)
+        # scalar readback, not block_until_ready: the tunneled axon backend
+        # "unblocks" before execution finishes, under-reporting train time
+        float(jnp.sum(model.user_factors))
         train_sec += time.monotonic() - t0
         out.append(round(float(rmse(model, te_users, te_items, te_vals)), 5))
         print(f"  sweep {s + 1:2d}: heldout RMSE {out[-1]:.5f}", flush=True)

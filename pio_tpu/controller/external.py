@@ -65,6 +65,7 @@ class ExternalProcess:
             raise ExternalEngineError("external engine command is empty")
         self.command = list(command)
         self.timeout = timeout
+        self.dead = False          # set when the bridge kills/abandons it
         self._lock = threading.Lock()
         self._next_id = 0
         try:
@@ -105,12 +106,18 @@ class ExternalProcess:
         except ValueError:
             pass  # pipe closed
 
-    def call(self, method: str, params: dict | None = None) -> Any:
+    def call(self, method: str, params: dict | None = None,
+             timeout: float | None = None) -> Any:
+        """timeout: None = the process default; <= 0 = wait indefinitely
+        (training runs are legitimately long)."""
+        timeout = self.timeout if timeout is None else timeout
         with self._lock:
-            if self._proc.poll() is not None and self._out_q.empty():
+            if self.dead or (
+                self._proc.poll() is not None and self._out_q.empty()
+            ):
                 raise ExternalEngineError(
                     f"external engine {self.command} exited with "
-                    f"rc={self._proc.returncode}"
+                    f"rc={self._proc.poll()}"
                 )
             self._next_id += 1
             req_id = self._next_id
@@ -121,17 +128,23 @@ class ExternalProcess:
                 self._proc.stdin.write(msg + "\n")
                 self._proc.stdin.flush()
             except (BrokenPipeError, OSError) as e:
+                self.dead = True
                 raise ExternalEngineError(
                     f"external engine {self.command} pipe broke during "
                     f"{method}: {e}"
                 ) from e
             try:
-                line = self._out_q.get(timeout=self.timeout)
+                line = self._out_q.get() if timeout <= 0 \
+                    else self._out_q.get(timeout=timeout)
             except queue.Empty:
-                self._proc.kill()  # a hung engine would wedge the pipe
+                # a hung engine would wedge the pipe; SIGKILL may not be
+                # reaped by the time the caller retries, so mark dead
+                # explicitly rather than trusting poll()
+                self.dead = True
+                self._proc.kill()
                 raise ExternalEngineError(
                     f"external engine {self.command} did not answer "
-                    f"{method} within {self.timeout}s; killed"
+                    f"{method} within {timeout}s; killed"
                 ) from None
         if not line:
             raise ExternalEngineError(
@@ -202,7 +215,10 @@ class ExternalAlgorithmParams(Params):
     command: tuple = ()        # argv of the engine executable
     config: dict = field(default_factory=dict)  # passed through verbatim
     workdir: str = ""          # cwd for the child ("" = inherit)
-    timeout: float = 600.0
+    timeout: float = 600.0     # per-RPC limit for serving/describe calls
+    train_timeout: float = 0.0  # train limit; <= 0 = unbounded (trains
+                                # are legitimately long; 0 matches the
+                                # reference's unbounded train)
 
     # the engine loader absolutizes these against the engine directory
     path_fields = ("workdir",)
@@ -237,7 +253,7 @@ class ExternalAlgorithm(LAlgorithm):
             info = proc.call("describe") or {}
             model = proc.call("train", {
                 "events": events, "config": dict(self.params.config),
-            })
+            }, timeout=self.params.train_timeout)
             if not isinstance(model, dict) or "model" not in model:
                 raise ExternalEngineError(
                     "train must return {\"model\": <json>}"
@@ -255,7 +271,9 @@ class ExternalAlgorithm(LAlgorithm):
         with self._proc_lock:
             key = id(model)
             if self._proc is not None and (
-                self._loaded_key != key or self._proc._proc.poll() is not None
+                self._loaded_key != key
+                or self._proc.dead
+                or self._proc._proc.poll() is not None
             ):
                 self._proc.close()
                 self._proc = None
@@ -278,6 +296,9 @@ class ExternalAlgorithm(LAlgorithm):
             )
         return out["prediction"]
 
+    _UNSUPPORTED_MARKERS = ("unknown method", "not implemented",
+                            "unsupported", "no such method")
+
     def batch_predict(self, model: dict, queries) -> list:
         proc = self._serving_proc(model)
         if not self._batch_unsupported:
@@ -293,13 +314,19 @@ class ExternalAlgorithm(LAlgorithm):
                     "matching the query count"
                 )
             except ExternalEngineError as e:
-                # optional method: remember the refusal so the hot path
-                # doesn't pay a probe round-trip per batch
-                self._batch_unsupported = True
-                log.warning(
-                    "external engine predict_batch unavailable (%s); "
-                    "falling back to per-query predicts", e,
-                )
+                msg = str(e).lower()
+                if any(m in msg for m in self._UNSUPPORTED_MARKERS):
+                    # optional method: remember the refusal so the hot
+                    # path doesn't pay a probe round-trip per batch
+                    self._batch_unsupported = True
+                    log.warning(
+                        "external engine has no predict_batch (%s); "
+                        "falling back to per-query predicts", e,
+                    )
+                else:
+                    # a real failure (timeout, crash, protocol bug) must
+                    # surface, not silently disable batching forever
+                    raise
         return [self.predict(model, q) for q in queries]
 
     def close(self):

@@ -10,11 +10,11 @@ TPU formulation is built around three hardware facts measured on v5e:
    the per-row normal equations  (Y^T C Y + lambda I) x = Y^T C p  are
    accumulated as *batched matmuls* over fixed-width rating slots — MXU
    work with O(nnz*k) traffic;
- * the solve is direct batched Cholesky by default: at MXU-sized ranks
-   the one k^3/3 factorization costs less than the ~2k batched matvecs a
-   converged CG needs (measured at rank 64, ML-20M shape on v5e: 50.8M
-   vs 44.8M ratings/s). Jacobi-preconditioned CG (cg_iters>0 or -1),
-   warm-started across sweeps, remains the memory-lean inexact option;
+ * the solve is direct batched Cholesky by default: readback-forced
+   interleaved timing at rank 64, ML-20M shape on v5e measures it EQUAL
+   to converged CG (within run noise), and it is exact — so exactness
+   wins. Jacobi-preconditioned CG (cg_iters>0 or -1), warm-started
+   across sweeps, remains the memory-lean inexact option;
  * the host is slow relative to the chip (single-core sort of 20M ratings
    costs more than the whole train), so the slot layout itself is built
    ON DEVICE from the raw COO arrays: one stable `lax.sort` by row, then
@@ -70,14 +70,13 @@ class ALSParams:
 
     def resolved_cg_iters(self) -> int:
         """0 = direct batched Cholesky — the default: exact, and measured
-        FASTER than converged CG at template ranks (rank 64, ML-20M shape on
-        v5e: 50.8M vs 44.8M ratings/s — CG's 2k matvecs out-cost the one
-        k^3/3 factorization once k is MXU-sized). CG remains for
-        memory-lean inexact sweeps; its auto cap scales WITH rank (2x the
-        k-dim Krylov bound — CG in f32 with Jacobi preconditioning needs
-        the extra iterations to reach direct-solve quality; a fixed cap
-        below rank k would quietly under-converge the rank 50-100 trains
-        MLlib templates commonly use)."""
+        (readback-forced, interleaved) EQUAL in wall-clock to converged CG
+        at rank 64 on the ML-20M shape on v5e, so the exact solve wins.
+        CG remains for memory-lean inexact sweeps; its auto cap scales
+        WITH rank (2x the k-dim Krylov bound — CG in f32 with Jacobi
+        preconditioning needs the extra iterations to reach direct-solve
+        quality; a fixed cap below rank k would quietly under-converge the
+        rank 50-100 trains MLlib templates commonly use)."""
         return max(2 * self.rank, 8) if self.cg_iters < 0 else self.cg_iters
 
 
@@ -326,19 +325,31 @@ def als_train(
 
     `init` warm-starts from an existing model (e.g. to continue sweeps or to
     record a per-sweep metric trajectory by calling with iterations=1 in a
-    loop — the compiled program is reused across such calls)."""
-    u = np.ascontiguousarray(user_idx, dtype=np.int32)
-    i = np.ascontiguousarray(item_idx, dtype=np.int32)
-    v = np.ascontiguousarray(values, dtype=np.float32)
+    loop — the compiled program is reused across such calls).
+
+    Inputs may be host numpy OR device-resident jax arrays: device inputs
+    skip the host conversion/padding copies entirely (pad concatenation
+    happens on device), so retrain loops that keep the COO arrays in HBM
+    pay the host->device transfer once, not per call."""
+    on_device = isinstance(user_idx, jax.Array)
+    if on_device:
+        u = user_idx.astype(jnp.int32)
+        i = item_idx.astype(jnp.int32)
+        v = values.astype(jnp.float32)
+    else:
+        u = np.ascontiguousarray(user_idx, dtype=np.int32)
+        i = np.ascontiguousarray(item_idx, dtype=np.int32)
+        v = np.ascontiguousarray(values, dtype=np.float32)
     # bucket nnz to a params.chunk multiple so retrains with slightly
     # different data sizes reuse the compiled program; padding entries
     # carry the sentinel id on BOTH sides (u = n_users, i = n_items) so
     # whichever side keys the layout drops them via its valid mask
-    pad = -len(u) % max(1, params.chunk)
+    pad = -u.shape[0] % max(1, params.chunk)
     if pad:
-        u = np.concatenate([u, np.full(pad, n_users, np.int32)])
-        i = np.concatenate([i, np.full(pad, n_items, np.int32)])
-        v = np.concatenate([v, np.zeros(pad, np.float32)])
+        xp = jnp if on_device else np
+        u = xp.concatenate([u, xp.full(pad, n_users, xp.int32)])
+        i = xp.concatenate([i, xp.full(pad, n_items, xp.int32)])
+        v = xp.concatenate([v, xp.zeros(pad, xp.float32)])
 
     if init is not None:
         user0, item0 = init.user_factors, init.item_factors

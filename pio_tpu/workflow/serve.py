@@ -127,11 +127,19 @@ class QueryServer:
                 ctx=self.ctx,
             )
             # hot-swap: retire the outgoing doers' resources (e.g. an
-            # external engine's child process) before replacing them
-            for algo in getattr(self, "algorithms", []):
-                close = getattr(algo, "close", None)
-                if callable(close):
-                    close()
+            # external engine's child process) — but on a delay: queries
+            # that snapshotted the old algorithms may still be mid-predict,
+            # and closing under them would kill their child mid-call
+            retired = [
+                close for algo in getattr(self, "algorithms", [])
+                if callable(close := getattr(algo, "close", None))
+            ]
+            if retired:
+                t = threading.Timer(
+                    30.0, lambda: [c() for c in retired]
+                )
+                t.daemon = True
+                t.start()
             _, _, self.algorithms, self.serving = self.engine._doers(
                 self.engine_params
             )

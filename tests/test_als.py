@@ -143,6 +143,30 @@ def test_high_rank_cg_matches_cholesky_implicit():
     assert float(np.abs(s_cg - s_direct).mean()) / denom < 0.05
 
 
+def test_device_resident_inputs_match_host():
+    """als_train accepts device-resident COO arrays (retrain loops keep
+    data in HBM); results must equal the host-numpy path bit-for-bit."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    nu, ni = 100, 60
+    users = rng.integers(0, nu, 3000)
+    items = rng.integers(0, ni, 3000)
+    vals = rng.integers(1, 6, 3000).astype(np.float32)
+    p = ALSParams(rank=8, iterations=3, reg=0.1, chunk=1024)
+    m_host = als_train(users, items, vals, nu, ni, p)
+    m_dev = als_train(
+        jnp.asarray(users, jnp.int32), jnp.asarray(items, jnp.int32),
+        jnp.asarray(vals), nu, ni, p,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(m_host.user_factors), np.asarray(m_dev.user_factors)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(m_host.item_factors), np.asarray(m_dev.item_factors)
+    )
+
+
 def test_bf16_gather_matches_f32():
     """The bf16 factor-gather option (halved HBM traffic) must track the
     exact f32 build closely — scores within 1% relative."""

@@ -304,6 +304,6 @@ def test_external_engine_hang_times_out(tmp_path):
     hang = tmp_path / "hang.py"
     hang.write_text("import time\nwhile True: time.sleep(1)\n")
     algo = ExternalAlgorithm(ExternalAlgorithmParams(
-        command=(sys.executable, str(hang)), timeout=2.0))
+        command=(sys.executable, str(hang)), timeout=2.0, train_timeout=2.0))
     with pytest.raises(ExternalEngineError, match="did not answer"):
         algo.train(None, [])
