@@ -21,11 +21,6 @@ actionable.
 
 from __future__ import annotations
 
-import json
-import ssl
-import urllib.error
-import urllib.parse
-import urllib.request
 from datetime import datetime
 from typing import Iterator, Sequence
 
@@ -33,6 +28,7 @@ from pio_tpu.data import dao as d
 from pio_tpu.data.backends import wire as w
 from pio_tpu.data.event import Event
 from pio_tpu.data.storage import Backend, StorageError
+from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
 
 
 class RemoteBackend(Backend):
@@ -41,45 +37,32 @@ class RemoteBackend(Backend):
         url = config.properties.get("URL", "http://127.0.0.1:7072")
         self._url = url.rstrip("/")
         self._key = config.properties.get("KEY", "")
-        self._timeout = float(config.properties.get("TIMEOUT", "30"))
         verify = config.properties.get("VERIFY_TLS", "true").lower()
-        self._ssl_ctx = None
-        if self._url.startswith("https") and verify in ("false", "0", "no"):
-            self._ssl_ctx = ssl.create_default_context()
-            self._ssl_ctx.check_hostname = False
-            self._ssl_ctx.verify_mode = ssl.CERT_NONE
+        self._http = JsonHttpClient(
+            self._url,
+            timeout=float(config.properties.get("TIMEOUT", "30")),
+            verify_tls=verify not in ("false", "0", "no"),
+        )
 
     # -- transport ----------------------------------------------------------
     def call(self, family: str, method: str, kwargs: dict):
-        url = f"{self._url}/rpc"
-        if self._key:
-            url += "?" + urllib.parse.urlencode({"accessKey": self._key})
-        body = json.dumps(
-            {"family": family, "method": method, "kwargs": kwargs}
-        ).encode()
-        req = urllib.request.Request(
-            url, data=body, method="POST",
-            headers={"Content-Type": "application/json"},
-        )
+        params = {"accessKey": self._key} if self._key else None
         try:
-            with urllib.request.urlopen(
-                req, timeout=self._timeout, context=self._ssl_ctx
-            ) as resp:
-                payload = json.loads(resp.read().decode())
-        except urllib.error.HTTPError as e:
-            try:
-                payload = json.loads(e.read().decode() or "{}")
-            except json.JSONDecodeError:
-                payload = {}
-            msg = payload.get("message", str(e))
+            payload = self._http.request(
+                "POST", "/rpc",
+                {"family": family, "method": method, "kwargs": kwargs},
+                params,
+            )
+        except HttpClientError as e:
+            if e.status:
+                raise StorageError(
+                    f"storage server {self._url}: {family}.{method}: "
+                    f"{e.message}"
+                ) from e
             raise StorageError(
-                f"storage server {self._url}: {family}.{method}: {msg}"
+                f"storage server {self._url} unreachable: {e.message}"
             ) from e
-        except (urllib.error.URLError, TimeoutError, OSError) as e:
-            raise StorageError(
-                f"storage server {self._url} unreachable: {e}"
-            ) from e
-        return payload.get("result")
+        return (payload or {}).get("result")
 
     def close(self):
         pass

@@ -1,0 +1,142 @@
+"""Client SDK — the counterpart of the reference's PredictionIO-python-sdk.
+
+Two clients, mirroring the SDK surface users of the reference already know
+(predictionio.EventClient / predictionio.EngineClient):
+
+    from pio_tpu.sdk import EventClient, EngineClient
+
+    events = EventClient(access_key="...", url="http://localhost:7070")
+    events.create_event(event="rate", entity_type="user", entity_id="u1",
+                        target_entity_type="item", target_entity_id="i9",
+                        properties={"rating": 5})
+    events.create_events_batch([...])            # <= 50 per request
+
+    engine = EngineClient(url="http://localhost:8000")
+    engine.send_query({"user": "u1", "num": 10})
+    engine.send_queries_batch([{...}, {...}])    # bulk endpoint
+
+Stdlib-only (urllib), keep-alive not required — for high-volume ingest use
+create_events_batch. Errors raise PIOError carrying the server's status
+and message.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Any, Sequence
+
+from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+BATCH_LIMIT = 50  # server-enforced (reference EventServer.scala:68)
+
+
+class PIOError(HttpClientError):
+    """SDK error: .status (0 = transport failure) + server message."""
+
+
+class _Http(JsonHttpClient):
+    def call(self, method: str, path: str, body: Any = None,
+             **params) -> Any:
+        try:
+            return self.request(method, path, body, params)
+        except HttpClientError as e:
+            raise PIOError(e.status, e.message) from e
+
+
+class EventClient:
+    """Event Server client (reference python-sdk EventClient)."""
+
+    def __init__(self, access_key: str, url: str = "http://localhost:7070",
+                 channel: str | None = None, timeout: float = 30.0,
+                 verify_tls: bool = True):
+        self.access_key = access_key
+        self.channel = channel
+        self._http = _Http(url, timeout, verify_tls)
+
+    # -- write --------------------------------------------------------------
+    def create_event(self, event: str, entity_type: str, entity_id: str,
+                     target_entity_type: str | None = None,
+                     target_entity_id: str | None = None,
+                     properties: dict | None = None,
+                     event_time: str | None = None) -> str:
+        """-> eventId. event_time: ISO-8601 string (server default: now)."""
+        body: dict[str, Any] = {
+            "event": event, "entityType": entity_type, "entityId": entity_id,
+        }
+        if target_entity_type:
+            body["targetEntityType"] = target_entity_type
+        if target_entity_id:
+            body["targetEntityId"] = target_entity_id
+        if properties:
+            body["properties"] = properties
+        if event_time:
+            body["eventTime"] = event_time
+        out = self._http.call(
+            "POST", "/events.json", body,
+            accessKey=self.access_key, channel=self.channel,
+        )
+        return out["eventId"]
+
+    def create_events_batch(self, events: Sequence[dict]) -> list[dict]:
+        """<= 50 events (server limit); returns per-item statuses."""
+        if len(events) > BATCH_LIMIT:
+            raise ValueError(
+                f"batch limit is {BATCH_LIMIT} events per request"
+            )
+        return self._http.call(
+            "POST", "/batch/events.json", list(events),
+            accessKey=self.access_key, channel=self.channel,
+        )
+
+    # -- convenience entity ops (reference SDK set_user/set_item/record) ----
+    def set_user(self, uid: str, properties: dict | None = None) -> str:
+        return self.create_event("$set", "user", uid, properties=properties)
+
+    def set_item(self, iid: str, properties: dict | None = None) -> str:
+        return self.create_event("$set", "item", iid, properties=properties)
+
+    def record_user_action_on_item(self, action: str, uid: str, iid: str,
+                                   properties: dict | None = None) -> str:
+        return self.create_event(
+            action, "user", uid, target_entity_type="item",
+            target_entity_id=iid, properties=properties,
+        )
+
+    # -- read ---------------------------------------------------------------
+    def get_event(self, event_id: str) -> dict:
+        return self._http.call(
+            "GET", f"/events/{urllib.parse.quote(event_id)}.json",
+            accessKey=self.access_key, channel=self.channel,
+        )
+
+    def find_events(self, **filters) -> list[dict]:
+        """filters: startTime/untilTime/entityType/entityId/event/limit/
+        reversed — the /events.json query params."""
+        return self._http.call(
+            "GET", "/events.json",
+            accessKey=self.access_key, channel=self.channel, **filters,
+        )
+
+    def delete_event(self, event_id: str) -> None:
+        self._http.call(
+            "DELETE", f"/events/{urllib.parse.quote(event_id)}.json",
+            accessKey=self.access_key, channel=self.channel,
+        )
+
+
+class EngineClient:
+    """Deploy-server client (reference python-sdk EngineClient)."""
+
+    def __init__(self, url: str = "http://localhost:8000",
+                 timeout: float = 30.0, verify_tls: bool = True):
+        self._http = _Http(url, timeout, verify_tls)
+
+    def send_query(self, query: dict) -> Any:
+        return self._http.call("POST", "/queries.json", query)
+
+    def send_queries_batch(self, queries: Sequence[dict]) -> list:
+        """Bulk endpoint: one batch_predict per algorithm server-side."""
+        return self._http.call("POST", "/batch/queries.json", list(queries))
+
+    def status(self) -> dict:
+        return self._http.call("GET", "/")

@@ -1,0 +1,77 @@
+"""Shared stdlib JSON-over-HTTP client transport.
+
+One implementation of the urllib dance (TLS-noverify context, JSON bodies,
+error-message extraction, timeout/reset normalization) for every in-repo
+client: the SDK (pio_tpu/sdk.py) and the remote storage backend
+(data/backends/remote.py). All failures surface as HttpClientError with
+`status` (0 = transport-level: unreachable, timeout, reset) and the
+server's message when one exists.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any
+
+
+class HttpClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}" if status
+                         else message)
+        self.status = status
+        self.message = message
+
+
+class JsonHttpClient:
+    def __init__(self, url: str, timeout: float = 30.0,
+                 verify_tls: bool = True):
+        self.base = url.rstrip("/")
+        self.timeout = timeout
+        self._ctx = None
+        if self.base.startswith("https") and not verify_tls:
+            self._ctx = ssl.create_default_context()
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = ssl.CERT_NONE
+
+    def request(self, method: str, path: str, body: Any = None,
+                params: dict | None = None) -> Any:
+        """-> parsed JSON body (None when empty). Raises HttpClientError."""
+        url = self.base + path
+        if params:
+            qs = {k: v for k, v in params.items() if v is not None}
+            if qs:
+                url += "?" + urllib.parse.urlencode(qs)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ctx
+            ) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else None
+        except urllib.error.HTTPError as e:
+            raw = e.read().decode(errors="replace")
+            msg = raw or str(e)
+            try:
+                parsed = json.loads(raw)
+                if isinstance(parsed, dict):
+                    msg = parsed.get("message", raw)
+            except json.JSONDecodeError:
+                pass
+            raise HttpClientError(e.code, msg) from e
+        except urllib.error.URLError as e:
+            raise HttpClientError(
+                0, f"{self.base} unreachable: {e.reason}"
+            ) from e
+        except (TimeoutError, ConnectionError, OSError) as e:
+            # read timeouts / mid-response resets are OSError, not URLError
+            raise HttpClientError(
+                0, f"{self.base} transport failure: {e}"
+            ) from e

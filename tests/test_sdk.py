@@ -1,0 +1,95 @@
+"""Client SDK against live servers (reference python-sdk behavior)."""
+
+import pytest
+
+from pio_tpu.data.dao import AccessKey, App
+from pio_tpu.sdk import BATCH_LIMIT, EngineClient, EventClient, PIOError
+from pio_tpu.server.eventserver import EventServerConfig, create_event_server
+
+
+@pytest.fixture()
+def event_server(memory_storage):
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "sdkapp"))
+    memory_storage.get_metadata_access_keys().insert(
+        AccessKey("SDKKEY", app_id, ())
+    )
+    memory_storage.get_events().init(app_id)
+    srv = create_event_server(
+        memory_storage, EventServerConfig(ip="127.0.0.1", port=0)
+    ).start()
+    yield srv, memory_storage, app_id
+    srv.stop()
+
+
+def test_event_client_crud(event_server):
+    srv, storage, app_id = event_server
+    c = EventClient("SDKKEY", f"http://127.0.0.1:{srv.port}")
+
+    eid = c.create_event(
+        event="rate", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i1",
+        properties={"rating": 4},
+    )
+    got = c.get_event(eid)
+    assert got["event"] == "rate" and got["properties"] == {"rating": 4}
+
+    c.set_user("u2", {"age": 30})
+    c.set_item("i2", {"categories": ["a"]})
+    c.record_user_action_on_item("view", "u2", "i2")
+    events = c.find_events(limit=-1)
+    assert len(events) == 4
+    assert {e["event"] for e in events} == {"rate", "$set", "view"}
+
+    c.delete_event(eid)
+    with pytest.raises(PIOError) as err:
+        c.get_event(eid)
+    assert err.value.status == 404
+
+    statuses = c.create_events_batch([
+        {"event": "buy", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": "i9"}
+        for i in range(10)
+    ])
+    assert len(statuses) == 10
+    assert all(s["status"] == 201 for s in statuses)
+
+    with pytest.raises(ValueError, match="batch limit"):
+        c.create_events_batch([{}] * (BATCH_LIMIT + 1))
+
+
+def test_event_client_auth_errors(event_server):
+    srv, *_ = event_server
+    bad = EventClient("WRONG", f"http://127.0.0.1:{srv.port}")
+    with pytest.raises(PIOError) as err:
+        bad.create_event(event="x", entity_type="user", entity_id="u")
+    assert err.value.status == 401
+
+    gone = EventClient("K", "http://127.0.0.1:1")  # nothing listens there
+    with pytest.raises(PIOError) as err:
+        gone.create_event(event="x", entity_type="user", entity_id="u")
+    assert err.value.status == 0 and "unreachable" in str(err.value)
+
+
+def test_engine_client_roundtrip(memory_storage):
+    from tests.test_serve import seed_and_train
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    engine, ep, ctx, _ = seed_and_train(memory_storage)
+    http, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec"),
+        ctx=ctx,
+    )
+    http.start()
+    try:
+        c = EngineClient(f"http://127.0.0.1:{http.port}")
+        assert c.status()["status"] == "alive"
+        out = c.send_query({"user": "u0", "num": 3})
+        assert len(out["itemScores"]) == 3
+        batch = c.send_queries_batch(
+            [{"user": "u0", "num": 2}, {"user": "u1", "num": 2}]
+        )
+        assert len(batch) == 2 and all(b["itemScores"] for b in batch)
+    finally:
+        http.stop()
+        qs.close()
