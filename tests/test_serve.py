@@ -328,6 +328,50 @@ def test_multi_algo_predicts_run_concurrently(memory_storage):
         qs.close()
 
 
+def test_queries_survive_concurrent_reloads(memory_storage):
+    """Race detection: clients hammering /queries.json while /reload
+    hot-swaps the model repeatedly must never see an error — the swap is
+    atomic under the lock and retired doers close on a delay."""
+    import threading
+
+    engine, ep, ctx, _ = seed_and_train(memory_storage)
+    http, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      server_key="SK"),
+        ctx=ctx,
+    )
+    http.start()
+    failures = []
+    stop = threading.Event()
+
+    def hammer(w):
+        while not stop.is_set():
+            status, body = call(http.port, "POST", "/queries.json",
+                                {"user": f"u{w}", "num": 2})
+            if status != 200 or "itemScores" not in body:
+                failures.append((w, status, body))
+                return
+
+    try:
+        threads = [threading.Thread(target=hammer, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(5):
+            status, body = call(http.port, "GET", "/reload", accessKey="SK")
+            assert status == 200
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not failures, failures[:3]
+        assert qs.request_count > 0
+    finally:
+        stop.set()
+        http.stop()
+        qs.close()
+
+
 def test_deploy_without_completed_instance(memory_storage):
     engine = RecommendationEngine.apply()
     ep = EngineParams(
