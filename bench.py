@@ -435,10 +435,8 @@ def phase_serving() -> dict:
 
 
 def phase_ingest() -> dict:
-    """Event-server ingest throughput over the wire (single + batch POSTs);
-    storage-bound, not TPU-bound (BASELINE.md)."""
-    import urllib.request
-
+    """Event-server ingest throughput over the wire (batch POSTs over
+    keep-alive connections); storage-bound, not TPU-bound (BASELINE.md)."""
     from pio_tpu.data.dao import AccessKey, App
     from pio_tpu.data.storage import Storage
     from pio_tpu.server.eventserver import EventServerConfig, create_event_server
@@ -472,40 +470,58 @@ def phase_ingest() -> dict:
         body = json.dumps(batch).encode()
 
         def sequential(n):
-            """One keep-alive connection, n batches."""
+            """One keep-alive connection, n batches; -> events ACCEPTED
+            (the batch route answers 200 with per-event statuses, so only
+            201 items count — failed ingests must not inflate the rate)."""
             conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            accepted = 0
             try:
-                t0 = time.monotonic()
                 for _ in range(n):
                     conn.request(
                         "POST", "/batch/events.json?accessKey=IK",
                         body=body,
                         headers={"Content-Type": "application/json"})
-                    conn.getresponse().read()
-                return time.monotonic() - t0
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"ingest HTTP {resp.status}: {payload[:200]}")
+                    accepted += sum(
+                        1 for s in json.loads(payload)
+                        if s.get("status") == 201)
+                return accepted
             finally:
                 conn.close()
 
-        seq_dt = sequential(n_batches // 4)
+        t0 = time.monotonic()
+        seq_accepted = sequential(n_batches // 4)
+        seq_dt = time.monotonic() - t0
 
         # concurrent keep-alive clients = the real server capacity (the
         # round-1 number was sequential urllib without keep-alive, i.e.
         # client-bound, not server-bound)
         per_worker = n_batches // workers
+        totals: list[int] = []
+        errors: list[Exception] = []
+
+        def worker():
+            try:
+                totals.append(sequential(per_worker))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
         t0 = time.monotonic()
-        threads = [
-            threading.Thread(target=sequential, args=(per_worker,))
-            for _ in range(workers)
-        ]
+        threads = [threading.Thread(target=worker) for _ in range(workers)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
         conc_dt = time.monotonic() - t0
+        if errors:
+            raise errors[0]
         return {
-            "events_per_sec": round(workers * per_worker * 50 / conc_dt, 1),
-            "events_per_sec_sequential": round(
-                (n_batches // 4) * 50 / seq_dt, 1),
+            "events_per_sec": round(sum(totals) / conc_dt, 1),
+            "events_per_sec_sequential": round(seq_accepted / seq_dt, 1),
             "batches": n_batches,
             "client_threads": workers,
         }

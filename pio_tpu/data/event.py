@@ -8,8 +8,9 @@ entity type `pio_pr`).
 
 from __future__ import annotations
 
+import copy
 import json
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Sequence
 
@@ -50,7 +51,12 @@ class Event:
             object.__setattr__(self, "tags", tuple(self.tags))
 
     def with_id(self, event_id: str) -> "Event":
-        return replace(self, event_id=event_id)
+        # shallow copy + setattr, NOT dataclasses.replace: replace re-runs
+        # __init__/__post_init__ (tz coercion, DataMap/tuple checks) on
+        # every insert — the hottest line of the ingest pipeline
+        e = copy.copy(self)
+        object.__setattr__(e, "event_id", event_id)
+        return e
 
     # -- wire format (reference EventJson4sSupport.scala APISerializer) -----
     def to_api_dict(self, with_id: bool = True) -> dict[str, Any]:
