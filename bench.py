@@ -470,12 +470,16 @@ def phase_ingest() -> dict:
         body = json.dumps(batch).encode()
 
         def sequential(n):
-            """One keep-alive connection, n batches; -> events ACCEPTED
-            (the batch route answers 200 with per-event statuses, so only
-            201 items count — failed ingests must not inflate the rate)."""
+            """One keep-alive connection, n batches; -> (loop seconds,
+            events ACCEPTED). Only per-event 201s count — failed ingests
+            must not inflate the rate — and response parsing happens
+            OUTSIDE the timed loop: the server shares this process (and
+            GIL), so client-side JSON work during the measurement would
+            deflate the server's rate."""
             conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-            accepted = 0
+            payloads = []
             try:
+                t0 = time.monotonic()
                 for _ in range(n):
                     conn.request(
                         "POST", "/batch/events.json?accessKey=IK",
@@ -486,41 +490,42 @@ def phase_ingest() -> dict:
                     if resp.status != 200:
                         raise RuntimeError(
                             f"ingest HTTP {resp.status}: {payload[:200]}")
-                    accepted += sum(
-                        1 for s in json.loads(payload)
-                        if s.get("status") == 201)
-                return accepted
+                    payloads.append(payload)
+                elapsed = time.monotonic() - t0
             finally:
                 conn.close()
+            accepted = sum(
+                1 for p in payloads for s in json.loads(p)
+                if s.get("status") == 201
+            )
+            return elapsed, accepted
 
-        t0 = time.monotonic()
-        seq_accepted = sequential(n_batches // 4)
-        seq_dt = time.monotonic() - t0
+        seq_dt, seq_accepted = sequential(n_batches // 4)
 
         # concurrent keep-alive clients = the real server capacity (the
         # round-1 number was sequential urllib without keep-alive, i.e.
         # client-bound, not server-bound)
         per_worker = n_batches // workers
-        totals: list[int] = []
+        results: list[tuple[float, int]] = []
         errors: list[Exception] = []
 
         def worker():
             try:
-                totals.append(sequential(per_worker))
+                results.append(sequential(per_worker))
             except Exception as e:  # noqa: BLE001 - surfaced below
                 errors.append(e)
 
-        t0 = time.monotonic()
         threads = [threading.Thread(target=worker) for _ in range(workers)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        conc_dt = time.monotonic() - t0
         if errors:
             raise errors[0]
+        conc_dt = max(dt for dt, _ in results)
         return {
-            "events_per_sec": round(sum(totals) / conc_dt, 1),
+            "events_per_sec": round(
+                sum(n for _, n in results) / conc_dt, 1),
             "events_per_sec_sequential": round(seq_accepted / seq_dt, 1),
             "batches": n_batches,
             "client_threads": workers,

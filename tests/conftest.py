@@ -87,6 +87,18 @@ def remote_storage(tmp_path):
     backing.close()
 
 
+@pytest.fixture()
+def cli(memory_storage, capsys):
+    """Invoke the CLI in-process with its global storage pointed at the
+    test's memory store: cli("verb", ...) -> (exit_code, captured)."""
+    from pio_tpu.data.storage import set_storage
+    from pio_tpu.tools.cli import main
+
+    set_storage(memory_storage)
+    yield lambda *argv: (main(list(argv)), capsys.readouterr())
+    set_storage(None)
+
+
 @pytest.fixture(params=["memory", "sqlite", "remote"])
 def any_storage(request):
     """Parameterized over backends — including the networked remote backend

@@ -10,12 +10,7 @@ from pio_tpu.data.storage import set_storage
 from pio_tpu.tools.cli import main
 
 
-@pytest.fixture()
-def cli(memory_storage, capsys):
-    """Route the CLI's process-global storage at the test's memory storage."""
-    set_storage(memory_storage)
-    yield lambda *argv: (main(list(argv)), capsys.readouterr())
-    set_storage(None)
+# the `cli` fixture lives in conftest.py (shared with test_cli_verbs.py)
 
 
 def test_version_and_status(cli):

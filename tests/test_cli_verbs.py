@@ -1,0 +1,182 @@
+"""CLI-level coverage for the verbs the other suites exercise only through
+their underlying libraries: eval, upgrade, deploy/undeploy."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EVAL_DEF = '''
+from pio_tpu.controller import EngineParamsGenerator, EngineParams, Evaluation
+from pio_tpu.e2.metrics import PrecisionAtK
+from pio_tpu.models.recommendation import (
+    ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+)
+
+
+class MyEval(Evaluation):
+    @classmethod
+    def engine_metric(cls):
+        return RecommendationEngine.apply(), PrecisionAtK(4)
+
+
+class MyParams(EngineParamsGenerator):
+    @classmethod
+    def params_list(cls):
+        return [
+            EngineParams(
+                datasource=("", DataSourceParams(app_name="evalapp",
+                                                 eval_k=2)),
+                algorithms=[("als", ALSAlgorithmParams(
+                    rank=r, num_iterations=3, lambda_=0.05, chunk=512))],
+            )
+            for r in (2, 4)
+        ]
+'''
+
+
+def _seed(storage, app_name):
+    from pio_tpu.data import DataMap, Event
+    from pio_tpu.data.dao import App
+
+    app_id = storage.get_metadata_apps().insert(App(0, app_name))
+    ev = storage.get_events()
+    ev.init(app_id)
+    for u in range(16):
+        for i in range(10):
+            if (u + i) % 2 == 0:
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5})), app_id)
+    return app_id
+
+
+def test_eval_verb_runs_grid(cli, memory_storage, tmp_path, monkeypatch):
+    _seed(memory_storage, "evalapp")
+    (tmp_path / "eval_def.py").write_text(EVAL_DEF)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    out_path = tmp_path / "best.json"
+    code, out = cli("eval", "eval_def.MyEval", "eval_def.MyParams",
+                    "--output", str(out_path), "--workers", "2")
+    assert code == 0, out.err
+    assert "Best score" in out.out
+    best = json.loads(out_path.read_text())
+    assert best["algorithmParamsList"][0]["params"]["rank"] in (2, 4)
+    inst = memory_storage.get_metadata_evaluation_instances().get_all()
+    assert any(i.status == "EVALCOMPLETED" for i in inst)
+
+
+def test_upgrade_verb_migrates_between_backends(cli, tmp_path):
+    from pio_tpu.data.storage import Storage
+
+    src_env = {
+        "PIO_STORAGE_SOURCES_A_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_A_PATH": str(tmp_path / "src.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "A",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "A",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "A",
+    }
+    dst_env = {
+        "PIO_STORAGE_SOURCES_B_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_B_PATH": str(tmp_path / "dst.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "B",
+    }
+    src = Storage(env=src_env)
+    _seed(src, "migapp")
+    src.close()
+    (tmp_path / "src.json").write_text(json.dumps(src_env))
+    (tmp_path / "dst.json").write_text(json.dumps(dst_env))
+    code, out = cli("upgrade", "--from-env", str(tmp_path / "src.json"),
+                    "--to-env", str(tmp_path / "dst.json"))
+    assert code == 0, out.err
+
+    dst = Storage(env=dst_env)
+    app = dst.get_metadata_apps().get_by_name("migapp")
+    assert app is not None
+    assert len(list(dst.get_events().find(app.id, limit=-1))) == 80
+    dst.close()
+
+
+@pytest.mark.slow
+def test_deploy_and_undeploy_subprocess(tmp_path):
+    """Real `pio deploy` child process answers /queries.json; `pio undeploy`
+    stops it cleanly (reference Console.deploy/undeploy)."""
+    from pio_tpu.data.storage import Storage
+
+    env_vars = {
+        "PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+    }
+    storage = Storage(env=env_vars)
+    _seed(storage, "deployapp")
+    storage.close()
+
+    eng = tmp_path / "eng"
+    eng.mkdir()
+    (eng / "engine.json").write_text(json.dumps({
+        "id": "deployrec",
+        "engineFactory":
+            "pio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "deployapp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "num_iterations": 2, "lambda_": 0.05, "chunk": 512}}],
+    }))
+    env = dict(os.environ, **env_vars,
+               PIO_TPU_PLATFORM="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    run = [sys.executable, "-m", "pio_tpu.tools.cli"]
+    out = subprocess.run([*run, "train", "--engine-dir", str(eng),
+                          "--no-mesh"],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-1500:]
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [*run, "deploy", "--engine-dir", str(eng), "--ip", "127.0.0.1",
+         "--port", str(port), "--no-mesh", "--server-key", "SK"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    try:
+        deadline = time.monotonic() + 120
+        body = None
+        while time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps({"user": "u0", "num": 2}).encode(),
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    body = json.loads(resp.read())
+                break
+            except Exception:
+                if proc.poll() is not None:
+                    pytest.fail(f"deploy died: {proc.stdout.read()[-1500:]}")
+                time.sleep(1)
+        assert body and len(body["itemScores"]) == 2
+
+        out = subprocess.run(
+            [*run, "undeploy", "--port", str(port), "--server-key", "SK"],
+            capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        proc.wait(timeout=60)
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
