@@ -112,6 +112,22 @@ class SumMetric(QPAMetric):
         return float(np.sum(scores))
 
 
+class MeanSquareError(AverageMetric):
+    """Regression MSE over served numeric predictions — the metric the
+    reference regression examples evaluate with
+    (examples/experimental/scala-parallel-regression/Run.scala imports
+    controller.MeanSquareError). Lower is better."""
+
+    higher_is_better = False
+
+    @property
+    def header(self) -> str:
+        return "MSE"
+
+    def calculate_one(self, query, prediction, actual):
+        return (float(prediction) - float(actual)) ** 2
+
+
 class ZeroMetric(Metric[float]):
     """Reference ZeroMetric: always 0 (placeholder)."""
 

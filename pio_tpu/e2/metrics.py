@@ -7,7 +7,10 @@ sets; these are the shared vectorized implementations.
 
 from __future__ import annotations
 
-from pio_tpu.controller.evaluation import OptionAverageMetric
+from pio_tpu.controller.evaluation import (  # noqa: F401 (re-export)
+    MeanSquareError,
+    OptionAverageMetric,
+)
 
 
 def _predicted_items(prediction) -> list[str]:

@@ -1,0 +1,278 @@
+"""Regression engine template — ridge (closed form) + linear SGD.
+
+Parity target: the reference's regression examples,
+examples/experimental/scala-parallel-regression/Run.scala:33-80 (PDataSource
+reading "label f1 f2 ..." lines with MLUtils.kFold, MLlib
+LinearRegressionWithSGD as a P2LAlgorithm, LAverageServing) and
+examples/experimental/scala-local-regression/Run.scala:26-60 (LDataSource +
+breeze normal-equations solve as an LAlgorithm).
+
+TPU-native redesign: the local example's `inv(X^T X) X^T y` becomes a
+batched ridge solve on the MXU — Gram matrix by one (D,N)x(N,D) matmul in
+f32, `jax.scipy.linalg.cho_solve` for the weights — exact, one compile,
+no SGD hyperparameters. The SGD algorithm is kept for MLlib signature
+parity (numIterations/stepSize/miniBatchFraction) and runs its whole
+iteration loop on-device under `lax.scan` with the MLlib GradientDescent
+step-size schedule (stepSize / sqrt(t)); the host never sees an
+intermediate iterate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import numpy as np
+
+from pio_tpu.controller.base import (
+    AverageServing,
+    DataSource,
+    IdentityPreparator,
+    P2LAlgorithm,
+    Params,
+)
+from pio_tpu.controller.engine import Engine, EngineFactory
+from pio_tpu.e2.crossvalidation import split_data
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    """Either a whitespace-separated text file ("label f1 f2 ...", the
+    reference ParallelDataSource filepath contract) or event-store entity
+    properties (numeric `attributes` + `label`, like the classification
+    template)."""
+
+    path_fields = ("filepath",)  # engine-dir-relative (CLI absolutizes)
+
+    filepath: str = ""
+    app_name: str = ""
+    attributes: tuple[str, ...] = ()
+    label: str = "label"
+    entity_type: str = "point"
+    eval_k: int = 0
+    seed: int = 9527
+
+
+@dataclass
+class RegressionData:
+    x: np.ndarray  # (N, D) float32
+    y: np.ndarray  # (N,) float32
+
+    def sanity_check(self):
+        if len(self.y) == 0:
+            raise ValueError(
+                "RegressionData is empty; check filepath / event properties."
+            )
+        if not np.isfinite(self.x).all() or not np.isfinite(self.y).all():
+            raise ValueError("RegressionData contains non-finite values.")
+
+
+class RegressionDataSource(DataSource):
+    """Reference ParallelDataSource (Run.scala:33-51): parse rows, k-fold
+    for eval. Event-store mode mirrors ClassificationDataSource but with
+    numeric attributes only."""
+
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def _read(self, ctx) -> RegressionData:
+        p = self.params
+        if p.filepath:
+            rows = []
+            with open(p.filepath) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        rows.append([float(v) for v in parts])
+            if not rows:
+                return RegressionData(
+                    np.zeros((0, 0), np.float32), np.zeros(0, np.float32)
+                )
+            arr = np.asarray(rows, np.float32)
+            return RegressionData(x=arr[:, 1:], y=arr[:, 0])
+        props = ctx.event_store.aggregate_properties(
+            app_name=p.app_name,
+            entity_type=p.entity_type,
+            required=[p.label, *p.attributes],
+        )
+        xs, ys = [], []
+        for _, pm in sorted(props.items()):
+            xs.append([float(pm.get(a)) for a in p.attributes])
+            ys.append(float(pm.get(p.label)))
+        return RegressionData(
+            x=np.asarray(xs, np.float32).reshape(len(ys), -1),
+            y=np.asarray(ys, np.float32),
+        )
+
+    def read_training(self, ctx) -> RegressionData:
+        return self._read(ctx)
+
+    def read_eval(self, ctx):
+        data = self._read(ctx)
+        if self.params.eval_k <= 1:
+            return []
+        rows = list(range(len(data.y)))
+        folds = []
+        for train_rows, info, test_rows in split_data(rows, self.params.eval_k):
+            tr = RegressionData(x=data.x[train_rows], y=data.y[train_rows])
+            qa = [
+                ({"features": data.x[i].tolist()}, float(data.y[i]))
+                for i in test_rows
+            ]
+            folds.append((tr, info, qa))
+        return folds
+
+
+@dataclass
+class LinearModel:
+    """w·x + b. Weights live on host (few KB); prediction is a matvec."""
+
+    weights: np.ndarray  # (D,)
+    intercept: float
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return x @ self.weights + self.intercept
+
+
+def _predict_query(model: LinearModel, query: dict) -> float:
+    x = np.asarray(query["features"], np.float32)
+    return float(x @ model.weights + model.intercept)
+
+
+def _batch_predict(model: LinearModel, queries: Sequence[dict]) -> list:
+    if not queries:
+        return []
+    x = np.stack([np.asarray(q["features"], np.float32) for q in queries])
+    return [float(v) for v in model.predict(x)]
+
+
+@dataclass(frozen=True)
+class RidgeParams(Params):
+    reg: float = 0.0          # L2 penalty (0 = ordinary least squares)
+    fit_intercept: bool = True
+
+
+class RidgeRegressionAlgorithm(P2LAlgorithm):
+    """Closed-form ridge on the MXU — the TPU answer to both the local
+    example's breeze normal equations (scala-local-regression/Run.scala:
+    nak LinearRegression) and MLlib RidgeRegressionWithSGD."""
+
+    params_class = RidgeParams
+
+    def __init__(self, params: RidgeParams = RidgeParams()):
+        self.params = params
+
+    def train(self, ctx, data: RegressionData) -> LinearModel:
+        import jax.numpy as jnp
+        from jax.scipy.linalg import cho_factor, cho_solve
+
+        data.sanity_check()
+        x = jnp.asarray(data.x, jnp.float32)
+        y = jnp.asarray(data.y, jnp.float32)
+        if self.params.fit_intercept:
+            x_mean = x.mean(axis=0)
+            y_mean = y.mean()
+            xc, yc = x - x_mean, y - y_mean
+        else:
+            xc, yc = x, y
+        d = xc.shape[1]
+        gram = xc.T @ xc + self.params.reg * jnp.eye(d, dtype=jnp.float32)
+        rhs = xc.T @ yc
+        w = cho_solve(cho_factor(gram), rhs)
+        w_host = np.asarray(w, np.float64)
+        if not np.isfinite(w_host).all():
+            # singular Gram (collinear features / D > N) with reg == 0:
+            # jax Cholesky yields NaNs rather than raising — fall back to
+            # the min-norm least-squares solution
+            w, *_ = jnp.linalg.lstsq(xc, yc)
+            w_host = np.asarray(w, np.float64)
+        if self.params.fit_intercept:
+            b = float(y_mean - x_mean @ w)
+        else:
+            b = 0.0
+        return LinearModel(weights=w_host, intercept=b)
+
+    def predict(self, model: LinearModel, query: dict) -> float:
+        return _predict_query(model, query)
+
+    def batch_predict(self, model: LinearModel, queries) -> list:
+        return _batch_predict(model, queries)
+
+
+@dataclass(frozen=True)
+class SGDParams(Params):
+    """MLlib LinearRegressionWithSGD.train signature
+    (scala-parallel-regression/Run.scala:55-63)."""
+
+    num_iterations: int = 200
+    step_size: float = 0.1
+    mini_batch_fraction: float = 1.0
+    seed: int = 0
+
+
+class SGDRegressionAlgorithm(P2LAlgorithm):
+    """LinearRegressionWithSGD parity. The full iteration loop runs
+    on-device in one compiled `lax.scan`; mini-batches are drawn by
+    pre-generated index matrix so shapes stay static."""
+
+    params_class = SGDParams
+
+    def __init__(self, params: SGDParams = SGDParams()):
+        self.params = params
+
+    def train(self, ctx, data: RegressionData) -> LinearModel:
+        import jax
+        import jax.numpy as jnp
+
+        data.sanity_check()
+        p = self.params
+        n, d = data.x.shape
+        batch = max(1, int(round(n * min(1.0, p.mini_batch_fraction))))
+        rng = np.random.default_rng(p.seed)
+        if batch >= n:
+            idx = np.broadcast_to(np.arange(n), (p.num_iterations, n))
+        else:
+            idx = rng.integers(0, n, size=(p.num_iterations, batch))
+
+        x = jnp.asarray(data.x, jnp.float32)
+        y = jnp.asarray(data.y, jnp.float32)
+        idx_dev = jnp.asarray(idx)
+        steps = p.step_size / jnp.sqrt(jnp.arange(1, p.num_iterations + 1, dtype=jnp.float32))
+
+        def body(carry, it):
+            w, b = carry
+            rows, step = it
+            xb, yb = x[rows], y[rows]
+            resid = xb @ w + b - yb           # (B,)
+            gw = xb.T @ resid / rows.shape[0]
+            gb = resid.mean()
+            return (w - step * gw, b - step * gb), None
+
+        init = (jnp.zeros((d,), jnp.float32), jnp.float32(0.0))
+        (w, b), _ = jax.lax.scan(body, init, (idx_dev, steps))
+        return LinearModel(
+            weights=np.asarray(w, np.float64), intercept=float(b)
+        )
+
+    def predict(self, model: LinearModel, query: dict) -> float:
+        return _predict_query(model, query)
+
+    def batch_predict(self, model: LinearModel, queries) -> list:
+        return _batch_predict(model, queries)
+
+
+class RegressionEngine(EngineFactory):
+    """Reference RegressionEngineFactory (scala-parallel-regression/
+    Run.scala:72-80): datasource + identity preparator + SGD algo +
+    LAverageServing; plus the exact ridge solver as a second algorithm."""
+
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            RegressionDataSource,
+            IdentityPreparator,
+            {"ridge": RidgeRegressionAlgorithm, "sgd": SGDRegressionAlgorithm},
+            AverageServing,
+        )

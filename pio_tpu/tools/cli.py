@@ -629,6 +629,11 @@ def cmd_template(args) -> int:
     if spec.engine_py is not None:
         with open(os.path.join(target, "engine.py"), "w") as f:
             f.write(spec.engine_py)
+    for rel, content in spec.data_files.items():
+        path = os.path.join(target, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content)
     with open(os.path.join(target, "README.md"), "w") as f:
         f.write(readme_for(spec, name))
     print(f"Engine template '{spec.name}' created at {target}")

@@ -203,6 +203,24 @@ def test_multi_algo_combines_two_algorithms(tmp_path):
 
 
 @pytest.mark.slow
+def test_regression_example_end_to_end(tmp_path):
+    """examples/regression: file-based datasource (engine-dir-relative path
+    resolved by the loader), two algorithms averaged by AverageServing."""
+    storage = _storage(tmp_path)
+    engine, ep, _ = _load_example("regression")
+    # loader must have absolutized ./data/sample.txt against the engine dir
+    assert os.path.isabs(ep.datasource[1].filepath)
+    http = _train_and_serve(engine, ep, storage, "regression")
+    try:
+        r = _query(http.port, {"features": [1.0, 0.0, 0.0, 0.0]})
+        # true fn = 2*f0 - f1 + 0.5*f2 + 3*f3 + 1.5 -> ~3.5 here
+        assert abs(float(r) - 3.5) < 0.5, r
+    finally:
+        http.stop()
+    storage.close()
+
+
+@pytest.mark.slow
 def test_cli_train_subprocess_from_example_dir(tmp_path):
     """The actual CLI verbs against an example dir: build + train in a real
     subprocess (the `pio train` a user runs), then the trained instance is

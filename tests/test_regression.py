@@ -1,0 +1,159 @@
+"""Regression model family tests (reference
+examples/experimental/scala-parallel-regression + scala-local-regression)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller import EngineParams
+from pio_tpu.e2.metrics import MeanSquareError
+from pio_tpu.models.regression import (
+    DataSourceParams,
+    LinearModel,
+    RegressionData,
+    RegressionDataSource,
+    RegressionEngine,
+    RidgeParams,
+    RidgeRegressionAlgorithm,
+    SGDParams,
+    SGDRegressionAlgorithm,
+)
+
+W_TRUE = np.array([2.0, -1.0, 0.5, 3.0])
+B_TRUE = 1.5
+
+
+def _make_data(n=400, noise=0.01, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, len(W_TRUE))).astype(np.float32)
+    y = (x @ W_TRUE + B_TRUE + rng.normal(scale=noise, size=n)).astype(
+        np.float32
+    )
+    return RegressionData(x=x, y=y)
+
+
+def test_ridge_recovers_weights():
+    data = _make_data()
+    model = RidgeRegressionAlgorithm(RidgeParams(reg=1e-6)).train(None, data)
+    np.testing.assert_allclose(model.weights, W_TRUE, atol=0.01)
+    assert model.intercept == pytest.approx(B_TRUE, abs=0.01)
+
+
+def test_ridge_no_intercept():
+    data = _make_data()
+    data = RegressionData(x=data.x, y=data.y - B_TRUE)
+    model = RidgeRegressionAlgorithm(
+        RidgeParams(reg=1e-6, fit_intercept=False)
+    ).train(None, data)
+    assert model.intercept == 0.0
+    np.testing.assert_allclose(model.weights, W_TRUE, atol=0.02)
+
+
+def test_ridge_regularization_shrinks():
+    data = _make_data()
+    free = RidgeRegressionAlgorithm(RidgeParams(reg=0.0)).train(None, data)
+    heavy = RidgeRegressionAlgorithm(RidgeParams(reg=1e4)).train(None, data)
+    assert np.linalg.norm(heavy.weights) < np.linalg.norm(free.weights)
+
+
+def test_sgd_approximates_solution():
+    data = _make_data(n=800)
+    model = SGDRegressionAlgorithm(
+        SGDParams(num_iterations=400, step_size=0.5)
+    ).train(None, data)
+    np.testing.assert_allclose(model.weights, W_TRUE, atol=0.15)
+    assert model.intercept == pytest.approx(B_TRUE, abs=0.15)
+
+
+def test_sgd_minibatch_runs():
+    data = _make_data(n=512)
+    model = SGDRegressionAlgorithm(
+        SGDParams(num_iterations=300, step_size=0.5, mini_batch_fraction=0.25)
+    ).train(None, data)
+    preds = model.predict(data.x)
+    mse = float(np.mean((preds - data.y) ** 2))
+    assert mse < 1.0
+
+
+def test_predict_and_batch_predict_agree():
+    data = _make_data()
+    algo = RidgeRegressionAlgorithm()
+    model = algo.train(None, data)
+    queries = [{"features": data.x[i].tolist()} for i in range(5)]
+    singles = [algo.predict(model, q) for q in queries]
+    batch = algo.batch_predict(model, queries)
+    np.testing.assert_allclose(singles, batch, rtol=1e-6)
+
+
+def test_filepath_datasource_and_kfold(tmp_path):
+    data = _make_data(n=90)
+    path = tmp_path / "points.txt"
+    with open(path, "w") as f:
+        for i in range(len(data.y)):
+            f.write(" ".join(
+                str(v) for v in [data.y[i], *data.x[i]]) + "\n")
+    ds = RegressionDataSource(DataSourceParams(filepath=str(path), eval_k=3))
+    td = ds.read_training(None)
+    assert td.x.shape == (90, 4)
+    folds = ds.read_eval(None)
+    assert len(folds) == 3
+    # index-mod-k disjointness: test rows across folds cover everything once
+    n_test = sum(len(qa) for _, _, qa in folds)
+    assert n_test == 90
+    tr, info, qa = folds[0]
+    assert len(tr.y) == 60 and len(qa) == 30
+    q, a = qa[0]
+    assert len(q["features"]) == 4 and isinstance(a, float)
+
+
+def test_empty_data_sanity_check():
+    with pytest.raises(ValueError, match="empty"):
+        RidgeRegressionAlgorithm().train(
+            None, RegressionData(np.zeros((0, 0), np.float32),
+                                 np.zeros(0, np.float32))
+        )
+
+
+def test_engine_eval_mse(tmp_path):
+    """Full engine.eval over k folds + MeanSquareError: the exact ridge
+    solver must beat a deliberately under-trained SGD."""
+    data = _make_data(n=90, noise=0.05)
+    path = tmp_path / "points.txt"
+    with open(path, "w") as f:
+        for i in range(len(data.y)):
+            f.write(" ".join(
+                str(v) for v in [data.y[i], *data.x[i]]) + "\n")
+    engine = RegressionEngine.apply()
+    metric = MeanSquareError()
+    assert not metric.higher_is_better
+
+    def eval_mse(algo_name, algo_params):
+        ep = EngineParams(
+            datasource=("", DataSourceParams(filepath=str(path), eval_k=3)),
+            algorithms=[(algo_name, algo_params)],
+        )
+        result = engine.eval(None, ep)
+        return metric.calculate(None, result)
+
+    mse_ridge = eval_mse("ridge", RidgeParams(reg=0.01))
+    mse_sgd = eval_mse("sgd", SGDParams(num_iterations=3, step_size=0.01))
+    assert mse_ridge < 0.01
+    assert mse_ridge < mse_sgd
+
+
+def test_average_serving_combines_algos(tmp_path):
+    """The engine's AverageServing averages ridge + sgd predictions, the
+    reference RegressionEngineFactory composition (Run.scala:72-80)."""
+    data = _make_data(n=200)
+    ridge = RidgeRegressionAlgorithm().train(None, data)
+    sgd = SGDRegressionAlgorithm(
+        SGDParams(num_iterations=200, step_size=0.5)
+    ).train(None, data)
+    from pio_tpu.controller import AverageServing
+
+    q = {"features": data.x[0].tolist()}
+    p1 = RidgeRegressionAlgorithm().predict(ridge, q)
+    p2 = SGDRegressionAlgorithm().predict(sgd, q)
+    served = AverageServing().serve(q, [p1, p2])
+    assert served == pytest.approx((p1 + p2) / 2)
