@@ -226,7 +226,8 @@ def phase_train() -> dict:
     # rates must not masquerade as measurements
     sweep_s = (dt - dt1) / max(iters - 1, 1) if dt > dt1 else None
     p = ALSParams(rank=RANK)
-    cg = p.resolved_cg_iters()
+    # auto dispatch is per-side; report the large (user) side's choice
+    cg = p.resolved_cg_iters(n_users)
     # padded nnz is what the kernel actually crunches
     nnz_pad = nnz + (-nnz % CHUNK)
     fl = als_flops_per_sweep(nnz_pad, n_users, n_items, RANK, cg)

@@ -114,7 +114,7 @@ def main() -> int:
     from pio_tpu.ops.als import ALSParams
 
     device = jax.devices()[0]
-    auto_cg = ALSParams(rank=RANK, cg_iters=-1).resolved_cg_iters()
+    auto_cg = ALSParams(rank=RANK, cg_iters=-1).resolved_cg_iters(n_users)
 
     print("CG trajectory:", flush=True)
     cg_traj, cg_sec = trajectory(tr_u, tr_i, tr_v, te_u, te_i, te_v,

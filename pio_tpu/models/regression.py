@@ -113,7 +113,11 @@ class RegressionDataSource(DataSource):
         data = self._read(ctx)
         if self.params.eval_k <= 1:
             return []
-        rows = list(range(len(data.y)))
+        # seeded shuffle before the index-mod-k split: the reference's
+        # MLUtils.kFold is seeded-random (Run.scala:45, seed 9527), and an
+        # unshuffled file sorted by label would otherwise give skewed folds
+        rows = list(np.random.default_rng(self.params.seed).permutation(
+            len(data.y)))
         folds = []
         for train_rows, info, test_rows in split_data(rows, self.params.eval_k):
             tr = RegressionData(x=data.x[train_rows], y=data.y[train_rows])
@@ -231,27 +235,35 @@ class SGDRegressionAlgorithm(P2LAlgorithm):
         n, d = data.x.shape
         batch = max(1, int(round(n * min(1.0, p.mini_batch_fraction))))
         rng = np.random.default_rng(p.seed)
-        if batch >= n:
-            idx = np.broadcast_to(np.arange(n), (p.num_iterations, n))
-        else:
-            idx = rng.integers(0, n, size=(p.num_iterations, batch))
 
         x = jnp.asarray(data.x, jnp.float32)
         y = jnp.asarray(data.y, jnp.float32)
-        idx_dev = jnp.asarray(idx)
         steps = p.step_size / jnp.sqrt(jnp.arange(1, p.num_iterations + 1, dtype=jnp.float32))
 
-        def body(carry, it):
+        def grad_step(carry, step, xb, yb, m):
             w, b = carry
-            rows, step = it
-            xb, yb = x[rows], y[rows]
             resid = xb @ w + b - yb           # (B,)
-            gw = xb.T @ resid / rows.shape[0]
+            gw = xb.T @ resid / m
             gb = resid.mean()
             return (w - step * gw, b - step * gb), None
 
         init = (jnp.zeros((d,), jnp.float32), jnp.float32(0.0))
-        (w, b), _ = jax.lax.scan(body, init, (idx_dev, steps))
+        if batch >= n:
+            # full-batch: no index matrix, no gather — scan over steps only
+            def body(carry, step):
+                return grad_step(carry, step, x, y, n)
+
+            (w, b), _ = jax.lax.scan(body, init, steps)
+        else:
+            idx_dev = jnp.asarray(
+                rng.integers(0, n, size=(p.num_iterations, batch))
+            )
+
+            def body(carry, it):
+                rows, step = it
+                return grad_step(carry, step, x[rows], y[rows], batch)
+
+            (w, b), _ = jax.lax.scan(body, init, (idx_dev, steps))
         return LinearModel(
             weights=np.asarray(w, np.float64), intercept=float(b)
         )

@@ -10,11 +10,16 @@ TPU formulation is built around three hardware facts measured on v5e:
    the per-row normal equations  (Y^T C Y + lambda I) x = Y^T C p  are
    accumulated as *batched matmuls* over fixed-width rating slots — MXU
    work with O(nnz*k) traffic;
- * the solve is direct batched Cholesky by default: readback-forced
-   interleaved timing at rank 64, ML-20M shape on v5e measures it EQUAL
-   to converged CG (within run noise), and it is exact — so exactness
-   wins. Jacobi-preconditioned CG (cg_iters>0 or -1), warm-started
-   across sweeps, remains the memory-lean inexact option;
+ * the solve is short warm-started Jacobi-CG by default: XLA's batched
+   Cholesky does not use the MXU (measured 10 GFLOP/s on (138k,64,64)
+   v5e — 1.16 s of a 1.75 s half-sweep), while CG is pure batched
+   matvecs. At the auto cap max(16, rank//4), per-sweep component timing
+   on the ML-20M shape shows the solve at 142 ms vs Cholesky's 1157 ms,
+   and quality is at parity or better: implicit objective within 1e-5
+   relative of the exact solve, explicit heldout RMSE *lower* (1.310 vs
+   1.352 at rank 64; 1.291 vs 1.322 at rank 100 — the inexact inner
+   solve early-stops the per-row overfit that exact ALS commits to).
+   cg_iters=0 selects the exact Cholesky when bit-exactness matters;
  * the host is slow relative to the chip (single-core sort of 20M ratings
    costs more than the whole train), so the slot layout itself is built
    ON DEVICE from the raw COO arrays: one stable `lax.sort` by row, then
@@ -65,19 +70,40 @@ class ALSParams:
     # reproducible wall-clock win, so exactness wins until a co-located
     # profile says otherwise.
     bf16_gather: bool = False
-    cg_iters: int = 0         # 0: direct Cholesky (default); >0: CG iters;
-                              # -1: auto-capped CG (max(2*rank, 8))
+    cg_iters: int = -1        # -1: auto (per-side: exact Cholesky for
+                              # small row batches, short warm-started CG
+                              # for large); 0: exact batched Cholesky;
+                              # >0: explicit CG iteration count
+    # auto mode switches a side to CG above this many rows: below it the
+    # batched Cholesky costs <~70ms (linear in batch; 1157ms at 138k on
+    # v5e) so exactness is free; above it CG's MXU matvecs win big
+    auto_cg_rows: int = 8192
 
-    def resolved_cg_iters(self) -> int:
-        """0 = direct batched Cholesky — the default: exact, and measured
-        (readback-forced, interleaved) EQUAL in wall-clock to converged CG
-        at rank 64 on the ML-20M shape on v5e, so the exact solve wins.
-        CG remains for memory-lean inexact sweeps; its auto cap scales
-        WITH rank (2x the k-dim Krylov bound — CG in f32 with Jacobi
-        preconditioning needs the extra iterations to reach direct-solve
-        quality; a fixed cap below rank k would quietly under-converge the
-        rank 50-100 trains MLlib templates commonly use)."""
-        return max(2 * self.rank, 8) if self.cg_iters < 0 else self.cg_iters
+    def resolved_cg_iters(self, n_self: int | None = None) -> int:
+        """-1 (default) = auto, decided per factor side by its row count:
+
+        * n_self <= auto_cg_rows: exact batched Cholesky (0) — at small
+          batch the solve is not the bottleneck, and on noiseless/tiny
+          data the exact solve measurably generalizes better;
+        * large sides: short warm-started Jacobi-CG capped at
+          max(16, rank//4). Measured on v5e at the ML-20M shape (rank
+          64, implicit, warm): 28.2M ratings/s at cg=8, 25.7M at cg=16,
+          vs 10.5M with the exact Cholesky — XLA's batched Cholesky runs
+          at ~10 GFLOP/s on TPU while CG is batched matvecs on the MXU.
+          Quality at the cap is at parity or better at realistic scale
+          (implicit objective within 1e-5; explicit heldout RMSE lower:
+          1.310 vs 1.352 at rank 64, 1.291 vs 1.322 at rank 100 — the
+          inexact inner solve early-stops per-row overfit). CG
+          convergence is governed by conditioning, not the Krylov
+          dimension, so the cap grows only mildly with rank; the warm
+          start carries convergence across sweeps.
+
+        With n_self=None (size unknown) auto returns the CG cap."""
+        if self.cg_iters >= 0:
+            return self.cg_iters
+        if n_self is not None and n_self <= self.auto_cg_rows:
+            return 0
+        return max(16, self.rank // 4)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -290,19 +316,20 @@ def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
     si = _slots_for(nnz, n_items, params.width, cs)
     by_user = _device_slot_layout(u, i, v, n_users, params.width, su)
     by_item = _device_slot_layout(i, u, v, n_items, params.width, si)
-    cg = params.resolved_cg_iters()
+    cg_u = params.resolved_cg_iters(n_users)
+    cg_i = params.resolved_cg_iters(n_items)
 
     def sweep(carry, _):
         users, items = carry
         users = _solve_factors(
             by_user, items, n_users,
             params.reg, params.implicit, params.alpha, cs,
-            x0=users, cg_iters=cg, bf16_gather=params.bf16_gather,
+            x0=users, cg_iters=cg_u, bf16_gather=params.bf16_gather,
         )
         items = _solve_factors(
             by_item, users, n_items,
             params.reg, params.implicit, params.alpha, cs,
-            x0=items, cg_iters=cg, bf16_gather=params.bf16_gather,
+            x0=items, cg_iters=cg_i, bf16_gather=params.bf16_gather,
         )
         return (users, items), None
 
@@ -433,7 +460,10 @@ def als_train_sharded(
     cs = min(params.chunk_slots, _slots_for(max(u_nnz, i_nnz), 0, params.width, 1))
     su = _slots_for(u_nnz, ub, params.width, cs)
     si = _slots_for(i_nnz, ib, params.width, cs)
-    cg = params.resolved_cg_iters()
+    # each device solves its LOCAL block of rows, so the auto exact-vs-CG
+    # decision keys on the per-device batch size
+    cg_u = params.resolved_cg_iters(ub)
+    cg_i = params.resolved_cg_iters(ib)
 
     dev_spec = P(DATA_AXIS)  # leading axis = device blocks
 
@@ -460,14 +490,14 @@ def als_train_sharded(
             users = _solve_factors(
                 by_user, all_items, ub,
                 params.reg, params.implicit, params.alpha, cs,
-                x0=users, cg_iters=cg,
+                x0=users, cg_iters=cg_u,
                 bf16_gather=params.bf16_gather,
             )
             all_users = jax.lax.all_gather(users, DATA_AXIS, tiled=True)
             items = _solve_factors(
                 by_item, all_users, ib,
                 params.reg, params.implicit, params.alpha, cs,
-                x0=items, cg_iters=cg,
+                x0=items, cg_iters=cg_i,
                 bf16_gather=params.bf16_gather,
             )
             return (users, items), None

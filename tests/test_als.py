@@ -105,22 +105,68 @@ def test_sharded_implicit_nondivisible_matches():
 
 def test_high_rank_cg_matches_cholesky():
     """Rank 64 (the BASELINE.md bench rank, and the MLlib-template range
-    50-100): the auto CG solve must reach direct-Cholesky quality — the
-    round-1 cap of min(2*rank, 40) sat below the rank-k Krylov bound and
-    quietly under-converged exactly here."""
+    50-100): the default short warm-started CG solve must reach
+    direct-Cholesky quality. The cap is deliberately far below the rank-k
+    Krylov bound — CG convergence is set by conditioning, not k, and the
+    warm start carries convergence across sweeps (measured at ML-20M:
+    equal-or-better heldout RMSE at 2.7x the training rate) — so THIS
+    equal-quality assertion, not the cap size, is the contract."""
     users, items, vals, nu, ni = synthetic(
         n_users=300, n_items=200, rank=8, density=0.4)
-    p_cg = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096,
-                     cg_iters=-1)
-    assert p_cg.resolved_cg_iters() >= 2 * 64
+    # at 300/200 rows BOTH sides of auto resolve to the exact solver, so
+    # auto must match an explicit cg_iters=0 train exactly (dispatch
+    # wiring test); the short-CG quality contract lives in
+    # test_short_cg_quality_on_noisy_data, on data where CG actually runs
+    p_auto = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096,
+                       cg_iters=-1)
+    assert p_auto.resolved_cg_iters(nu) == 0
     p_direct = ALSParams(rank=64, iterations=6, reg=0.1, chunk=4096,
                          cg_iters=0)
-    m_cg = als_train(users, items, vals, nu, ni, p_cg)
+    m_auto = als_train(users, items, vals, nu, ni, p_auto)
     m_direct = als_train(users, items, vals, nu, ni, p_direct)
-    e_cg = rmse(m_cg, users, items, vals)
-    e_direct = rmse(m_direct, users, items, vals)
-    # equal-quality contract: CG within 2% relative of the exact solve
-    assert e_cg < e_direct * 1.02 + 1e-4, (e_cg, e_direct)
+    np.testing.assert_allclose(
+        np.asarray(m_auto.user_factors), np.asarray(m_direct.user_factors),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_auto_solver_dispatch_per_side():
+    """auto (-1) picks exact Cholesky for small row batches and the short
+    CG cap for large ones; explicit settings pass through."""
+    p = ALSParams(rank=64)
+    assert p.resolved_cg_iters(300) == 0            # small side: exact
+    assert p.resolved_cg_iters(8192) == 0           # at threshold: exact
+    assert p.resolved_cg_iters(138_493) == 16       # large side: CG cap
+    assert ALSParams(rank=256).resolved_cg_iters(100_000) == 64
+    assert p.resolved_cg_iters(None) == 16          # unknown size: CG cap
+    assert ALSParams(rank=64, cg_iters=0).resolved_cg_iters(1 << 20) == 0
+    assert ALSParams(rank=64, cg_iters=7).resolved_cg_iters(10) == 7
+
+
+def test_short_cg_quality_on_noisy_data():
+    """The short CG cap (16 at rank 64) must hold heldout quality on NOISY
+    data — the realistic regime the large-side auto dispatch runs in
+    (measured at ML-20M: CG heldout RMSE 1.310 vs Cholesky 1.352). On
+    noiseless interpolation problems exact wins, which is why auto keeps
+    Cholesky for small sides."""
+    rng = np.random.default_rng(3)
+    nu, ni, sig_rank = 500, 300, 8
+    U = rng.normal(size=(nu, sig_rank)) / np.sqrt(sig_rank)
+    V = rng.normal(size=(ni, sig_rank)) / np.sqrt(sig_rank)
+    mask = rng.random((nu, ni)) < 0.25
+    users, items = np.nonzero(mask)
+    vals = (U @ V.T + 3.0)[users, items] + rng.normal(
+        scale=0.3, size=len(users))
+    vals = vals.astype(np.float32)
+    hold = rng.random(len(vals)) < 0.1
+    tr = ~hold
+    kw = dict(rank=64, iterations=6, reg=0.1, chunk=4096)
+    m_cg = als_train(users[tr], items[tr], vals[tr], nu, ni,
+                     ALSParams(**kw, cg_iters=16))
+    m_ch = als_train(users[tr], items[tr], vals[tr], nu, ni,
+                     ALSParams(**kw, cg_iters=0))
+    e_cg = rmse(m_cg, users[hold], items[hold], vals[hold])
+    e_ch = rmse(m_ch, users[hold], items[hold], vals[hold])
+    assert e_cg < e_ch * 1.02 + 1e-4, (e_cg, e_ch)
 
 
 def test_high_rank_cg_matches_cholesky_implicit():
@@ -131,8 +177,10 @@ def test_high_rank_cg_matches_cholesky_implicit():
     vals = rng.integers(1, 6, 6000).astype(np.float32)
     kw = dict(rank=64, iterations=4, reg=0.05, alpha=10.0, implicit=True,
               chunk=4096)
+    # explicit cg_iters=16 (the large-side auto cap): at 250/150 rows auto
+    # would pick the exact solver, which would make this test vacuous
     m_cg = als_train(users, items, vals, nu, ni,
-                     ALSParams(**kw, cg_iters=-1))
+                     ALSParams(**kw, cg_iters=16))
     m_direct = als_train(users, items, vals, nu, ni,
                          ALSParams(**kw, cg_iters=0))
     # factors from equal-quality solves produce near-identical preference
