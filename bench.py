@@ -232,8 +232,10 @@ def phase_train() -> dict:
     # rates must not masquerade as measurements
     sweep_s = (dt - dt1) / max(iters - 1, 1) if dt > dt1 else None
     p = ALSParams(rank=RANK)
-    # auto dispatch is per-side; report the large (user) side's choice
-    cg = p.resolved_cg_iters(n_users)
+    # MUST match run_als's pin: the solver is resolved against the FULL
+    # bench shape (N_USERS) even when this phase runs a scaled-down CPU
+    # proxy, so the reported cg/FLOPs describe the solver that actually ran
+    cg = p.resolved_cg_iters(N_USERS)
     # padded nnz is what the kernel actually crunches
     nnz_pad = nnz + (-nnz % CHUNK)
     fl = als_flops_per_sweep(nnz_pad, n_users, n_items, RANK, cg)

@@ -23,6 +23,7 @@ from pio_tpu.controller.base import (
     DataSource,
     FirstServing,
     IdentityPreparator,
+    P2LAlgorithm,
     PAlgorithm,
     Params,
 )
@@ -35,7 +36,7 @@ from pio_tpu.models.filtering import (
     rank_candidates,
 )
 from pio_tpu.ops import als
-from pio_tpu.ops.similarity import cosine_topk, mean_vector
+from pio_tpu.ops.similarity import column_cosine_topk, cosine_topk, mean_vector
 
 
 @dataclass(frozen=True)
@@ -108,10 +109,27 @@ class SimilarProductModel:
         return cls(children[0], *aux)
 
     def cat_index(self) -> dict:
-        """category -> [item ids], built lazily once per model."""
-        if not hasattr(self, "_cat_index"):
-            self._cat_index = invert_categories(self.item_categories)
-        return self._cat_index
+        return _cached_cat_index(self)
+
+
+def _parse_similar_query(items_index, query: dict):
+    """Shared query parsing for the similarproduct algorithms (reference
+    predict() preamble: item->index map, white/black lists, query items
+    always excluded from results)."""
+    items = query.get("items") or []
+    num = int(query.get("num", 10))
+    known = [i for i in items if i in items_index]
+    exclude = set(items) | set(query.get("blackList") or ())
+    white = set(query.get("whiteList") or ()) or None
+    categories = set(query.get("categories") or ()) or None
+    return num, known, exclude, white, categories
+
+
+def _cached_cat_index(model) -> dict:
+    """category -> [item ids], built lazily once per model instance."""
+    if not hasattr(model, "_cat_index"):
+        model._cat_index = invert_categories(model.item_categories)
+    return model._cat_index
 
 
 class ALSSimilarityAlgorithm(PAlgorithm):
@@ -147,16 +165,12 @@ class ALSSimilarityAlgorithm(PAlgorithm):
         """Reference ALSAlgorithm.predict: average query-item vectors,
         cosine top-k over the catalog, filter query items / categories /
         white / black lists."""
-        items = query.get("items") or []
-        num = int(query.get("num", 10))
-        known = [i for i in items if i in model.items]
+        num, known, exclude, white, categories = \
+            _parse_similar_query(model.items, query)
         if not known:
             return {"itemScores": []}
         q_idx = model.items.encode(known)
         qv = mean_vector(model.item_factors, q_idx)
-        exclude = set(items) | set(query.get("blackList") or ())
-        white = set(query.get("whiteList") or ()) or None
-        categories = set(query.get("categories") or ()) or None
         candidates = candidate_ids(
             model.items, model.item_categories, white, categories, exclude,
             cat_index=model.cat_index,
@@ -188,12 +202,97 @@ class ALSSimilarityAlgorithm(PAlgorithm):
         return {"itemScores": out}
 
 
+@dataclass(frozen=True)
+class DIMSUMParams(Params):
+    """Reference DIMSUMAlgorithmParams(threshold)
+    (examples/experimental/scala-parallel-similarproduct-dimsum/src/main/
+    scala/DIMSUMAlgorithm.scala:22). `k_sim` bounds the neighbor table
+    kept per item (the reference keeps full sparse similarity rows; a
+    top-k table is the fixed-shape equivalent)."""
+
+    threshold: float = 0.0
+    k_sim: int = 50
+    user_batch: int = 4096
+
+
+@dataclass
+class DIMSUMModel:
+    """Top-k item-to-item cosine table over the RAW interaction matrix
+    (reference DIMSUMModel.similarities sparse rows)."""
+
+    sim_scores: np.ndarray      # (n_items, k_sim) cosine scores
+    sim_idx: np.ndarray         # (n_items, k_sim) neighbor item indices
+    items: EntityIdIndex
+    item_categories: dict
+
+    def cat_index(self) -> dict:
+        return _cached_cat_index(self)
+
+
+class DIMSUMAlgorithm(P2LAlgorithm):
+    """Exact all-pairs column cosine (ops/similarity.column_cosine_topk) —
+    the TPU redesign of MLlib RowMatrix.columnSimilarities(threshold)
+    (DIMSUMAlgorithm.scala:125-132). Unlike the ALS algorithm this scores
+    items by raw co-occurrence, no factorization. P2L: device-heavy train,
+    small host model (the reference persists its RDD rows; the top-k table
+    checkpoints whole)."""
+
+    params_class = DIMSUMParams
+
+    def __init__(self, params: DIMSUMParams = DIMSUMParams()):
+        self.params = params
+
+    def train(self, ctx, data: SimilarProductData) -> DIMSUMModel:
+        data.sanity_check()
+        inter = data.interactions
+        p = self.params
+        scores, idx = column_cosine_topk(
+            inter.user_idx, inter.item_idx, inter.values,
+            inter.n_users, inter.n_items,
+            k=p.k_sim, threshold=p.threshold, user_batch=p.user_batch,
+        )
+        return DIMSUMModel(scores, idx, inter.items, data.item_categories)
+
+    def predict(self, model: DIMSUMModel, query: dict) -> dict:
+        """Reference DIMSUMAlgorithm.predict: union the query items'
+        similarity rows, sum scores per candidate, filter query items /
+        white / black lists, top num."""
+        num, known, exclude, white, categories = \
+            _parse_similar_query(model.items, query)
+        if not known:
+            return {"itemScores": []}
+        q_idx = model.items.encode(known)
+        agg: dict[int, float] = {}
+        for qi in q_idx:
+            for j, s in zip(model.sim_idx[qi], model.sim_scores[qi]):
+                if s > 0:
+                    agg[int(j)] = agg.get(int(j), 0.0) + float(s)
+        # filter semantics shared with the ALS path (filtering.py): when a
+        # selective filter is present, membership comes from candidate_ids
+        allowed = candidate_ids(
+            model.items, model.item_categories, white, categories, exclude,
+            cat_index=model.cat_index,
+        )
+        allowed = None if allowed is None else set(allowed)
+        out = []
+        for j, s in sorted(agg.items(), key=lambda kv: (-kv[1], kv[0])):
+            iid = model.items.id_of(j)
+            if iid in exclude:
+                continue
+            if allowed is not None and iid not in allowed:
+                continue
+            out.append({"item": iid, "score": s})
+            if len(out) >= num:
+                break
+        return {"itemScores": out}
+
+
 class SimilarProductEngine(EngineFactory):
     @classmethod
     def apply(cls) -> Engine:
         return Engine(
             SimilarProductDataSource,
             IdentityPreparator,
-            {"als": ALSSimilarityAlgorithm},
+            {"als": ALSSimilarityAlgorithm, "dimsum": DIMSUMAlgorithm},
             FirstServing,
         )

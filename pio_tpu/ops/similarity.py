@@ -45,3 +45,122 @@ def mean_vector(matrix: jax.Array, indices: np.ndarray) -> jax.Array:
     """Average of the given rows — the similarproduct query combiner
     (reference ALSAlgorithm.scala: sum of query-item feature vectors)."""
     return jnp.mean(matrix[jnp.asarray(indices)], axis=0, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("n_items_pad", "user_batch", "k"))
+def _column_cosine_topk_jit(u_local, i_b, v_b, n_items_pad: int,
+                            user_batch: int, k: int, threshold):
+    """Exact all-pairs column cosine + top-k on device.
+
+    G = M^T M for the column-normalized user x item matrix M, accumulated
+    as one (I,B)x(B,I) matmul per user batch: a lax.scan scatters each
+    batch's pre-bucketed COO slice (host-grouped, so total scatter work is
+    O(nnz)) into a dense strip, casts to bf16, and feeds the MXU with f32
+    accumulation. Then diagonal masked, sub-threshold entries zeroed (the
+    DIMSUM `threshold` contract: entries below it are not guaranteed),
+    top-k per row.
+
+    u_local/i_b/v_b: (n_batches, L) with sentinel-padded entries that drop
+    out of range on every scatter.
+
+    Normalization comes from the accumulated Gram's own diagonal (the true
+    column norms AFTER duplicate (user, item) entries have summed in the
+    scatter) — pre-normalizing raw COO values would over-count columns
+    with duplicate entries."""
+
+    def body(G, xs):
+        ul, ib, vb = xs
+        D = jnp.zeros((user_batch, n_items_pad), jnp.float32)
+        D = D.at[ul, ib].add(vb, mode="drop")
+        Db = D.astype(jnp.bfloat16)
+        G = G + jnp.einsum(
+            "bi,bj->ij", Db, Db, preferred_element_type=jnp.float32
+        )
+        return G, None
+
+    G0 = jnp.zeros((n_items_pad, n_items_pad), jnp.float32)
+    G, _ = jax.lax.scan(body, G0, (u_local, i_b, v_b))
+    d = jnp.diagonal(G)
+    inv = jnp.where(d > 0, jax.lax.rsqrt(jnp.maximum(d, 1e-30)), 0.0)
+    G = G * inv[:, None] * inv[None, :]
+    G = jnp.where(G >= threshold, G, 0.0)
+    # self-similarity must never rank
+    G = jnp.where(jnp.eye(n_items_pad, dtype=bool), -1e9, G)
+    return jax.lax.top_k(G, k)
+
+
+def column_cosine_topk(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    values: np.ndarray,
+    n_users: int,
+    n_items: int,
+    k: int,
+    threshold: float = 0.0,
+    user_batch: int = 4096,
+    chunk: int = 65536,
+):
+    """All-pairs item-to-item cosine over the raw interaction matrix — the
+    TPU answer to MLlib `RowMatrix.columnSimilarities(threshold)` as used
+    by the reference DIMSUM similarproduct template
+    (examples/experimental/scala-parallel-similarproduct-dimsum/src/main/
+    scala/DIMSUMAlgorithm.scala:125-132).
+
+    DIMSUM's oversampling/threshold scheme exists to bound Spark shuffle
+    traffic; on TPU the co-occurrence Gram matrix is a dense bf16 matmul
+    stream (2*n_users*n_items^2 FLOPs on the MXU — ~200 TFLOP at the
+    ML-20M shape, measured 7.4s warm on one v5e), so the EXACT similarities are
+    computed and `threshold` is honored only as the reference's contract
+    knob (entries below it zero). Memory bound: the f32 Gram matrix is
+    (n_items^2), so catalogs up to ~50k items fit a 16GB chip; larger
+    catalogs should use the ALS-factor cosine path (`cosine_topk`), which
+    is rank-compressed.
+
+    Returns (scores, idx): (n_items, k) host arrays, k-nearest per item.
+    """
+    n_items_pad = max(256, -(-n_items // 256) * 256)
+    k = max(1, min(int(k), n_items - 1))
+    k_bucket = min(n_items_pad, 1 << (k - 1).bit_length())
+
+    u = np.ascontiguousarray(user_idx, dtype=np.int64)
+    i = np.ascontiguousarray(item_idx, dtype=np.int32)
+    v = np.ascontiguousarray(values, dtype=np.float32)
+
+    # group the COO by user batch on host so each scan step scatters only
+    # its own slice — total scatter work stays O(nnz), not
+    # O(nnz * n_batches). Skewed batches waste padding; widening the batch
+    # evens them out (bounded so the dense strip stays ~<=2GB).
+    while True:
+        n_batches = max(1, -(-n_users // user_batch))
+        counts = np.bincount(u // user_batch, minlength=n_batches)
+        L = -(-int(counts.max()) // max(1, chunk)) * max(1, chunk)
+        # stop once: padding waste is bounded, OR widening cannot help any
+        # more (single batch / batch >= n_users), OR the dense strip would
+        # exceed ~2GB. L is floored at `chunk`, so the waste bound alone
+        # would otherwise escalate tiny inputs to the memory cap.
+        if (n_batches * L <= 4 * max(len(u), 1)
+                or n_batches == 1
+                or user_batch >= n_users
+                or user_batch * n_items_pad >= 1 << 29):
+            break
+        user_batch *= 2
+
+    order = np.argsort(u // user_batch, kind="stable")
+    u, i, v = u[order], i[order], v[order]
+    starts = np.zeros(n_batches + 1, np.int64)
+    np.cumsum(np.bincount(u // user_batch, minlength=n_batches),
+              out=starts[1:])
+    u_b = np.full((n_batches, L), user_batch, np.int32)   # sentinel: OOB row
+    i_b = np.full((n_batches, L), n_items_pad, np.int32)  # sentinel: OOB col
+    v_b = np.zeros((n_batches, L), np.float32)
+    for b in range(n_batches):
+        s, e = starts[b], starts[b + 1]
+        u_b[b, : e - s] = u[s:e] - b * user_batch
+        i_b[b, : e - s] = i[s:e]
+        v_b[b, : e - s] = v[s:e]
+
+    scores, idx = _column_cosine_topk_jit(
+        jnp.asarray(u_b), jnp.asarray(i_b), jnp.asarray(v_b),
+        n_items_pad, user_batch, k_bucket, jnp.float32(threshold),
+    )
+    return np.asarray(scores)[:n_items, :k], np.asarray(idx)[:n_items, :k]
