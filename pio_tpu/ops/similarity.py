@@ -47,9 +47,11 @@ def mean_vector(matrix: jax.Array, indices: np.ndarray) -> jax.Array:
     return jnp.mean(matrix[jnp.asarray(indices)], axis=0, keepdims=True)
 
 
-@partial(jax.jit, static_argnames=("n_items_pad", "user_batch", "k"))
-def _column_cosine_topk_jit(u_local, i_b, v_b, n_items_pad: int,
-                            user_batch: int, k: int, threshold):
+@partial(jax.jit, static_argnames=("n_items", "n_items_pad", "user_batch",
+                                   "k"))
+def _column_cosine_topk_jit(u_local, i_b, v_b, n_items: int,
+                            n_items_pad: int, user_batch: int, k: int,
+                            threshold):
     """Exact all-pairs column cosine + top-k on device.
 
     G = M^T M for the column-normalized user x item matrix M, accumulated
@@ -84,8 +86,12 @@ def _column_cosine_topk_jit(u_local, i_b, v_b, n_items_pad: int,
     inv = jnp.where(d > 0, jax.lax.rsqrt(jnp.maximum(d, 1e-30)), 0.0)
     G = G * inv[:, None] * inv[None, :]
     G = jnp.where(G >= threshold, G, 0.0)
-    # self-similarity must never rank
-    G = jnp.where(jnp.eye(n_items_pad, dtype=bool), -1e9, G)
+    # self-similarity and padding columns must never rank: padded ids
+    # would decode out of range in callers that trust the idx contract
+    mask = jnp.eye(n_items_pad, dtype=bool) | (
+        jnp.arange(n_items_pad)[None, :] >= n_items
+    )
+    G = jnp.where(mask, -1e9, G)
     return jax.lax.top_k(G, k)
 
 
@@ -161,6 +167,6 @@ def column_cosine_topk(
 
     scores, idx = _column_cosine_topk_jit(
         jnp.asarray(u_b), jnp.asarray(i_b), jnp.asarray(v_b),
-        n_items_pad, user_batch, k_bucket, jnp.float32(threshold),
+        n_items, n_items_pad, user_batch, k_bucket, jnp.float32(threshold),
     )
     return np.asarray(scores)[:n_items, :k], np.asarray(idx)[:n_items, :k]

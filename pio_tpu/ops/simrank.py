@@ -1,0 +1,93 @@
+"""SimRank similarity as dense MXU iteration.
+
+Replaces the reference friend-recommendation template's Delta-SimRank over
+GraphX (examples/experimental/scala-parallel-friend-recommendation/src/main/
+scala/DeltaSimRankRDD.scala): there each iteration materializes RDD deltas
+over in-neighbor cartesian products and reduces by key — a shuffle per
+iteration. The TPU formulation is the closed matrix recurrence
+
+    S_{t+1} = decay * W^T S_t W,   diag(S) := 1
+
+with W the in-neighbor-normalized adjacency (W[i,j] = A[i,j]/indeg(j)):
+two dense (n,n) matmuls per iteration on the MXU, no shuffles, no deltas.
+The reference's delta trick exists because Spark pays per-pair traffic;
+here the full n^2 state is a resident HBM buffer (n <= ~16k nodes on a
+16GB chip — beyond that, sample the graph first: the reference ships node
+and forest-fire sampling datasources for exactly this reason, mirrored in
+models/friendrecommendation.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n_pad", "iterations"))
+def _simrank_jit(src, dst, n_pad: int, iterations: int, decay):
+    """Dense SimRank: build W from COO edges, iterate the recurrence."""
+    A = jnp.zeros((n_pad, n_pad), jnp.float32)
+    A = A.at[src, dst].add(1.0, mode="drop")
+    A = jnp.minimum(A, 1.0)            # parallel edges count once
+    indeg = A.sum(axis=0)              # in-degree of each dst column
+    W = A * jnp.where(indeg > 0, 1.0 / jnp.maximum(indeg, 1.0), 0.0)[None, :]
+    Wb = W.astype(jnp.bfloat16)
+    eye = jnp.eye(n_pad, dtype=bool)
+
+    def body(_, S):
+        # decay * W^T S W, then pin the diagonal back to 1
+        T = jnp.einsum(
+            "ij,ik->jk", Wb, S.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )  # W^T S
+        S = decay * jnp.einsum(
+            "ij,jk->ik", T.astype(jnp.bfloat16), Wb,
+            preferred_element_type=jnp.float32,
+        )  # (W^T S) W
+        return jnp.where(eye, 1.0, S)
+
+    S0 = jnp.eye(n_pad, dtype=jnp.float32)
+    return jax.lax.fori_loop(0, iterations, body, S0)
+
+
+def simrank_scores(
+    src: np.ndarray,
+    dst: np.ndarray,
+    n_nodes: int,
+    decay: float = 0.8,
+    iterations: int = 5,
+) -> np.ndarray:
+    """-> (n_nodes, n_nodes) SimRank matrix (host numpy).
+
+    decay/iterations mirror the reference SimRankParams
+    (SimRankAlgorithm.scala:10-12; DeltaSimRankRDD.decay default 0.8)."""
+    if n_nodes <= 0:
+        return np.zeros((0, 0), np.float32)
+    n_pad = max(128, -(-n_nodes // 128) * 128)
+    s = np.ascontiguousarray(src, dtype=np.int32)
+    d = np.ascontiguousarray(dst, dtype=np.int32)
+    S = _simrank_jit(
+        jnp.asarray(s), jnp.asarray(d), n_pad, int(iterations),
+        jnp.float32(decay),
+    )
+    return np.asarray(S)[:n_nodes, :n_nodes]
+
+
+def simrank_topk(S: np.ndarray, k: int):
+    """Top-k most similar nodes per node, self excluded.
+    Returns (scores, idx): (n, k)."""
+    n = S.shape[0]
+    if n == 0:
+        return np.zeros((0, 0), np.float32), np.zeros((0, 0), np.int64)
+    k = max(1, min(int(k), n - 1))
+    M = S.copy()
+    np.fill_diagonal(M, -np.inf)
+    idx = np.argpartition(-M, k - 1, axis=1)[:, :k]
+    part = np.take_along_axis(M, idx, axis=1)
+    order = np.argsort(-part, axis=1, kind="stable")
+    idx = np.take_along_axis(idx, order, axis=1)
+    scores = np.take_along_axis(part, order, axis=1)
+    return scores.astype(np.float32), idx

@@ -86,6 +86,17 @@ def test_column_cosine_empty_columns_are_silent():
         assert not (idx[col][pos] == 3).any()
 
 
+def test_column_cosine_idx_never_exceeds_catalog():
+    """Padded Gram columns must never leak into idx (callers decode idx
+    against an n_items-sized id array): an item whose similarities are all
+    zero still gets in-range neighbor indices."""
+    u = np.array([0, 1], np.int64)
+    i = np.array([0, 1], np.int64)  # items 0,1 never co-occur; 2 is empty
+    v = np.ones(2, np.float32)
+    scores, idx = column_cosine_topk(u, i, v, 2, 3, k=2)
+    assert (idx < 3).all(), idx
+
+
 def test_column_cosine_identical_columns_score_one():
     # items 0 and 1 have identical user sets -> cosine 1
     u = np.array([0, 0, 1, 1, 2, 2], np.int32)

@@ -1,0 +1,163 @@
+"""SimRank kernel + friend-recommendation engine tests (reference
+examples/experimental/scala-parallel-friend-recommendation)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pio_tpu.models.friendrecommendation import (
+    DataSourceParams,
+    FriendGraph,
+    FriendGraphDataSource,
+    SimRankAlgorithm,
+    SimRankParams,
+    forest_fire_sample,
+    node_sample,
+)
+from pio_tpu.data.bimap import EntityIdIndex
+from pio_tpu.ops.simrank import simrank_scores, simrank_topk
+
+
+def naive_simrank(src, dst, n, decay, iterations):
+    """Direct per-definition SimRank in float64: s(a,b) =
+    decay/(|I(a)||I(b)|) * sum over in-neighbor pairs; s(a,a)=1."""
+    in_nbrs = [[] for _ in range(n)]
+    for s, d in zip(src, dst):
+        if s not in in_nbrs[d]:
+            in_nbrs[d].append(s)
+    S = np.eye(n)
+    for _ in range(iterations):
+        S2 = np.zeros_like(S)
+        for a in range(n):
+            for b in range(n):
+                if a == b:
+                    S2[a, b] = 1.0
+                    continue
+                Ia, Ib = in_nbrs[a], in_nbrs[b]
+                if not Ia or not Ib:
+                    continue
+                acc = sum(S[i, j] for i in Ia for j in Ib)
+                S2[a, b] = decay * acc / (len(Ia) * len(Ib))
+        S = S2
+    return S
+
+
+def test_simrank_matches_naive_definition():
+    rng = np.random.default_rng(0)
+    n, e = 25, 80
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    S = simrank_scores(src, dst, n, decay=0.8, iterations=5)
+    ref = naive_simrank(src, dst, n, 0.8, 5)
+    np.testing.assert_allclose(S, ref, atol=2e-2)  # bf16 matmul tolerance
+
+
+def test_simrank_symmetric_structure():
+    # two nodes followed by the same people are maximally similar
+    # 0 and 1 are both followed by 2, 3, 4
+    src = np.array([2, 3, 4, 2, 3, 4])
+    dst = np.array([0, 0, 0, 1, 1, 1])
+    S = simrank_scores(src, dst, 5, decay=0.8, iterations=5)
+    # s(0,1) = decay * mean pairwise sim of in-neighbors {2,3,4}; those
+    # have no in-neighbors so only the 3 diagonal s(i,i)=1 terms survive:
+    # 0.8 * 3/9
+    assert S[0, 1] == pytest.approx(0.8 / 3, abs=2e-2)
+    assert S[0, 1] == pytest.approx(S[1, 0], abs=1e-3)
+    # no shared in-neighbors with 2 -> 0
+    assert S[0, 2] == pytest.approx(0.0, abs=1e-3)
+
+
+def test_simrank_no_in_neighbors_scores_zero():
+    src = np.array([0])
+    dst = np.array([1])
+    S = simrank_scores(src, dst, 3, iterations=3)
+    assert S[1, 2] == 0.0 and S[0, 2] == 0.0
+    assert S[0, 0] == 1.0
+
+
+def test_simrank_topk_excludes_self():
+    src = np.array([2, 3, 2, 3, 4])
+    dst = np.array([0, 0, 1, 1, 1])
+    S = simrank_scores(src, dst, 5, iterations=4)
+    scores, idx = simrank_topk(S, 3)
+    for i in range(5):
+        assert i not in idx[i]
+
+
+def test_node_sampling_induces_subgraph():
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 100, 400)
+    dst = rng.integers(0, 100, 400)
+    s2, d2 = node_sample(src, dst, 100, 0.4, seed=7)
+    assert len(s2) < len(src)
+    kept_nodes = set(s2) | set(d2)
+    # induced: every surviving edge has both endpoints kept
+    assert kept_nodes <= set(range(100))
+
+
+def test_forest_fire_sampling_hits_target_fraction():
+    rng = np.random.default_rng(2)
+    src = rng.integers(0, 200, 1200)
+    dst = rng.integers(0, 200, 1200)
+    s2, d2 = forest_fire_sample(src, dst, 200, 0.3, 0.3, seed=3)
+    kept = set(s2) | set(d2)
+    assert len(s2) < len(src)
+    assert len(kept) <= 200
+
+
+def test_sampling_shrinks_node_index(tmp_path):
+    """Sampling exists so the n^2 SimRank state fits the chip — the node
+    index must shrink with the sampled subgraph, not keep dead nodes."""
+    rng = np.random.default_rng(4)
+    lines = [f"{rng.integers(0, 500)} {rng.integers(0, 500)}"
+             for _ in range(2000)]
+    path = tmp_path / "edges.txt"
+    path.write_text("\n".join(lines))
+    ds = FriendGraphDataSource(DataSourceParams(
+        graph_edgelist_path=str(path), sample_method="node",
+        sample_fraction=0.2, seed=1))
+    g = ds.read_training(None)
+    assert 0 < len(g.nodes) < 250  # ~20% of 500 survive
+    assert g.src.max() < len(g.nodes) and g.dst.max() < len(g.nodes)
+    # trains on the small matrix and answers queries for surviving ids
+    model = SimRankAlgorithm(SimRankParams(num_iterations=2)).train(None, g)
+    assert model.pair_scores.shape == (len(g.nodes), len(g.nodes))
+
+
+def test_engine_pairwise_and_retrieval_queries(tmp_path):
+    """Both query shapes through the algorithm, edge-list-file datasource
+    (reference GraphLoader.edgeListFile contract incl. # comments)."""
+    path = tmp_path / "edges.txt"
+    path.write_text(
+        "# comment line\n"
+        "2 0\n3 0\n4 0\n"
+        "2 1\n3 1\n4 1\n"
+        "0 5\n1 5\n"
+    )
+    ds = FriendGraphDataSource(
+        DataSourceParams(graph_edgelist_path=str(path)))
+    graph = ds.read_training(None)
+    assert len(graph.src) == 8
+    algo = SimRankAlgorithm(SimRankParams(num_iterations=5, decay=0.8))
+    model = algo.train(None, graph)
+    # "0" and "1" share all in-neighbors {2,3,4}, which themselves have
+    # no in-neighbors -> converged s(0,1) = 0.8 * 3/9 (see symmetric test)
+    r = algo.predict(model, {"item1": "0", "item2": "1"})
+    assert r["score"] == pytest.approx(0.8 / 3, abs=2e-2)
+    r2 = algo.predict(model, {"user": "0", "num": 3})
+    friends = [f["friend"] for f in r2["friendScores"]]
+    assert friends and friends[0] == "1"
+    # unknown ids are graceful
+    assert algo.predict(model, {"item1": "0", "item2": "zz"}) == \
+        {"score": 0.0}
+    assert algo.predict(model, {"user": "zz"}) == {"friendScores": []}
+
+
+def test_engine_empty_graph_raises():
+    g = FriendGraph(np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    EntityIdIndex([]))
+    with pytest.raises(ValueError, match="no edges"):
+        SimRankAlgorithm().train(None, g)
