@@ -34,10 +34,12 @@
 // scans for exclusion (the log itself is immutable).
 
 #include <cctype>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <random>
 #include <string>
 #include <type_traits>
 #include <vector>
@@ -400,6 +402,830 @@ bool json_top_level_number(const uint8_t* js, uint32_t n, const char* key,
 // C API
 // ---------------------------------------------------------------------------
 
+
+// el_append is defined in the extern "C" block below; the ingest path
+// (anonymous namespace) needs it early.
+extern "C" int64_t el_append(void* h, const uint8_t* payload, uint32_t len);
+
+namespace {
+// ---------------------------------------------------------------------------
+// ingest fast path: JSON event parsing + validation + packing, all in C++
+// (the Python pipeline tops out ~48k events/s; the per-event cost there is
+// spread over json.loads, dataclass construction, datetime parsing, uuid4
+// and copy-on-insert — this path goes straight from the HTTP body bytes to
+// framed log records)
+// ---------------------------------------------------------------------------
+
+struct JStr {
+  const uint8_t* p = nullptr;  // raw span INSIDE the quotes (escapes intact)
+  uint32_t n = 0;
+  bool esc = false;
+};
+
+struct JVal {
+  enum Kind { kNull, kBool, kNum, kStr, kObj, kArr } kind = kNull;
+  JStr str;                    // valid when kind == kStr
+  const uint8_t* raw = nullptr;  // full value span (any kind)
+  uint32_t raw_n = 0;
+};
+
+// Decode a JSON string span (escapes included) to UTF-8.
+bool json_unescape(const JStr& s, std::string* out) {
+  out->clear();
+  if (!s.esc) {
+    out->assign(reinterpret_cast<const char*>(s.p), s.n);
+    return true;
+  }
+  out->reserve(s.n);
+  const uint8_t* p = s.p;
+  const uint8_t* end = s.p + s.n;
+  auto hex4 = [&](const uint8_t* q, uint32_t* v) {
+    *v = 0;
+    for (int k = 0; k < 4; k++) {
+      uint8_t c = q[k];
+      uint32_t d;
+      if (c >= '0' && c <= '9') d = c - '0';
+      else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+      else return false;
+      *v = (*v << 4) | d;
+    }
+    return true;
+  };
+  auto put_utf8 = [&](uint32_t cp) {
+    if (cp < 0x80) {
+      out->push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  };
+  while (p < end) {
+    if (*p != '\\') {
+      out->push_back(static_cast<char>(*p++));
+      continue;
+    }
+    if (p + 1 >= end) return false;
+    uint8_t c = p[1];
+    p += 2;
+    switch (c) {
+      case '"': out->push_back('"'); break;
+      case '\\': out->push_back('\\'); break;
+      case '/': out->push_back('/'); break;
+      case 'b': out->push_back('\b'); break;
+      case 'f': out->push_back('\f'); break;
+      case 'n': out->push_back('\n'); break;
+      case 'r': out->push_back('\r'); break;
+      case 't': out->push_back('\t'); break;
+      case 'u': {
+        if (p + 4 > end) return false;
+        uint32_t cp;
+        if (!hex4(p, &cp)) return false;
+        p += 4;
+        if (cp >= 0xD800 && cp <= 0xDBFF && p + 6 <= end && p[0] == '\\' &&
+            p[1] == 'u') {
+          uint32_t lo;
+          if (!hex4(p + 2, &lo)) return false;
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            p += 6;
+          }
+        }
+        put_utf8(cp);
+        break;
+      }
+      default: return false;
+    }
+  }
+  return true;
+}
+
+// Minimal recursive-descent JSON parser producing spans.
+struct JParser {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  explicit JParser(const uint8_t* data, uint32_t n) : p(data), end(data + n) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+
+  bool string_span(JStr* out) {  // at opening quote; validates strictly
+    if (p >= end || *p != '"') return false;
+    p++;
+    out->p = p;
+    out->esc = false;
+    while (p < end) {
+      uint8_t c = *p;
+      if (c == '\\') {
+        out->esc = true;
+        if (p + 1 >= end) return false;
+        uint8_t e = p[1];
+        if (e == 'u') {
+          if (p + 6 > end) return false;
+          for (int k = 2; k < 6; k++)
+            if (!isxdigit(p[k])) return false;
+          p += 6;
+        } else if (e == '"' || e == '\\' || e == '/' || e == 'b' ||
+                   e == 'f' || e == 'n' || e == 'r' || e == 't') {
+          p += 2;
+        } else {
+          return false;  // invalid escape = malformed JSON (json.loads parity)
+        }
+        continue;
+      }
+      if (c == '"') {
+        out->n = static_cast<uint32_t>(p - out->p);
+        p++;
+        return true;
+      }
+      if (c < 0x20) return false;  // raw control chars are invalid in JSON
+      p++;
+    }
+    return false;
+  }
+
+  bool value(JVal* out) {
+    ws();
+    if (p >= end) return false;
+    out->raw = p;
+    bool ok;
+    switch (*p) {
+      case '"':
+        out->kind = JVal::kStr;
+        ok = string_span(&out->str);
+        break;
+      case '{': {
+        out->kind = JVal::kObj;
+        ok = skip_object();
+        break;
+      }
+      case '[': {
+        out->kind = JVal::kArr;
+        ok = skip_array();
+        break;
+      }
+      case 't':
+        out->kind = JVal::kBool;
+        ok = lit("true");
+        break;
+      case 'f':
+        out->kind = JVal::kBool;
+        ok = lit("false");
+        break;
+      case 'n':
+        out->kind = JVal::kNull;
+        ok = lit("null");
+        break;
+      default:
+        out->kind = JVal::kNum;
+        ok = number();
+        break;
+    }
+    if (ok) out->raw_n = static_cast<uint32_t>(p - out->raw);
+    return ok;
+  }
+
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if (p + n > end || memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  bool number() {
+    // strict JSON grammar: -?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?
+    // (liberal scanning would let e.g. leading-zero numbers into stored
+    // property spans that json.loads then rejects at read time)
+    if (p < end && *p == '-') p++;
+    if (p >= end || !isdigit(*p)) return false;
+    if (*p == '0') {
+      p++;
+    } else {
+      while (p < end && isdigit(*p)) p++;
+    }
+    if (p < end && *p == '.') {
+      p++;
+      if (p >= end || !isdigit(*p)) return false;
+      while (p < end && isdigit(*p)) p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      p++;
+      if (p < end && (*p == '+' || *p == '-')) p++;
+      if (p >= end || !isdigit(*p)) return false;
+      while (p < end && isdigit(*p)) p++;
+    }
+    return true;
+  }
+
+  bool skip_object() {  // at '{'
+    p++;
+    ws();
+    if (p < end && *p == '}') {
+      p++;
+      return true;
+    }
+    while (p < end) {
+      ws();
+      JStr key;
+      if (!string_span(&key)) return false;
+      ws();
+      if (p >= end || *p != ':') return false;
+      p++;
+      JVal v;
+      if (!value(&v)) return false;
+      ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        p++;
+        return true;
+      }
+      return false;
+    }
+    return false;
+  }
+
+  bool skip_array() {  // at '['
+    p++;
+    ws();
+    if (p < end && *p == ']') {
+      p++;
+      return true;
+    }
+    while (p < end) {
+      JVal v;
+      if (!value(&v)) return false;
+      ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        p++;
+        return true;
+      }
+      return false;
+    }
+    return false;
+  }
+
+  // Iterate an object's top-level members: cb(key, value) -> bool keep_going.
+  template <typename F>
+  bool object_members(F&& cb) {  // at '{'
+    ws();
+    if (p >= end || *p != '{') return false;
+    p++;
+    ws();
+    if (p < end && *p == '}') {
+      p++;
+      return true;
+    }
+    while (p < end) {
+      ws();
+      JStr key;
+      if (!string_span(&key)) return false;
+      ws();
+      if (p >= end || *p != ':') return false;
+      p++;
+      JVal v;
+      if (!value(&v)) return false;
+      if (!cb(key, v)) return false;
+      ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        p++;
+        return true;
+      }
+      return false;
+    }
+    return false;
+  }
+};
+
+// strict UTF-8 validation (json.loads decodes the body first; the fast
+// path must reject what it would reject, or invalid bytes get stored)
+bool valid_utf8(const uint8_t* p, uint32_t n) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint8_t c = *p;
+    if (c < 0x80) {
+      p++;
+    } else if ((c >> 5) == 0x6) {
+      if (p + 2 > end || (p[1] & 0xC0) != 0x80 || c < 0xC2) return false;
+      p += 2;
+    } else if ((c >> 4) == 0xE) {
+      if (p + 3 > end || (p[1] & 0xC0) != 0x80 || (p[2] & 0xC0) != 0x80)
+        return false;
+      uint32_t cp = ((c & 0x0F) << 12) | ((p[1] & 0x3F) << 6) | (p[2] & 0x3F);
+      if (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF)) return false;
+      p += 3;
+    } else if ((c >> 3) == 0x1E) {
+      if (p + 4 > end || (p[1] & 0xC0) != 0x80 || (p[2] & 0xC0) != 0x80 ||
+          (p[3] & 0xC0) != 0x80)
+        return false;
+      uint32_t cp = ((c & 0x07) << 18) | ((p[1] & 0x3F) << 12) |
+                    ((p[2] & 0x3F) << 6) | (p[3] & 0x3F);
+      if (cp < 0x10000 || cp > 0x10FFFF) return false;
+      p += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// days from civil (Howard Hinnant) -> days since 1970-01-01
+int64_t days_from_civil(int y, int m, int d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = static_cast<unsigned>(y - era * 400);
+  const unsigned doy = (153u * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + static_cast<int64_t>(doe) - 719468;
+}
+
+// ISO-8601 -> (micros since epoch UTC, tz offset minutes). Accepts the
+// subset datetime.fromisoformat does for the wire format: date, optional
+// [T ]HH:MM[:SS[.frac]], optional Z / +HH:MM / +HHMM / +HH. Naive = UTC
+// (utils/time.parse_time contract).
+bool parse_iso8601(const std::string& s, int64_t* us_out, int16_t* tz_out) {
+  const char* p = s.c_str();
+  const char* end = p + s.size();
+  auto digits = [&](int n, int* out) {
+    int v = 0;
+    for (int k = 0; k < n; k++) {
+      if (p >= end || !isdigit(*p)) return false;
+      v = v * 10 + (*p - '0');
+      p++;
+    }
+    *out = v;
+    return true;
+  };
+  int Y, M, D;
+  if (!digits(4, &Y)) return false;
+  if (p < end && *p == '-') p++; else return false;
+  if (!digits(2, &M)) return false;
+  if (p < end && *p == '-') p++; else return false;
+  if (!digits(2, &D)) return false;
+  if (M < 1 || M > 12 || D < 1) return false;
+  static const int kDim[12] = {31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31};
+  int dim = kDim[M - 1];
+  if (M == 2 && ((Y % 4 == 0 && Y % 100 != 0) || Y % 400 == 0)) dim = 29;
+  if (D > dim) return false;  // fromisoformat rejects calendar-invalid dates
+  int h = 0, mi = 0, sec = 0;
+  int64_t frac_us = 0;
+  int tz_min = 0;
+  bool have_tz = false;
+  if (p < end && (*p == 'T' || *p == ' ')) {
+    p++;
+    if (!digits(2, &h)) return false;
+    if (p < end && *p == ':') p++; else return false;
+    if (!digits(2, &mi)) return false;
+    if (p < end && *p == ':') {
+      p++;
+      if (!digits(2, &sec)) return false;
+      if (p < end && (*p == '.' || *p == ',')) {
+        p++;
+        int64_t scale = 100000;
+        bool any = false;
+        while (p < end && isdigit(*p)) {
+          if (scale > 0) frac_us += (*p - '0') * scale;
+          scale /= 10;
+          p++;
+          any = true;
+        }
+        if (!any) return false;
+      }
+    }
+    if (h > 23 || mi > 59 || sec > 59) return false;  // no leap-second
+    if (p < end) {
+      if (*p == 'Z' || *p == 'z') {
+        p++;
+        have_tz = true;
+        tz_min = 0;
+      } else if (*p == '+' || *p == '-') {
+        int sign = (*p == '-') ? -1 : 1;
+        p++;
+        int th, tm = 0;
+        if (!digits(2, &th)) return false;
+        if (p < end && *p == ':') p++;
+        if (p < end && isdigit(*p)) {
+          if (!digits(2, &tm)) return false;
+        }
+        // fromisoformat parity: reject offsets a python timezone() cannot
+        // represent — one accepted bad offset would poison every read of
+        // the namespace at decode time
+        if (th > 23 || tm > 59) return false;
+        tz_min = sign * (th * 60 + tm);
+        have_tz = true;
+      }
+    }
+  }
+  if (p != end) return false;
+  (void)have_tz;  // naive input is taken as UTC: tz_min stays 0
+  int64_t days = days_from_civil(Y, M, D);
+  int64_t local_us = ((days * 24 + h) * 60 + mi) * 60 + sec;
+  local_us = local_us * 1000000 + frac_us;
+  *us_out = local_us - static_cast<int64_t>(tz_min) * 60 * 1000000;
+  *tz_out = static_cast<int16_t>(tz_min);
+  return true;
+}
+
+// 32-hex-char event id (shape-compatible with uuid4().hex)
+thread_local std::mt19937_64 g_id_rng = []() {
+  std::random_device rd;
+  uint64_t seed = (static_cast<uint64_t>(rd()) << 32) ^ rd();
+  seed ^= static_cast<uint64_t>(
+      std::chrono::steady_clock::now().time_since_epoch().count());
+  seed ^= reinterpret_cast<uint64_t>(&seed);
+  return std::mt19937_64(seed);
+}();
+
+void gen_event_id(char out[33]) {
+  static const char* hexd = "0123456789abcdef";
+  uint64_t a = g_id_rng(), b = g_id_rng();
+  for (int k = 0; k < 16; k++) out[k] = hexd[(a >> (4 * k)) & 0xF];
+  for (int k = 0; k < 16; k++) out[16 + k] = hexd[(b >> (4 * k)) & 0xF];
+  out[32] = 0;
+}
+
+bool starts_with(const std::string& s, const char* pre) {
+  size_t n = strlen(pre);
+  return s.size() >= n && memcmp(s.data(), pre, n) == 0;
+}
+
+bool reserved_prefix(const std::string& s) {
+  return starts_with(s, "$") || starts_with(s, "pio_");
+}
+
+bool special_event(const std::string& s) {
+  return s == "$set" || s == "$unset" || s == "$delete";
+}
+
+struct IngestResult {
+  uint8_t status;       // 0 = created, 1 = 400, 2 = 403 (whitelist)
+  std::string id_or_msg;
+  std::string event;
+  std::string entity_type;
+};
+
+void pack_u16str(std::vector<uint8_t>* out, const std::string& s) {
+  uint16_t n = static_cast<uint16_t>(s.size());
+  out->push_back(n & 0xFF);
+  out->push_back(n >> 8);
+  out->insert(out->end(), s.begin(), s.end());
+}
+
+// Parse + validate one event object; append to the log on success.
+// Mirrors Event.from_api_dict + validate_event + the server whitelist
+// (pio_tpu/data/event.py, server/eventserver.py) — messages included.
+IngestResult ingest_one(Log* lg, JParser& jp,
+                        const std::vector<std::string>& allowed,
+                        int64_t now_us, int16_t now_tz) {
+  IngestResult r;
+  r.status = 1;
+  JVal root;
+  {
+    // the caller positions jp at the value start
+    if (!jp.value(&root)) {
+      r.id_or_msg = "malformed JSON event";
+      return r;
+    }
+  }
+  if (root.kind != JVal::kObj) {
+    r.id_or_msg = "event must be a JSON object";
+    return r;
+  }
+  struct Field {
+    bool present = false;
+    JVal v;
+  };
+  Field f_event, f_etype, f_eid, f_tetype, f_teid, f_props, f_etime,
+      f_ctime, f_tags, f_prid, f_eventid;
+  {
+    JParser sub(root.raw, root.raw_n);
+    bool ok = sub.object_members([&](const JStr& key, const JVal& v) {
+      std::string k;
+      if (!json_unescape(key, &k)) return false;
+      Field* slot = nullptr;
+      if (k == "event") slot = &f_event;
+      else if (k == "entityType") slot = &f_etype;
+      else if (k == "entityId") slot = &f_eid;
+      else if (k == "targetEntityType") slot = &f_tetype;
+      else if (k == "targetEntityId") slot = &f_teid;
+      else if (k == "properties") slot = &f_props;
+      else if (k == "eventTime") slot = &f_etime;
+      else if (k == "creationTime") slot = &f_ctime;
+      else if (k == "tags") slot = &f_tags;
+      else if (k == "prId") slot = &f_prid;
+      else if (k == "eventId") slot = &f_eventid;
+      if (slot) {
+        slot->present = true;
+        slot->v = v;
+      }
+      return true;
+    });
+    if (!ok) {
+      r.id_or_msg = "malformed JSON event";
+      return r;
+    }
+  }
+
+  auto req_str = [&](Field& f, const char* name, std::string* out) {
+    if (!f.present) {
+      r.id_or_msg = std::string("field ") + name + " is required";
+      return false;
+    }
+    if (f.v.kind != JVal::kStr) {
+      r.id_or_msg = std::string("field ") + name + " must be a string";
+      return false;
+    }
+    if (!json_unescape(f.v.str, out)) {
+      r.id_or_msg = "malformed JSON event";
+      return false;
+    }
+    return true;
+  };
+  std::string ev, etype, eid;
+  if (!req_str(f_event, "event", &ev)) return r;
+  if (!req_str(f_etype, "entityType", &etype)) return r;
+  if (!req_str(f_eid, "entityId", &eid)) return r;
+
+  auto opt_str = [&](Field& f, const char* name, std::string* out,
+                     bool* has) {
+    *has = false;
+    if (!f.present || f.v.kind == JVal::kNull) return true;
+    if (f.v.kind != JVal::kStr || !json_unescape(f.v.str, out)) {
+      r.id_or_msg = std::string("field ") + name + " must be a string";
+      return false;
+    }
+    *has = true;
+    return true;
+  };
+  std::string tetype, teid, prid, eventid;
+  bool has_tetype, has_teid, has_prid, has_eventid;
+  if (!opt_str(f_tetype, "targetEntityType", &tetype, &has_tetype))
+    return r;
+  if (!opt_str(f_teid, "targetEntityId", &teid, &has_teid)) return r;
+  if (!opt_str(f_prid, "prId", &prid, &has_prid)) return r;
+  if (!opt_str(f_eventid, "eventId", &eventid, &has_eventid)) return r;
+
+  // properties: keep the raw JSON span; validate kind + top-level keys
+  std::string props_json = "{}";
+  size_t n_props = 0;
+  if (f_props.present && f_props.v.kind != JVal::kNull) {
+    if (f_props.v.kind != JVal::kObj) {
+      r.id_or_msg = "properties must be a JSON object";
+      return r;
+    }
+    props_json.assign(reinterpret_cast<const char*>(f_props.v.raw),
+                      f_props.v.raw_n);
+    JParser pp(f_props.v.raw, f_props.v.raw_n);
+    bool keys_ok = true;
+    std::string bad_key;
+    pp.object_members([&](const JStr& key, const JVal&) {
+      std::string k;
+      if (!json_unescape(key, &k)) {
+        keys_ok = false;
+        return false;
+      }
+      n_props++;
+      if (reserved_prefix(k)) {  // BUILTIN_PROPERTIES is empty
+        bad_key = k;
+        keys_ok = false;
+        return false;
+      }
+      return true;
+    });
+    if (!keys_ok) {
+      if (!bad_key.empty())
+        r.id_or_msg = "The property " + bad_key +
+                      " is not allowed. 'pio_' is a reserved name prefix.";
+      else
+        r.id_or_msg = "malformed JSON event";
+      return r;
+    }
+  }
+
+  // tags: raw span, every element must be a string
+  std::string tags_json;
+  if (f_tags.present && f_tags.v.kind != JVal::kNull) {
+    if (f_tags.v.kind != JVal::kArr) {
+      r.id_or_msg = "tags must be a list of strings";
+      return r;
+    }
+    bool all_str = true;
+    size_t n_tags = 0;
+    JParser tp(f_tags.v.raw, f_tags.v.raw_n);
+    tp.p++;  // consume '['
+    tp.ws();
+    if (tp.p < tp.end && *tp.p != ']') {
+      while (tp.p < tp.end) {
+        JVal v;
+        if (!tp.value(&v)) {
+          all_str = false;
+          break;
+        }
+        if (v.kind != JVal::kStr) {
+          all_str = false;
+          break;
+        }
+        n_tags++;
+        tp.ws();
+        if (tp.p < tp.end && *tp.p == ',') {
+          tp.p++;
+          continue;
+        }
+        break;
+      }
+    }
+    if (!all_str) {
+      r.id_or_msg = "tags must be a list of strings";
+      return r;
+    }
+    if (n_tags > 0)
+      tags_json.assign(reinterpret_cast<const char*>(f_tags.v.raw),
+                       f_tags.v.raw_n);
+  }
+
+  // times
+  int64_t et_us = now_us, ct_us = now_us;
+  int16_t et_tz = now_tz, ct_tz = now_tz;
+  auto json_falsy = [](const JVal& v) {
+    // Python-falsy JSON values (from_api_dict: `if v else utcnow()`):
+    // null, false, 0/0.0/-0, "", [], {}
+    switch (v.kind) {
+      case JVal::kNull:
+        return true;
+      case JVal::kBool:
+        return v.raw_n == 5;  // "false"
+      case JVal::kStr:
+        return v.str.n == 0;
+      case JVal::kNum: {
+        std::string n(reinterpret_cast<const char*>(v.raw), v.raw_n);
+        return strtod(n.c_str(), nullptr) == 0.0;
+      }
+      case JVal::kObj:
+      case JVal::kArr: {
+        for (uint32_t k = 1; k + 1 < v.raw_n; k++) {
+          uint8_t c = v.raw[k];
+          if (c != ' ' && c != '\t' && c != '\n' && c != '\r') return false;
+        }
+        return true;
+      }
+    }
+    return false;
+  };
+  auto time_field = [&](Field& f, const char* name, int64_t* us,
+                        int16_t* tz) {
+    if (!f.present || json_falsy(f.v))
+      return true;  // falsy values fall back to now (from_api_dict parity)
+    std::string s;
+    bool bad = f.v.kind != JVal::kStr || !json_unescape(f.v.str, &s) ||
+               !parse_iso8601(s, us, tz);
+    if (bad) {
+      std::string shown = s;
+      if (f.v.kind != JVal::kStr) {
+        shown.assign(reinterpret_cast<const char*>(f.v.raw), f.v.raw_n);
+        if (shown == "true") shown = "True";  // python str() of the value
+      }
+      r.id_or_msg = std::string("invalid ") + name + ": " + shown;
+      return false;
+    }
+    return true;
+  };
+  if (!time_field(f_etime, "eventTime", &et_us, &et_tz)) return r;
+  if (!time_field(f_ctime, "creationTime", &ct_us, &ct_tz)) return r;
+
+  // validation contract (validate_event)
+  auto fail = [&](const std::string& msg) {
+    r.id_or_msg = msg;
+    return r;
+  };
+  if (ev.empty()) return fail("event must not be empty.");
+  if (etype.empty()) return fail("entityType must not be empty string.");
+  if (eid.empty()) return fail("entityId must not be empty string.");
+  if (has_tetype && tetype.empty())
+    return fail("targetEntityType must not be empty string");
+  if (has_teid && teid.empty())
+    return fail("targetEntityId must not be empty string.");
+  if (has_tetype != has_teid)
+    return fail(
+        "targetEntityType and targetEntityId must be specified together.");
+  if (ev == "$unset" && n_props == 0)
+    return fail("properties cannot be empty for $unset event");
+  if (reserved_prefix(ev) && !special_event(ev))
+    return fail(ev + " is not a supported reserved event name.");
+  if (special_event(ev) && (has_tetype || has_teid))
+    return fail("Reserved event " + ev + " cannot have targetEntity");
+  if (reserved_prefix(etype) && etype != "pio_pr")
+    return fail("The entityType " + etype +
+                " is not allowed. 'pio_' is a reserved name prefix.");
+  if (has_tetype && reserved_prefix(tetype) && tetype != "pio_pr")
+    return fail("The targetEntityType " + tetype +
+                " is not allowed. 'pio_' is a reserved name prefix.");
+
+  // per-key event-name whitelist (server/eventserver.py check_event_allowed)
+  if (!allowed.empty()) {
+    bool ok = false;
+    for (const auto& a : allowed)
+      if (a == ev) {
+        ok = true;
+        break;
+      }
+    if (!ok) {
+      r.status = 2;
+      r.id_or_msg = ev + " events are not allowed";
+      r.event = ev;
+      return r;
+    }
+  }
+
+  // id + pack + append (layout mirrors pio_tpu/native/eventlog.py
+  // pack_event; see the payload doc at the top of this file)
+  if (!has_eventid) {
+    char idbuf[33];
+    gen_event_id(idbuf);
+    eventid.assign(idbuf, 32);
+  }
+  std::vector<uint8_t> payload;
+  payload.reserve(96 + ev.size() + etype.size() + eid.size() +
+                  props_json.size() + tags_json.size() + 64);
+  auto put_i64 = [&](int64_t v) {
+    for (int k = 0; k < 8; k++)
+      payload.push_back(static_cast<uint8_t>((v >> (8 * k)) & 0xFF));
+  };
+  auto put_i16 = [&](int16_t v) {
+    payload.push_back(static_cast<uint8_t>(v & 0xFF));
+    payload.push_back(static_cast<uint8_t>((v >> 8) & 0xFF));
+  };
+  auto put_u64 = [&](uint64_t v) {
+    for (int k = 0; k < 8; k++)
+      payload.push_back(static_cast<uint8_t>((v >> (8 * k)) & 0xFF));
+  };
+  auto hash_of = [&](const std::string& s) {
+    return fnv1a(reinterpret_cast<const uint8_t*>(s.data()), s.size());
+  };
+  put_i64(et_us);
+  put_i16(et_tz);
+  put_i64(ct_us);
+  put_i16(ct_tz);
+  put_u64(hash_of(ev));
+  put_u64(hash_of(etype));
+  put_u64(hash_of(eid));
+  put_u64(has_tetype ? hash_of(tetype) : 0);
+  put_u64(has_teid ? hash_of(teid) : 0);
+  put_u64(hash_of(eventid));
+  payload.push_back(static_cast<uint8_t>((has_tetype ? 1 : 0) |
+                                         (has_prid ? 2 : 0)));
+  pack_u16str(&payload, ev);
+  pack_u16str(&payload, etype);
+  pack_u16str(&payload, eid);
+  pack_u16str(&payload, has_tetype ? tetype : std::string());
+  pack_u16str(&payload, has_teid ? teid : std::string());
+  pack_u16str(&payload, eventid);
+  pack_u16str(&payload, has_prid ? prid : std::string());
+  pack_u16str(&payload, tags_json);
+  uint32_t pn = static_cast<uint32_t>(props_json.size());
+  payload.push_back(pn & 0xFF);
+  payload.push_back((pn >> 8) & 0xFF);
+  payload.push_back((pn >> 16) & 0xFF);
+  payload.push_back((pn >> 24) & 0xFF);
+  payload.insert(payload.end(), props_json.begin(), props_json.end());
+
+  if (el_append(static_cast<void*>(lg), payload.data(),
+                static_cast<uint32_t>(payload.size())) < 0) {
+    r.id_or_msg = "log append failed";
+    return r;
+  }
+  r.status = 0;
+  r.id_or_msg = eventid;
+  r.event = ev;
+  r.entity_type = etype;
+  return r;
+}
+
+
+}  // namespace (ingest helpers)
+
+
 extern "C" {
 
 void* el_open(const char* path, int create) {
@@ -653,6 +1479,130 @@ int64_t el_columnarize(
   *n_users = static_cast<uint32_t>(users.count);
   *n_items = static_cast<uint32_t>(items.count);
   return static_cast<int64_t>(n);
+}
+
+// Ingest fast path: parse a JSON body (array of events, or one object when
+// `single`), validate each event exactly as the Python pipeline does, pack
+// and append the valid ones, and return per-event results.
+//
+//   allowed: n_allowed u16-len-prefixed event names (the access key's
+//            whitelist); empty = all events allowed
+//   now_us/now_tz: server time used when eventTime/creationTime are absent
+//   max_events: batch size cap (0 = uncapped); exceeding it returns -2
+//
+// Returns the number of results packed into *out (caller frees via
+// el_free), each as: u8 status (0=created, 1=invalid, 2=not-allowed),
+// u16+bytes id-or-message, u16+bytes event name, u16+bytes entity type.
+// Returns -1 when the body itself is not well-formed JSON of the expected
+// shape, -2 when max_events is exceeded.
+int64_t el_ingest_batch(void* h, const uint8_t* json, uint32_t json_len,
+                        const uint8_t* allowed, uint32_t allowed_len,
+                        uint32_t n_allowed, int64_t now_us, int16_t now_tz,
+                        int single, uint32_t max_events, uint8_t** out,
+                        uint64_t* out_len) {
+  auto* lg = static_cast<Log*>(h);
+  if (!valid_utf8(json, json_len)) return -1;
+  std::vector<std::string> allow;
+  allow.reserve(n_allowed);
+  {
+    const uint8_t* p = allowed;
+    const uint8_t* end = allowed + allowed_len;
+    for (uint32_t k = 0; k < n_allowed; k++) {
+      if (p + 2 > end) return -1;
+      uint16_t n = static_cast<uint16_t>(p[0] | (p[1] << 8));
+      p += 2;
+      if (p + n > end) return -1;
+      allow.emplace_back(reinterpret_cast<const char*>(p), n);
+      p += n;
+    }
+  }
+
+  // well-formedness pre-pass over the WHOLE body before anything is
+  // appended: a malformed body (or an over-limit batch) must reject with
+  // zero inserts, exactly like the Python route's json.loads-then-check
+  {
+    JParser pre(json, json_len);
+    pre.ws();
+    if (single) {
+      JVal v;
+      if (!pre.value(&v)) return -1;
+    } else {
+      if (pre.p >= pre.end || *pre.p != '[') return -1;
+      pre.p++;
+      pre.ws();
+      uint32_t n = 0;
+      if (pre.p < pre.end && *pre.p == ']') {
+        pre.p++;
+      } else {
+        while (pre.p < pre.end) {
+          JVal v;
+          if (!pre.value(&v)) return -1;
+          if (max_events && ++n > max_events) return -2;
+          pre.ws();
+          if (pre.p < pre.end && *pre.p == ',') {
+            pre.p++;
+            continue;
+          }
+          if (pre.p < pre.end && *pre.p == ']') {
+            pre.p++;
+            break;
+          }
+          return -1;
+        }
+      }
+    }
+    pre.ws();
+    if (pre.p != pre.end) return -1;  // trailing garbage
+  }
+
+  std::vector<IngestResult> results;
+  JParser jp(json, json_len);
+  if (single) {
+    results.push_back(ingest_one(lg, jp, allow, now_us, now_tz));
+    if (results[0].status == 1 &&
+        results[0].id_or_msg == "malformed JSON event")
+      return -1;  // defensive: pre-pass should have caught it
+  } else {
+    jp.ws();
+    if (jp.p >= jp.end || *jp.p != '[') return -1;
+    jp.p++;
+    jp.ws();
+    bool done = (jp.p < jp.end && *jp.p == ']');
+    if (done) jp.p++;
+    while (!done) {
+      IngestResult r = ingest_one(lg, jp, allow, now_us, now_tz);
+      if (r.status == 1 && r.id_or_msg == "malformed JSON event")
+        return -1;  // cannot trust the array cursor past a parse error
+      results.push_back(std::move(r));
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == ',') {
+        jp.p++;
+        continue;
+      }
+      if (jp.p < jp.end && *jp.p == ']') {
+        jp.p++;
+        done = true;
+        continue;
+      }
+      return -1;
+    }
+    jp.ws();
+    if (jp.p != jp.end) return -1;
+  }
+
+  std::vector<uint8_t> buf;
+  buf.reserve(results.size() * 48);
+  for (const auto& r : results) {
+    buf.push_back(r.status);
+    pack_u16str(&buf, r.id_or_msg);
+    pack_u16str(&buf, r.event);
+    pack_u16str(&buf, r.entity_type);
+  }
+  *out = static_cast<uint8_t*>(malloc(buf.size() ? buf.size() : 1));
+  if (!*out) return -1;
+  memcpy(*out, buf.data(), buf.size());
+  *out_len = buf.size();
+  return static_cast<int64_t>(results.size());
 }
 
 }  // extern "C"

@@ -151,6 +151,28 @@ class _EventLogEvents(d.EventsDAO):
             ns.log.append(event.with_id(eid))
             return eid
 
+    def insert_api_batch(
+        self,
+        raw: bytes,
+        app_id,
+        channel_id=None,
+        allowed_events=None,
+        single: bool = False,
+        max_events: int = 0,
+    ):
+        """Native ingest fast path: raw JSON request body -> validated,
+        packed, appended records, one C call (EventLog.ingest_batch).
+        Returns [(status, id_or_message, event_name, entity_type)].
+        Raises ValueError (malformed body) / BatchTooLarge."""
+        from pio_tpu.utils.time import utcnow
+
+        with self._lock:
+            ns = self._ns(app_id, channel_id)
+            return ns.log.ingest_batch(
+                raw, list(allowed_events or ()), utcnow(),
+                single=single, max_events=max_events,
+            )
+
     def get(self, event_id, app_id, channel_id=None):
         with self._lock:
             ns = self._ns(app_id, channel_id)

@@ -95,6 +95,10 @@ class Event:
         for k in ("event", "entityType", "entityId"):
             if not isinstance(d[k], str):
                 raise EventValidationError(f"field {k} must be a string")
+        for k in ("targetEntityType", "targetEntityId", "prId", "eventId"):
+            v = d.get(k)
+            if v is not None and not isinstance(v, str):
+                raise EventValidationError(f"field {k} must be a string")
         props = d.get("properties", {}) or {}
         if not isinstance(props, dict):
             raise EventValidationError("properties must be a JSON object")

@@ -69,6 +69,12 @@ def _lib() -> C.CDLL:
     lib.el_read.argtypes = [
         C.c_void_p, C.c_uint64, C.POINTER(u8p), C.POINTER(C.c_uint32)
     ]
+    lib.el_ingest_batch.restype = C.c_int64
+    lib.el_ingest_batch.argtypes = [
+        C.c_void_p, C.c_char_p, C.c_uint32, C.c_char_p, C.c_uint32,
+        C.c_uint32, C.c_int64, C.c_int16, C.c_int, C.c_uint32,
+        C.POINTER(u8p), u64p,
+    ]
     lib.el_columnarize.restype = C.c_int64
     lib.el_columnarize.argtypes = [
         C.c_void_p, C.c_uint32, C.c_int64, C.c_int64, C.c_uint64,
@@ -251,6 +257,10 @@ def _decode_table(ptr, total_len: int, count: int) -> list[str]:
     return out
 
 
+class BatchTooLarge(Exception):
+    """Batch exceeded the server's max events per request."""
+
+
 class EventLog:
     """One open log file (one per app/channel namespace)."""
 
@@ -275,6 +285,56 @@ class EventLog:
         if off < 0:
             raise OSError(f"append failed on {self.path}")
         return off
+
+    def ingest_batch(
+        self,
+        raw: bytes,
+        allowed_events: list[str] | None,
+        now: datetime,
+        single: bool = False,
+        max_events: int = 0,
+    ) -> list[tuple[int, str, str, str]]:
+        """Native parse+validate+append of a JSON request body.
+
+        raw: the HTTP body (JSON array of events, or one object when
+        `single`). Returns [(status, id_or_message, event, entity_type)]
+        per event — status 0 = created, 1 = invalid (400), 2 = not allowed
+        by the key's whitelist (403). Raises ValueError on a malformed
+        body and BatchTooLarge when max_events is exceeded (matching the
+        Python route semantics in server/eventserver.py)."""
+        allow_blob = b"".join(
+            struct.pack("<H", len(b)) + b
+            for b in ((s.encode("utf-8") for s in allowed_events or ()))
+        )
+        n_allowed = len(allowed_events or ())
+        out = C.POINTER(C.c_uint8)()
+        out_len = C.c_uint64()
+        n = self._lib.el_ingest_batch(
+            self._h, raw, len(raw), allow_blob, len(allow_blob), n_allowed,
+            _micros(now), _tz_minutes(now), 1 if single else 0,
+            max_events, C.byref(out), C.byref(out_len),
+        )
+        if n == -2:
+            raise BatchTooLarge()
+        if n < 0:
+            raise ValueError("request body is not well-formed JSON")
+        try:
+            buf = C.string_at(out, out_len.value)
+        finally:
+            self._lib.el_free(out)
+        results = []
+        pos = 0
+        for _ in range(n):
+            status = buf[pos]
+            pos += 1
+            fields = []
+            for _ in range(3):
+                (ln,) = struct.unpack_from("<H", buf, pos)
+                pos += 2
+                fields.append(buf[pos:pos + ln].decode("utf-8"))
+                pos += ln
+            results.append((status, *fields))
+        return results
 
     def stats(self) -> tuple[int, int]:
         end = C.c_uint64()

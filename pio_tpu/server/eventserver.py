@@ -146,9 +146,44 @@ def build_event_app(
     def root(req: Request):
         return 200, {"status": "alive"}
 
+    def _native_fast_path():
+        """The native C++ ingest path (parse+validate+append in one call)
+        applies when the events DAO exposes it and no input plugins are
+        registered (plugins see parsed dicts, which the fast path never
+        materializes). Stats stay accurate: the native results carry the
+        event name + entity type."""
+        fast = getattr(events_dao, "insert_api_batch", None)
+        if fast is None:
+            return None
+        if plugins.input_blockers or plugins.input_sniffers:
+            return None
+        return fast
+
+    def _one_native(fast, req: Request, ak, channel_id):
+        results = fast(
+            req.body, ak.appid, channel_id,
+            allowed_events=list(ak.events or ()), single=True,
+        )
+        status, payload, event_name, entity_type = results[0]
+        if status == 0:
+            if config.stats:
+                stats.update(ak.appid, 201, event_name, entity_type)
+            return 201, {"eventId": payload}
+        if status == 2:
+            return 403, {"message": payload}
+        if payload == "event must be a JSON object":
+            payload = "request body must be a JSON object"
+        return 400, {"message": payload}
+
     @app.route("POST", r"/events\.json")
     @authed
     def create_event(req: Request, ak, channel_id):
+        fast = _native_fast_path()
+        if fast is not None:
+            try:
+                return _one_native(fast, req, ak, channel_id)
+            except ValueError:
+                pass  # malformed body: Python path produces the message
         body = req.json()
         if not isinstance(body, dict):
             return 400, {"message": "request body must be a JSON object"}
@@ -209,6 +244,36 @@ def build_event_app(
     @app.route("POST", r"/batch/events\.json")
     @authed
     def batch_events(req: Request, ak, channel_id):
+        fast = _native_fast_path()
+        if fast is not None:
+            from pio_tpu.native.eventlog import BatchTooLarge
+
+            try:
+                results = fast(
+                    req.body, ak.appid, channel_id,
+                    allowed_events=list(ak.events or ()),
+                    max_events=MAX_EVENTS_PER_BATCH,
+                )
+            except BatchTooLarge:
+                return 400, {
+                    "message": "Batch request must have less than or equal "
+                    f"to {MAX_EVENTS_PER_BATCH} events"
+                }
+            except ValueError:
+                results = None  # malformed body: Python path for messages
+            if results is not None:
+                out = []
+                for status, payload, event_name, entity_type in results:
+                    if status == 0:
+                        if config.stats:
+                            stats.update(ak.appid, 201, event_name,
+                                         entity_type)
+                        out.append({"status": 201, "eventId": payload})
+                    elif status == 2:
+                        out.append({"status": 403, "message": payload})
+                    else:
+                        out.append({"status": 400, "message": payload})
+                return 200, out
         body = req.json()
         if not isinstance(body, list):
             return 400, {"message": "request body must be a JSON array"}

@@ -1,0 +1,284 @@
+"""Native C++ ingest fast path: differential parity with the Python
+parse/validate pipeline (Event.from_api_dict + validate_event + whitelist),
+round-trip fidelity, batch semantics, and server-level wiring.
+
+Reference analogue: the event-route contracts of
+data/.../api/EventServer.scala:145-418 — here asserted identical between the
+two implementations of the same route.
+"""
+
+from __future__ import annotations
+
+import json
+from datetime import datetime, timezone
+
+import pytest
+
+from pio_tpu.data.backends.eventlog import EventLogBackend
+from pio_tpu.data.event import Event, EventValidationError, validate_event
+from pio_tpu.data.storage import StorageClientConfig
+from pio_tpu.native.eventlog import BatchTooLarge
+
+
+@pytest.fixture
+def dao(tmp_path):
+    backend = EventLogBackend(
+        StorageClientConfig(properties={"PATH": str(tmp_path / "el")})
+    )
+    d = backend.events()
+    d.init(7)
+    yield d
+    backend.close()
+
+
+def python_verdict(d: dict, allowed: list[str]) -> tuple[int, str]:
+    """(status, message) the Python route path produces for one event dict."""
+    try:
+        e = Event.from_api_dict(d)
+        validate_event(e)
+    except (EventValidationError, ValueError) as ex:
+        return 1, str(ex)
+    if allowed and e.event not in allowed:
+        return 2, f"{e.event} events are not allowed"
+    return 0, ""
+
+
+GOOD = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5, "tags": ["a", "b"], "nested": {"x": 1}},
+    "eventTime": "2026-07-30T12:34:56.789Z",
+}
+
+# every error class validate_event / from_api_dict covers + valid variants
+CASES = [
+    GOOD,
+    {"event": "view", "entityType": "user", "entityId": "u2"},
+    {"event": "$set", "entityType": "user", "entityId": "u1",
+     "properties": {"age": 30}},
+    {"event": "$unset", "entityType": "user", "entityId": "u1",
+     "properties": {"age": None}},
+    {"event": "$delete", "entityType": "user", "entityId": "u1"},
+    {"event": "rate", "entityType": "pio_pr", "entityId": "p1"},
+    {"event": "buy", "entityType": "user", "entityId": "u1",
+     "eventTime": "2026-07-30T12:00:00+05:30",
+     "creationTime": "2026-07-30T11:00:00-08:00"},
+    {"event": "buy", "entityType": "user", "entityId": "u1",
+     "eventTime": ""},
+    {"event": "tag", "entityType": "user", "entityId": "u1",
+     "tags": ["alpha", "beta"]},
+    {"event": "pr", "entityType": "user", "entityId": "u1", "prId": "abc"},
+    {"event": "unié", "entityType": "usér", "entityId": "ü1"},
+    # --- invalid ---
+    {"entityType": "user", "entityId": "u1"},
+    {"event": "rate", "entityId": "u1"},
+    {"event": "rate", "entityType": "user"},
+    {"event": 5, "entityType": "user", "entityId": "u1"},
+    {"event": "rate", "entityType": None, "entityId": "u1"},
+    {"event": "", "entityType": "user", "entityId": "u1"},
+    {"event": "rate", "entityType": "", "entityId": "u1"},
+    {"event": "rate", "entityType": "user", "entityId": ""},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityId": "i1"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "", "targetEntityId": "i1"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item", "targetEntityId": ""},
+    {"event": "$unset", "entityType": "user", "entityId": "u1"},
+    {"event": "$unset", "entityType": "user", "entityId": "u1",
+     "properties": {}},
+    {"event": "$foo", "entityType": "user", "entityId": "u1"},
+    {"event": "pio_x", "entityType": "user", "entityId": "u1"},
+    {"event": "$set", "entityType": "user", "entityId": "u1",
+     "properties": {"a": 1}, "targetEntityType": "item",
+     "targetEntityId": "i1"},
+    {"event": "rate", "entityType": "pio_bad", "entityId": "u1"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "pio_bad", "targetEntityId": "i1"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "properties": {"pio_secret": 1}},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "properties": {"$weird": 1}},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "properties": [1, 2]},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "tags": "notalist"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "tags": ["ok", 5]},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": "not-a-time"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": 123},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "creationTime": "2026-99-99"},
+    # review-found parity classes: tz range, calendar validity, leap
+    # seconds, falsy/truthy non-string times, non-string optional fields
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": "2026-07-30T10:00:00+99:99"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": "2026-02-31T10:00:00Z"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": "2028-02-29T10:00:00Z"},          # valid leap day
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": "2026-02-29T10:00:00Z"},          # not a leap year
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": "2026-07-30T10:00:60Z"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": 0},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": False},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": True},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "eventTime": []},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "creationTime": {}},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": 5, "targetEntityId": "i1"},
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item", "targetEntityId": 5},
+    {"event": "rate", "entityType": "user", "entityId": "u1", "prId": 5},
+    {"event": "rate", "entityType": "user", "entityId": "u1", "eventId": 5},
+]
+
+
+def test_differential_parity_with_python_pipeline(dao):
+    """Every case must get the same status AND message from both paths."""
+    for allowed in ([], ["rate", "buy", "$set", "$unset", "$delete"]):
+        for d in CASES:
+            want_status, want_msg = python_verdict(d, allowed)
+            raw = json.dumps([d]).encode()
+            (got_status, got_payload, got_event, _), = dao.insert_api_batch(
+                raw, 7, allowed_events=allowed)
+            assert got_status == want_status, (d, got_payload, want_msg)
+            if want_status != 0:
+                assert got_payload == want_msg, (d, got_payload, want_msg)
+
+
+def test_roundtrip_fidelity(dao):
+    """A natively ingested event must read back exactly like one inserted
+    through the Python path (times incl. tz, props, tags, prId)."""
+    d = dict(GOOD)
+    d["prId"] = "pr-9"
+    d["tags"] = ["x", "y"]
+    d["creationTime"] = "2026-07-30T01:02:03.004+02:00"
+    (status, eid, _, _), = dao.insert_api_batch(
+        json.dumps([d]).encode(), 7)
+    assert status == 0
+    native = dao.get(eid, 7)
+    py = Event.from_api_dict(dict(d))
+    assert native is not None
+    assert native.event == py.event
+    assert native.entity_type == py.entity_type
+    assert native.entity_id == py.entity_id
+    assert native.target_entity_type == py.target_entity_type
+    assert native.target_entity_id == py.target_entity_id
+    assert dict(native.properties.fields) == dict(py.properties.fields)
+    assert native.tags == py.tags
+    assert native.pr_id == py.pr_id
+    assert native.event_time == py.event_time
+    assert native.event_time.utcoffset() == py.event_time.utcoffset()
+    assert native.creation_time == py.creation_time
+
+
+def test_supplied_event_id_is_honored(dao):
+    d = dict(GOOD, eventId="custom-id-1")
+    (status, eid, _, _), = dao.insert_api_batch(json.dumps([d]).encode(), 7)
+    assert (status, eid) == (0, "custom-id-1")
+    assert dao.get("custom-id-1", 7) is not None
+
+
+def test_default_times_are_now(dao):
+    d = {"event": "view", "entityType": "user", "entityId": "u9"}
+    before = datetime.now(timezone.utc)
+    (status, eid, _, _), = dao.insert_api_batch(json.dumps([d]).encode(), 7)
+    after = datetime.now(timezone.utc)
+    e = dao.get(eid, 7)
+    assert status == 0
+    assert before <= e.event_time <= after
+    assert before <= e.creation_time <= after
+
+
+def test_batch_limit_rejects_before_inserting(dao):
+    events = [dict(GOOD, entityId=f"u{i}") for i in range(51)]
+    with pytest.raises(BatchTooLarge):
+        dao.insert_api_batch(json.dumps(events).encode(), 7, max_events=50)
+    assert list(dao.find(7, limit=-1)) == []
+
+
+def test_malformed_body_inserts_nothing(dao):
+    for raw in (b"[{\"event\": \"a\",}]",      # trailing comma
+                b"[{\"event\": 01}]",           # leading-zero number
+                b"[{\"event\": \"a\\q\"}]",     # bad escape
+                b"[{\"event\": \"a\"} extra",   # trailing garbage
+                b"{\"event\": \"a\"}",          # object, not array
+                "[{\"event\": \"\udcff\"}]".encode("utf-8", "surrogatepass")):
+        with pytest.raises(ValueError):
+            dao.insert_api_batch(raw, 7)
+    assert list(dao.find(7, limit=-1)) == []
+
+
+def test_mixed_batch_statuses(dao):
+    events = [
+        GOOD,
+        {"event": "nope"},                       # 400
+        dict(GOOD, event="blocked"),             # 403 under whitelist
+        5,                                       # 400 not an object
+    ]
+    res = dao.insert_api_batch(
+        json.dumps(events).encode(), 7,
+        allowed_events=["rate"], max_events=50)
+    assert [r[0] for r in res] == [0, 1, 2, 1]
+    assert res[3][1] == "event must be a JSON object"
+
+
+def test_server_routes_use_fast_path(tmp_path):
+    """Server-level: eventlog backend + no plugins -> native path serves
+    /events.json and /batch/events.json with the same contracts."""
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.data.dao import App
+    from pio_tpu.server.eventserver import EventServerConfig, build_event_app
+
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "el"),
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    apps = storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "FastApp"))
+    storage.get_events().init(app_id)
+    keys = storage.get_metadata_access_keys()
+    from pio_tpu.data.dao import AccessKey
+    keys.insert(AccessKey("k1", app_id, []))
+    app = build_event_app(storage, EventServerConfig())
+
+    from pio_tpu.server.http import Request
+
+    def post(path, body):
+        return app.dispatch(Request(
+            method="POST", path=path, params={"accessKey": "k1"},
+            headers={}, body=json.dumps(body).encode()))
+
+    status, out = post("/events.json", GOOD)
+    assert status == 201 and "eventId" in out
+    status, out = post("/events.json", [1, 2])
+    assert status == 400
+    assert out["message"] == "request body must be a JSON object"
+    status, out = post("/batch/events.json", [GOOD, {"event": "x"}])
+    assert status == 200
+    assert out[0]["status"] == 201 and out[1]["status"] == 400
+    status, out = post("/batch/events.json",
+                       [GOOD for _ in range(51)])
+    assert status == 400 and "less than or equal" in out["message"]
+    # stored events are readable through the normal DAO
+    evs = list(storage.get_events().find(app_id, limit=-1))
+    assert len(evs) == 2
+    storage.close()
