@@ -384,11 +384,14 @@ def phase_serving() -> dict:
                 "n_requests": len(lat), "client_threads": workers}
 
     def deploy(backend, batch_window_ms=0.0):
+        # steady-state measurement: warm_query pre-compiles the single path
+        # AND every micro-batch bucket before traffic (a bucket-miss compile
+        # through the tunnel is ~30-60s — client-timeout territory)
         http, qs = create_query_server(
             engine, ep, storage,
             ServingConfig(ip="127.0.0.1", port=0, engine_id="bench",
-                          warm_query={"user": "u0", "num": 10},
-                          backend=backend, batch_window_ms=batch_window_ms),
+                          backend=backend, batch_window_ms=batch_window_ms,
+                          warm_query={"user": "u0", "num": 10}),
             ctx=ctx,
         )
         http.start()
@@ -436,6 +439,15 @@ def phase_serving() -> dict:
     http, qs = deploy("async", batch_window_ms=2.0)
     try:
         out["concurrent"]["async_batched"] = measure_concurrent(
+            http.port, n_conc)
+    finally:
+        http.stop()
+        qs.close()
+    # adaptive (continuous) batching: batch = whatever queued during the
+    # previous batch's execution; self-tunes to RTT-dominated dispatch
+    http, qs = deploy("async", batch_window_ms=-1.0)
+    try:
+        out["concurrent"]["async_adaptive"] = measure_concurrent(
             http.port, n_conc)
     finally:
         http.stop()

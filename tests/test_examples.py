@@ -202,7 +202,24 @@ def test_multi_algo_combines_two_algorithms(tmp_path):
     storage.close()
 
 
-@pytest.mark.slow
+def test_custom_datasource_example(tmp_path):
+    """examples/custom-datasource: user-code DataSource reading
+    user::item::rate lines; no event store involved in training."""
+    storage = _storage(tmp_path)
+    engine, ep, _ = _load_example("custom-datasource")
+    assert os.path.isabs(ep.datasource[1].filepath)
+    http = _train_and_serve(engine, ep, storage, "custom-datasource")
+    try:
+        r = _query(http.port, {"user": "u0", "num": 3})
+        items = [s["item"] for s in r["itemScores"]]
+        assert items, r
+        # u0 rates even items 5 (odd items occasionally 1)
+        assert all(int(i[1:]) % 2 == 0 for i in items), items
+    finally:
+        http.stop()
+    storage.close()
+
+
 def test_regression_example_end_to_end(tmp_path):
     """examples/regression: file-based datasource (engine-dir-relative path
     resolved by the loader), two algorithms averaged by AverageServing."""

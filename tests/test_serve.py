@@ -214,6 +214,57 @@ def test_batch_queries_endpoint(deployed):
     assert status == 400
 
 
+def test_adaptive_batching_backpressure(memory_storage):
+    """Adaptive mode (batch_window_ms < 0): with execution slowed and a
+    single pipeline slot, requests arriving mid-execution must coalesce
+    into later batches (continuous batching), and every request still
+    answers correctly. Locks the backpressure semaphore behavior — without
+    it the collector shreds the queue into 1-sized batches."""
+    import threading
+    import time as _time
+
+    engine, ep, ctx, _ = seed_and_train(memory_storage)
+    http, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      batch_window_ms=-1.0, batch_max=16,
+                      batch_pipeline=1),
+        ctx=ctx,
+    )
+    http.start()
+    try:
+        assert qs.batcher is not None
+        calls = []
+        orig = qs.query_batch
+
+        def slow(queries, record=True):
+            calls.append(len(queries))
+            _time.sleep(0.15)  # hold the single pipeline slot
+            return orig(queries, record)
+
+        qs.query_batch = slow
+        results = {}
+
+        def hit(u):
+            results[u] = call(http.port, "POST", "/queries.json",
+                              {"user": f"u{u}", "num": 3})
+
+        threads = [threading.Thread(target=hit, args=(u,)) for u in range(8)]
+        for t in threads:
+            t.start()
+            _time.sleep(0.02)  # staggered arrivals DURING execution
+        for t in threads:
+            t.join(timeout=30)
+        assert all(status == 200 for status, _ in results.values())
+        # requests that arrived while the slot was busy must have ridden
+        # together: strictly fewer batches than requests
+        assert sum(calls) >= 8 and len(calls) < 8, calls
+        assert max(calls) >= 2, calls
+    finally:
+        http.stop()
+        qs.close()
+
+
 def test_micro_batching_coalesces(memory_storage):
     """Concurrent /queries.json under batch_window_ms resolve through ONE
     query_batch; results must equal the unbatched path's."""
