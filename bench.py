@@ -64,7 +64,7 @@ PROBE_ATTEMPTS = 4
 PROBE_TIMEOUTS = (420, 240, 180, 180)
 PROBE_BACKOFF = (20, 45, 90)  # sleep between failed probe attempts
 TRAIN_TIMEOUT = 3000
-SERVING_TIMEOUT = 1500
+SERVING_TIMEOUT = 2700
 INGEST_TIMEOUT = 600
 CPU_TIMEOUT = 1800
 
@@ -391,6 +391,9 @@ def phase_serving() -> dict:
             engine, ep, storage,
             ServingConfig(ip="127.0.0.1", port=0, engine_id="bench",
                           backend=backend, batch_window_ms=batch_window_ms,
+                          # 16 clients -> batches <= 16; warming buckets
+                          # beyond that only buys tunnel compiles
+                          batch_max=16,
                           warm_query={"user": "u0", "num": 10}),
             ctx=ctx,
         )

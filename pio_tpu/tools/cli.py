@@ -371,8 +371,8 @@ def cmd_train(args) -> int:
 def cmd_eval(args) -> int:
     from pio_tpu.workflow.evaluate import run_evaluation_class
 
-    evaluation = _load_factory(args.evaluation_class)
-    generator = _load_factory(args.params_generator_class)
+    evaluation = _load_factory(args.evaluation_class, args.engine_dir)
+    generator = _load_factory(args.params_generator_class, args.engine_dir)
     instance_id, result = run_evaluation_class(
         evaluation, generator, get_storage(),
         output_path=args.output or None,
@@ -716,6 +716,9 @@ def build_parser() -> argparse.ArgumentParser:
     x = sub.add_parser("eval")
     x.add_argument("evaluation_class")
     x.add_argument("params_generator_class")
+    x.add_argument("--engine-dir", default=None,
+                   help="directory holding the user-code engine.py the "
+                        "classes live in (joins sys.path)")
     x.add_argument("--output", default="best.json")
     x.add_argument("--workers", type=int, default=1,
                    help="params-grid parallelism (reference runs .par)")
@@ -736,8 +739,11 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--server-backend", choices=["async", "threaded"],
                    default="async")
     x.add_argument("--batch-window-ms", type=float, default=0.0,
-                   help="coalesce concurrent queries into one device batch "
-                        "within this window (0 = off)")
+                   help="micro-batching: > 0 coalesces concurrent queries "
+                        "within this fixed window (ms); < 0 = adaptive "
+                        "continuous batching (no added wait; batch = "
+                        "whatever queued during the previous execution); "
+                        "0 = off")
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser("undeploy")

@@ -106,6 +106,7 @@ class QueryServer:
             if config.batch_window_ms != 0 else None
         )
         self._buckets_warmed = False
+        self._warm_once = threading.Lock()
         self._warm()
 
     # -- model lifecycle ----------------------------------------------------
@@ -199,9 +200,14 @@ class QueryServer:
         (a fresh bucket costs a full XLA compile — tens of seconds through
         a remote tunnel, i.e. client-timeout territory). Explicit
         ServingConfig.warm_query still does this up-front at startup."""
-        if self.batcher is None or self._buckets_warmed:
+        # atomic test-and-set: concurrent batch executions must not spawn
+        # duplicate warm threads (each runs a full compile sweep)
+        if self.batcher is None:
             return
-        self._buckets_warmed = True
+        with self._warm_once:
+            if self._buckets_warmed:
+                return
+            self._buckets_warmed = True
 
         def go():
             try:

@@ -202,6 +202,36 @@ def test_multi_algo_combines_two_algorithms(tmp_path):
     storage.close()
 
 
+@pytest.mark.slow
+def test_evaluation_example_tunes_params(tmp_path):
+    """examples/evaluation: user-code Evaluation + EngineParamsGenerator
+    through the real eval workflow — the reference's
+    scala-local-movielens-evaluation role. The winner must come from the
+    grid and best.json must be written."""
+    from pio_tpu.tools.cli import _load_factory
+    from pio_tpu.workflow.evaluate import run_evaluation_class
+
+    storage = _storage(tmp_path)
+    _seed_ratings(storage, "EvalApp")
+    d = os.path.join(EXAMPLES, "evaluation")
+    sys.modules.pop("engine", None)
+    evaluation = _load_factory("engine.RecEvaluation", d)
+    generator = _load_factory("engine.RecParamsGenerator", d)
+    out = tmp_path / "best.json"
+    instance_id, result = run_evaluation_class(
+        evaluation, generator, storage, output_path=str(out), workers=2)
+    assert result.best_engine_params in generator.params_list()
+    assert 0.0 <= result.best_score.score <= 1.0
+    assert out.exists()
+    best = json.loads(out.read_text())
+    assert "algorithmParamsList" in best
+    # the evaluation instance is recorded (dashboard source of truth)
+    insts = storage.get_metadata_evaluation_instances()
+    inst = insts.get(instance_id)
+    assert inst is not None and inst.status == "EVALCOMPLETED"
+    storage.close()
+
+
 def test_custom_datasource_example(tmp_path):
     """examples/custom-datasource: user-code DataSource reading
     user::item::rate lines; no event store involved in training."""

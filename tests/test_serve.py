@@ -238,8 +238,9 @@ def test_adaptive_batching_backpressure(memory_storage):
         orig = qs.query_batch
 
         def slow(queries, record=True):
-            calls.append(len(queries))
-            _time.sleep(0.15)  # hold the single pipeline slot
+            if record:  # ignore the background auto-warm's batches
+                calls.append(len(queries))
+                _time.sleep(0.15)  # hold the single pipeline slot
             return orig(queries, record)
 
         qs.query_batch = slow
@@ -284,7 +285,8 @@ def test_micro_batching_coalesces(memory_storage):
         orig = qs.query_batch
 
         def spy(queries, record=True):
-            calls.append(len(queries))
+            if record:  # ignore the background auto-warm's batches
+                calls.append(len(queries))
             return orig(queries, record)
 
         qs.query_batch = spy
