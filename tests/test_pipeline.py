@@ -1,0 +1,75 @@
+"""Pipeline parallelism: GPipe schedule correctness + differentiability on
+the virtual CPU mesh."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pio_tpu.parallel.mesh import MODEL_AXIS, MeshConfig, create_mesh
+from pio_tpu.parallel.pipeline import pipeline_apply, split_microbatches
+
+
+def _mesh(n):
+    return create_mesh(MeshConfig(data=1, model=n), jax.devices()[:n])
+
+
+def _stages(n_stages, d, seed=0):
+    k = jax.random.PRNGKey(seed)
+    kw, kb = jax.random.split(k)
+    return {
+        "w": jax.random.normal(kw, (n_stages, d, d)) / np.sqrt(d),
+        "b": jax.random.normal(kb, (n_stages, d)) * 0.1,
+    }
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _sequential(params, x):
+    for s in range(params["w"].shape[0]):
+        x = _stage_fn({"w": params["w"][s], "b": params["b"][s]}, x)
+    return x
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 4), (4, 1), (8, 3)])
+def test_pipeline_matches_sequential(n_stages, n_micro):
+    d, mb = 8, 4
+    mesh = _mesh(n_stages)
+    params = _stages(n_stages, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro * mb, d))
+    xm = split_microbatches(x, n_micro)
+    out = pipeline_apply(params, xm, _stage_fn, mesh)
+    ref = _sequential(params, x).reshape(n_micro, mb, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_is_differentiable():
+    """The scan schedule must be reverse-differentiable: gradients through
+    the pipeline == gradients through the sequential composition."""
+    n_stages, n_micro, d, mb = 4, 2, 6, 3
+    mesh = _mesh(n_stages)
+    params = _stages(n_stages, d, seed=2)
+    x = jax.random.normal(jax.random.PRNGKey(3), (n_micro * mb, d))
+    xm = split_microbatches(x, n_micro)
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_apply(p, xm, _stage_fn, mesh) ** 2)
+
+    def loss_seq(p):
+        return jnp.sum(_sequential(p, x) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(g_pipe[k]), np.asarray(g_seq[k]), atol=1e-4)
+
+
+def test_split_microbatches_validates():
+    with pytest.raises(ValueError, match="divisible"):
+        split_microbatches(jnp.zeros((10, 4)), 3)
+    assert split_microbatches(jnp.zeros((12, 4)), 3).shape == (3, 4, 4)
