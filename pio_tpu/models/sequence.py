@@ -67,6 +67,13 @@ class SequenceParams(Params):
     steps: int = 300
     seed: int = 0
     attention: str = "auto"    # "auto" | "reference" | "ring"
+    # mixture-of-experts FFN: 0 = dense (default). With > 0 experts each
+    # block's FFN becomes a Switch-style MoE (ops/moe.py) — one-hot-matmul
+    # dispatch, capacity-dropped tokens ride the residual, and the
+    # load-balance aux loss joins the objective with moe_aux_weight
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
     unseen_only: bool = True   # serve-time: drop items already in history
     # serve-time live history read (empty app_name = training snapshot only)
     app_name: str = ""
@@ -77,14 +84,19 @@ class SequenceParams(Params):
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block with a pluggable attention fn."""
+    """Pre-LN transformer block with a pluggable attention fn and an
+    optional MoE FFN (moe_experts > 0; ops/moe.py)."""
 
     num_heads: int
     head_dim: int
     ffn_dim: int
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, attn_fn):
+        from pio_tpu.ops.moe import MoEConfig, moe_ffn
+
         b, s, e = x.shape
         h, d = self.num_heads, self.head_dim
         y = nn.LayerNorm()(x)
@@ -93,9 +105,28 @@ class Block(nn.Module):
         o = attn_fn(q, k, v)                            # (b, s, h, d)
         x = x + nn.Dense(e, use_bias=False)(o.reshape(b, s, h * d))
         y = nn.LayerNorm()(x)
-        y = nn.Dense(self.ffn_dim)(y)
-        y = nn.gelu(y)
-        x = x + nn.Dense(e)(y)
+        if self.moe_experts > 0:
+            E, f = self.moe_experts, self.ffn_dim
+            init = nn.initializers.normal(1.0 / np.sqrt(e))
+            init_out = nn.initializers.normal(1.0 / np.sqrt(f))
+            moe_params = {
+                "router": self.param("moe_router", init, (e, E)),
+                "w_in": self.param("moe_w_in", init, (E, e, f)),
+                "b_in": self.param("moe_b_in", nn.initializers.zeros, (E, f)),
+                "w_out": self.param("moe_w_out", init_out, (E, f, e)),
+                "b_out": self.param(
+                    "moe_b_out", nn.initializers.zeros, (E, e)),
+            }
+            cfg = MoEConfig(E, e, f, self.moe_capacity_factor)
+            y2, aux = moe_ffn(moe_params, y.reshape(b * s, e), cfg)
+            # sow is a no-op unless the caller makes "moe_aux" mutable
+            # (training does; serving never pays for it)
+            self.sow("moe_aux", "aux", aux)
+            x = x + y2.reshape(b, s, e)
+        else:
+            y = nn.Dense(self.ffn_dim)(y)
+            y = nn.gelu(y)
+            x = x + nn.Dense(e)(y)
         return x
 
 
@@ -109,6 +140,8 @@ class SeqEncoder(nn.Module):
     num_heads: int
     num_layers: int
     ffn_dim: int
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, ids, attn_fn, pos_offset=0):
@@ -125,7 +158,8 @@ class SeqEncoder(nn.Module):
         x = x + jax.lax.dynamic_slice_in_dim(pos, pos_offset, s, axis=0)[None]
         head_dim = self.embed_dim // self.num_heads
         for _ in range(self.num_layers):
-            x = Block(self.num_heads, head_dim, self.ffn_dim)(x, attn_fn)
+            x = Block(self.num_heads, head_dim, self.ffn_dim,
+                      self.moe_experts, self.moe_capacity_factor)(x, attn_fn)
         x = nn.LayerNorm()(x)
         logits = x @ emb.T                              # weight-tied head
         return x, logits
@@ -178,6 +212,25 @@ class SequenceData:
 POS_HEADROOM = 16
 
 
+def _apply_with_aux(encoder, params, inp, attn, pos_offset, p):
+    """encoder.apply collecting the MoE load-balance aux loss (zero for
+    dense models — the moe_aux collection is only populated by MoE
+    blocks)."""
+    if p.moe_experts > 0:
+        out, aux_vars = encoder.apply(
+            {"params": params}, inp, attn, pos_offset=pos_offset,
+            mutable=["moe_aux"],
+        )
+        leaves = jax.tree_util.tree_leaves(aux_vars)
+        aux = p.moe_aux_weight * sum(jnp.mean(a) for a in leaves) \
+            / max(1, len(leaves))
+        return out, aux
+    out = encoder.apply(
+        {"params": params}, inp, attn, pos_offset=pos_offset
+    )
+    return out, jnp.float32(0.0)
+
+
 def make_encoder(n_items: int, p: SequenceParams) -> SeqEncoder:
     # Position-table headroom: the train step right-pads the sequence so it
     # splits evenly over the seq mesh axis (up to n_seq-1 extra positions).
@@ -188,6 +241,8 @@ def make_encoder(n_items: int, p: SequenceParams) -> SeqEncoder:
         vocab=n_items + 1, max_len=p.max_len + POS_HEADROOM,
         embed_dim=p.embed_dim,
         num_heads=p.num_heads, num_layers=p.num_layers, ffn_dim=p.ffn_dim,
+        moe_experts=p.moe_experts,
+        moe_capacity_factor=p.moe_capacity_factor,
     )
 
 
@@ -251,8 +306,8 @@ def train_sequence_model(
                 )
             else:
                 attn = partial(attention_reference, causal=True)
-            _, logits = encoder.apply(
-                {"params": params}, inp, attn, pos_offset=pos_offset
+            (_, logits), aux = _apply_with_aux(
+                encoder, params, inp, attn, pos_offset, p
             )
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
             mask = (tgt != PAD).astype(jnp.float32)
@@ -260,7 +315,8 @@ def train_sequence_model(
                 jnp.sum(ce * mask), (DATA_AXIS, SEQ_AXIS)
             )
             count = jax.lax.psum(jnp.sum(mask), (DATA_AXIS, SEQ_AXIS))
-            return loss_sum / jnp.maximum(count, 1.0)
+            aux = jax.lax.pmean(aux, (DATA_AXIS, SEQ_AXIS))
+            return loss_sum / jnp.maximum(count, 1.0) + aux
 
         @partial(
             jax.shard_map, mesh=mesh,
@@ -290,10 +346,12 @@ def train_sequence_model(
         attn = partial(attention_reference, causal=True)
 
         def loss_fn(params, inp, tgt):
-            _, logits = encoder.apply({"params": params}, inp, attn)
+            (_, logits), aux = _apply_with_aux(
+                encoder, params, inp, attn, 0, p
+            )
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
             mask = (tgt != PAD).astype(jnp.float32)
-            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0) + aux
 
         @jax.jit
         def step(params, opt_state, inp, tgt):
@@ -383,6 +441,20 @@ class SequenceAlgorithm(PAlgorithm):
 
     def train(self, ctx, data: SequenceData) -> SequenceModel:
         data.sanity_check()
+        # max_len lives in BOTH the datasource and the algorithm params
+        # (the datasource builds sequences, the algorithm sizes its
+        # position table); adapt rather than explode on a mismatch —
+        # right-aligned truncate (keep the most recent items) or left-pad
+        s = data.seqs
+        if s.shape[1] != self.params.max_len:
+            if s.shape[1] > self.params.max_len:
+                s = s[:, -self.params.max_len:]
+            else:
+                s = np.pad(s, ((0, 0), (self.params.max_len - s.shape[1], 0)))
+            data = SequenceData(
+                seqs=np.ascontiguousarray(s), users=data.users,
+                items=data.items,
+            )
         mesh = (
             ctx.mesh
             if ctx and ctx.mesh is not None and ctx.mesh.devices.size > 1

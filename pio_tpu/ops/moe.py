@@ -126,6 +126,10 @@ def moe_ffn_ep(params, x, cfg: MoEConfig, mesh: Mesh, axis: str = "data"):
             f"n_experts ({cfg.n_experts}) must divide over {n_dev} devices"
         )
     T = x.shape[0]
+    if T % n_dev != 0:
+        raise ValueError(
+            f"token count ({T}) must divide over {n_dev} devices"
+        )
     t_local = T // n_dev
     cap = _capacity(t_local, cfg.n_experts, cfg.capacity_factor)
 

@@ -68,8 +68,11 @@ def pipeline_apply(
 
         def tick(carry, t):
             left_in, out = carry
-            # stage 0 consumes microbatch t (zeros during drain ticks);
-            # other stages consume what their left neighbor handed over
+            # stage 0 consumes microbatch t; during drain ticks
+            # (t >= n_micro) the clip re-feeds the LAST microbatch — its
+            # results are garbage that the validity mask below never
+            # lands, but drain-tick inputs are NOT zeros: do not rely on
+            # them (e.g. for activation statistics)
             mb_idx = jnp.clip(t, 0, n_micro - 1)
             fresh = jax.lax.dynamic_index_in_dim(
                 xs, mb_idx, axis=0, keepdims=False

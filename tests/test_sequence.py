@@ -83,6 +83,65 @@ def test_sp_training_matches_single_device():
     assert abs(loss_single - loss_sharded) < 1e-3
 
 
+def test_moe_ffn_trains_and_serves():
+    """moe_experts > 0: the Switch FFN replaces the dense FFN — the model
+    must still learn the cyclic pattern under dp x sp sharding and serve
+    through the normal path (where the aux sow is a silent no-op)."""
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=16)
+    data = SequenceData(seqs, users, items)
+    p = SequenceParams(
+        max_len=16, embed_dim=32, num_heads=2, num_layers=2, ffn_dim=64,
+        steps=200, batch_size=32, moe_experts=4,
+    )
+    mesh = create_mesh(MeshConfig(data=2, seq=4, model=1))
+    params, _, loss = train_sequence_model(data, p, mesh)
+    assert np.isfinite(loss) and loss < 1.2, loss
+    # MoE params exist in the tree; dense FFN kernels are absent
+    flat = {"/".join(str(k) for k in path): v
+            for path, v in jax.tree_util.tree_flatten_with_path(params)[0]}
+    assert any("moe_router" in k for k in flat)
+    model = SequenceModel(
+        params=params, seqs=seqs, users=users, items=items, config=p
+    )
+    out = SequenceAlgorithm(p).predict(model, {"user": "u0", "num": 3})
+    assert out["itemScores"][0]["item"] == "i8"
+
+
+def test_algorithm_adapts_datasource_max_len_mismatch():
+    """max_len lives in both the datasource and algorithm params; a
+    mismatch must adapt (right-aligned truncate / left-pad), not explode
+    in the position-table slice (found by a CLI drive of the scaffolded
+    template, where the two defaults diverge)."""
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=64)
+    data = SequenceData(seqs, users, items)
+    p = SequenceParams(max_len=16, embed_dim=16, num_heads=2, num_layers=1,
+                       ffn_dim=32, steps=3, batch_size=16)
+    model = SequenceAlgorithm(p).train(None, data)
+    assert model.seqs.shape[1] == 16
+    # and the other direction: datasource shorter than the model
+    seqs8, users8, items8 = build_sequences(_cyclic_events(), max_len=8)
+    model2 = SequenceAlgorithm(p).train(
+        None, SequenceData(seqs8, users8, items8))
+    assert model2.seqs.shape[1] == 16
+
+
+def test_moe_single_device_matches_sharded_loss():
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=16)
+    data = SequenceData(seqs, users, items)
+    p = SequenceParams(
+        max_len=16, embed_dim=32, num_heads=2, num_layers=1, ffn_dim=64,
+        steps=20, batch_size=32, moe_experts=4,
+    )
+    _, _, loss_single = train_sequence_model(data, p, None)
+    mesh = create_mesh(MeshConfig(data=2, seq=4, model=1))
+    _, _, loss_sharded = train_sequence_model(data, p, mesh)
+    # unlike the dense model (1e-3 agreement, test above), sharded MoE is
+    # NOT bit-equivalent: capacity queues form per shard, so borderline
+    # tokens can drop differently and gradients drift — the standard
+    # sharded-MoE behavior. The contract is same-ballpark convergence.
+    assert abs(loss_single - loss_sharded) < 0.05, (loss_single, loss_sharded)
+
+
 def test_learns_and_serves_next_item(trained):
     model, loss = trained
     assert loss < 1.0  # the cyclic pattern is learnable
