@@ -879,6 +879,32 @@ bool special_event(const std::string& s) {
   return s == "$set" || s == "$unset" || s == "$delete";
 }
 
+// Python-falsy JSON values (from_api_dict uses `or {}` / `if v else`):
+// null, false, 0/0.0/-0, "", [], {}
+bool json_falsy(const JVal& v) {
+  switch (v.kind) {
+    case JVal::kNull:
+      return true;
+    case JVal::kBool:
+      return v.raw_n == 5;  // "false"
+    case JVal::kStr:
+      return v.str.n == 0;
+    case JVal::kNum: {
+      std::string n(reinterpret_cast<const char*>(v.raw), v.raw_n);
+      return strtod(n.c_str(), nullptr) == 0.0;
+    }
+    case JVal::kObj:
+    case JVal::kArr: {
+      for (uint32_t k = 1; k + 1 < v.raw_n; k++) {
+        uint8_t c = v.raw[k];
+        if (c != ' ' && c != '\t' && c != '\n' && c != '\r') return false;
+      }
+      return true;
+    }
+  }
+  return false;
+}
+
 struct IngestResult {
   uint8_t status;       // 0 = created, 1 = 400, 2 = 403 (whitelist)
   std::string id_or_msg;
@@ -990,7 +1016,8 @@ IngestResult ingest_one(Log* lg, JParser& jp,
   // properties: keep the raw JSON span; validate kind + top-level keys
   std::string props_json = "{}";
   size_t n_props = 0;
-  if (f_props.present && f_props.v.kind != JVal::kNull) {
+  // falsy properties values collapse to {} (from_api_dict: `... or {}`)
+  if (f_props.present && !json_falsy(f_props.v)) {
     if (f_props.v.kind != JVal::kObj) {
       r.id_or_msg = "properties must be a JSON object";
       return r;
@@ -1026,7 +1053,8 @@ IngestResult ingest_one(Log* lg, JParser& jp,
 
   // tags: raw span, every element must be a string
   std::string tags_json;
-  if (f_tags.present && f_tags.v.kind != JVal::kNull) {
+  // falsy tags values collapse to [] (from_api_dict: `... or []`)
+  if (f_tags.present && !json_falsy(f_tags.v)) {
     if (f_tags.v.kind != JVal::kArr) {
       r.id_or_msg = "tags must be a list of strings";
       return r;
@@ -1068,31 +1096,6 @@ IngestResult ingest_one(Log* lg, JParser& jp,
   // times
   int64_t et_us = now_us, ct_us = now_us;
   int16_t et_tz = now_tz, ct_tz = now_tz;
-  auto json_falsy = [](const JVal& v) {
-    // Python-falsy JSON values (from_api_dict: `if v else utcnow()`):
-    // null, false, 0/0.0/-0, "", [], {}
-    switch (v.kind) {
-      case JVal::kNull:
-        return true;
-      case JVal::kBool:
-        return v.raw_n == 5;  // "false"
-      case JVal::kStr:
-        return v.str.n == 0;
-      case JVal::kNum: {
-        std::string n(reinterpret_cast<const char*>(v.raw), v.raw_n);
-        return strtod(n.c_str(), nullptr) == 0.0;
-      }
-      case JVal::kObj:
-      case JVal::kArr: {
-        for (uint32_t k = 1; k + 1 < v.raw_n; k++) {
-          uint8_t c = v.raw[k];
-          if (c != ' ' && c != '\t' && c != '\n' && c != '\r') return false;
-        }
-        return true;
-      }
-    }
-    return false;
-  };
   auto time_field = [&](Field& f, const char* name, int64_t* us,
                         int16_t* tz) {
     if (!f.present || json_falsy(f.v))

@@ -1,0 +1,194 @@
+"""Differential fuzzing of the native ingest parser against the Python
+pipeline: randomized event dicts, structure mutations, and raw byte
+garbage must never crash the C++ path, and every per-event verdict must
+match the Python implementation exactly (deterministic seeds — this is a
+regression corpus, not a flaky fuzzer)."""
+
+from __future__ import annotations
+
+import json
+import random
+import string
+
+import pytest
+
+from pio_tpu.data.backends.eventlog import EventLogBackend
+from pio_tpu.data.event import Event, EventValidationError, validate_event
+from pio_tpu.data.storage import StorageClientConfig
+
+
+@pytest.fixture
+def dao(tmp_path):
+    backend = EventLogBackend(
+        StorageClientConfig(properties={"PATH": str(tmp_path / "el")})
+    )
+    d = backend.events()
+    d.init(3)
+    yield d
+    backend.close()
+
+
+def python_verdict(d) -> int:
+    if not isinstance(d, dict):
+        return 1
+    try:
+        e = Event.from_api_dict(d)
+        validate_event(e)
+        return 0
+    except (EventValidationError, ValueError):
+        return 1
+
+
+def _random_value(rng: random.Random, depth=0):
+    kind = rng.randrange(8 if depth < 2 else 6)
+    if kind == 0:
+        return rng.randrange(-5, 100)
+    if kind == 1:
+        return rng.random() * 10 - 5
+    if kind == 2:
+        return rng.choice([True, False, None])
+    if kind == 3:
+        n = rng.randrange(0, 12)
+        alphabet = string.ascii_letters + string.digits + " $_.:-日本é"
+        return "".join(rng.choice(alphabet) for _ in range(n))
+    if kind == 4:
+        return rng.choice([
+            "$set", "pio_x", "", "2026-07-30T12:00:00Z", "not-a-time",
+            "2026-02-31T00:00:00Z", "1999-12-31T23:59:59.999+09:30",
+        ])
+    if kind == 5:
+        return rng.choice(["user", "item", "pio_pr", "rate", "view"])
+    if kind == 6:
+        return [_random_value(rng, depth + 1)
+                for _ in range(rng.randrange(0, 3))]
+    return {f"k{i}": _random_value(rng, depth + 1)
+            for i in range(rng.randrange(0, 3))}
+
+
+def _valid_event(rng: random.Random):
+    """A guaranteed-valid base with random optional decorations — keeps
+    the accept path exercised at a healthy rate regardless of how hostile
+    the fully-random generator is."""
+    d = {
+        "event": rng.choice(["rate", "view", "buy"]),
+        "entityType": "user",
+        "entityId": rng.choice(["u1", "u2", "идент"]),
+    }
+    if rng.random() < 0.7:
+        d["targetEntityType"] = "item"
+        d["targetEntityId"] = rng.choice(["i1", "i2"])
+    if rng.random() < 0.6:
+        d["properties"] = {"rating": rng.randrange(1, 6)}
+    if rng.random() < 0.5:
+        d["eventTime"] = "2026-07-30T12:00:00.5+02:00"
+    if rng.random() < 0.3:
+        d["tags"] = ["a", "b"]
+    if rng.random() < 0.3:
+        d["prId"] = "pr1"
+    return d
+
+
+def _random_event(rng: random.Random):
+    if rng.random() < 0.35:
+        return _valid_event(rng)
+    fields = ["event", "entityType", "entityId", "targetEntityType",
+              "targetEntityId", "properties", "eventTime", "creationTime",
+              "tags", "prId", "eventId"]
+    d = {}
+    # target pair: usually both-or-neither (the validation rule); the
+    # per-field loop below still perturbs them sometimes
+    if rng.random() < 0.5:
+        d["targetEntityType"] = rng.choice(["item", "item", "pio_pr", ""])
+        d["targetEntityId"] = rng.choice(["i1", "i1", "x" * 30, ""])
+    for f in fields:
+        roll = rng.random()
+        # required-triple fields stay mostly present and mostly valid so a
+        # healthy fraction of fuzzed events actually exercises the accept
+        # path; optional fields skew adversarial
+        required = f in ("event", "entityType", "entityId")
+        if f.startswith("targetEntity") and roll < 0.85:
+            continue                      # mostly keep the paired values
+        if roll < (0.08 if required else 0.45):
+            continue                      # absent
+        if roll < (0.92 if required else 0.80):  # plausible value
+            if f in ("event",):
+                d[f] = rng.choice(["rate", "view", "rate", "view", "$set",
+                                   "$delete", "$bad", "pio_y", ""])
+            elif f in ("entityType", "targetEntityType"):
+                d[f] = rng.choice(["user", "item", "user", "item",
+                                   "pio_pr", "pio_bad", ""])
+            elif f in ("entityId", "targetEntityId", "prId", "eventId"):
+                d[f] = rng.choice(["u1", "i2", "", "x" * 40, "идент"])
+            elif f == "properties":
+                d[f] = {
+                    rng.choice(["rating", "ok", "k2", "k3",
+                                "pio_k", "$k"]):
+                        _random_value(rng, 1)
+                    for _ in range(rng.randrange(0, 3))
+                }
+            elif f in ("eventTime", "creationTime"):
+                d[f] = rng.choice([
+                    "2026-07-30T12:00:00Z", "2026-07-30 07:08:09.123456",
+                    "2026-07-30T12:00:00+05:30", "2026-07-30",
+                    "2026-13-01T00:00:00Z", "", "garbage",
+                ])
+            elif f == "tags":
+                d[f] = rng.choice([[], ["a", "b"], ["c"],
+                                   ["a", 5], "nope"])
+        else:                             # adversarial: any JSON value
+            d[f] = _random_value(rng)
+    return d
+
+
+def test_fuzz_event_dicts_verdict_parity(dao):
+    """800 randomized events in batches of 8: per-event status must match
+    the Python pipeline's verdict, and accepted events must be readable."""
+    rng = random.Random(1234)
+    accepted = 0
+    for batch_i in range(100):
+        events = [_random_event(rng) for _ in range(8)]
+        raw = json.dumps(events).encode()
+        results = dao.insert_api_batch(raw, 3)
+        assert len(results) == 8
+        for d, (status, payload, _, _) in zip(events, results):
+            want = python_verdict(d)
+            assert (status != 0) == (want != 0), (d, status, payload)
+            if status == 0:
+                accepted += 1
+    assert accepted > 50  # the generator must actually produce valid events
+    # every accepted event is decodable through the normal read path
+    evs = list(dao.find(3, limit=-1))
+    assert len(evs) == accepted
+
+
+def test_fuzz_raw_bytes_never_crash(dao):
+    """Random byte garbage and truncated/mutated JSON must raise ValueError
+    (or report per-event errors) — never crash, never partially insert."""
+    rng = random.Random(99)
+    base = json.dumps([{
+        "event": "rate", "entityType": "user", "entityId": "u1",
+        "properties": {"rating": 4},
+    }]).encode()
+    for trial in range(300):
+        kind = trial % 3
+        if kind == 0:     # pure garbage
+            raw = bytes(rng.randrange(256) for _ in range(rng.randrange(80)))
+        elif kind == 1:   # truncation
+            raw = base[: rng.randrange(len(base))]
+        else:             # single-byte mutation
+            b = bytearray(base)
+            b[rng.randrange(len(b))] = rng.randrange(256)
+            raw = bytes(b)
+        before = sum(1 for _ in dao.find(3, limit=-1))
+        try:
+            results = dao.insert_api_batch(raw, 3)
+        except ValueError:
+            # whole-body reject must be atomic: nothing partially inserted
+            after = sum(1 for _ in dao.find(3, limit=-1))
+            assert after == before, (before, after, raw[:60])
+            continue
+        for status, payload, _, _ in results:
+            assert status in (0, 1, 2)
+    # whatever was inserted must be cleanly readable (no corrupt records)
+    for e in dao.find(3, limit=-1):
+        assert e.event_id
