@@ -50,3 +50,35 @@ def delete_app_data(
     """Wipe and re-init event data for one channel (or the default)."""
     storage.get_events().remove(app.id, channel_id)
     storage.get_events().init(app.id, channel_id)
+
+
+def trim_copy(
+    storage: Storage,
+    src_app: App,
+    dst_app: App,
+    start_time=None,
+    until_time=None,
+    channel_id: int | None = None,
+) -> int:
+    """Copy src app's events within [start_time, until_time) into dst app —
+    the reference trim-app workflow (examples/experimental/
+    scala-parallel-trim-app/src/main/scala/DataSource.scala:31-51: windowed
+    PEvents.find -> write into a destination app that MUST be empty, so a
+    botched window can never destroy the only copy). Returns events copied.
+    """
+    ev = storage.get_events()
+    ev.init(dst_app.id, channel_id)
+    if next(iter(ev.find(dst_app.id, channel_id=channel_id, limit=1)), None) \
+            is not None:
+        raise ValueError(
+            f"destination app {dst_app.name!r} is not empty; trim refuses "
+            "to mix into existing data (reference TrimApp contract)"
+        )
+    n = 0
+    for event in ev.find(
+        src_app.id, channel_id=channel_id,
+        start_time=start_time, until_time=until_time, limit=-1,
+    ):
+        ev.insert(event, dst_app.id, channel_id)
+        n += 1
+    return n

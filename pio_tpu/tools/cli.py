@@ -2,7 +2,7 @@
 
 Verb parity with reference tools/.../console/Console.scala:186-677:
   version status
-  app {new,list,show,delete,data-delete,channel-new,channel-delete}
+  app {new,list,show,delete,data-delete,trim,channel-new,channel-delete}
   accesskey {new,list,delete}
   build train deploy undeploy eval
   eventserver adminserver dashboard
@@ -262,6 +262,45 @@ def cmd_app(args) -> int:
             channel_id = ch.id
         appops.delete_app_data(storage, a, channel_id)
         print(f"Data of app '{args.name}' deleted.")
+        return 0
+    if sub == "trim":
+        from pio_tpu.utils.time import parse_time
+
+        a = apps.get_by_name(args.name)
+        if a is None:
+            return _fail(f"App {args.name} does not exist.")
+        dst = apps.get_by_name(args.dst)
+        if dst is None:
+            return _fail(f"Destination app {args.dst} does not exist "
+                         "(create it with `pio app new` first).")
+        channel_id = None
+        if args.channel:
+            ch = next((c for c in channels.get_by_appid(a.id)
+                       if c.name == args.channel), None)
+            if ch is None:
+                return _fail(f"Channel {args.channel} does not exist.")
+            channel_id = ch.id
+        else:
+            named = [c for c in channels.get_by_appid(a.id)
+                     if c.name != "default"]
+            if named:
+                # a silent default-only copy would look like a full trim;
+                # per-channel copies must be explicit
+                print(f"[WARN] app '{a.name}' has named channels "
+                      f"({', '.join(c.name for c in named)}); only the "
+                      "default channel is copied — rerun with --channel "
+                      "for each to trim them too.")
+        try:
+            n = appops.trim_copy(
+                storage, a, dst,
+                start_time=parse_time(args.start) if args.start else None,
+                until_time=parse_time(args.until) if args.until else None,
+                channel_id=channel_id,
+            )
+        except ValueError as e:
+            return _fail(str(e))
+        where = f" (channel {args.channel})" if args.channel else ""
+        print(f"Copied {n} events from '{a.name}' to '{dst.name}'{where}.")
         return 0
     if sub == "channel-new":
         a = apps.get_by_name(args.name)
@@ -676,6 +715,18 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("name")
     x = pas.add_parser("delete")
     x.add_argument("name")
+    x = pas.add_parser(
+        "trim", help="copy a time window of events into an EMPTY "
+        "destination app (reference experimental trim-app)")
+    x.add_argument("name")
+    x.add_argument("dst")
+    x.add_argument("--start", default="", help="ISO-8601 inclusive start")
+    x.add_argument("--until", default="", help="ISO-8601 exclusive end")
+    x.add_argument("--channel", default="",
+                   help="named channel to copy (default channel otherwise; "
+                        "named channels are never copied implicitly)")
+    x.set_defaults(fn=cmd_app, subcommand="trim")
+
     x = pas.add_parser("data-delete")
     x.add_argument("name")
     x.add_argument("--channel")

@@ -75,6 +75,60 @@ def test_eval_verb_runs_grid(cli, memory_storage, tmp_path, monkeypatch):
     assert any(i.status == "EVALCOMPLETED" for i in inst)
 
 
+def test_app_trim_copies_window(cli, memory_storage):
+    """`pio app trim SRC DST --start --until` copies the window into an
+    EMPTY destination app and refuses a non-empty one (reference
+    experimental trim-app contract)."""
+    from datetime import datetime, timedelta, timezone
+
+    from pio_tpu.data.event import Event
+
+    code, _ = cli("app", "new", "Src")
+    assert code == 0
+    code, _ = cli("app", "new", "Dst")
+    assert code == 0
+    apps = memory_storage.get_metadata_apps()
+    src = apps.get_by_name("Src")
+    ev = memory_storage.get_events()
+    T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    for d in range(10):
+        ev.insert(Event(event="view", entity_type="user",
+                        entity_id=f"u{d}", event_time=T0 + timedelta(days=d)),
+                  src.id)
+    code, out = cli("app", "trim", "Src", "Dst",
+                    "--start", "2026-01-03T00:00:00Z",
+                    "--until", "2026-01-07T00:00:00Z")
+    assert code == 0 and "Copied 4 events" in out.out
+    dst = apps.get_by_name("Dst")
+    copied = list(ev.find(dst.id, limit=-1))
+    assert len(copied) == 4
+    assert {e.entity_id for e in copied} == {"u2", "u3", "u4", "u5"}
+    # destination no longer empty -> refuse
+    code, out = cli("app", "trim", "Src", "Dst")
+    assert code == 1
+    # unknown destination -> clear failure
+    code, _ = cli("app", "trim", "Src", "Nope")
+    assert code == 1
+    # named channels are never copied implicitly: warn without --channel,
+    # copy that channel's window with it
+    code, _ = cli("app", "channel-new", "Src", "live")
+    assert code == 0
+    ch = next(c for c in memory_storage.get_metadata_channels()
+              .get_by_appid(src.id) if c.name == "live")
+    ev.init(src.id, ch.id)
+    ev.insert(Event(event="buy", entity_type="user", entity_id="cu",
+                    event_time=T0 + timedelta(days=1)), src.id, ch.id)
+    code, _ = cli("app", "new", "Dst3")
+    assert code == 0
+    code, out = cli("app", "trim", "Src", "Dst3")
+    assert code == 0 and "named channels" in out.out
+    code, _ = cli("app", "new", "Dst4")
+    code, out = cli("app", "trim", "Src", "Dst4", "--channel", "live")
+    assert code == 0 and "Copied 1 events" in out.out
+    dst4 = memory_storage.get_metadata_apps().get_by_name("Dst4")
+    assert len(list(ev.find(dst4.id, channel_id=ch.id, limit=-1))) == 1
+
+
 def test_upgrade_verb_migrates_between_backends(cli, tmp_path):
     from pio_tpu.data.storage import Storage
 
