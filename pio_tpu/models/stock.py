@@ -1,0 +1,348 @@
+"""Stock backtesting engine template — indicator regression + walk-forward
+backtest.
+
+Parity target: reference examples/experimental/scala-stock: price frames
+(YahooDataSource.scala / DataSource.scala), indicator feature pipelines
+(Indicators.scala), per-ticker next-day-return linear regression
+(RegressionStrategy.scala:38-53 nak LinearRegression per symbol), and the
+backtesting evaluator with enter/exit thresholds, max positions, NAV /
+return / volatility / Sharpe stats (BackTestingMetrics.scala:19-60).
+
+TPU-first redesign: the reference regresses ONE TICKER AT A TIME on the
+driver; here the whole universe is a single batched solve — indicator
+features are (T, N, F) tensors (ops/indicators.py), the per-ticker normal
+equations are one einsum pair, and the solve is a batched Cholesky on the
+MXU (the same shape of work as the ALS kernel's per-row systems). The
+walk-forward backtest retrains on a sliding window and simulates the
+threshold strategy day by day on host (portfolio bookkeeping is branchy
+and tiny — exactly the part that does NOT belong on the accelerator).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pio_tpu.controller.base import (
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    P2LAlgorithm,
+    Params,
+)
+from pio_tpu.controller.engine import Engine, EngineFactory
+from pio_tpu.ops.indicators import indicator_matrix, log_returns
+
+DEFAULT_INDICATORS = (("return", 1), ("return", 5), ("rsi", 14))
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    """Price series from `$set` events carrying a `price` property on
+    ticker entities (one event per ticker per day), or a CSV file of
+    `date,ticker,price` rows (the offline stand-in for the reference's
+    YahooDataSource)."""
+
+    path_fields = ("filepath",)
+
+    filepath: str = ""
+    app_name: str = ""
+    entity_type: str = "ticker"
+    price_key: str = "price"
+
+
+@dataclass
+class PriceFrame:
+    """(T, N) price panel + labels (the reference's saddle Frame role)."""
+
+    log_price: np.ndarray        # (T, N) float32 log prices
+    tickers: list[str]
+    dates: list                  # length T, sorted ascending
+
+    def sanity_check(self):
+        if self.log_price.size == 0:
+            raise ValueError("PriceFrame is empty; check price events/file.")
+        if not np.isfinite(self.log_price).all():
+            raise ValueError("PriceFrame has non-finite log prices.")
+
+
+def _frame_from_rows(rows: list[tuple]) -> PriceFrame:
+    """rows: (date, ticker, price). Missing points forward-fill; leading
+    gaps back-fill from the first seen price."""
+    dates = sorted({d for d, _, _ in rows})
+    tickers = sorted({t for _, t, _ in rows})
+    d_ix = {d: i for i, d in enumerate(dates)}
+    t_ix = {t: j for j, t in enumerate(tickers)}
+    m = np.full((len(dates), len(tickers)), np.nan, np.float64)
+    for d, t, p in rows:
+        if p <= 0:
+            raise ValueError(f"non-positive price {p} for {t} @ {d}")
+        m[d_ix[d], t_ix[t]] = np.log(p)
+    # forward-fill then back-fill per column
+    for j in range(m.shape[1]):
+        col = m[:, j]
+        mask = np.isnan(col)
+        if mask.all():
+            raise ValueError(f"ticker {tickers[j]} has no prices")
+        idx = np.where(~mask, np.arange(len(col)), 0)
+        np.maximum.accumulate(idx, out=idx)
+        col[:] = col[idx]
+        first = np.flatnonzero(~mask)[0]
+        col[:first] = col[first]
+    return PriceFrame(m.astype(np.float32), tickers, dates)
+
+
+class StockDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx) -> PriceFrame:
+        p = self.params
+        rows: list[tuple] = []
+        if p.filepath:
+            with open(p.filepath) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("date,"):
+                        continue
+                    d, t, price = line.split(",")
+                    rows.append((d, t, float(price)))
+        else:
+            # $set price events only; the panel row key is the DATE — one
+            # row per calendar day regardless of intraday timestamps, the
+            # latest event of a day winning (events arrive time-ordered,
+            # and _frame_from_rows overwrites on duplicate (date, ticker))
+            events = sorted(
+                ctx.event_store.find(
+                    app_name=p.app_name, entity_type=p.entity_type,
+                    event_names=["$set"],
+                ),
+                key=lambda e: e.event_time,
+            )
+            for e in events:
+                price = e.properties.get_or_else(p.price_key, None)
+                if price is not None:
+                    rows.append(
+                        (e.event_time.date(), e.entity_id, float(price)))
+        return _frame_from_rows(rows)
+
+
+@dataclass(frozen=True)
+class RegressionStrategyParams(Params):
+    """Reference RegressionStrategyParams (indicators +
+    maxTrainingWindowSize) merged with BacktestingParams (enter/exit
+    thresholds, maxPositions)."""
+
+    indicators: tuple = DEFAULT_INDICATORS
+    max_training_window: int = 200
+    enter_threshold: float = 0.001
+    exit_threshold: float = 0.0
+    max_positions: int = 3
+    ridge: float = 1e-4
+
+
+def score_with_weights(feats: np.ndarray, weights: np.ndarray) -> np.ndarray:
+    """(N, F) features x (N, F+1) weights (bias last) -> (N,) scores —
+    the ONE scoring implementation predict and backtest both use."""
+    f1 = np.concatenate(
+        [feats, np.ones((feats.shape[0], 1), np.float32)], axis=1)
+    return np.einsum("nf,nf->n", f1, weights)
+
+
+def select_positions(
+    scores: np.ndarray,
+    held: set[int],
+    params: "RegressionStrategyParams",
+) -> set[int]:
+    """The threshold policy (reference BacktestingParams semantics): exit
+    holdings below exit_threshold, then enter the top scorers above
+    enter_threshold until max_positions are held. Shared by predict
+    (held = empty: stateless advice) and backtest (persistent holdings)."""
+    held = {i for i in held if scores[i] >= params.exit_threshold}
+    for i in np.argsort(-scores):
+        if len(held) >= params.max_positions:
+            break
+        if scores[i] > params.enter_threshold:
+            held.add(int(i))
+    return held
+
+
+@dataclass
+class StockModel:
+    weights: np.ndarray          # (N, F+1) per-ticker regression weights
+    latest_features: np.ndarray  # (N, F) indicator values at the last day
+    tickers: list[str]
+    params: RegressionStrategyParams
+
+    def scores(self) -> np.ndarray:
+        return score_with_weights(self.latest_features, self.weights)
+
+
+def fit_ticker_regressions(
+    feats: jax.Array, targets: jax.Array, ridge: float
+) -> jax.Array:
+    """Batched per-ticker least squares: feats (T, N, F), targets (T, N)
+    -> weights (N, F+1) with a bias column — the reference's per-symbol
+    nak regression (RegressionStrategy.scala:39-53) as ONE batched solve."""
+    T, N, F = feats.shape
+    ones = jnp.ones((T, N, 1), feats.dtype)
+    X = jnp.concatenate([feats, ones], axis=-1)       # (T, N, F+1)
+    A = jnp.einsum("tnf,tng->nfg", X, X)
+    A = A + ridge * jnp.eye(F + 1, dtype=X.dtype)[None]
+    b = jnp.einsum("tnf,tn->nf", X, targets)
+    chol = jax.scipy.linalg.cho_factor(A)
+    return jax.scipy.linalg.cho_solve(chol, b)
+
+
+class RegressionStrategyAlgorithm(P2LAlgorithm):
+    params_class = RegressionStrategyParams
+
+    def __init__(self, params=RegressionStrategyParams()):
+        self.params = params
+
+    def _features_targets(self, frame: PriceFrame):
+        lp = jnp.asarray(frame.log_price)
+        feats = indicator_matrix(lp, tuple(self.params.indicators))
+        target = log_returns(lp, 1)                   # realized 1d return
+        # predict NEXT day's return from today's features
+        return feats[:-1], target[1:], feats[-1]
+
+    def train(self, ctx, frame: PriceFrame) -> StockModel:
+        frame.sanity_check()
+        p = self.params
+        feats, targets, latest = self._features_targets(frame)
+        w = p.max_training_window
+        if feats.shape[0] > w:
+            feats, targets = feats[-w:], targets[-w:]
+        weights = fit_ticker_regressions(feats, targets, p.ridge)
+        return StockModel(
+            weights=np.asarray(weights),
+            latest_features=np.asarray(latest),
+            tickers=frame.tickers,
+            params=p,
+        )
+
+    def predict(self, model: StockModel, query: dict) -> dict:
+        """{"tickers"?: [...]} -> predicted next-day log returns + the
+        threshold strategy's enter/exit calls (reference DailyResult)."""
+        scores = model.scores()
+        order = {t: i for i, t in enumerate(model.tickers)}
+        asked = [t for t in (query.get("tickers") or model.tickers)
+                 if t in order]
+        idx = {order[t] for t in asked}
+        # the SAME policy the backtest simulates, restricted to the asked
+        # universe, from a flat (no holdings) position
+        sub_scores = scores.copy()
+        mask = np.full(len(scores), -np.inf)
+        for i in idx:
+            mask[i] = scores[i]
+        enter_idx = select_positions(mask, set(), model.params)
+        out = sorted(
+            ({"ticker": t, "score": float(scores[order[t]])} for t in asked),
+            key=lambda d: -d["score"],
+        )
+        enter = sorted((model.tickers[i] for i in enter_idx),
+                       key=lambda t: -scores[order[t]])
+        exit_ = [t for t in asked
+                 if scores[order[t]] < model.params.exit_threshold]
+        return {
+            "tickerScores": out,
+            "toEnter": enter,
+            "toExit": exit_,
+        }
+
+
+# ---------------------------------------------------------------------------
+# walk-forward backtest (reference BackTestingMetrics.scala)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BacktestResult:
+    nav: list[float]             # daily net asset value (starts at 1.0)
+    daily_returns: list[float]
+    total_return: float
+    volatility: float            # stdev of daily returns
+    sharpe: float                # annualized (sqrt(252))
+    days: int
+
+    def to_dict(self) -> dict:
+        return {
+            "nav": self.nav, "dailyReturns": self.daily_returns,
+            "ret": self.total_return, "vol": self.volatility,
+            "sharpe": self.sharpe, "days": self.days,
+        }
+
+
+def backtest(
+    frame: PriceFrame,
+    params: RegressionStrategyParams = RegressionStrategyParams(),
+    train_window: int = 100,
+    retrain_every: int = 5,
+) -> BacktestResult:
+    """Walk-forward: retrain the batched regression every `retrain_every`
+    days on the trailing window, each day enter the top-scoring tickers
+    above enter_threshold (up to max_positions, reference
+    BacktestingParams), exit below exit_threshold, and realize the held
+    tickers' next-day returns equal-weighted into NAV."""
+    lp = frame.log_price
+    T, N = lp.shape
+    if T <= train_window + 2:
+        raise ValueError(
+            f"need more than {train_window + 2} days, have {T}"
+        )
+    feats_all = np.asarray(indicator_matrix(
+        jnp.asarray(lp), tuple(params.indicators)))
+    rets_all = np.asarray(log_returns(jnp.asarray(lp), 1))
+
+    algo = RegressionStrategyAlgorithm(params)
+    nav = [1.0]
+    daily: list[float] = []
+    held: set[int] = set()
+    weights = None
+    for t in range(train_window, T - 1):
+        if weights is None or (t - train_window) % retrain_every == 0:
+            f = jnp.asarray(feats_all[t - train_window:t - 1])
+            y = jnp.asarray(rets_all[t - train_window + 1:t])
+            weights = np.asarray(
+                fit_ticker_regressions(f, y, params.ridge))
+        scores = score_with_weights(feats_all[t], weights)
+        held = select_positions(scores, held, params)
+        day_ret = (
+            float(np.mean([rets_all[t + 1, i] for i in held]))
+            if held else 0.0
+        )
+        daily.append(day_ret)
+        nav.append(nav[-1] * float(np.exp(day_ret)))
+    arr = np.array(daily)
+    vol = float(arr.std())
+    mean = float(arr.mean())
+    sharpe = float(mean / vol * np.sqrt(252)) if vol > 0 else 0.0
+    return BacktestResult(
+        nav=[float(v) for v in nav],
+        daily_returns=[float(r) for r in daily],
+        total_return=float(nav[-1] - 1.0),
+        volatility=vol,
+        sharpe=sharpe,
+        days=len(daily),
+    )
+
+
+class StockEngine(EngineFactory):
+    """Reference scala-stock Run.scala composition: DataSource +
+    RegressionStrategy + (backtest via `backtest()` / the evaluation
+    workflow)."""
+
+    @classmethod
+    def apply(cls) -> Engine:
+        return Engine(
+            StockDataSource,
+            IdentityPreparator,
+            {"regression": RegressionStrategyAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,87 @@
+"""Technical indicators over (time, tickers) log-price matrices.
+
+Replaces the reference stock template's per-ticker saddle Series pipelines
+(examples/experimental/scala-stock/src/main/scala/Indicators.scala: RSI via
+rolling means of signed returns, shift-difference returns) with matrix ops
+over ALL tickers at once: rolling means are cumsum differences, EMA is a
+`lax.scan` — every indicator is (T, N) in, (T, N) out, so the whole
+universe rides one kernel instead of a Scala loop per symbol.
+
+All functions take log prices; leading positions that lack a full window
+are emitted as 0 (the reference fills NA with 0,
+Indicators.scala getRet `.fillNA(_ => 0.0)`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def log_returns(log_price: jax.Array, d: int = 1) -> jax.Array:
+    """d-day log return: x_t - x_{t-d}; first d rows are 0 (reference
+    RegressionStrategy.getRet / ShiftsIndicator)."""
+    shifted = jnp.roll(log_price, d, axis=0)
+    out = log_price - shifted
+    return out.at[:d].set(0.0)
+
+
+def rolling_mean(x: jax.Array, window: int) -> jax.Array:
+    """Trailing mean over `window` rows via cumsum difference; rows with an
+    incomplete window are 0."""
+    c = jnp.cumsum(x, axis=0)
+    c = jnp.concatenate([jnp.zeros_like(c[:1]), c], axis=0)
+    # value at row t (t >= window-1) = mean of rows t-window+1 .. t
+    out = (c[window:] - c[:-window]) / window
+    pad = jnp.zeros(
+        (min(window - 1, x.shape[0]),) + x.shape[1:], x.dtype
+    )
+    return jnp.concatenate([pad, out], axis=0)[: x.shape[0]]
+
+
+def rsi(log_price: jax.Array, period: int = 14) -> jax.Array:
+    """Relative Strength Index on daily log returns (reference
+    RSIIndicator: RS = rolling-mean(gains) / rolling-mean(losses),
+    RSI = 100 - 100/(1+RS)); incomplete windows emit 0, flat windows 50."""
+    ret = log_returns(log_price, 1)
+    gains = jnp.maximum(ret, 0.0)
+    losses = jnp.maximum(-ret, 0.0)
+    avg_g = rolling_mean(gains, period)
+    avg_l = rolling_mean(losses, period)
+    rs = avg_g / jnp.maximum(avg_l, 1e-12)
+    out = 100.0 - 100.0 / (1.0 + rs)
+    # flat window (no gains, no losses): RSI conventionally 50
+    flat = (avg_g <= 1e-12) & (avg_l <= 1e-12)
+    out = jnp.where(flat, 50.0, out)
+    return out.at[: period + 1].set(0.0)
+
+
+def ema(x: jax.Array, period: int) -> jax.Array:
+    """Exponential moving average (alpha = 2/(period+1)) down the time
+    axis via lax.scan."""
+    alpha = 2.0 / (period + 1.0)
+
+    def step(carry, row):
+        carry = alpha * row + (1 - alpha) * carry
+        return carry, carry
+
+    _, out = jax.lax.scan(step, x[0], x)
+    return out
+
+
+def indicator_matrix(log_price: jax.Array, spec: tuple) -> jax.Array:
+    """(T, N) log prices -> (T, N, F) feature tensor for the strategy
+    regression. spec entries: ("return", d) | ("rsi", period) |
+    ("ema_ratio", period) — the reference's indicator set
+    (ShiftsIndicator / RSIIndicator) plus an EMA-distance feature."""
+    feats = []
+    for kind, arg in spec:
+        if kind == "return":
+            feats.append(log_returns(log_price, int(arg)))
+        elif kind == "rsi":
+            feats.append(rsi(log_price, int(arg)) / 100.0)  # scale to ~[0,1]
+        elif kind == "ema_ratio":
+            feats.append(log_price - ema(log_price, int(arg)))
+        else:
+            raise ValueError(f"unknown indicator {kind!r}")
+    return jnp.stack(feats, axis=-1)

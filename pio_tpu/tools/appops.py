@@ -7,7 +7,7 @@ cleanup) and admin/CommandClient.scala, which both drive the same sequence.
 
 from __future__ import annotations
 
-from pio_tpu.data.dao import AccessKey, App
+from pio_tpu.data.dao import AccessKey, App, Channel
 from pio_tpu.data.storage import Storage
 
 
@@ -58,27 +58,68 @@ def trim_copy(
     dst_app: App,
     start_time=None,
     until_time=None,
-    channel_id: int | None = None,
-) -> int:
+    channel_name: str | None = None,
+) -> dict[str, int]:
     """Copy src app's events within [start_time, until_time) into dst app —
     the reference trim-app workflow (examples/experimental/
     scala-parallel-trim-app/src/main/scala/DataSource.scala:31-51: windowed
     PEvents.find -> write into a destination app that MUST be empty, so a
-    botched window can never destroy the only copy). Returns events copied.
-    """
+    botched window can never destroy the only copy).
+
+    With channel_name=None every namespace is copied (the default one plus
+    each named channel, which is created in dst under the same name —
+    channel ids are app-scoped, so the destination always gets its OWN
+    channels). With a channel_name only that channel is copied. Either
+    way the destination app must be ENTIRELY empty first. Returns
+    {namespace_label: events_copied}."""
     ev = storage.get_events()
-    ev.init(dst_app.id, channel_id)
-    if next(iter(ev.find(dst_app.id, channel_id=channel_id, limit=1)), None) \
-            is not None:
-        raise ValueError(
-            f"destination app {dst_app.name!r} is not empty; trim refuses "
-            "to mix into existing data (reference TrimApp contract)"
+    channels = storage.get_metadata_channels()
+
+    # whole-app emptiness guard: default namespace + every dst channel
+    for ch in [None] + [c.id for c in channels.get_by_appid(dst_app.id)]:
+        try:
+            probe = next(
+                iter(ev.find(dst_app.id, channel_id=ch, limit=1)), None)
+        except Exception:  # noqa: BLE001 - uninitialized namespace = empty
+            continue
+        if probe is not None:
+            raise ValueError(
+                f"destination app {dst_app.name!r} is not empty; trim "
+                "refuses to mix into existing data (reference TrimApp "
+                "contract)"
+            )
+
+    src_channels = {c.name: c.id for c in channels.get_by_appid(src_app.id)}
+    if channel_name is not None:
+        if channel_name not in src_channels:
+            raise ValueError(f"Channel {channel_name} does not exist.")
+        pairs = [(channel_name, src_channels[channel_name])]
+    else:
+        pairs = [("default", None)] + sorted(
+            (n, cid) for n, cid in src_channels.items() if n != "default"
         )
-    n = 0
-    for event in ev.find(
-        src_app.id, channel_id=channel_id,
-        start_time=start_time, until_time=until_time, limit=-1,
-    ):
-        ev.insert(event, dst_app.id, channel_id)
-        n += 1
-    return n
+
+    counts: dict[str, int] = {}
+    for name, src_ch in pairs:
+        if src_ch is None:
+            dst_ch = None
+        else:
+            existing = {c.name: c.id
+                        for c in channels.get_by_appid(dst_app.id)}
+            dst_ch = existing.get(name)
+            if dst_ch is None:
+                dst_ch = channels.insert(Channel(0, name, dst_app.id))
+        ev.init(dst_app.id, dst_ch)
+        n = 0
+        try:
+            found = ev.find(
+                src_app.id, channel_id=src_ch,
+                start_time=start_time, until_time=until_time, limit=-1,
+            )
+        except Exception:  # noqa: BLE001 - src namespace never initialized
+            found = []
+        for event in found:
+            ev.insert(event, dst_app.id, dst_ch)
+            n += 1
+        counts[name] = n
+    return counts

@@ -273,34 +273,19 @@ def cmd_app(args) -> int:
         if dst is None:
             return _fail(f"Destination app {args.dst} does not exist "
                          "(create it with `pio app new` first).")
-        channel_id = None
-        if args.channel:
-            ch = next((c for c in channels.get_by_appid(a.id)
-                       if c.name == args.channel), None)
-            if ch is None:
-                return _fail(f"Channel {args.channel} does not exist.")
-            channel_id = ch.id
-        else:
-            named = [c for c in channels.get_by_appid(a.id)
-                     if c.name != "default"]
-            if named:
-                # a silent default-only copy would look like a full trim;
-                # per-channel copies must be explicit
-                print(f"[WARN] app '{a.name}' has named channels "
-                      f"({', '.join(c.name for c in named)}); only the "
-                      "default channel is copied — rerun with --channel "
-                      "for each to trim them too.")
         try:
-            n = appops.trim_copy(
+            counts = appops.trim_copy(
                 storage, a, dst,
                 start_time=parse_time(args.start) if args.start else None,
                 until_time=parse_time(args.until) if args.until else None,
-                channel_id=channel_id,
+                channel_name=args.channel or None,
             )
         except ValueError as e:
             return _fail(str(e))
-        where = f" (channel {args.channel})" if args.channel else ""
-        print(f"Copied {n} events from '{a.name}' to '{dst.name}'{where}.")
+        total = sum(counts.values())
+        detail = ", ".join(f"{k}: {v}" for k, v in counts.items())
+        print(f"Copied {total} events from '{a.name}' to '{dst.name}' "
+              f"({detail}).")
         return 0
     if sub == "channel-new":
         a = apps.get_by_name(args.name)
@@ -723,8 +708,8 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--start", default="", help="ISO-8601 inclusive start")
     x.add_argument("--until", default="", help="ISO-8601 exclusive end")
     x.add_argument("--channel", default="",
-                   help="named channel to copy (default channel otherwise; "
-                        "named channels are never copied implicitly)")
+                   help="copy only this named channel (all namespaces — "
+                        "default + every channel — are copied otherwise)")
     x.set_defaults(fn=cmd_app, subcommand="trim")
 
     x = pas.add_parser("data-delete")

@@ -98,7 +98,7 @@ def test_app_trim_copies_window(cli, memory_storage):
     code, out = cli("app", "trim", "Src", "Dst",
                     "--start", "2026-01-03T00:00:00Z",
                     "--until", "2026-01-07T00:00:00Z")
-    assert code == 0 and "Copied 4 events" in out.out
+    assert code == 0 and "Copied 4 events" in out.out, out.out
     dst = apps.get_by_name("Dst")
     copied = list(ev.find(dst.id, limit=-1))
     assert len(copied) == 4
@@ -109,24 +109,36 @@ def test_app_trim_copies_window(cli, memory_storage):
     # unknown destination -> clear failure
     code, _ = cli("app", "trim", "Src", "Nope")
     assert code == 1
-    # named channels are never copied implicitly: warn without --channel,
-    # copy that channel's window with it
+    # channels: a plain trim copies EVERY namespace, creating same-named
+    # channels in the destination (channel ids are app-scoped — reusing
+    # the source's id would orphan the events)
     code, _ = cli("app", "channel-new", "Src", "live")
     assert code == 0
-    ch = next(c for c in memory_storage.get_metadata_channels()
-              .get_by_appid(src.id) if c.name == "live")
+    channels = memory_storage.get_metadata_channels()
+    ch = next(c for c in channels.get_by_appid(src.id) if c.name == "live")
     ev.init(src.id, ch.id)
     ev.insert(Event(event="buy", entity_type="user", entity_id="cu",
                     event_time=T0 + timedelta(days=1)), src.id, ch.id)
     code, _ = cli("app", "new", "Dst3")
     assert code == 0
     code, out = cli("app", "trim", "Src", "Dst3")
-    assert code == 0 and "named channels" in out.out
+    assert code == 0 and "live: 1" in out.out and "default: 10" in out.out
+    dst3 = memory_storage.get_metadata_apps().get_by_name("Dst3")
+    d3_live = next(c for c in channels.get_by_appid(dst3.id)
+                   if c.name == "live")
+    assert d3_live.id != ch.id  # dst owns its OWN channel
+    assert len(list(ev.find(dst3.id, channel_id=d3_live.id, limit=-1))) == 1
+    # the copied channel is reachable through the normal resolve path
+    from pio_tpu.data.eventstore import EventStore
+    es = EventStore(memory_storage)
+    assert len(list(es.find(app_name="Dst3", channel_name="live"))) == 1
+    # --channel copies only that channel, into a wholly-empty app
     code, _ = cli("app", "new", "Dst4")
     code, out = cli("app", "trim", "Src", "Dst4", "--channel", "live")
     assert code == 0 and "Copied 1 events" in out.out
-    dst4 = memory_storage.get_metadata_apps().get_by_name("Dst4")
-    assert len(list(ev.find(dst4.id, channel_id=ch.id, limit=-1))) == 1
+    # and the whole-app emptiness guard refuses a second trim of ANY kind
+    code, out = cli("app", "trim", "Src", "Dst4")
+    assert code == 1
 
 
 def test_upgrade_verb_migrates_between_backends(cli, tmp_path):
