@@ -59,10 +59,13 @@ CPU_N_USERS = max(64, N_USERS // _CPU_SCALE)
 CPU_N_ITEMS = max(32, N_ITEMS // _CPU_SCALE)
 
 PROBE_ATTEMPTS = 4
-# first TPU init + compile can take minutes; later attempts shorter so a
-# down tunnel (which hangs, not errors) can't eat the whole round
-PROBE_TIMEOUTS = (420, 240, 180, 180)
-PROBE_BACKOFF = (20, 45, 90)  # sleep between failed probe attempts
+# the probe only inits the backend + compiles one tiny op (measured: 2.5s
+# init, <40s worst-case first compile through the tunnel), so 180s is a
+# 4x margin; a DOWN tunnel HANGS rather than erroring, so every second
+# here is paid in full before the CPU fallback — the whole ladder tops
+# out at ~9 min (was ~20) of a dead tunnel
+PROBE_TIMEOUTS = (180, 120, 90, 90)
+PROBE_BACKOFF = (15, 30, 60)  # sleep between failed probe attempts
 TRAIN_TIMEOUT = 3000
 SERVING_TIMEOUT = 2700
 INGEST_TIMEOUT = 600
