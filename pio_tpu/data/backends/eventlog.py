@@ -190,6 +190,27 @@ class _EventLogEvents(d.EventsDAO):
             self._ns(app_id, channel_id).add_tombstone(event_id)
             return True
 
+    def delete_many(self, event_ids, app_id, channel_id=None):
+        """Bulk tombstone: ONE existence scan for the whole batch instead
+        of the per-id get() (a full log scan each) the base loop would do
+        — retention cleanups over large logs stay a single pass."""
+        ids = [e for e in event_ids if e]
+        if not ids:
+            return 0
+        with self._lock:
+            ns = self._ns(app_id, channel_id)
+            want = set(ids) - ns.tombstones
+            if not want:
+                return 0
+            existing = {
+                e.event_id
+                for e in ns.log.scan(ScanFilter(), ns.tomb_blob)
+                if e.event_id in want
+            }
+            for eid in existing:
+                ns.add_tombstone(eid)
+            return len(existing)
+
     # -- query ---------------------------------------------------------------
     def find(
         self,

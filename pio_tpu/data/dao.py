@@ -296,6 +296,19 @@ class EventsDAO(abc.ABC):
         self, event_id: str, app_id: int, channel_id: int | None = None
     ) -> bool: ...
 
+    def delete_many(
+        self,
+        event_ids: Sequence[str],
+        app_id: int,
+        channel_id: int | None = None,
+    ) -> int:
+        """Delete a batch of events, returning how many existed. Default =
+        per-id delete loop; backends with cheaper bulk primitives (e.g.
+        the eventlog's tombstone file) override."""
+        return sum(
+            1 for eid in event_ids if self.delete(eid, app_id, channel_id)
+        )
+
     @abc.abstractmethod
     def find(
         self,

@@ -8,7 +8,7 @@ cleanup) and admin/CommandClient.scala, which both drive the same sequence.
 from __future__ import annotations
 
 from pio_tpu.data.dao import AccessKey, App, Channel
-from pio_tpu.data.storage import Storage
+from pio_tpu.data.storage import Storage, StorageError
 
 
 def create_app(
@@ -52,6 +52,26 @@ def delete_app_data(
     storage.get_events().init(app.id, channel_id)
 
 
+def _namespaces(
+    channels_dao, app_id: int, channel_name: str | None
+) -> list[tuple[str, int | None]]:
+    """[(label, channel_id)] for an app: the default namespace plus every
+    registered channel. Labels stay unique even if a user names a channel
+    literally "default" (the default NAMESPACE is channel_id None; such a
+    channel is a distinct namespace and must not be skipped)."""
+    chans = channels_dao.get_by_appid(app_id)
+    if channel_name is not None:
+        match = [c for c in chans if c.name == channel_name]
+        if not match:
+            raise ValueError(f"Channel {channel_name} does not exist.")
+        return [(channel_name, match[0].id)]
+    out: list[tuple[str, int | None]] = [("default", None)]
+    for c in sorted(chans, key=lambda c: c.name):
+        label = c.name if c.name != "default" else f"default (channel {c.id})"
+        out.append((label, c.id))
+    return out
+
+
 def trim_copy(
     storage: Storage,
     src_app: App,
@@ -80,7 +100,7 @@ def trim_copy(
         try:
             probe = next(
                 iter(ev.find(dst_app.id, channel_id=ch, limit=1)), None)
-        except Exception:  # noqa: BLE001 - uninitialized namespace = empty
+        except StorageError:  # uninitialized namespace = empty
             continue
         if probe is not None:
             raise ValueError(
@@ -89,15 +109,7 @@ def trim_copy(
                 "contract)"
             )
 
-    src_channels = {c.name: c.id for c in channels.get_by_appid(src_app.id)}
-    if channel_name is not None:
-        if channel_name not in src_channels:
-            raise ValueError(f"Channel {channel_name} does not exist.")
-        pairs = [(channel_name, src_channels[channel_name])]
-    else:
-        pairs = [("default", None)] + sorted(
-            (n, cid) for n, cid in src_channels.items() if n != "default"
-        )
+    pairs = _namespaces(channels, src_app.id, channel_name)
 
     counts: dict[str, int] = {}
     for name, src_ch in pairs:
@@ -116,10 +128,44 @@ def trim_copy(
                 src_app.id, channel_id=src_ch,
                 start_time=start_time, until_time=until_time, limit=-1,
             )
-        except Exception:  # noqa: BLE001 - src namespace never initialized
+        except StorageError:  # src namespace never initialized
             found = []
         for event in found:
             ev.insert(event, dst_app.id, dst_ch)
             n += 1
         counts[name] = n
+    return counts
+
+
+def cleanup_events(
+    storage: Storage,
+    app: App,
+    until_time,
+    channel_name: str | None = None,
+) -> dict[str, int]:
+    """Delete events with event_time < until_time IN PLACE — the reference
+    cleanup-app workflow (examples/experimental/scala-cleanup-app/src/main/
+    scala/DataSource.scala:31-66: windowed PEvents.find -> per-event
+    LEvents.futureDelete). With channel_name=None every namespace is
+    cleaned. Returns {namespace_label: events_deleted}."""
+    if until_time is None:
+        raise ValueError("cleanup requires an --until cutoff time")
+    ev = storage.get_events()
+    channels = storage.get_metadata_channels()
+    pairs = _namespaces(channels, app.id, channel_name)
+    counts: dict[str, int] = {}
+    for name, ch in pairs:
+        try:
+            doomed = [
+                e.event_id
+                for e in ev.find(app.id, channel_id=ch,
+                                 until_time=until_time, limit=-1)
+                if e.event_id
+            ]
+        except StorageError:  # uninitialized namespace: nothing to clean
+            counts[name] = 0
+            continue
+        # a backend failure (e.g. remote store unreachable) must RAISE,
+        # not report a successful no-op — retention crons trust this count
+        counts[name] = ev.delete_many(doomed, app.id, ch)
     return counts

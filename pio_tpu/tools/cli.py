@@ -2,7 +2,7 @@
 
 Verb parity with reference tools/.../console/Console.scala:186-677:
   version status
-  app {new,list,show,delete,data-delete,trim,channel-new,channel-delete}
+  app {new,list,show,delete,data-delete,trim,cleanup,channel-new,channel-delete}
   accesskey {new,list,delete}
   build train deploy undeploy eval
   eventserver adminserver dashboard
@@ -286,6 +286,24 @@ def cmd_app(args) -> int:
         detail = ", ".join(f"{k}: {v}" for k, v in counts.items())
         print(f"Copied {total} events from '{a.name}' to '{dst.name}' "
               f"({detail}).")
+        return 0
+    if sub == "cleanup":
+        from pio_tpu.utils.time import parse_time
+
+        a = apps.get_by_name(args.name)
+        if a is None:
+            return _fail(f"App {args.name} does not exist.")
+        try:
+            counts = appops.cleanup_events(
+                storage, a,
+                until_time=parse_time(args.until),  # --until is required
+                channel_name=args.channel or None,
+            )
+        except ValueError as e:
+            return _fail(str(e))
+        total = sum(counts.values())
+        detail = ", ".join(f"{k}: {v}" for k, v in counts.items())
+        print(f"Deleted {total} events from '{a.name}' ({detail}).")
         return 0
     if sub == "channel-new":
         a = apps.get_by_name(args.name)
@@ -711,6 +729,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="copy only this named channel (all namespaces — "
                         "default + every channel — are copied otherwise)")
     x.set_defaults(fn=cmd_app, subcommand="trim")
+
+    x = pas.add_parser(
+        "cleanup", help="delete events OLDER than --until in place "
+        "(reference experimental cleanup-app)")
+    x.add_argument("name")
+    x.add_argument("--until", required=True,
+                   help="ISO-8601 exclusive cutoff: events before it go")
+    x.add_argument("--channel", default="",
+                   help="clean only this channel (all namespaces otherwise)")
+    x.set_defaults(fn=cmd_app, subcommand="cleanup")
 
     x = pas.add_parser("data-delete")
     x.add_argument("name")

@@ -141,6 +141,43 @@ def test_app_trim_copies_window(cli, memory_storage):
     assert code == 1
 
 
+def test_app_cleanup_deletes_old_events(cli, memory_storage):
+    """`pio app cleanup NAME --until` deletes events before the cutoff IN
+    PLACE, across all namespaces (reference experimental cleanup-app)."""
+    from datetime import datetime, timedelta, timezone
+
+    from pio_tpu.data.event import Event
+
+    code, _ = cli("app", "new", "CleanMe")
+    assert code == 0
+    apps = memory_storage.get_metadata_apps()
+    app = apps.get_by_name("CleanMe")
+    ev = memory_storage.get_events()
+    T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    for d in range(10):
+        ev.insert(Event(event="view", entity_type="user",
+                        entity_id=f"u{d}", event_time=T0 + timedelta(days=d)),
+                  app.id)
+    code, _ = cli("app", "channel-new", "CleanMe", "side")
+    ch = next(c for c in memory_storage.get_metadata_channels()
+              .get_by_appid(app.id) if c.name == "side")
+    ev.init(app.id, ch.id)
+    ev.insert(Event(event="old", entity_type="user", entity_id="c0",
+                    event_time=T0), app.id, ch.id)
+    ev.insert(Event(event="new", entity_type="user", entity_id="c1",
+                    event_time=T0 + timedelta(days=9)), app.id, ch.id)
+    code, out = cli("app", "cleanup", "CleanMe",
+                    "--until", "2026-01-06T00:00:00Z")
+    assert code == 0 and "Deleted 6 events" in out.out, out.out
+    remaining = list(ev.find(app.id, limit=-1))
+    assert {e.entity_id for e in remaining} == {"u5", "u6", "u7", "u8", "u9"}
+    side = list(ev.find(app.id, channel_id=ch.id, limit=-1))
+    assert [e.entity_id for e in side] == ["c1"]
+    # --until is required
+    code, _ = cli("app", "cleanup", "CleanMe", "--until", "garbage")
+    assert code == 1
+
+
 def test_upgrade_verb_migrates_between_backends(cli, tmp_path):
     from pio_tpu.data.storage import Storage
 

@@ -120,6 +120,21 @@ class TestEventsSpec:
         assert events_dao.get("ev1", 1) is None
         assert len(list(events_dao.find(1, limit=-1))) == len(CORPUS) - 1
 
+    def test_delete_many(self, events_dao):
+        """Bulk delete (retention cleanups): counts only events that
+        existed; deleted + unknown + duplicate ids are not double-counted.
+        The eventlog backend overrides this with a single-scan tombstone
+        batch — the spec body must hold for it and the base loop alike."""
+        _load(events_dao)
+        n = events_dao.delete_many(["ev1", "ev2", "nope", "ev2"], 1)
+        assert n == 2
+        assert events_dao.get("ev1", 1) is None
+        assert events_dao.get("ev2", 1) is None
+        assert len(list(events_dao.find(1, limit=-1))) == len(CORPUS) - 2
+        # repeat is a no-op
+        assert events_dao.delete_many(["ev1", "ev2"], 1) == 0
+        assert events_dao.delete_many([], 1) == 0
+
     def test_channels_isolated(self, events_dao):
         events_dao.init(1, 7)
         events_dao.insert(CORPUS[0], 1)
