@@ -825,8 +825,12 @@ bool parse_iso8601(const std::string& s, int64_t* us_out, int16_t* tz_out) {
         p++;
         int th, tm = 0;
         if (!digits(2, &th)) return false;
-        if (p < end && *p == ':') p++;
-        if (p < end && isdigit(*p)) {
+        if (p < end && *p == ':') {
+          // a colon commits to minutes: '+05:' is invalid (fromisoformat
+          // parity), only +HH / +HHMM may omit them
+          p++;
+          if (!digits(2, &tm)) return false;
+        } else if (p < end && isdigit(*p)) {
           if (!digits(2, &tm)) return false;
         }
         // fromisoformat parity: reject offsets a python timezone() cannot
@@ -913,10 +917,15 @@ struct IngestResult {
 };
 
 void pack_u16str(std::vector<uint8_t>* out, const std::string& s) {
-  uint16_t n = static_cast<uint16_t>(s.size());
+  // The u16 prefix caps a field at 65535 bytes. Oversize input is truncated
+  // so the frame stays parseable no matter what; ingest_one rejects oversize
+  // *event data* before it ever reaches here (parity with the Python pack
+  // path's ValueError), so truncation only applies to diagnostic messages.
+  size_t cap = s.size() > 0xFFFF ? 0xFFFF : s.size();
+  uint16_t n = static_cast<uint16_t>(cap);
   out->push_back(n & 0xFF);
   out->push_back(n >> 8);
-  out->insert(out->end(), s.begin(), s.end());
+  out->insert(out->end(), s.begin(), s.begin() + cap);
 }
 
 // Parse + validate one event object; append to the log on success.
@@ -1167,6 +1176,21 @@ IngestResult ingest_one(Log* lg, JParser& jp,
     char idbuf[33];
     gen_event_id(idbuf);
     eventid.assign(idbuf, 32);
+  }
+  // u16 framing caps every string field at 65535 bytes; reject before
+  // packing rather than corrupt the record. Same order and message as the
+  // Python path (_pack_str, pio_tpu/native/eventlog.py) so both paths
+  // return identical 400s.
+  {
+    const std::string* fields[] = {&ev,      &etype, &eid,  &tetype,
+                                   &teid,    &eventid, &prid, &tags_json};
+    for (const std::string* s : fields) {
+      if (s->size() > 0xFFFF) {
+        r.id_or_msg = "string field too long (" +
+                      std::to_string(s->size()) + " bytes)";
+        return r;
+      }
+    }
   }
   std::vector<uint8_t> payload;
   payload.reserve(96 + ev.size() + etype.size() + eid.size() +

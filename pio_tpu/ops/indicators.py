@@ -53,7 +53,9 @@ def rsi(log_price: jax.Array, period: int = 14) -> jax.Array:
     # flat window (no gains, no losses): RSI conventionally 50
     flat = (avg_g <= 1e-12) & (avg_l <= 1e-12)
     out = jnp.where(flat, 50.0, out)
-    return out.at[: period + 1].set(0.0)
+    # rows [:period] contain the artificial zero return at row 0 inside the
+    # window; row `period` is the first RSI over `period` real returns
+    return out.at[:period].set(0.0)
 
 
 def ema(x: jax.Array, period: int) -> jax.Array:

@@ -118,8 +118,14 @@ def moe_ffn_ep(params, x, cfg: MoEConfig, mesh: Mesh, axis: str = "data"):
 
     x: (T, D) GLOBAL tokens (T divisible by mesh[axis]). The router is
     replicated; w_in/b_in/w_out/b_out are sharded on the expert axis.
-    Returns (y (T, D), aux_loss) — identical to moe_ffn up to float
-    reassociation (tests pin the two together)."""
+    Returns (y (T, D), aux_loss).
+
+    Capacity semantics: each device budgets cf * t_local / E slots per
+    expert from ITS shard (Switch-style), vs moe_ffn's one global
+    cf * T / E pool — so a skewed routing distribution can drop tokens
+    here that the single-device path keeps. Equivalence with moe_ffn
+    (which tests pin, up to float reassociation) holds exactly when no
+    expert exceeds capacity on any device."""
     n_dev = mesh.shape[axis]
     if cfg.n_experts % n_dev != 0:
         raise ValueError(

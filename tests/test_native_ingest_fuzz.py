@@ -15,6 +15,7 @@ import pytest
 from pio_tpu.data.backends.eventlog import EventLogBackend
 from pio_tpu.data.event import Event, EventValidationError, validate_event
 from pio_tpu.data.storage import StorageClientConfig
+from pio_tpu.native.eventlog import pack_event
 
 
 @pytest.fixture
@@ -34,6 +35,10 @@ def python_verdict(d) -> int:
     try:
         e = Event.from_api_dict(d)
         validate_event(e)
+        # the Python pipeline's storage step packs the record; oversize
+        # string fields fail HERE (u16 framing), so the verdict must
+        # include it to mirror what the server actually returns
+        pack_event(e if e.event_id is not None else e.with_id("0" * 32))
         return 0
     except (EventValidationError, ValueError):
         return 1
@@ -159,6 +164,52 @@ def test_fuzz_event_dicts_verdict_parity(dao):
     # every accepted event is decodable through the normal read path
     evs = list(dao.find(3, limit=-1))
     assert len(evs) == accepted
+
+
+def test_oversize_string_fields_rejected_both_paths(dao):
+    """u16 framing caps string fields at 65535 bytes: the native path must
+    reject (not silently corrupt) any oversize field, with the exact
+    message the Python pack path raises, and the log must stay readable."""
+    for field, base in [
+        ("entityId", {"event": "rate", "entityType": "user"}),
+        ("event", {"entityType": "user", "entityId": "u1"}),
+        ("prId", {"event": "rate", "entityType": "user", "entityId": "u1"}),
+        ("eventId", {"event": "rate", "entityType": "user",
+                     "entityId": "u1"}),
+    ]:
+        d = dict(base)
+        d[field] = "x" * 70000
+        raw = json.dumps([d]).encode()
+        (status, payload, _, _) = dao.insert_api_batch(raw, 3)[0]
+        assert status == 1, (field, status, payload)
+        assert payload == "string field too long (70000 bytes)", payload
+        assert python_verdict(d) == 1  # Python pack path agrees
+    # boundary: exactly 65535 bytes is legal and round-trips
+    d = {"event": "rate", "entityType": "user", "entityId": "y" * 65535}
+    (status, payload, _, _) = dao.insert_api_batch(
+        json.dumps([d]).encode(), 3)[0]
+    assert status == 0, payload
+    evs = [e for e in dao.find(3, limit=-1) if e.entity_id == "y" * 65535]
+    assert len(evs) == 1
+    # every stored record still parses (no framing corruption)
+    for e in dao.find(3, limit=-1):
+        assert e.event_id
+
+
+def test_tz_offset_trailing_colon_rejected(dao):
+    """'+05:' (colon with no minute digits) must 400 on the native path,
+    matching datetime.fromisoformat; +05 and +05:30 stay accepted."""
+    def ingest(t):
+        d = {"event": "rate", "entityType": "user", "entityId": "u1",
+             "eventTime": t}
+        res = dao.insert_api_batch(json.dumps([d]).encode(), 3)[0]
+        assert (res[0] != 0) == (python_verdict(d) != 0), (t, res)
+        return res[0]
+
+    assert ingest("2024-01-01T00:00:00+05:") == 1
+    assert ingest("2024-01-01T00:00:00-08:") == 1
+    assert ingest("2024-01-01T00:00:00+05:30") == 0
+    assert ingest("2024-01-01T00:00:00+0530") == 0
 
 
 def test_fuzz_raw_bytes_never_crash(dao):

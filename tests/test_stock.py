@@ -50,6 +50,15 @@ def test_rsi_extremes():
     np.testing.assert_allclose(r_flat, 50.0)
 
 
+def test_rsi_first_valid_row():
+    """The first full window of `period` real returns ends at row
+    `period`; that row must carry a real RSI (regression: it was zeroed)."""
+    up = np.cumsum(np.full((40, 1), 0.01, np.float32), axis=0)
+    r = np.asarray(rsi(jnp.asarray(up), 14))
+    assert (r[:14] == 0).all()
+    assert r[14] > 99
+
+
 def test_ema_converges_to_constant():
     x = np.full((60, 2), 3.5, np.float32)
     out = np.asarray(ema(jnp.asarray(x), 10))
