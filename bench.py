@@ -195,10 +195,13 @@ def phase_train() -> dict:
 
     # CPU-fallback (tunnel down): shrink to a tractable single-core slice,
     # scaling dims WITH nnz (constant ratings/user density) so the per-sweep
-    # cost structure matches the full problem and the rate stays meaningful
+    # cost structure matches the full problem and the rate stays meaningful.
+    # Still MULTI-sweep: the fixed-cost-vs-per-sweep decomposition (and
+    # every derived field) must land even on the fallback platform, or
+    # rounds stop being comparable (round-2 verdict weak #5)
     on_cpu = os.environ.get("PIO_BENCH_PLATFORM") == "cpu" and not SMALL
     nnz = 1_000_000 if on_cpu else NNZ
-    iters = 1 if on_cpu else ITERS
+    iters = 4 if on_cpu else ITERS
     scale = max(1, NNZ // nnz)
     n_users = max(64, N_USERS // scale)
     n_items = max(32, N_ITEMS // scale)
@@ -265,6 +268,7 @@ def phase_train() -> dict:
         "device_kind": kind,
         "rank": RANK,
         "cg_iters": cg,
+        "accum": ALSParams().resolved_accum(),
     }
 
 
@@ -683,7 +687,7 @@ def main() -> int:
                  "fixed_layout_sec",
                  "per_sweep_sec", "per_sweep_rate", "flops_per_sweep",
                  "flops_per_sec", "mfu_vs_bf16_peak",
-                 "sweep_mfu_vs_bf16_peak", "rank", "cg_iters")
+                 "sweep_mfu_vs_bf16_peak", "rank", "cg_iters", "accum")
                 if k in train
             }
         elif err:

@@ -916,6 +916,78 @@ struct IngestResult {
   std::string entity_type;
 };
 
+// Append a decoded (UTF-8) string as json.dumps would emit it —
+// ensure_ascii=True, lowercase hex, surrogate pairs for astral planes.
+// Byte-for-byte parity with the Python pack path matters: the stored tags
+// bytes AND the u16 framing limit must agree across both ingest paths.
+void append_json_escaped(std::string* out, const std::string& s) {
+  static const char* kHex = "0123456789abcdef";
+  auto u_esc = [&](uint32_t v) {
+    out->push_back('\\');
+    out->push_back('u');
+    out->push_back(kHex[(v >> 12) & 0xF]);
+    out->push_back(kHex[(v >> 8) & 0xF]);
+    out->push_back(kHex[(v >> 4) & 0xF]);
+    out->push_back(kHex[v & 0xF]);
+  };
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(s.data());
+  const uint8_t* end = p + s.size();
+  out->push_back('"');
+  while (p < end) {
+    uint8_t c = *p;
+    if (c == '"') { out->append("\\\""); p++; continue; }
+    if (c == '\\') { out->append("\\\\"); p++; continue; }
+    if (c >= 0x20 && c < 0x7F) {
+      out->push_back(static_cast<char>(c));
+      p++;
+      continue;
+    }
+    if (c == 0x7F) {  // DEL: ensure_ascii escapes it
+      u_esc(c);
+      p++;
+      continue;
+    }
+    if (c < 0x20) {
+      switch (c) {
+        case '\b': out->append("\\b"); break;
+        case '\t': out->append("\\t"); break;
+        case '\n': out->append("\\n"); break;
+        case '\f': out->append("\\f"); break;
+        case '\r': out->append("\\r"); break;
+        default: u_esc(c);
+      }
+      p++;
+      continue;
+    }
+    // multi-byte UTF-8 (input validated by valid_utf8 / built by
+    // json_unescape, which may hold WTF-8 lone surrogates — Python's
+    // json round-trips those the same way)
+    uint32_t cp;
+    if ((c & 0xE0) == 0xC0 && p + 1 < end) {
+      cp = ((c & 0x1F) << 6) | (p[1] & 0x3F);
+      p += 2;
+    } else if ((c & 0xF0) == 0xE0 && p + 2 < end) {
+      cp = ((c & 0x0F) << 12) | ((p[1] & 0x3F) << 6) | (p[2] & 0x3F);
+      p += 3;
+    } else if ((c & 0xF8) == 0xF0 && p + 3 < end) {
+      cp = ((c & 0x07) << 18) | ((p[1] & 0x3F) << 12) |
+           ((p[2] & 0x3F) << 6) | (p[3] & 0x3F);
+      p += 4;
+    } else {  // unreachable on validated input; emit replacement
+      cp = 0xFFFD;
+      p++;
+    }
+    if (cp > 0xFFFF) {
+      cp -= 0x10000;
+      u_esc(0xD800 + (cp >> 10));
+      u_esc(0xDC00 + (cp & 0x3FF));
+    } else {
+      u_esc(cp);
+    }
+  }
+  out->push_back('"');
+}
+
 void pack_u16str(std::vector<uint8_t>* out, const std::string& s) {
   // The u16 prefix caps a field at 65535 bytes. Oversize input is truncated
   // so the frame stays parseable no matter what; ingest_one rejects oversize
@@ -1060,7 +1132,10 @@ IngestResult ingest_one(Log* lg, JParser& jp,
     }
   }
 
-  // tags: raw span, every element must be a string
+  // tags: every element must be a string; stored CANONICALIZED as the
+  // exact bytes json.dumps(list(tags)) produces (the Python pack path),
+  // so the two ingest paths store identical records and hit the u16
+  // framing limit at exactly the same inputs
   std::string tags_json;
   // falsy tags values collapse to [] (from_api_dict: `... or []`)
   if (f_tags.present && !json_falsy(f_tags.v)) {
@@ -1070,6 +1145,7 @@ IngestResult ingest_one(Log* lg, JParser& jp,
     }
     bool all_str = true;
     size_t n_tags = 0;
+    std::string canon = "[";
     JParser tp(f_tags.v.raw, f_tags.v.raw_n);
     tp.p++;  // consume '['
     tp.ws();
@@ -1084,6 +1160,13 @@ IngestResult ingest_one(Log* lg, JParser& jp,
           all_str = false;
           break;
         }
+        std::string tag;
+        if (!json_unescape(v.str, &tag)) {
+          all_str = false;
+          break;
+        }
+        if (n_tags > 0) canon += ", ";
+        append_json_escaped(&canon, tag);
         n_tags++;
         tp.ws();
         if (tp.p < tp.end && *tp.p == ',') {
@@ -1097,9 +1180,10 @@ IngestResult ingest_one(Log* lg, JParser& jp,
       r.id_or_msg = "tags must be a list of strings";
       return r;
     }
-    if (n_tags > 0)
-      tags_json.assign(reinterpret_cast<const char*>(f_tags.v.raw),
-                       f_tags.v.raw_n);
+    if (n_tags > 0) {
+      canon += "]";
+      tags_json = std::move(canon);
+    }
   }
 
   // times

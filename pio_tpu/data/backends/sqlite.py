@@ -1,30 +1,25 @@
 """SQLite storage backend — the default durable single-host backend.
 
-Plays the role of the reference's JDBC backend (data/.../storage/jdbc/*,
-scalikejdbc on PostgreSQL/MySQL): full DAO set including the events store and
+Plays the role of the reference's embedded/single-node JDBC deployments
+(data/.../storage/jdbc/*): full DAO set including the events store and
 model blobs, in one database file. Uses a single `events` table keyed by
 (app_id, channel_id) with a time index instead of the reference's
 table-per-app DDL (JDBCLEvents.scala:106) — same namespace semantics via an
-explicit namespaces table.
+explicit namespaces table. The DAO bodies live in sqlcommon.py, shared
+with the PostgreSQL backend; this module provides the sqlite dialect
+(INSERT OR REPLACE upserts, `IS ?` null-safe equality, lastrowid) and
+the schema/migration.
 """
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import sqlite3
 import threading
-from dataclasses import replace
-from datetime import datetime
-from typing import Iterator, Sequence
 
-from pio_tpu.data import dao as d
-from pio_tpu.data.backends.common import DEFAULT_FIND_LIMIT, new_event_id
-from pio_tpu.data.datamap import DataMap
-from pio_tpu.data.event import Event
-from pio_tpu.data.storage import Backend, StorageError
-from pio_tpu.utils.time import format_time, millis, parse_time
+from pio_tpu.data.backends import sqlcommon as sc
+from pio_tpu.data.storage import Backend
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS apps (
@@ -68,6 +63,57 @@ CREATE INDEX IF NOT EXISTS idx_events_entity
 """
 
 
+class _SqliteDb:
+    """sqlcommon.SqlDb over one serialized sqlite connection."""
+
+    nullsafe = "IS"
+
+    def __init__(self, conn: sqlite3.Connection, lock: threading.RLock):
+        self._conn = conn
+        self._lock = lock
+
+    def exec(self, sql: str, params: tuple = ()) -> int:
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            self._conn.commit()
+            return cur.rowcount
+
+    def query(self, sql: str, params: tuple = ()) -> list[tuple]:
+        with self._lock:
+            return list(self._conn.execute(sql, params))
+
+    def insert_auto_id(self, table, cols, params):
+        sql = (
+            f"INSERT INTO {table} ({','.join(cols)}) "
+            f"VALUES ({','.join('?' * len(cols))})"
+        )
+        try:
+            with self._lock:
+                cur = self._conn.execute(sql, params)
+                self._conn.commit()
+                return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def try_exec(self, sql: str, params: tuple = ()) -> bool:
+        try:
+            self.exec(sql, params)
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+    def upsert_sql(self, table, cols, conflict):
+        # OR REPLACE keys on whichever unique index covers `conflict`
+        # (the expression index idx_events_ns_id for events)
+        return (
+            f"INSERT OR REPLACE INTO {table} ({','.join(cols)}) "
+            f"VALUES ({','.join('?' * len(cols))})"
+        )
+
+    def sync_auto_id(self, table):
+        pass  # sqlite rowid allocation is MAX(rowid)+1: always aligned
+
+
 class SqliteBackend(Backend):
     def __init__(self, config):
         super().__init__(config)
@@ -80,6 +126,7 @@ class SqliteBackend(Backend):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._lock = threading.RLock()
+        self._db = _SqliteDb(self._conn, self._lock)
         with self._lock:
             self._migrate_events_pk()
             self._conn.executescript(_SCHEMA)
@@ -136,490 +183,28 @@ class SqliteBackend(Backend):
                 pass
             self._conn.close()
 
-    def _exec(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
-        with self._lock:
-            cur = self._conn.execute(sql, params)
-            self._conn.commit()
-            return cur
-
-    def _query(self, sql: str, params: tuple = ()) -> list[tuple]:
-        with self._lock:
-            return list(self._conn.execute(sql, params))
-
     def apps(self):
-        return _SqlApps(self)
+        return sc.SqlApps(self._db)
 
     def access_keys(self):
-        return _SqlAccessKeys(self)
+        return sc.SqlAccessKeys(self._db)
 
     def channels(self):
-        return _SqlChannels(self)
+        return sc.SqlChannels(self._db)
 
     def engine_instances(self):
-        return _SqlEngineInstances(self)
+        return sc.SqlEngineInstances(self._db)
 
     def engine_manifests(self):
-        return _SqlEngineManifests(self)
+        return sc.SqlEngineManifests(self._db)
 
     def evaluation_instances(self):
-        return _SqlEvaluationInstances(self)
+        return sc.SqlEvaluationInstances(self._db)
 
     def models(self):
-        return _SqlModels(self)
+        return sc.SqlModels(self._db)
 
     def events(self):
-        return _SqlEvents(self)
-
-
-class _SqlApps(d.AppsDAO):
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-
-    def insert(self, app: d.App):
-        try:
-            if app.id > 0:
-                self.b._exec(
-                    "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
-                    (app.id, app.name, app.description),
-                )
-                return app.id
-            cur = self.b._exec(
-                "INSERT INTO apps (name, description) VALUES (?,?)",
-                (app.name, app.description),
-            )
-            return cur.lastrowid
-        except sqlite3.IntegrityError:
-            return None
-
-    def get(self, app_id):
-        rows = self.b._query(
-            "SELECT id, name, description FROM apps WHERE id=?", (app_id,)
-        )
-        return d.App(*rows[0]) if rows else None
-
-    def get_by_name(self, name):
-        rows = self.b._query(
-            "SELECT id, name, description FROM apps WHERE name=?", (name,)
-        )
-        return d.App(*rows[0]) if rows else None
-
-    def get_all(self):
-        return [d.App(*r) for r in self.b._query(
-            "SELECT id, name, description FROM apps")]
-
-    def update(self, app):
-        self.b._exec(
-            "UPDATE apps SET name=?, description=? WHERE id=?",
-            (app.name, app.description, app.id),
-        )
-
-    def delete(self, app_id):
-        self.b._exec("DELETE FROM apps WHERE id=?", (app_id,))
-
-
-class _SqlAccessKeys(d.AccessKeysDAO):
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-
-    def insert(self, k: d.AccessKey):
-        key = k.key or self.generate_key()
-        try:
-            self.b._exec(
-                "INSERT INTO access_keys (key, appid, events) VALUES (?,?,?)",
-                (key, k.appid, json.dumps(list(k.events))),
-            )
-            return key
-        except sqlite3.IntegrityError:
-            return None
-
-    def _row(self, r):
-        return d.AccessKey(r[0], r[1], tuple(json.loads(r[2])))
-
-    def get(self, key):
-        rows = self.b._query(
-            "SELECT key, appid, events FROM access_keys WHERE key=?", (key,)
-        )
-        return self._row(rows[0]) if rows else None
-
-    def get_all(self):
-        return [self._row(r) for r in self.b._query(
-            "SELECT key, appid, events FROM access_keys")]
-
-    def get_by_appid(self, appid):
-        return [self._row(r) for r in self.b._query(
-            "SELECT key, appid, events FROM access_keys WHERE appid=?", (appid,))]
-
-    def update(self, k):
-        self.b._exec(
-            "UPDATE access_keys SET appid=?, events=? WHERE key=?",
-            (k.appid, json.dumps(list(k.events)), k.key),
-        )
-
-    def delete(self, key):
-        self.b._exec("DELETE FROM access_keys WHERE key=?", (key,))
-
-
-class _SqlChannels(d.ChannelsDAO):
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-
-    def insert(self, channel: d.Channel):
-        if not d.Channel.is_valid_name(channel.name):
-            return None
-        try:
-            if channel.id > 0:
-                self.b._exec(
-                    "INSERT INTO channels (id, name, appid) VALUES (?,?,?)",
-                    (channel.id, channel.name, channel.appid),
-                )
-                return channel.id
-            cur = self.b._exec(
-                "INSERT INTO channels (name, appid) VALUES (?,?)",
-                (channel.name, channel.appid),
-            )
-            return cur.lastrowid
-        except sqlite3.IntegrityError:
-            return None
-
-    def get(self, channel_id):
-        rows = self.b._query(
-            "SELECT id, name, appid FROM channels WHERE id=?", (channel_id,)
-        )
-        return d.Channel(*rows[0]) if rows else None
-
-    def get_by_appid(self, appid):
-        return [d.Channel(*r) for r in self.b._query(
-            "SELECT id, name, appid FROM channels WHERE appid=?", (appid,))]
-
-    def delete(self, channel_id):
-        self.b._exec("DELETE FROM channels WHERE id=?", (channel_id,))
-
-
-def _dt(s: str | None) -> datetime | None:
-    return parse_time(s) if s else None
-
-
-class _SqlEngineInstances(d.EngineInstancesDAO):
-    COLS = (
-        "id,status,start_time,end_time,engine_id,engine_version,engine_variant,"
-        "engine_factory,batch,env,spark_conf,datasource_params,"
-        "preparator_params,algorithms_params,serving_params"
-    )
-
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-        self._counter_lock = threading.Lock()
-
-    def _to_row(self, i: d.EngineInstance):
-        return (
-            i.id, i.status, format_time(i.start_time), format_time(i.end_time),
-            i.engine_id, i.engine_version, i.engine_variant, i.engine_factory,
-            i.batch, json.dumps(i.env), json.dumps(i.spark_conf),
-            i.datasource_params, i.preparator_params, i.algorithms_params,
-            i.serving_params,
-        )
-
-    def _from_row(self, r) -> d.EngineInstance:
-        return d.EngineInstance(
-            id=r[0], status=r[1], start_time=_dt(r[2]), end_time=_dt(r[3]),
-            engine_id=r[4], engine_version=r[5], engine_variant=r[6],
-            engine_factory=r[7], batch=r[8], env=json.loads(r[9] or "{}"),
-            spark_conf=json.loads(r[10] or "{}"), datasource_params=r[11],
-            preparator_params=r[12], algorithms_params=r[13],
-            serving_params=r[14],
-        )
-
-    def insert(self, i: d.EngineInstance):
-        iid = i.id or new_event_id()
-        i = replace(i, id=iid)
-        self.b._exec(
-            f"INSERT INTO engine_instances ({self.COLS}) VALUES "
-            f"({','.join('?' * 15)})",
-            self._to_row(i),
-        )
-        return iid
-
-    def get(self, instance_id):
-        rows = self.b._query(
-            f"SELECT {self.COLS} FROM engine_instances WHERE id=?", (instance_id,)
-        )
-        return self._from_row(rows[0]) if rows else None
-
-    def get_all(self):
-        return [self._from_row(r) for r in self.b._query(
-            f"SELECT {self.COLS} FROM engine_instances")]
-
-    def update(self, i):
-        self.b._exec(
-            "UPDATE engine_instances SET status=?, start_time=?, end_time=?, "
-            "engine_id=?, engine_version=?, engine_variant=?, engine_factory=?, "
-            "batch=?, env=?, spark_conf=?, datasource_params=?, "
-            "preparator_params=?, algorithms_params=?, serving_params=? "
-            "WHERE id=?",
-            self._to_row(i)[1:] + (i.id,),
-        )
-
-    def delete(self, instance_id):
-        self.b._exec("DELETE FROM engine_instances WHERE id=?", (instance_id,))
-
-
-class _SqlEngineManifests(d.EngineManifestsDAO):
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-
-    def insert(self, m: d.EngineManifest):
-        self.b._exec(
-            "INSERT OR REPLACE INTO engine_manifests "
-            "(id, version, name, description, files, engine_factory) "
-            "VALUES (?,?,?,?,?,?)",
-            (m.id, m.version, m.name, m.description,
-             json.dumps(list(m.files)), m.engine_factory),
-        )
-
-    def _from_row(self, r):
-        return d.EngineManifest(
-            id=r[0], version=r[1], name=r[2], description=r[3],
-            files=tuple(json.loads(r[4] or "[]")), engine_factory=r[5],
-        )
-
-    def get(self, manifest_id, version):
-        rows = self.b._query(
-            "SELECT id, version, name, description, files, engine_factory "
-            "FROM engine_manifests WHERE id=? AND version=?",
-            (manifest_id, version),
-        )
-        return self._from_row(rows[0]) if rows else None
-
-    def get_all(self):
-        return [self._from_row(r) for r in self.b._query(
-            "SELECT id, version, name, description, files, engine_factory "
-            "FROM engine_manifests")]
-
-    def update(self, m, upsert=False):
-        self.insert(m)
-
-    def delete(self, manifest_id, version):
-        self.b._exec(
-            "DELETE FROM engine_manifests WHERE id=? AND version=?",
-            (manifest_id, version),
-        )
-
-
-class _SqlEvaluationInstances(d.EvaluationInstancesDAO):
-    COLS = (
-        "id,status,start_time,end_time,evaluation_class,"
-        "engine_params_generator_class,batch,env,evaluator_results,"
-        "evaluator_results_html,evaluator_results_json"
-    )
-
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-
-    def _to_row(self, i: d.EvaluationInstance):
-        return (
-            i.id, i.status, format_time(i.start_time), format_time(i.end_time),
-            i.evaluation_class, i.engine_params_generator_class, i.batch,
-            json.dumps(i.env), i.evaluator_results, i.evaluator_results_html,
-            i.evaluator_results_json,
-        )
-
-    def _from_row(self, r):
-        return d.EvaluationInstance(
-            id=r[0], status=r[1], start_time=_dt(r[2]), end_time=_dt(r[3]),
-            evaluation_class=r[4], engine_params_generator_class=r[5],
-            batch=r[6], env=json.loads(r[7] or "{}"), evaluator_results=r[8],
-            evaluator_results_html=r[9], evaluator_results_json=r[10],
-        )
-
-    def insert(self, i: d.EvaluationInstance):
-        iid = i.id or new_event_id()
-        i = replace(i, id=iid)
-        self.b._exec(
-            f"INSERT INTO evaluation_instances ({self.COLS}) VALUES "
-            f"({','.join('?' * 11)})",
-            self._to_row(i),
-        )
-        return iid
-
-    def get(self, instance_id):
-        rows = self.b._query(
-            f"SELECT {self.COLS} FROM evaluation_instances WHERE id=?",
-            (instance_id,),
-        )
-        return self._from_row(rows[0]) if rows else None
-
-    def get_all(self):
-        return [self._from_row(r) for r in self.b._query(
-            f"SELECT {self.COLS} FROM evaluation_instances")]
-
-    def update(self, i):
-        self.b._exec(
-            "UPDATE evaluation_instances SET status=?, start_time=?, "
-            "end_time=?, evaluation_class=?, engine_params_generator_class=?, "
-            "batch=?, env=?, evaluator_results=?, evaluator_results_html=?, "
-            "evaluator_results_json=? WHERE id=?",
-            self._to_row(i)[1:] + (i.id,),
-        )
-
-    def delete(self, instance_id):
-        self.b._exec("DELETE FROM evaluation_instances WHERE id=?", (instance_id,))
-
-
-class _SqlModels(d.ModelsDAO):
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-
-    def insert(self, m: d.Model):
-        self.b._exec(
-            "INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
-            (m.id, m.models),
-        )
-
-    def get(self, model_id):
-        rows = self.b._query("SELECT id, models FROM models WHERE id=?", (model_id,))
-        return d.Model(rows[0][0], rows[0][1]) if rows else None
-
-    def delete(self, model_id):
-        self.b._exec("DELETE FROM models WHERE id=?", (model_id,))
-
-
-class _SqlEvents(d.EventsDAO):
-    def __init__(self, b: SqliteBackend):
-        self.b = b
-
-    def init(self, app_id, channel_id=None):
-        self.b._exec(
-            "INSERT OR IGNORE INTO event_namespaces (app_id, channel_id) "
-            "VALUES (?,?)",
-            (app_id, channel_id),
-        )
-        return True
-
-    def _check_ns(self, app_id, channel_id):
-        rows = self.b._query(
-            "SELECT 1 FROM event_namespaces WHERE app_id=? AND channel_id IS ?",
-            (app_id, channel_id),
-        )
-        if not rows:
-            raise StorageError(
-                f"events namespace not initialized for app {app_id} "
-                f"channel {channel_id} (call init first)"
-            )
-
-    def remove(self, app_id, channel_id=None):
-        self.b._exec(
-            "DELETE FROM events WHERE app_id=? AND channel_id IS ?",
-            (app_id, channel_id),
-        )
-        cur = self.b._exec(
-            "DELETE FROM event_namespaces WHERE app_id=? AND channel_id IS ?",
-            (app_id, channel_id),
-        )
-        return cur.rowcount > 0
-
-    def close(self):
-        pass
-
-    def insert(self, event: Event, app_id, channel_id=None):
-        self._check_ns(app_id, channel_id)
-        eid = event.event_id or new_event_id()
-        # OR REPLACE against the per-namespace unique index
-        # (app_id, channel_id, id): re-inserting an explicit event id upserts
-        # within its own namespace only, matching the memory backend and the
-        # reference's HBase Put-by-rowkey semantics
-        # (hbase/HBEventsUtil.scala:144) — and making migration re-runs
-        # idempotent.
-        self.b._exec(
-            "INSERT OR REPLACE INTO events (id, app_id, channel_id, event, entity_type, "
-            "entity_id, target_entity_type, target_entity_id, properties, "
-            "event_time, event_time_ms, tags, pr_id, creation_time) "
-            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
-            (
-                eid, app_id, channel_id, event.event, event.entity_type,
-                event.entity_id, event.target_entity_type,
-                event.target_entity_id, event.properties.to_json(),
-                format_time(event.event_time), millis(event.event_time),
-                json.dumps(list(event.tags)), event.pr_id,
-                format_time(event.creation_time),
-            ),
-        )
-        return eid
-
-    def _from_row(self, r) -> Event:
-        return Event(
-            event_id=r[0], event=r[3], entity_type=r[4], entity_id=r[5],
-            target_entity_type=r[6], target_entity_id=r[7],
-            properties=DataMap.from_json(r[8]), event_time=parse_time(r[9]),
-            tags=tuple(json.loads(r[11] or "[]")), pr_id=r[12],
-            creation_time=parse_time(r[13]),
-        )
-
-    def get(self, event_id, app_id, channel_id=None):
-        self._check_ns(app_id, channel_id)
-        rows = self.b._query(
-            "SELECT * FROM events WHERE id=? AND app_id=? AND channel_id IS ?",
-            (event_id, app_id, channel_id),
-        )
-        return self._from_row(rows[0]) if rows else None
-
-    def delete(self, event_id, app_id, channel_id=None):
-        self._check_ns(app_id, channel_id)
-        cur = self.b._exec(
-            "DELETE FROM events WHERE id=? AND app_id=? AND channel_id IS ?",
-            (event_id, app_id, channel_id),
-        )
-        return cur.rowcount > 0
-
-    def find(
-        self,
-        app_id: int,
-        channel_id: int | None = None,
-        start_time: datetime | None = None,
-        until_time: datetime | None = None,
-        entity_type: str | None = None,
-        entity_id: str | None = None,
-        event_names: Sequence[str] | None = None,
-        target_entity_type=...,
-        target_entity_id=...,
-        limit: int | None = None,
-        reversed: bool = False,
-    ) -> Iterator[Event]:
-        self._check_ns(app_id, channel_id)
-        sql = "SELECT * FROM events WHERE app_id=? AND channel_id IS ?"
-        params: list = [app_id, channel_id]
-        if start_time is not None:
-            sql += " AND event_time_ms >= ?"
-            params.append(millis(start_time))
-        if until_time is not None:
-            sql += " AND event_time_ms < ?"
-            params.append(millis(until_time))
-        if entity_type is not None:
-            sql += " AND entity_type = ?"
-            params.append(entity_type)
-        if entity_id is not None:
-            sql += " AND entity_id = ?"
-            params.append(entity_id)
-        if event_names is not None:
-            sql += f" AND event IN ({','.join('?' * len(event_names))})"
-            params.extend(event_names)
-        if target_entity_type is not ...:
-            if target_entity_type is None:
-                sql += " AND target_entity_type IS NULL"
-            else:
-                sql += " AND target_entity_type = ?"
-                params.append(target_entity_type)
-        if target_entity_id is not ...:
-            if target_entity_id is None:
-                sql += " AND target_entity_id IS NULL"
-            else:
-                sql += " AND target_entity_id = ?"
-                params.append(target_entity_id)
-        # push ordering + paging into SQL so the serve path stays O(limit)
-        sql += f" ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}"
-        if limit is None:
-            limit = DEFAULT_FIND_LIMIT
-        if limit >= 0:
-            sql += " LIMIT ?"
-            params.append(limit)
-        rows = self.b._query(sql, tuple(params))
-        return iter(self._from_row(r) for r in rows)
+        # sqlite's OR REPLACE resolves against the expression index
+        # idx_events_ns_id; the conflict tuple is informational here
+        return sc.SqlEvents(self._db, ("app_id", "channel_id", "id"))

@@ -79,6 +79,19 @@ class ALSParams:
     # batched Cholesky costs <~70ms (linear in batch; 1157ms at 138k on
     # v5e) so exactness is free; above it CG's MXU matvecs win big
     auto_cg_rows: int = 8192
+    # normal-equation accumulation strategy:
+    #   "carry":   scatter-add each chunk's blocks into the (n,k,k)
+    #              accumulator inside the scan (the accumulator is a loop
+    #              carry — if XLA materializes the carry per iteration the
+    #              full accumulator re-streams once per chunk);
+    #   "stacked": chunks emit their blocks as scan OUTPUTS (no big carry),
+    #              then one sorted scatter-add per slot group folds them
+    #              into A — bounded temp via group_slots;
+    #   "auto":    stacked (measured-safe default; see eval/als_accum_bench)
+    accum: str = "auto"
+    # stacked mode: max slots whose (k,k) blocks are materialized at once;
+    # temp bytes = group_slots * k * k * 4 (73k slots @ k=64 = 1.2 GB)
+    group_slots: int = 73728
 
     def resolved_cg_iters(self, n_self: int | None = None) -> int:
         """-1 (default) = auto, decided per factor side by its row count:
@@ -105,6 +118,12 @@ class ALSParams:
         if n_self is not None and n_self <= self.auto_cg_rows:
             return 0
         return max(16, self.rank // 4)
+
+    def resolved_accum(self) -> str:
+        """The accumulation strategy that actually runs ("auto" resolves
+        here, next to resolved_cg_iters, so callers — bench artifacts
+        included — can report the real mode, not the knob)."""
+        return "stacked" if self.accum == "auto" else self.accum
 
 
 @jax.tree_util.register_pytree_node_class
@@ -158,9 +177,13 @@ def _device_slot_layout(u, o, v, n_self: int, width: int, slots_max: int):
     valid = u_s < n_self
 
     slot_id = jnp.where(valid, slot_id, slots_max)  # OOB -> dropped
+    # unused slots carry the sentinel row id n_self: the accumulation
+    # scatter drops them (mode="drop"), and the slot->row index stays
+    # globally NON-DECREASING (real slots ascend, sentinel tail is the
+    # max) so scatters can declare indices_are_sorted
     rows = (
-        jnp.zeros((slots_max,), jnp.int32)
-        .at[slot_id].max(u_s, mode="drop")
+        jnp.full((slots_max,), n_self, jnp.int32)
+        .at[slot_id].min(u_s, mode="drop")
     )
     lens = (
         jnp.zeros((slots_max,), jnp.int32)
@@ -177,61 +200,132 @@ def _device_slot_layout(u, o, v, n_self: int, width: int, slots_max: int):
     return rows, idx, val, lens
 
 
+def _chunk_blocks(src, i_c, v_c, l_c, implicit: bool, alpha: float):
+    """One slot chunk -> per-slot normal-equation blocks
+    a_blk (C,k,k), b_blk (C,k) via batched MXU matmuls."""
+    W = i_c.shape[1]
+    mask = (
+        jnp.arange(W, dtype=jnp.int32)[None, :] < l_c[:, None]
+    ).astype(jnp.float32)
+    y = src[i_c].astype(jnp.float32)  # (C, W, k) gather
+    if implicit:
+        # c = 1 + alpha*v; A += (c-1) y y^T ; b += c * y   (p == 1)
+        w_outer = alpha * v_c * mask
+        w_rhs = (1.0 + alpha * v_c) * mask
+    else:
+        w_outer = mask
+        w_rhs = v_c * mask
+    # Precision.HIGH (3-pass bf16): the MXU's default 1-pass contraction
+    # loses ~3e-3 relative on A, which the CG solve then cannot recover;
+    # HIGH restores ~1e-5 at ~3x the matmul passes
+    a_blk = jnp.einsum(
+        "bwi,bwj->bij", y * w_outer[:, :, None], y,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGH,
+    )
+    b_blk = jnp.einsum(
+        "bwk,bw->bk", y, w_rhs, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGH,
+    )
+    return a_blk, b_blk
+
+
 def _normal_equations(layout, other_factors, n_self, implicit: bool,
                       alpha: float, chunk_slots: int,
-                      bf16_gather: bool = False):
-    """Accumulate per-row normal equations A (n_self,k,k), b (n_self,k):
-    a lax.scan over slot chunks, one batched matmul per chunk."""
+                      bf16_gather: bool = False, accum: str = "auto",
+                      group_slots: int = 73728):
+    """Accumulate per-row normal equations A (n_self,k,k), b (n_self,k).
+
+    Slots sharing a row (rows wider than `width`) scatter-add into the same
+    row system; the slot->row index is non-decreasing with a sentinel tail
+    (see _device_slot_layout), so every scatter declares
+    indices_are_sorted=True.
+
+    accum="carry" keeps A as a lax.scan carry and scatters each chunk into
+    it — O(1) temp, but a backend that materializes the carry per iteration
+    re-streams the full (n,k,k) accumulator once per chunk (measured as the
+    dominant cost at ML-20M scale on v5e: ~2.3 GB x ~36 chunks per sweep).
+    accum="stacked" emits per-slot blocks as scan OUTPUTS and folds each
+    group of `group_slots` slots into A with ONE sorted scatter-add — the
+    accumulator is written, not carried, at the price of a bounded
+    (group_slots,k,k) temp."""
     rows, idx, val, lens = layout
     k = other_factors.shape[1]
     S, W = idx.shape
-    n_ch = S // chunk_slots
     # bf16 source halves the gather's HBM traffic — the build's bottleneck;
     # the f32 upcast happens in-register before the (still f32-accumulated)
     # matmuls. RMSE impact measured at 5e-5 relative (ALSParams.bf16_gather)
     src = (
         other_factors.astype(jnp.bfloat16) if bf16_gather else other_factors
     )
+    if accum == "auto":
+        accum = "stacked"  # keep in sync with ALSParams.resolved_accum
+    # every caller pads S to a chunk_slots multiple via _slots_for
+    assert S % chunk_slots == 0, (S, chunk_slots)
 
-    def body(carry, xs):
-        A, b = carry
-        r_c, i_c, v_c, l_c = xs
-        mask = (
-            jnp.arange(W, dtype=jnp.int32)[None, :] < l_c[:, None]
-        ).astype(jnp.float32)
-        y = src[i_c].astype(jnp.float32)  # (C, W, k) gather
-        if implicit:
-            # c = 1 + alpha*v; A += (c-1) y y^T ; b += c * y   (p == 1)
-            w_outer = alpha * v_c * mask
-            w_rhs = (1.0 + alpha * v_c) * mask
-        else:
-            w_outer = mask
-            w_rhs = v_c * mask
-        # Precision.HIGH (3-pass bf16): the MXU's default 1-pass contraction
-        # loses ~3e-3 relative on A, which the CG solve then cannot recover;
-        # HIGH restores ~1e-5 at ~3x the matmul passes
-        a_blk = jnp.einsum(
-            "bwi,bwj->bij", y * w_outer[:, :, None], y,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGH,
-        )
-        b_blk = jnp.einsum(
-            "bwk,bw->bk", y, w_rhs, preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGH,
-        )
-        A = A.at[r_c].add(a_blk)
-        b = b.at[r_c].add(b_blk)
-        return (A, b), None
+    if accum == "carry":
+        n_ch = S // chunk_slots
 
-    xs = (
-        rows.reshape(n_ch, chunk_slots),
-        idx.reshape(n_ch, chunk_slots, W),
-        val.reshape(n_ch, chunk_slots, W),
-        lens.reshape(n_ch, chunk_slots),
-    )
-    A0 = jnp.zeros((n_self, k, k), dtype=jnp.float32)
-    b0 = jnp.zeros((n_self, k), dtype=jnp.float32)
-    (A, b), _ = jax.lax.scan(body, (A0, b0), xs)
+        def body(carry, xs):
+            A, b = carry
+            r_c, i_c, v_c, l_c = xs
+            a_blk, b_blk = _chunk_blocks(
+                src, i_c, v_c, l_c, implicit, alpha
+            )
+            A = A.at[r_c].add(
+                a_blk, mode="drop", indices_are_sorted=True
+            )
+            b = b.at[r_c].add(
+                b_blk, mode="drop", indices_are_sorted=True
+            )
+            return (A, b), None
+
+        xs = (
+            rows.reshape(n_ch, chunk_slots),
+            idx.reshape(n_ch, chunk_slots, W),
+            val.reshape(n_ch, chunk_slots, W),
+            lens.reshape(n_ch, chunk_slots),
+        )
+        A0 = jnp.zeros((n_self, k, k), dtype=jnp.float32)
+        b0 = jnp.zeros((n_self, k), dtype=jnp.float32)
+        (A, b), _ = jax.lax.scan(body, (A0, b0), xs)
+        return A, b
+
+    if accum != "stacked":
+        raise ValueError(f"unknown accum mode {accum!r}")
+    # group = as many whole chunks as fit the temp budget
+    ch_per_group = max(1, group_slots // chunk_slots)
+    g_slots = ch_per_group * chunk_slots
+    n_groups = math.ceil(S / g_slots)
+    A = jnp.zeros((n_self, k, k), dtype=jnp.float32)
+    b = jnp.zeros((n_self, k), dtype=jnp.float32)
+    for g in range(n_groups):
+        lo = g * g_slots
+        hi = min(S, lo + g_slots)
+        n_ch = (hi - lo) // chunk_slots
+        c_sz = chunk_slots
+        xs = (
+            idx[lo:hi].reshape(n_ch, c_sz, W),
+            val[lo:hi].reshape(n_ch, c_sz, W),
+            lens[lo:hi].reshape(n_ch, c_sz),
+        )
+
+        def body(_, xs_c):
+            i_c, v_c, l_c = xs_c
+            return None, _chunk_blocks(
+                src, i_c, v_c, l_c, implicit, alpha
+            )
+
+        _, (a_blks, b_blks) = jax.lax.scan(body, None, xs)
+        r_g = rows[lo:hi]
+        A = A.at[r_g].add(
+            a_blks.reshape(hi - lo, k, k), mode="drop",
+            indices_are_sorted=True,
+        )
+        b = b.at[r_g].add(
+            b_blks.reshape(hi - lo, k), mode="drop",
+            indices_are_sorted=True,
+        )
     return A, b
 
 
@@ -275,10 +369,11 @@ def _cg_solve(A, b, x0, n_iter: int):
 
 def _solve_factors(layout, other_factors, n_self, reg, implicit, alpha,
                    chunk_slots, x0=None, cg_iters: int = 0,
-                   bf16_gather: bool = False):
+                   bf16_gather: bool = False, accum: str = "auto",
+                   group_slots: int = 73728):
     A, b = _normal_equations(
         layout, other_factors, n_self, implicit, alpha, chunk_slots,
-        bf16_gather=bf16_gather,
+        bf16_gather=bf16_gather, accum=accum, group_slots=group_slots,
     )
     k = other_factors.shape[1]
     eye = jnp.eye(k, dtype=jnp.float32)
@@ -326,11 +421,13 @@ def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
             by_user, items, n_users,
             params.reg, params.implicit, params.alpha, cs,
             x0=users, cg_iters=cg_u, bf16_gather=params.bf16_gather,
+            accum=params.accum, group_slots=params.group_slots,
         )
         items = _solve_factors(
             by_item, users, n_items,
             params.reg, params.implicit, params.alpha, cs,
             x0=items, cg_iters=cg_i, bf16_gather=params.bf16_gather,
+            accum=params.accum, group_slots=params.group_slots,
         )
         return (users, items), None
 
@@ -493,6 +590,7 @@ def als_train_sharded(
                 params.reg, params.implicit, params.alpha, cs,
                 x0=users, cg_iters=cg_u,
                 bf16_gather=params.bf16_gather,
+                accum=params.accum, group_slots=params.group_slots,
             )
             all_users = jax.lax.all_gather(users, DATA_AXIS, tiled=True)
             items = _solve_factors(
@@ -500,6 +598,7 @@ def als_train_sharded(
                 params.reg, params.implicit, params.alpha, cs,
                 x0=items, cg_iters=cg_i,
                 bf16_gather=params.bf16_gather,
+                accum=params.accum, group_slots=params.group_slots,
             )
             return (users, items), None
 

@@ -36,6 +36,52 @@ def test_explicit_als_reconstructs():
     assert model.user_factors.shape == (nu, 8)
 
 
+def test_accum_modes_agree():
+    """carry (scatter-into-scan-carry) and stacked (scan outputs + grouped
+    sorted scatter) accumulation must build the same normal equations;
+    multi-slot rows (width < max row count) and multiple groups are both
+    exercised. (Compared at the A/b level: full ALS sweeps amplify benign
+    float-reassociation deltas through the solve.)"""
+    import jax.numpy as jnp
+
+    from pio_tpu.ops.als import _device_slot_layout, _normal_equations
+
+    users, items, vals, nu, ni = synthetic(
+        n_users=70, n_items=30, density=0.8, seed=5
+    )
+    width, cs = 8, 64
+    rng = np.random.default_rng(0)
+    other = jnp.asarray(rng.normal(size=(ni, 8)).astype(np.float32))
+    from pio_tpu.ops.als import _slots_for
+
+    su = _slots_for(len(vals), nu, width, cs)
+    layout = _device_slot_layout(
+        jnp.asarray(users, jnp.int32), jnp.asarray(items, jnp.int32),
+        jnp.asarray(vals), nu, width, su,
+    )
+    for implicit in (False, True):
+        A_c, b_c = _normal_equations(
+            layout, other, nu, implicit, 2.0, cs, accum="carry")
+        A_s, b_s = _normal_equations(
+            layout, other, nu, implicit, 2.0, cs, accum="stacked",
+            group_slots=128)
+        np.testing.assert_allclose(
+            np.asarray(A_c), np.asarray(A_s), atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(b_c), np.asarray(b_s), atol=1e-4, rtol=1e-4)
+    # and end-to-end: both modes reach the same solution quality
+    kw = dict(rank=8, iterations=12, reg=0.05, chunk=512, width=8,
+              chunk_slots=64)
+    e_carry = rmse(als_train(users, items, vals, nu, ni,
+                             ALSParams(**kw, accum="carry")),
+                   users, items, vals)
+    e_stack = rmse(als_train(users, items, vals, nu, ni,
+                             ALSParams(**kw, accum="stacked",
+                                       group_slots=128)),
+                   users, items, vals)
+    assert abs(e_carry - e_stack) < 5e-3, (e_carry, e_stack)
+
+
 def test_explicit_als_beats_mean_baseline():
     users, items, vals, nu, ni = synthetic(seed=1)
     # hold out 20%

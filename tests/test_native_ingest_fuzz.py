@@ -196,6 +196,41 @@ def test_oversize_string_fields_rejected_both_paths(dao):
         assert e.event_id
 
 
+def test_tags_canonicalized_to_python_bytes(dao):
+    """The native path must store tags as the exact bytes
+    json.dumps(list(tags)) produces (escapes, ', ' separators), so both
+    ingest paths store identical records and the u16 framing limit bites
+    at the same inputs."""
+    tags = ["a", "é", "日本", "", "𝄞", 'q"\\x', " spaced ", "d\x7fl", "\t\n"]
+    raw = json.dumps([{
+        "event": "rate", "entityType": "user", "entityId": "u1",
+        "tags": tags,
+    }]).encode()
+    (status, payload, _, _) = dao.insert_api_batch(raw, 3)[0]
+    assert status == 0, payload
+    evs = list(dao.find(3, limit=-1))
+    assert len(evs) == 1 and list(evs[0].tags) == tags
+    # the CANONICAL length decides, not the request's raw span:
+    # (a) non-ascii tags: raw utf-8 is small but \u-escaped canonical
+    #     overflows -> reject (matches the Python path byte-for-byte)
+    many = ["é"] * 10000  # raw minified ~50KB; canonical = 100000 bytes
+    raw = json.dumps([{
+        "event": "rate", "entityType": "user", "entityId": "u2",
+        "tags": many,
+    }], separators=(",", ":"), ensure_ascii=False).encode()
+    assert len(raw) < 65535
+    (status, payload, _, _) = dao.insert_api_batch(raw, 3)[0]
+    assert status == 1
+    assert payload == "string field too long (100000 bytes)", payload
+    # (b) huge raw span that canonicalizes tiny -> accepted
+    spaced = b'[{"event":"rate","entityType":"user","entityId":"u3",' \
+        b'"tags":[' + b" " * 70000 + b'"a"]}]'
+    (status, payload, _, _) = dao.insert_api_batch(spaced, 3)[0]
+    assert status == 0, payload
+    ev3 = [e for e in dao.find(3, limit=-1) if e.entity_id == "u3"]
+    assert len(ev3) == 1 and list(ev3[0].tags) == ["a"]
+
+
 def test_tz_offset_trailing_colon_rejected(dao):
     """'+05:' (colon with no minute digits) must 400 on the native path,
     matching datetime.fromisoformat; +05 and +05:30 stay accepted."""
