@@ -67,7 +67,15 @@ class ServingConfig:
     # (one big matmul beats many small ones on the MXU). 0 = off.
     batch_window_ms: float = 0.0
     batch_max: int = 64
-    batch_pipeline: int = 4       # batches concurrently in flight
+    # batches concurrently in flight. 0 = AUTO: pipelining only pays when
+    # a batch's wall-time is dominated by dispatch round-trip (remote /
+    # tunneled device) — overlapped batches then hide the RTT. When the
+    # device is local, execution itself is the bottleneck and overlapping
+    # batches just contend (measured co-located CPU, 16 clients: depth 1
+    # = 2657 qps / p99 70 ms vs depth 4 = 1040 qps / p99 226 ms — the
+    # round-2 "357 ms p99" artifact was this convoy), so auto resolves to
+    # 1 on a local device and 4 over a high-RTT link.
+    batch_pipeline: int = 0
 
 
 class QueryServer:
@@ -102,7 +110,8 @@ class QueryServer:
         self._load(instance_id)
         self.batcher = (
             QueryBatcher(self, config.batch_window_ms / 1e3, config.batch_max,
-                         pipeline_depth=config.batch_pipeline)
+                         pipeline_depth=config.batch_pipeline
+                         or _auto_pipeline_depth())
             if config.batch_window_ms != 0 else None
         )
         self._buckets_warmed = False
@@ -380,6 +389,48 @@ class QueryServer:
         }
 
 
+def _depth_for_rtt(rtt_s: float) -> int:
+    """Dispatch-RTT -> pipeline depth. High-RTT (remote/tunneled) devices
+    want several batches in flight to hide the link; local devices want
+    exactly one — overlap there is pure contention (see
+    ServingConfig.batch_pipeline). Note this sizes the pipeline GIVEN that
+    the operator enabled batching; whether batching pays at all over a
+    high-RTT link is a separate call (BASELINE.md measured the tunnel
+    pipelining per-query dispatches well enough that per-query serving
+    won end-to-end — the QueryBatcher docstring's 'batch when co-located'
+    note)."""
+    return 4 if rtt_s > 0.005 else 1
+
+
+_auto_depth_cache: int | None = None
+
+
+def _auto_pipeline_depth() -> int:
+    """Resolve ServingConfig.batch_pipeline=0: measure the device dispatch
+    round-trip once per process (cached — re-deploys and multi-engine
+    processes skip the probe) and map it via _depth_for_rtt."""
+    global _auto_depth_cache
+    if _auto_depth_cache is not None:
+        return _auto_depth_cache
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        one = jnp.ones(())
+        add = jax.jit(lambda x: x + 1)
+        jax.block_until_ready(add(one))  # compile outside the measurement
+        samples = []
+        for _ in range(5):
+            t0 = time.monotonic()
+            jax.block_until_ready(add(one))
+            samples.append(time.monotonic() - t0)
+        depth = _depth_for_rtt(sorted(samples)[len(samples) // 2])
+    except Exception:  # noqa: BLE001 - sizing heuristic must never fail boot
+        depth = 2
+    _auto_depth_cache = depth
+    return depth
+
+
 class QueryBatcher:
     """Dynamic micro-batching: requests enqueue, a collector thread drains
     up to `max_batch` of them within `window_s`, and each batch executes as
@@ -401,7 +452,7 @@ class QueryBatcher:
     accelerator, serve per-query over high-RTT links."""
 
     def __init__(self, server: QueryServer, window_s: float, max_batch: int,
-                 pipeline_depth: int = 4):
+                 pipeline_depth: int):
         self.server = server
         self.window_s = window_s
         self.max_batch = max_batch

@@ -266,6 +266,82 @@ def test_adaptive_batching_backpressure(memory_storage):
         qs.close()
 
 
+def test_pipeline_depth_rtt_mapping():
+    """The RTT->depth mapping is deterministic: local (sub-ms dispatch)
+    runs one batch at a time — overlap there is pure contention (the
+    round-2 357 ms p99 convoy) — while a high-RTT tunnel overlaps 4."""
+    from pio_tpu.workflow.serve import _depth_for_rtt
+
+    assert _depth_for_rtt(0.0002) == 1   # co-located device
+    assert _depth_for_rtt(0.004) == 1
+    assert _depth_for_rtt(0.066) == 4    # the image's tunnel RTT
+
+
+def test_batched_tail_latency_bounded(memory_storage):
+    """Load test for the fixed-window micro-batcher: under sustained
+    concurrent load the tail must stay tied to the body — p99 within 3x
+    p90 (plus a small absolute floor for CI scheduler noise). Locks the
+    round-2 regression where 4 overlapped batches convoyed on the local
+    device and p99 hit 357 ms vs p90 11.8 ms (30x)."""
+    import http.client
+    import threading
+    import time as _time
+
+    engine, ep, ctx, _ = seed_and_train(memory_storage)
+    http_srv, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      batch_window_ms=2.0, batch_max=16,
+                      warm_query={"user": "u0", "num": 3}),
+        ctx=ctx,
+    )
+    http_srv.start()
+    try:
+        lat: list[float] = []
+        lock = threading.Lock()
+
+        def worker(w, n):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", http_srv.port, timeout=30)
+            mine = []
+            try:
+                for r in range(n):
+                    q = json.dumps(
+                        {"user": f"u{(w * n + r) % 20}", "num": 3}).encode()
+                    t0 = _time.monotonic()
+                    conn.request("POST", "/queries.json", body=q)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    assert resp.status == 200, (resp.status, body[:200])
+                    mine.append(_time.monotonic() - t0)
+            finally:
+                conn.close()
+            with lock:
+                lat.extend(mine)
+
+        # 4 clients: this CI box is ~1 core, so the load harness itself
+        # competes with the server for the GIL/CPU; heavier in-process
+        # client fan-out measures scheduler starvation, not the batcher
+        threads = [threading.Thread(target=worker, args=(w, 100))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(lat) == 4 * 100
+        lat.sort()
+        p90 = lat[int(0.9 * len(lat))]
+        p99 = lat[int(0.99 * len(lat))]
+        # 3x relative bound with a 60ms absolute floor: a single OS
+        # scheduling hiccup on the shared CI box must not flake the test,
+        # but a convoy (100s of ms) must still fail it
+        assert p99 <= max(3 * p90, 0.060), (
+            f"p99 {p99 * 1e3:.1f}ms vs p90 {p90 * 1e3:.1f}ms")
+    finally:
+        http_srv.stop()
+        qs.close()
+
+
 def test_micro_batching_coalesces(memory_storage):
     """Concurrent /queries.json under batch_window_ms resolve through ONE
     query_batch; results must equal the unbatched path's."""
