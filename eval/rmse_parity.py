@@ -1,15 +1,28 @@
-"""RMSE-parity evaluation: CG solver vs direct Cholesky at rank 64, with a
-heldout-RMSE trajectory over sweeps, at (up to) MovieLens-20M shape.
+"""Model-quality evidence: ALS vs trivial baselines + CG/Cholesky parity.
 
 Supports the project north star ("≥10x vs Spark-CPU **at equal RMSE**",
-BASELINE.md): the bench measures speed; this artifact shows the fast CG
-kernel reaches the same quality as the exact solve the reference's MLlib ALS
-performs (normal-equation Cholesky per entity,
-examples/scala-parallel-recommendation/custom-query/src/main/scala/ALSAlgorithm.scala:56-67).
+BASELINE.md) with two claims the bench's speed numbers rest on:
 
-Synthetic data with a planted low-rank structure + noise (rank 32 signal,
-observed through 1-5 ratings), zipf-ish popularity — same generator family
-as bench.py. Heldout split 5%.
+ 1. ABSOLUTE quality: the shipped ALS clearly beats the global-mean
+    predictor (and the stronger per-user/per-item bias baseline) on
+    heldout data, with the regularizer picked by a real validation
+    sweep — not asserted at a default.
+ 2. RELATIVE parity: the fast auto solver (short warm-started CG,
+    ops/als.py) matches the exact per-entity Cholesky solve that MLlib's
+    ALS performs (reference examples/scala-parallel-recommendation/
+    custom-query/src/main/scala/ALSAlgorithm.scala:56-67) — within 1%
+    heldout RMSE, usually better.
+
+Synthetic ratings with REALISTIC learnable structure (round-2 verdict:
+the old planted-rank generator was noise-dominated, so nothing could
+beat the mean — that artifact demonstrated parity but not quality):
+
+    r_ui = clip(round(mu + b_u + b_i + <p_u, q_i> + eps), 1, 5)
+
+mean 3.4, user/item bias std 0.45, low-rank (rank 24) dot std ~0.75,
+noise std 0.35 — bias structure is a rank-2 component, so the whole
+signal is learnable by rank>=26 factors. Popularity is zipf on both
+sides (the gather/scatter pattern the kernel actually faces).
 
 Writes eval/RMSE_PARITY.json and eval/RMSE_PARITY.md.
 
@@ -39,51 +52,83 @@ SCALES = {
     "small": (4_000, 1_200, 200_000),
 }
 RANK = 64
-SIGNAL_RANK = 32
+SIGNAL_RANK = 24
 SWEEPS = 10
-REG = 0.05
+TUNE_SWEEPS = 6
+REGS = (0.02, 0.05, 0.1, 0.2, 0.4)
 HOLDOUT = 0.05
 
 
 def synth_ratings(n_users: int, n_items: int, nnz: int, seed=0):
-    """Planted low-rank preference matrix observed as 1-5 star ratings."""
+    """mu + user bias + item bias + low-rank + noise -> 1..5 stars."""
     rng = np.random.default_rng(seed)
-    U = rng.normal(size=(n_users, SIGNAL_RANK)).astype(np.float32)
-    V = rng.normal(size=(n_items, SIGNAL_RANK)).astype(np.float32)
+    mu = 3.4
+    b_u = rng.normal(scale=0.45, size=n_users).astype(np.float32)
+    b_i = rng.normal(scale=0.45, size=n_items).astype(np.float32)
+    P = rng.normal(size=(n_users, SIGNAL_RANK)).astype(np.float32)
+    Q = rng.normal(size=(n_items, SIGNAL_RANK)).astype(np.float32)
+    scale = 0.75 / np.sqrt(SIGNAL_RANK)  # dot std ~0.75
     users = (rng.zipf(1.2, nnz) % n_users).astype(np.int64)
     items = (rng.zipf(1.2, nnz) % n_items).astype(np.int64)
-    score = np.einsum("nk,nk->n", U[users], V[items]) / SIGNAL_RANK
-    noisy = score + rng.normal(scale=0.35, size=nnz).astype(np.float32)
-    # map to 1..5 by quantile so the marginal looks like star ratings
-    qs = np.quantile(noisy, [0.1, 0.35, 0.65, 0.9])
-    vals = (1.0 + np.searchsorted(qs, noisy)).astype(np.float32)
+    score = (
+        mu + b_u[users] + b_i[items]
+        + np.einsum("nk,nk->n", P[users] * scale, Q[items])
+        + rng.normal(scale=0.35, size=nnz).astype(np.float32)
+    )
+    vals = np.clip(np.rint(score), 1.0, 5.0).astype(np.float32)
     return users, items, vals
 
 
-def trajectory(users, items, vals, te_users, te_items, te_vals,
-               n_users, n_items, cg_iters: int, chunk: int):
-    """Train SWEEPS sweeps one at a time (warm start), recording heldout
-    RMSE after each sweep. Returns (rmse_list, total_train_seconds)."""
-    import jax
+def bias_baseline_rmse(tr_u, tr_i, tr_v, te_u, te_i, te_v,
+                       n_users, n_items, reg=10.0) -> float:
+    """Damped per-user/per-item bias model (one alternating pass) — the
+    strong trivial baseline: mu + b_i + b_u."""
+    mu = tr_v.mean()
+    resid = tr_v - mu
+    item_sum = np.bincount(tr_i, weights=resid, minlength=n_items)
+    item_cnt = np.bincount(tr_i, minlength=n_items)
+    b_i = item_sum / (item_cnt + reg)
+    resid2 = resid - b_i[tr_i]
+    user_sum = np.bincount(tr_u, weights=resid2, minlength=n_users)
+    user_cnt = np.bincount(tr_u, minlength=n_users)
+    b_u = user_sum / (user_cnt + reg)
+    pred = mu + b_i[te_i] + b_u[te_u]
+    return float(np.sqrt(np.mean((te_v - pred) ** 2)))
 
-    from pio_tpu.ops.als import ALSModel, ALSParams, als_train, rmse
 
-    p = ALSParams(rank=RANK, iterations=1, reg=REG, chunk=chunk,
-                  cg_iters=cg_iters)
-    model = None
-    out = []
-    train_sec = 0.0
+def train_eval(users, items, vals, te_users, te_items, te_vals,
+               n_users, n_items, reg, cg_iters, chunk, sweeps,
+               trajectory=False):
+    """-> (heldout RMSE list if trajectory else final-only list,
+    train seconds)."""
     import jax.numpy as jnp
 
-    for s in range(SWEEPS):
+    from pio_tpu.ops.als import ALSParams, als_train, rmse
+
+    out = []
+    train_sec = 0.0
+    if trajectory:
+        p = ALSParams(rank=RANK, iterations=1, reg=reg, chunk=chunk,
+                      cg_iters=cg_iters)
+        model = None
+        for _ in range(sweeps):
+            t0 = time.monotonic()
+            model = als_train(users, items, vals, n_users, n_items, p,
+                              init=model)
+            # scalar readback, not block_until_ready: the tunneled axon
+            # backend "unblocks" before execution finishes
+            float(jnp.sum(model.user_factors))
+            train_sec += time.monotonic() - t0
+            out.append(round(float(
+                rmse(model, te_users, te_items, te_vals)), 5))
+    else:
+        p = ALSParams(rank=RANK, iterations=sweeps, reg=reg, chunk=chunk,
+                      cg_iters=cg_iters)
         t0 = time.monotonic()
-        model = als_train(users, items, vals, n_users, n_items, p, init=model)
-        # scalar readback, not block_until_ready: the tunneled axon backend
-        # "unblocks" before execution finishes, under-reporting train time
+        model = als_train(users, items, vals, n_users, n_items, p)
         float(jnp.sum(model.user_factors))
-        train_sec += time.monotonic() - t0
+        train_sec = time.monotonic() - t0
         out.append(round(float(rmse(model, te_users, te_items, te_vals)), 5))
-        print(f"  sweep {s + 1:2d}: heldout RMSE {out[-1]:.5f}", flush=True)
     return out, train_sec
 
 
@@ -104,9 +149,12 @@ def main() -> int:
     users, items, vals = synth_ratings(n_users, n_items, nnz)
     rng = np.random.default_rng(1)
     idx = rng.permutation(nnz)
-    cut = int(nnz * (1 - HOLDOUT))
-    tr, te = idx[:cut], idx[cut:]
+    # train / validation (reg tuning) / heldout test
+    cut_te = int(nnz * (1 - HOLDOUT))
+    cut_va = int(cut_te * (1 - HOLDOUT))
+    tr, va, te = idx[:cut_va], idx[cut_va:cut_te], idx[cut_te:]
     tr_u, tr_i, tr_v = users[tr], items[tr], vals[tr]
+    va_u, va_i, va_v = users[va], items[va], vals[va]
     te_u, te_i, te_v = users[te], items[te], vals[te]
 
     import jax
@@ -114,38 +162,58 @@ def main() -> int:
     from pio_tpu.ops.als import ALSParams
 
     device = jax.devices()[0]
-    # the artifact validates the SHIPPED default solver (auto, -1), which
-    # dispatches per side: short CG above auto_cg_rows rows, exact
-    # Cholesky below. Record both sides' resolution so the label is exact
-    # (at scales where a side is small, "CG" is genuinely a hybrid — the
-    # small dense side NEEDS the exact solve, which is the point of auto;
-    # at the full ML-20M shape both sides run CG).
     _p = ALSParams(rank=RANK, cg_iters=-1)
-    cg_user, cg_item = _p.resolved_cg_iters(n_users), _p.resolved_cg_iters(n_items)
+    cg_user = _p.resolved_cg_iters(n_users)
+    cg_item = _p.resolved_cg_iters(n_items)
     solver_label = (
         f"user side {'CG-' + str(cg_user) if cg_user else 'exact Cholesky'}, "
         f"item side {'CG-' + str(cg_item) if cg_item else 'exact Cholesky'}"
     )
 
-    print(f"auto-solver trajectory ({solver_label}):", flush=True)
-    cg_traj, cg_sec = trajectory(tr_u, tr_i, tr_v, te_u, te_i, te_v,
-                                 n_users, n_items, -1, chunk)
+    # -- reg sweep on the validation slice (auto solver) --------------------
+    print(f"reg sweep ({solver_label}, {TUNE_SWEEPS} sweeps):", flush=True)
+    sweep_rows = []
+    for reg in REGS:
+        (v_rmse,), sec = train_eval(
+            tr_u, tr_i, tr_v, va_u, va_i, va_v, n_users, n_items,
+            reg, -1, chunk, TUNE_SWEEPS)
+        sweep_rows.append({"reg": reg, "val_rmse": v_rmse,
+                           "train_sec": round(sec, 2)})
+        print(f"  reg={reg}: val RMSE {v_rmse:.5f}", flush=True)
+    best = min(sweep_rows, key=lambda r: r["val_rmse"])
+    reg = best["reg"]
+    print(f"best reg = {reg}", flush=True)
+
+    # -- trajectories at the tuned reg --------------------------------------
+    print("auto-solver trajectory:", flush=True)
+    cg_traj, cg_sec = train_eval(
+        tr_u, tr_i, tr_v, te_u, te_i, te_v, n_users, n_items,
+        reg, -1, chunk, SWEEPS, trajectory=True)
+    for s, r in enumerate(cg_traj):
+        print(f"  sweep {s + 1:2d}: heldout RMSE {r:.5f}", flush=True)
     print("direct-Cholesky trajectory:", flush=True)
-    ch_traj, ch_sec = trajectory(tr_u, tr_i, tr_v, te_u, te_i, te_v,
-                                 n_users, n_items, 0, chunk)
+    ch_traj, ch_sec = train_eval(
+        tr_u, tr_i, tr_v, te_u, te_i, te_v, n_users, n_items,
+        reg, 0, chunk, SWEEPS, trajectory=True)
+    for s, r in enumerate(ch_traj):
+        print(f"  sweep {s + 1:2d}: heldout RMSE {r:.5f}", flush=True)
 
     mean_base = float(np.sqrt(np.mean((te_v - tr_v.mean()) ** 2)))
-    # SIGNED gap: negative = auto solver generalizes better than the exact
-    # solve (measured at full scale: the short inner solve early-stops
-    # per-row overfit). Parity bar is one-sided — auto must not be WORSE
-    # than exact by >1%.
+    bias_base = bias_baseline_rmse(
+        tr_u, tr_i, tr_v, te_u, te_i, te_v, n_users, n_items)
+    # the SHIPPED configuration's result (final sweep) — not min() over the
+    # trajectory, which would peek at the test set
+    als_final = cg_traj[-1]
     final_gap = (cg_traj[-1] - ch_traj[-1]) / ch_traj[-1]
+    quality = als_final < 0.95 * mean_base and als_final < bias_base
     result = {
         "scale": args.scale,
         "shape": {"n_users": n_users, "n_items": n_items, "nnz": nnz},
         "rank": RANK,
-        "reg": REG,
+        "signal_rank": SIGNAL_RANK,
         "sweeps": SWEEPS,
+        "reg_sweep": sweep_rows,
+        "best_reg": reg,
         "cg_iters_auto": {"user": cg_user, "item": cg_item},
         "solver_label": solver_label,
         "holdout_frac": HOLDOUT,
@@ -155,22 +223,38 @@ def main() -> int:
         "heldout_rmse_cholesky": ch_traj,
         "final_rel_gap": round(final_gap, 6),
         "mean_baseline_rmse": round(mean_base, 5),
+        "bias_baseline_rmse": round(bias_base, 5),
+        "als_vs_mean_improvement": round(1 - als_final / mean_base, 4),
+        "als_vs_bias_improvement": round(1 - als_final / bias_base, 4),
         "train_sec_cg": round(cg_sec, 2),
         "train_sec_cholesky": round(ch_sec, 2),
-        "parity": final_gap < 0.01,  # one-sided
+        "parity": final_gap < 0.01,   # one-sided: auto must not be worse
+        "beats_baselines": quality,
     }
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "RMSE_PARITY.json"), "w") as f:
         json.dump(result, f, indent=2)
 
     lines = [
-        "# RMSE parity: auto solver (short CG) vs direct Cholesky (rank 64)",
+        "# ALS model quality: baselines, reg sweep, CG-vs-Cholesky parity",
         "",
-        f"Synthetic planted-rank-{SIGNAL_RANK} ratings at scale "
+        f"Synthetic bias+rank-{SIGNAL_RANK} ratings at scale "
         f"`{args.scale}` = {n_users:,} users x {n_items:,} items, "
-        f"{nnz:,} ratings; {int(HOLDOUT * 100)}% heldout; rank {RANK}, "
-        f"reg {REG}; auto solver: {solver_label}.",
+        f"{nnz:,} ratings; {int(HOLDOUT * 100)}% heldout; rank {RANK}; "
+        f"auto solver: {solver_label}.",
         f"Platform: {device.platform} ({device.device_kind}).",
+        "",
+        "## Regularizer sweep (validation slice, auto solver)",
+        "",
+        "| reg | validation RMSE |",
+        "|---|---|",
+    ]
+    for r in sweep_rows:
+        mark = " **<- best**" if r["reg"] == reg else ""
+        lines.append(f"| {r['reg']} | {r['val_rmse']:.5f}{mark} |")
+    lines += [
+        "",
+        f"## Heldout trajectories at reg={reg}",
         "",
         "| sweep | auto-solver heldout RMSE | all-Cholesky heldout RMSE |",
         "|---|---|---|",
@@ -179,17 +263,28 @@ def main() -> int:
         lines.append(f"| {s + 1} | {cg_traj[s]:.5f} | {ch_traj[s]:.5f} |")
     lines += [
         "",
-        f"Global-mean predictor baseline RMSE: {mean_base:.5f}.",
-        f"Final signed gap auto vs all-Cholesky: {final_gap * 100:+.3f}% "
-        f"(negative = auto better) "
-        f"({'PARITY' if result['parity'] else 'NO PARITY'} at the 1% bar).",
-        f"Train wall-clock: auto {cg_sec:.1f}s vs Cholesky {ch_sec:.1f}s "
-        f"for {SWEEPS} sweeps.",
+        "## Verdicts",
+        "",
+        f"- Global-mean baseline RMSE: **{mean_base:.5f}**",
+        f"- Damped user/item-bias baseline RMSE: **{bias_base:.5f}**",
+        f"- ALS final heldout RMSE: **{als_final:.5f}** "
+        f"({(1 - als_final / mean_base) * 100:.1f}% below mean baseline, "
+        f"{(1 - als_final / bias_base) * 100:.1f}% below bias baseline) — "
+        f"{'QUALITY OK' if quality else 'QUALITY FAIL'}",
+        f"- Auto-vs-Cholesky final signed gap: {final_gap * 100:+.3f}% "
+        f"(negative = auto better) — "
+        f"{'PARITY' if result['parity'] else 'NO PARITY'} at the 1% bar",
+        f"- Train wall-clock: auto {cg_sec:.1f}s vs Cholesky {ch_sec:.1f}s "
+        f"for {SWEEPS} sweeps",
     ]
     with open(os.path.join(here, "RMSE_PARITY.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     print(json.dumps({"final_rel_gap": result["final_rel_gap"],
-                      "parity": result["parity"]}))
+                      "parity": result["parity"],
+                      "beats_baselines": quality,
+                      "als_rmse": als_final,
+                      "mean_baseline": result["mean_baseline_rmse"],
+                      "bias_baseline": result["bias_baseline_rmse"]}))
     return 0
 
 

@@ -1,0 +1,146 @@
+"""Deterministic generator for the committed quickstart dataset.
+
+~100k interactions over 3,000 users x 1,200 items with realistic shape:
+
+ * zipf popularity on items AND activity on users (the committed file's
+   heavy rows exercise the kernel's multi-slot row paths; the long tail
+   exercises bucketing/padding with non-uniform distributions)
+ * ratings follow mean + user bias + item bias + low-rank taste + noise
+   (learnable structure, so training measurably beats trivial baselines)
+ * ~12% implicit `buy` events without a rating (the datasource's
+   implicit_value path)
+ * hex-shaped entity ids (u_3fa2c81b / i_07d41e9a), ISO-8601 eventTime
+   spread over six months of 2026 with a weekly cycle
+
+Regenerate (bit-identical) with:  python examples/quickstart/gen_data.py
+Output: examples/quickstart/events.jsonl.gz (one Event-API dict per line,
+the `pio import` wire format).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+import numpy as np
+
+N_USERS = 3_000
+N_ITEMS = 1_200
+N_EVENTS = 100_000
+SIGNAL_RANK = 12
+SEED = 20260730
+
+
+def ids(prefix: str, n: int, rng) -> list[str]:
+    raw = rng.integers(0, 1 << 32, size=n, dtype=np.uint64)
+    return [f"{prefix}_{int(x):08x}" for x in raw]
+
+
+def main() -> str:
+    rng = np.random.default_rng(SEED)
+    user_ids = ids("u", N_USERS, rng)
+    item_ids = ids("i", N_ITEMS, rng)
+
+    b_u = rng.normal(scale=0.45, size=N_USERS)
+    b_i = rng.normal(scale=0.45, size=N_ITEMS)
+    P = rng.normal(size=(N_USERS, SIGNAL_RANK))
+    Q = rng.normal(size=(N_ITEMS, SIGNAL_RANK))
+    scale = 0.75 / np.sqrt(SIGNAL_RANK)
+
+    # rank-based power law: realistic head share (top user ~1.5% of
+    # events, top item ~3%) with a long tail — not the degenerate
+    # zipf-mod-N head that concentrates 20% of mass on one id
+    def powerlaw_weights(n, alpha):
+        w = (np.arange(n) + 8.0) ** -alpha
+        return w / w.sum()
+
+    users = rng.choice(
+        N_USERS, size=N_EVENTS, p=powerlaw_weights(N_USERS, 1.05)
+    ).astype(np.int64)
+    # item CHOICE mixes global popularity with the user's taste (softmax
+    # over popularity logits + taste affinity). Without the taste term,
+    # which items a user touches would be pure popularity and the optimal
+    # interaction predictor would be the popularity baseline by
+    # construction — no personalized recommender could beat it.
+    w_items = powerlaw_weights(N_ITEMS, 1.15)
+    # taste coefficient 2.5: strong enough that ~7-interaction users carry
+    # a learnable personal signal (measured fold-0 precision@10: implicit
+    # ALS 0.23 vs popularity 0.14, oracle 0.55) — at 1.2 the popularity
+    # logits (~5.8 nats of spread) drown the taste term and no
+    # personalized model can beat the popularity baseline
+    taste = (P @ Q.T) * (2.5 / np.sqrt(SIGNAL_RANK))  # (U, I) affinity
+    logits = np.log(w_items)[None, :] + taste
+    logits -= logits.max(axis=1, keepdims=True)
+    probs = np.exp(logits)
+    probs /= probs.sum(axis=1, keepdims=True)
+    items = np.empty(N_EVENTS, dtype=np.int64)
+    order = np.argsort(users, kind="stable")
+    sorted_users = users[order]
+    starts = np.searchsorted(sorted_users,
+                             np.arange(N_USERS), side="left")
+    ends = np.searchsorted(sorted_users, np.arange(N_USERS), side="right")
+    for u in range(N_USERS):
+        cnt = ends[u] - starts[u]
+        if cnt:
+            items[order[starts[u]:ends[u]]] = rng.choice(
+                N_ITEMS, size=cnt, p=probs[u])
+    score = (
+        3.4 + b_u[users] + b_i[items]
+        + np.einsum("nk,nk->n", P[users] * scale, Q[items])
+        + rng.normal(scale=0.35, size=N_EVENTS)
+    )
+    stars = np.clip(np.rint(score), 1, 5).astype(int)
+    is_buy = rng.random(N_EVENTS) < 0.12
+
+    # six months of 2026, denser on weekends (weekly cycle)
+    t0 = 1767225600  # 2026-01-01T00:00:00Z
+    span = 182 * 86400
+    ts = rng.integers(0, span, N_EVENTS)
+    dow = (ts // 86400) % 7
+    keep_bias = np.where(dow >= 5, 1.0, 0.75)
+    ts = np.where(rng.random(N_EVENTS) < keep_bias, ts,
+                  rng.integers(0, span, N_EVENTS))
+    ts = np.sort(ts + t0)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "events.jsonl.gz")
+    from datetime import datetime, timezone
+
+    # GzipFile directly: mtime=0 keeps the committed artifact bit-identical
+    # across regenerations
+    import io
+
+    raw = open(out_path, "wb")
+    gz = gzip.GzipFile(fileobj=raw, mode="wb", compresslevel=9, mtime=0)
+    with io.TextIOWrapper(gz, encoding="utf-8") as f:
+        for m in range(N_EVENTS):
+            when = datetime.fromtimestamp(
+                int(ts[m]), tz=timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.000Z")
+            if is_buy[m]:
+                d = {
+                    "event": "buy",
+                    "entityType": "user",
+                    "entityId": user_ids[users[m]],
+                    "targetEntityType": "item",
+                    "targetEntityId": item_ids[items[m]],
+                    "eventTime": when,
+                }
+            else:
+                d = {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": user_ids[users[m]],
+                    "targetEntityType": "item",
+                    "targetEntityId": item_ids[items[m]],
+                    "properties": {"rating": int(stars[m])},
+                    "eventTime": when,
+                }
+            f.write(json.dumps(d, sort_keys=True) + "\n")
+    raw.close()
+    return out_path
+
+
+if __name__ == "__main__":
+    print(main())

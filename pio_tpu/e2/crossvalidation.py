@@ -43,17 +43,36 @@ def split_interactions(
     data: Interactions,
     k: int,
     num: int = 10,
+    exclude_seen: bool = True,
 ) -> list[tuple[Interactions, FoldInfo, list[tuple[dict, Any]]]]:
     """Interactions -> k folds of (train, info, [(query, actual)]).
 
     Queries follow the recommendation template shape {"user", "num"}; the
     actual is the list of held-out item ids for that user (what the metric
-    layer scores against, reference MetricEvaluator input shape)."""
+    layer scores against, reference MetricEvaluator input shape).
+
+    exclude_seen (default): each query carries the user's TRAIN-fold items
+    as blackList, and heldout actuals are deduped against that blackList
+    (a blacklisted item is unhittable by construction — leaving it in the
+    actuals would deflate every engine's score). Without the blacklist the
+    metric mostly measures how much of the top-k an engine wastes on
+    reconstruction (standard unseen-item evaluation; the reference's
+    ecommerce template applies the same seen-filter at serve time)."""
     if k <= 1:
         return []
-    folds = []
     n = len(data)
+    # one numpy group-by over the FULL dataset (per-user row slices +
+    # fold tags), instead of k Python passes over the train folds
+    order = np.lexsort((data.item_idx, data.user_idx))
+    u_sorted = data.user_idx[order]
+    i_sorted = data.item_idx[order]
+    f_sorted = (order % k).astype(np.int64)  # fold of each row
+    bounds = np.flatnonzero(
+        np.concatenate([[True], u_sorted[1:] != u_sorted[:-1], [True]])
+    )
+    folds: list[tuple[Interactions, FoldInfo, list[tuple[dict, Any]]]] = []
     for train_mask, test_mask in split_indices(n, k):
+        f = len(folds)
         train = Interactions(
             user_idx=data.user_idx[train_mask],
             item_idx=data.item_idx[train_mask],
@@ -62,15 +81,23 @@ def split_interactions(
             items=data.items,
         )
         qa: list[tuple[dict, Any]] = []
-        test_users = data.user_idx[test_mask]
-        test_items = data.item_idx[test_mask]
-        by_user: dict[int, list[int]] = {}
-        for u, i in zip(test_users, test_items):
-            by_user.setdefault(int(u), []).append(int(i))
-        for u, item_list in sorted(by_user.items()):
-            qa.append((
-                {"user": data.users.id_of(u), "num": num},
-                [data.items.id_of(i) for i in item_list],
-            ))
-        folds.append((train, FoldInfo(len(folds), k), qa))
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            in_test = f_sorted[s:e] == f
+            test_items = i_sorted[s:e][in_test]
+            if not len(test_items):
+                continue
+            u = int(u_sorted[s])
+            q: dict = {"user": data.users.id_of(u), "num": num}
+            if exclude_seen:
+                seen = np.unique(i_sorted[s:e][~in_test])
+                if len(seen):
+                    q["blackList"] = data.items.decode(seen)
+                    # actuals the blacklist makes unhittable are dropped
+                    test_items = test_items[
+                        ~np.isin(test_items, seen)]
+                    if not len(test_items):
+                        qa.append((q, []))  # metric scores this as None
+                        continue
+            qa.append((q, data.items.decode(test_items)))
+        folds.append((train, FoldInfo(f, k), qa))
     return folds

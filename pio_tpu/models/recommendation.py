@@ -37,6 +37,9 @@ class DataSourceParams(Params):
     rating_event: str = "rate"      # events carrying an explicit rating
     implicit_value: float = 4.0     # value assigned to non-rating events
     eval_k: int = 0                 # >0 -> read_eval produces k folds
+    # fold queries blacklist the user's train-fold items (unseen-item
+    # evaluation; see e2.crossvalidation.split_interactions)
+    eval_exclude_seen: bool = True
 
 
 class RecommendationDataSource(DataSource):
@@ -75,7 +78,10 @@ class RecommendationDataSource(DataSource):
         from pio_tpu.e2.crossvalidation import split_interactions
 
         data = self._read(ctx)
-        return split_interactions(data, self.params.eval_k)
+        return split_interactions(
+            data, self.params.eval_k,
+            exclude_seen=self.params.eval_exclude_seen,
+        )
 
 
 @dataclass(frozen=True)
@@ -192,34 +198,46 @@ class ALSAlgorithm(PAlgorithm):
 
     def batch_predict(self, model: RecommendationModel, queries) -> list:
         """Vectorized batch scoring (evaluation + the serving micro-batcher):
-        one top-k matmul for all plain known-user queries; queries carrying
-        white/black lists keep full per-query filter semantics via the
-        single-query path."""
+        ONE top-k matmul for all known-user queries — blackList queries
+        included (over-fetch k = num + max blacklist, filter per row on
+        host; unseen-item evaluation blacklists on every query, so routing
+        them to the single-query path would collapse the batch API into
+        thousands of single-row dispatches). whiteList queries keep full
+        candidate-set semantics via the single-query path."""
         results: list[dict] = [{"itemScores": []} for _ in queries]
         known = []
         for i, q in enumerate(queries):
             if q["user"] not in model.users:
                 continue
-            if q.get("whiteList") or q.get("blackList"):
+            if q.get("whiteList"):
                 results[i] = self.predict(model, q)
             else:
                 known.append((i, model.users.index_of(q["user"])))
         if not known:
             return results
+        n_items = model.factors.item_factors.shape[0]
         rows = np.array([u for _, u in known], dtype=np.int32)
-        num = max(int(queries[qi].get("num", 10)) for qi, _ in known)
-        k = min(num, model.factors.item_factors.shape[0])
+        k = min(
+            max(int(queries[qi].get("num", 10))
+                + len(queries[qi].get("blackList") or ())
+                for qi, _ in known),
+            n_items,
+        )
         scores, idx = als.recommend_topk(model.factors, rows, k)
         scores, idx = np.asarray(scores), np.asarray(idx)
         for row, (qi, _) in enumerate(known):
-            n = int(queries[qi].get("num", 10))
-            items = model.items.decode(idx[row][:n])
-            results[qi] = {
-                "itemScores": [
-                    {"item": it, "score": float(s)}
-                    for it, s in zip(items, scores[row][:n])
-                ]
-            }
+            q = queries[qi]
+            n = int(q.get("num", 10))
+            black = set(q.get("blackList") or ())
+            items = model.items.decode(idx[row])
+            out = []
+            for it, s in zip(items, scores[row]):
+                if it in black:
+                    continue
+                out.append({"item": it, "score": float(s)})
+                if len(out) >= n:
+                    break
+            results[qi] = {"itemScores": out}
         return results
 
     def prepare_model_for_deploy(self, ctx, model: RecommendationModel):

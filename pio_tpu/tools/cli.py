@@ -596,10 +596,19 @@ def cmd_export(args) -> int:
             storage, args.appid, args.output, channel_id=channel_id
         )
     else:
-        with open(args.output, "w") as f:
+        with _open_text(args.output, "wt") as f:
             n = export_events(storage, args.appid, f, channel_id=channel_id)
     print(f"Exported {n} events to {args.output}")
     return 0
+
+
+def _open_text(path: str, mode: str):
+    """open() with transparent .gz (committed datasets ship gzipped)."""
+    if path.endswith(".gz"):
+        import gzip
+
+        return gzip.open(path, mode, encoding="utf-8")
+    return open(path, mode.rstrip("t"), encoding="utf-8")
 
 
 def cmd_import(args) -> int:
@@ -608,7 +617,7 @@ def cmd_import(args) -> int:
     if _io_format(getattr(args, "format", None), args.input) == "parquet":
         ok, failed = import_events_parquet(get_storage(), args.appid, args.input)
     else:
-        with open(args.input) as f:
+        with _open_text(args.input, "rt") as f:
             ok, failed = import_events(get_storage(), args.appid, f)
     print(f"Imported {ok} events ({failed} failed).")
     return 0 if failed == 0 else 1

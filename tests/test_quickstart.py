@@ -1,0 +1,113 @@
+"""The README quickstart, executed: app new -> import (committed .gz
+dataset) -> train (engine.json) -> deploy -> query -> eval, all through
+the CLI against the real 100k power-law dataset — the non-uniform
+bucketing/padding path a synthetic uniform seed never hits."""
+
+import gzip
+import json
+import os
+import socket
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "examples", "quickstart", "events.jsonl.gz")
+ENGINE_JSON = os.path.join(REPO, "examples", "quickstart", "engine.json")
+
+
+@pytest.fixture()
+def quickstart_app(cli):
+    import re
+
+    code, out = cli("app", "new", "quickstart")
+    assert code == 0, out.err
+    return int(re.search(r"\(id (\d+)\)", out.out).group(1))
+
+
+def test_quickstart_end_to_end(cli, quickstart_app, memory_storage,
+                               tmp_path, monkeypatch):
+    # -- import the committed dataset (gz transparently) --------------------
+    code, out = cli("import", "--appid", str(quickstart_app), "--input", DATA)
+    assert code == 0, out.err
+    assert "Imported 100000 events (0 failed)" in out.out
+
+    # spot-check the store: power-law head user exists and reads back
+    ev = memory_storage.get_events()
+    n = sum(1 for _ in ev.find(quickstart_app, limit=-1))
+    assert n == 100_000
+
+    # -- train from the committed engine.json -------------------------------
+    engine_dir = os.path.dirname(ENGINE_JSON)
+    code, out = cli("train", "--engine-dir", engine_dir)
+    assert code == 0, out.err
+    instances = memory_storage.get_metadata_engine_instances().get_all()
+    done = [i for i in instances if i.status == "COMPLETED"]
+    assert done, [i.status for i in instances]
+
+    # -- deploy + query over the wire ---------------------------------------
+    from pio_tpu.tools.cli import _engine_from_variant, _load_variant
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    variant = _load_variant(engine_dir)
+    engine, ep = _engine_from_variant(variant, engine_dir)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    http, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id=variant["id"]),
+        ctx=ctx,
+    )
+    http.start()
+    try:
+        # a real user id from the dataset
+        with gzip.open(DATA, "rt") as f:
+            uid = json.loads(next(iter(f)))["entityId"]
+        q = json.dumps({"user": uid, "num": 5}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/queries.json", data=q,
+            method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = json.loads(resp.read())
+        assert len(body["itemScores"]) == 5
+        assert all(s["item"].startswith("i_") for s in body["itemScores"])
+    finally:
+        http.stop()
+        qs.close()
+
+    # -- eval: one-variant grid through the pio eval path -------------------
+    (tmp_path / "qs_eval.py").write_text(
+        "from examples.quickstart.eval_def import QuickstartEval\n"
+        "from pio_tpu.controller import EngineParams, EngineParamsGenerator\n"
+        "from pio_tpu.models.recommendation import (\n"
+        "    ALSAlgorithmParams, DataSourceParams)\n"
+        "class OneParams(EngineParamsGenerator):\n"
+        "    @classmethod\n"
+        "    def params_list(cls):\n"
+        "        return [EngineParams(\n"
+        "            datasource=('', DataSourceParams(\n"
+        "                app_name='quickstart', eval_k=2,\n"
+        "                rating_event='', implicit_value=1.0)),\n"
+        "            algorithms=[('als', ALSAlgorithmParams(\n"
+        "                rank=16, num_iterations=4, lambda_=0.05,\n"
+        "                alpha=8.0, implicit_prefs=True, chunk=8192))])]\n"
+    )
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.syspath_prepend(REPO)
+    out_path = tmp_path / "best.json"
+    code, out = cli("eval", "qs_eval.QuickstartEval", "qs_eval.OneParams",
+                    "--output", str(out_path))
+    assert code == 0, out.err
+    import re
+
+    best = json.loads(out_path.read_text())
+    # best.json carries the winning EngineParams (reference output shape);
+    # the score itself prints on stdout
+    assert best["algorithmParamsList"][0]["params"]["rank"] == 16
+    score = float(
+        re.search(r"Best score: \[([0-9.e-]+)\]", out.out).group(1))
+    # beating popularity is demonstrated by the full-grid artifact
+    # (eval/RANKING_EVAL.md); this 1-variant smoke proves the precision is
+    # a real signal, far above random (10/1200 ~ 0.008)
+    assert score > 0.05, score
