@@ -47,6 +47,7 @@ from pio_tpu.ops.attention import (
     attention_reference,
     flash_attention,
     ring_attention,
+    ulysses_attention,
 )
 from pio_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
@@ -66,7 +67,12 @@ class SequenceParams(Params):
     batch_size: int = 128
     steps: int = 300
     seed: int = 0
-    attention: str = "auto"    # "auto" | "reference" | "ring"
+    # "auto" | "reference" | "ring" | "ulysses" — auto picks ring when the
+    # mesh shards the sequence axis. ulysses = all-to-all head-sharded
+    # sequence parallelism (ops/attention.py ulysses_attention): two
+    # collectives per layer vs ring's n-1 hops; requires num_heads
+    # divisible by the seq-axis size
+    attention: str = "auto"
     # mixture-of-experts FFN: 0 = dense (default). With > 0 experts each
     # block's FFN becomes a Switch-style MoE (ops/moe.py) — one-hot-matmul
     # dispatch, capacity-dropped tokens ride the residual, and the
@@ -262,18 +268,32 @@ def train_sequence_model(
     inp_all, tgt_all = seqs[:, :-1], seqs[:, 1:]
     s_global = inp_all.shape[1]
 
-    # once the sequence is sharded, attention MUST be ring — a local-only
-    # attention would silently drop cross-shard interactions
-    use_ring = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
-    if use_ring and p.attention == "reference":
+    if p.attention not in ("auto", "reference", "ring", "ulysses"):
+        raise ValueError(
+            f"unknown attention mode {p.attention!r}: expected "
+            "'auto' | 'reference' | 'ring' | 'ulysses'"
+        )
+    # once the sequence is sharded, attention MUST be sequence-parallel
+    # (ring or ulysses) — a local-only attention would silently drop
+    # cross-shard interactions
+    use_sp = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
+    if use_sp and p.attention == "reference":
         raise ValueError(
             "attention='reference' cannot run with the sequence sharded "
-            "over the mesh seq axis; use 'auto'/'ring' or a seq=1 mesh"
+            "over the mesh seq axis; use 'auto'/'ring'/'ulysses' or a "
+            "seq=1 mesh"
         )
-    if not use_ring and p.attention == "ring":
+    if not use_sp and p.attention in ("ring", "ulysses"):
         raise ValueError(
-            "attention='ring' requires a mesh with a seq axis > 1"
+            f"attention={p.attention!r} requires a mesh with a seq axis > 1"
         )
+    if use_sp and p.attention == "ulysses":
+        n_seq_axis = mesh.shape[SEQ_AXIS]
+        if p.num_heads % n_seq_axis:
+            raise ValueError(
+                f"attention='ulysses' needs num_heads ({p.num_heads}) "
+                f"divisible by the seq axis ({n_seq_axis})"
+            )
 
     params = encoder.init(
         jax.random.PRNGKey(p.seed),
@@ -300,7 +320,11 @@ def train_sequence_model(
         s_local = s_global // n_seq
 
         def local_loss(params, inp, tgt, pos_offset):
-            if use_ring:
+            if use_sp and p.attention == "ulysses":
+                attn = partial(
+                    ulysses_attention, axis_name=SEQ_AXIS, causal=True,
+                )
+            elif use_sp:
                 attn = partial(
                     ring_attention, axis_name=SEQ_AXIS, causal=True,
                 )
@@ -539,14 +563,24 @@ class SequenceAlgorithm(PAlgorithm):
         )
         return logits[0, -1]
 
-    def predict(self, model: SequenceModel, query: dict) -> dict:
+    def history_row(self, model: SequenceModel, query: dict):
+        """The (max_len,) PAD-left row predict actually scores from: the
+        live event-store history when app_name is configured (including
+        post-training events), else the training snapshot; None for an
+        unknown user with no live history. Public so user-code stages
+        (e.g. a no-repeat Serving) reason about the SAME history the
+        scores came from instead of re-deriving a stale one."""
         user = query.get("user", "")
-        num = int(query.get("num", 10))
         row = self._live_history(model, user)
-        if row is None:
-            if user not in model.users:
-                return {"itemScores": []}
+        if row is None and user in model.users:
             row = model.seqs[model.users.index_of(user)]
+        return row
+
+    def predict(self, model: SequenceModel, query: dict) -> dict:
+        num = int(query.get("num", 10))
+        row = self.history_row(model, query)
+        if row is None:
+            return {"itemScores": []}
         scores = np.array(self._score_last(model, row))  # writable copy
         scores[PAD] = -np.inf
         seen = (

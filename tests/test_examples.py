@@ -302,6 +302,104 @@ def test_cli_train_subprocess_from_example_dir(tmp_path):
     storage.close()
 
 
+def test_twotower_weighted_example(tmp_path):
+    """examples/twotower-weighted: user-code DataSource weighting buy
+    events 4x via row repetition + min-score Serving, around the built-in
+    TwoTowerAlgorithm — the net-new neural family has the same DASE
+    user-code surface as the classic templates."""
+    storage = _storage(tmp_path)
+    app_id = storage.get_metadata_apps().insert(App(0, "MyApp"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    # parity-block structure delivered ONLY through buys; views are noise
+    rng_items = 12
+    for u in range(24):
+        for i in range(rng_items):
+            if (u + i) % 2 == 0:
+                ev.insert(Event(
+                    event="buy", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}"), app_id)
+            elif (u * 7 + i) % 5 == 0:
+                ev.insert(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{i}"), app_id)
+    engine, ep, variant = _load_example("twotower-weighted")
+    # the datasource repeats buys: its training set must be larger than
+    # the raw event count and dominated by buy rows
+    ctx = create_workflow_context(storage, use_mesh=False)
+    ds_name, ds_params = ep.datasource
+    ds_cls = next(iter(engine.datasource_classes.values()))
+    inter = ds_cls(ds_params).read_training(ctx)
+    n_buys = sum(1 for _ in ev.find(
+        app_id, event_names=["buy"], limit=-1))
+    n_views = sum(1 for _ in ev.find(
+        app_id, event_names=["view"], limit=-1))
+    assert len(inter) == 4 * n_buys + n_views
+    http = _train_and_serve(engine, ep, storage, "twotower-weighted")
+    try:
+        r = _query(http.port, {"user": "u0", "num": 6})
+        assert r["itemScores"], r
+        # min_score floor applied by the user Serving
+        assert all(s["score"] >= 0.05 for s in r["itemScores"])
+        # buys carried the parity signal: recommended items lean even
+        even = sum(1 for s in r["itemScores"]
+                   if int(s["item"][1:]) % 2 == 0)
+        assert even >= len(r["itemScores"]) - 1, r
+    finally:
+        http.stop()
+    storage.close()
+
+
+def test_sequence_custom_example(tmp_path):
+    """examples/sequence-custom: ulysses sequence parallelism selected in
+    engine.json params (trained on a real dp x sp mesh) + user-code
+    no-repeat-window Serving over the enriched prediction."""
+    from pio_tpu.parallel.mesh import MeshConfig
+
+    storage = _storage(tmp_path)
+    app_id = storage.get_metadata_apps().insert(App(0, "MyApp"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    # deterministic cycles: u's history is i_(u%3), i_(u%3+1), ...
+    for u in range(30):
+        for t in range(8):
+            ev.insert(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{(u % 3 + t) % 10}",
+                properties=DataMap({})), app_id)
+    engine, ep, variant = _load_example("sequence-custom")
+    assert ep.algorithms[0][1].attention == "ulysses"
+    # train over a dp x sp mesh so the params-selected ulysses all_to_all
+    # path actually executes
+    ctx = create_workflow_context(
+        storage, mesh_config=MeshConfig(data=4, seq=2, model=1))
+    run_train(engine, ep, storage, engine_id="sequence-custom", ctx=ctx)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="sequence-custom"),
+        ctx=ctx,
+    )
+    http.start()
+    try:
+        r = _query(http.port, {"user": "u0", "num": 8})
+        assert r["itemScores"], r
+        # u0's last 4 history items (t=4..7 of the cycle (0+t)%10) are
+        # i4,i5,i6,i7: the no-repeat window must exclude them
+        recent = {f"i{(0 + t) % 10}" for t in range(4, 8)}
+        assert all(s["item"] not in recent for s in r["itemScores"]), r
+        # query-level override disables the window: recents may reappear
+        r2 = _query(http.port,
+                    {"user": "u0", "num": 8, "noRepeatWindow": 0})
+        assert len(r2["itemScores"]) >= len(r["itemScores"])
+    finally:
+        http.stop()
+        qs.close()
+    storage.close()
+
+
 def test_external_engine_protocol(tmp_path):
     """An engine implemented OUTSIDE the framework (stdio JSON protocol,
     examples/external-engine) trains, persists its opaque model through the

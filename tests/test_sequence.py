@@ -83,6 +83,29 @@ def test_sp_training_matches_single_device():
     assert abs(loss_single - loss_sharded) < 1e-3
 
 
+def test_ulysses_sp_matches_single_device():
+    """attention='ulysses' (all-to-all head-sharded sequence parallelism)
+    must reproduce the single-device loss exactly like ring does; heads
+    (2) sharded over seq axis (2)."""
+    import dataclasses
+
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=16)
+    data = SequenceData(seqs, users, items)
+    p = SequenceParams(
+        max_len=16, embed_dim=32, num_heads=2, num_layers=1, ffn_dim=64,
+        steps=30, batch_size=32, attention="ulysses",
+    )
+    _, _, loss_single = train_sequence_model(
+        data, dataclasses.replace(p, attention="auto"), None)
+    mesh = create_mesh(MeshConfig(data=4, seq=2, model=1))
+    _, _, loss_ulysses = train_sequence_model(data, p, mesh)
+    assert abs(loss_single - loss_ulysses) < 1e-3
+    # num_heads not divisible by seq axis is rejected up front
+    bad_mesh = create_mesh(MeshConfig(data=1, seq=8, model=1))
+    with pytest.raises(ValueError, match="divisible"):
+        train_sequence_model(data, p, bad_mesh)
+
+
 def test_moe_ffn_trains_and_serves():
     """moe_experts > 0: the Switch FFN replaces the dense FFN — the model
     must still learn the cyclic pattern under dp x sp sharding and serve
