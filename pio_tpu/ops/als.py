@@ -122,8 +122,15 @@ class ALSParams:
     def resolved_accum(self) -> str:
         """The accumulation strategy that actually runs ("auto" resolves
         here, next to resolved_cg_iters, so callers — bench artifacts
-        included — can report the real mode, not the knob)."""
-        return "stacked" if self.accum == "auto" else self.accum
+        included — can report the real mode, not the knob).
+
+        auto is per-backend: on TPU the scan-carry scatter re-streams the
+        (n,k,k) accumulator once per chunk (the round-2 ~0.35%-MFU wall),
+        so stacked wins; on CPU XLA updates the carry in place and carry
+        measured faster (eval/als_accum_bench.py)."""
+        if self.accum != "auto":
+            return self.accum
+        return "stacked" if _accelerator_backend() else "carry"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -140,6 +147,18 @@ class ALSModel:
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children)
+
+
+def _accelerator_backend() -> bool:
+    """True on TPU-class backends (incl. the tunneled 'axon' platform,
+    which does not report platform == 'tpu'); False on cpu/gpu."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # noqa: BLE001 - backend init failure: be conservative
+        return False
+    return dev.platform not in ("cpu", "gpu")
 
 
 def _slots_for(nnz: int, n_self: int, width: int, chunk_slots: int) -> int:
@@ -259,7 +278,8 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
         other_factors.astype(jnp.bfloat16) if bf16_gather else other_factors
     )
     if accum == "auto":
-        accum = "stacked"  # keep in sync with ALSParams.resolved_accum
+        # keep in sync with ALSParams.resolved_accum (per-backend choice)
+        accum = "stacked" if _accelerator_backend() else "carry"
     # every caller pads S to a chunk_slots multiple via _slots_for
     assert S % chunk_slots == 0, (S, chunk_slots)
 
