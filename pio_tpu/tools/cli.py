@@ -650,22 +650,54 @@ def cmd_upgrade(args) -> int:
 
 def cmd_template(args) -> int:
     """Scaffold a new engine directory from the template gallery
-    (reference console/Template.scala — the gallery is the local model zoo;
-    no network in this build)."""
-    from pio_tpu.tools.templates import TEMPLATES, readme_for
+    (reference console/Template.scala). The built-in gallery is the local
+    model zoo; `--gallery-url` (or PIO_TEMPLATE_GALLERY_URL) additionally
+    lists/fetches an organization-hosted remote gallery."""
+    from pio_tpu.tools.templates import (
+        GALLERY_ENV, TEMPLATES, GalleryError, fetch_gallery, readme_for,
+        scaffold_remote,
+    )
+
+    explicit_url = getattr(args, "gallery_url", None)
+    gallery_url = explicit_url or os.environ.get(GALLERY_ENV)
+    # a builtin scaffold must never need the network: fetch the remote
+    # index only when the command actually involves it (list, or a non-
+    # builtin name); an env-var-configured gallery that is down degrades
+    # to a warning instead of blocking local work
+    need_remote = gallery_url and (
+        args.subcommand == "list"
+        or (args.subcommand == "new" and args.template not in TEMPLATES)
+    )
+    remote = {}
+    if need_remote:
+        try:
+            remote = fetch_gallery(gallery_url)
+        except GalleryError as e:
+            if explicit_url or args.subcommand == "new":
+                return _fail(str(e))
+            print(f"[WARN] {e} (continuing with the builtin gallery)",
+                  file=sys.stderr)
+        # builtin names are trusted: a remote entry cannot shadow one
+        for clash in set(remote) & set(TEMPLATES):
+            print(f"[WARN] remote template {clash!r} shadows a builtin "
+                  "and is ignored", file=sys.stderr)
+            del remote[clash]
 
     if args.subcommand == "list":
         for spec in TEMPLATES.values():
             print(f"{spec.name:16} {spec.description}")
+        for rspec in remote.values():
+            print(f"{rspec.name:16} {rspec.description} [remote]")
         return 0
     if args.subcommand != "new":
         return _fail("use 'template new <dir> [--template NAME]' or "
                      "'template list'")
     spec = TEMPLATES.get(args.template)
-    if spec is None:
+    if spec is None and args.template not in remote:
+        choices = list(TEMPLATES) + list(remote)
         return _fail(
             f"unknown template {args.template!r}; "
-            f"choose from: {', '.join(TEMPLATES)}"
+            f"choose from: {', '.join(choices)}"
         )
     target = args.directory
     if os.path.exists(target) and (
@@ -673,6 +705,14 @@ def cmd_template(args) -> int:
     ):
         return _fail(f"{target} exists and is not an empty directory")
     os.makedirs(target, exist_ok=True)
+    if spec is None:              # remote template
+        try:
+            scaffold_remote(remote[args.template], gallery_url, target)
+        except GalleryError as e:
+            return _fail(str(e))
+        print(f"Engine template '{args.template}' (remote) created at "
+              f"{target}")
+        return 0
     name = os.path.basename(os.path.abspath(target))
     variant = dict(spec.engine_json, id=name)
     with open(os.path.join(target, "engine.json"), "w") as f:
@@ -904,8 +944,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("directory")
     t.add_argument("--template", default="custom",
                    help="engine shape (see `pio template list`)")
+    t.add_argument("--gallery-url",
+                   help="remote gallery base URL (or "
+                        "PIO_TEMPLATE_GALLERY_URL)")
     t.set_defaults(fn=cmd_template)
     t = xs.add_parser("list")
+    t.add_argument("--gallery-url",
+                   help="remote gallery base URL (or "
+                        "PIO_TEMPLATE_GALLERY_URL)")
     t.set_defaults(fn=cmd_template)
     x.set_defaults(fn=cmd_template)
 

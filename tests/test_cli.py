@@ -141,6 +141,142 @@ def test_template_new(cli, tmp_path):
     assert code == 1
 
 
+@pytest.fixture()
+def gallery_server(tmp_path):
+    """A local HTTP gallery (reference Template.scala's remote index,
+    testable without egress): index.json + one template with a trainable
+    engine.json + an extra data file in a subdirectory."""
+    import http.server
+    import threading
+
+    root = tmp_path / "gallery"
+    tdir = root / "acme-rec"
+    (tdir / "data").mkdir(parents=True)
+    (root / "index.json").write_text(json.dumps([{
+        "name": "acme-rec",
+        "description": "ACME's tuned recommender",
+        "files": ["engine.json", "data/notes.txt"],
+    }]))
+    (tdir / "engine.json").write_text(json.dumps({
+        "id": "acme-rec",
+        "description": "ACME's tuned recommender",
+        "engineFactory":
+            "pio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "acmeapp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "num_iterations": 3, "lambda_": 0.05,
+            "chunk": 512}}],
+    }))
+    (tdir / "data" / "notes.txt").write_text("hello from the gallery\n")
+
+    handler = type("H", (http.server.SimpleHTTPRequestHandler,), {
+        "directory": str(root),
+        "log_message": lambda *a, **k: None,
+    })
+    srv = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", 0), lambda *a, **k: handler(*a, directory=str(root),
+                                                  **k))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_template_remote_gallery(cli, gallery_server, tmp_path):
+    """Remote gallery: list merges remote entries, new downloads the
+    declared files, and the scaffold trains through the normal CLI path
+    (reference console/Template.scala:130-429 fetch-and-scaffold)."""
+    code, out = cli("template", "list", "--gallery-url", gallery_server)
+    assert code == 0
+    assert "acme-rec" in out.out and "[remote]" in out.out
+
+    target = tmp_path / "from-remote"
+    code, out = cli("template", "new", str(target),
+                    "--template", "acme-rec",
+                    "--gallery-url", gallery_server)
+    assert code == 0, out.err
+    assert (target / "data" / "notes.txt").read_text().startswith("hello")
+    variant = json.loads((target / "engine.json").read_text())
+    assert variant["engineFactory"].endswith("RecommendationEngine")
+    code, out = cli("build", "--engine-dir", str(target))
+    assert code == 0, out.err
+    # scaffold trains as-is once its app exists
+    code, out = cli("app", "new", "acmeapp")
+    assert code == 0
+    from pio_tpu.data import DataMap, Event
+    from pio_tpu.data.storage import get_storage
+
+    storage = get_storage()
+    app_id = storage.get_metadata_apps().get_by_name("acmeapp").id
+    ev = storage.get_events()
+    for u in range(12):
+        for i in range(8):
+            if (u + i) % 2 == 0:
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5})), app_id)
+    code, out = cli("train", "--engine-dir", str(target))
+    assert code == 0, out.err
+
+
+def test_template_remote_gallery_errors(cli, tmp_path, monkeypatch):
+    """Unreachable gallery and unsafe file paths fail cleanly."""
+    code, out = cli("template", "list",
+                    "--gallery-url", "http://127.0.0.1:1")
+    assert code == 1 and "gallery fetch failed" in out.err
+
+    from pio_tpu.tools.templates import GalleryError, fetch_gallery
+
+    class FakeResp:
+        def __init__(self, body):
+            self.body = body
+
+        def read(self):
+            return self.body
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    import urllib.request
+
+    def serve(body):
+        monkeypatch.setattr(
+            urllib.request, "urlopen",
+            lambda url, timeout=0: FakeResp(body))
+
+    for rel in ("../../etc/passwd", "..\\..\\evil.py", "C:/evil",
+                "/abs/path", "", "data/", "a/../b"):
+        serve(json.dumps([{"name": "evil", "files": [rel]}]).encode())
+        with pytest.raises(GalleryError, match="unsafe"):
+            fetch_gallery("http://gallery.example")
+    # malformed index shapes fail cleanly, not with raw tracebacks
+    for body in (b'["just-a-string"]', b'[{"files": [123]}]', b'{"x": 1}'):
+        serve(body)
+        with pytest.raises(GalleryError):
+            fetch_gallery("http://gallery.example")
+    # non-http scheme rejected before any fetch
+    with pytest.raises(GalleryError, match="http"):
+        fetch_gallery("file:///etc")
+
+
+def test_template_builtin_works_with_dead_env_gallery(
+        cli, tmp_path, monkeypatch):
+    """A down gallery configured via env var must not block builtin
+    scaffolds (no network needed), and `list` degrades with a warning."""
+    monkeypatch.setenv("PIO_TEMPLATE_GALLERY_URL", "http://127.0.0.1:1")
+    target = tmp_path / "local-eng"
+    code, out = cli("template", "new", str(target))
+    assert code == 0, out.err
+    assert (target / "engine.json").exists()
+    code, out = cli("template", "list")
+    assert code == 0
+    assert "recommendation" in out.out
+    assert "WARN" in out.err
+
+
 def test_template_gallery_every_shape_builds(cli, tmp_path):
     """`pio template list` + one scaffold per zoo shape, each passing
     `pio build` untouched (reference console/Template.scala gallery,
