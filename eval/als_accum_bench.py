@@ -49,6 +49,9 @@ CELLS = [
     {"accum": "carry", "chunk_slots": 32768},    # fewer carries
     {"accum": "stacked", "chunk_slots": 8192},
     {"accum": "stacked", "chunk_slots": 32768},
+    # fused segment-flush kernel (ops/als_pallas.py); its internal VMEM
+    # chunk is capped at 128 regardless of the layout chunk
+    {"accum": "pallas", "chunk_slots": 8192},
 ]
 
 
@@ -64,7 +67,13 @@ def main() -> None:
 
     dev = jax.devices()[0]
     results = []
-    for cell in CELLS:
+    cells = [
+        c for c in CELLS
+        if not (c["accum"] == "pallas" and dev.platform == "cpu")
+        # pallas on CPU runs in interpret mode — a correctness tool
+        # (tests/test_als_pallas.py), meaningless to time
+    ]
+    for cell in cells:
         p = ALSParams(
             rank=RANK, iterations=SWEEPS, reg=0.05, alpha=10.0,
             implicit=True, chunk=8192,

@@ -87,7 +87,9 @@ class ALSParams:
     #   "stacked": chunks emit their blocks as scan OUTPUTS (no big carry),
     #              then one sorted scatter-add per slot group folds them
     #              into A — bounded temp via group_slots;
-    #   "auto":    stacked (measured-safe default; see eval/als_accum_bench)
+    #   "pallas":  fused Pallas segment-flush kernel (ops/als_pallas.py):
+    #              no scatter, no carry, each A row written once;
+    #   "auto":    per-backend (see resolved_accum)
     accum: str = "auto"
     # stacked mode: max slots whose (k,k) blocks are materialized at once;
     # temp bytes = group_slots * k * k * 4 (73k slots @ k=64 = 1.2 GB)
@@ -282,6 +284,16 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
         accum = "stacked" if _accelerator_backend() else "carry"
     # every caller pads S to a chunk_slots multiple via _slots_for
     assert S % chunk_slots == 0, (S, chunk_slots)
+
+    if accum == "pallas":
+        from pio_tpu.ops.als_pallas import normal_equations_pallas
+
+        # the kernel sizes its own VMEM chunk; cap by the layout's chunk
+        return normal_equations_pallas(
+            layout, other_factors, n_self, implicit, alpha,
+            chunk_slots=min(128, chunk_slots),
+            bf16_gather=bf16_gather,
+        )
 
     if accum == "carry":
         n_ch = S // chunk_slots

@@ -1,0 +1,246 @@
+"""Pallas TPU kernel for the ALS normal-equation accumulation.
+
+The third accumulation strategy (ops/als.py accum="pallas"), designed for
+the case where neither XLA path reaches the memory bound
+(eval/ALS_ROOFLINE.md):
+
+ * "carry":   scatter into a (n,k,k) lax.scan carry — re-streams the
+              accumulator once per chunk if the backend materializes it;
+ * "stacked": per-slot blocks as scan outputs + grouped sorted
+              scatter-add — bounded temp, but still materializes S·k²
+              floats and trusts XLA's scatter lowering;
+ * "pallas":  THIS kernel. Slots are processed in GROUPS (bounding the
+              XLA factor-gather temp at group_slots·W·k bytes); within a
+              group the kernel fuses the per-slot (k,W)x(W,k) MXU
+              products with a SEGMENT FLUSH: slots are row-sorted
+              (_device_slot_layout) and TPU Pallas grids execute
+              sequentially on a core, so a (k,k) VMEM scratch
+              accumulates the open row's partial blocks (scratch
+              persists across grid steps) and DMAs each segment that
+              ENDS inside the group to A in HBM. The group's final open
+              segment is emitted as a "trail" output — a row may span
+              groups, and each group contributes at most one trail — and
+              every trail folds in afterwards with ONE tiny
+              n_groups-row scatter-add (rows are sorted, flush is the
+              only writer of its row, so flush + trail-adds sum exactly;
+              no cross-group seeding or host synchronization needed).
+              A/b zero-initialize via input/output aliasing, so empty
+              rows read as zeros with no extra pass over A.
+
+Per-sweep traffic: the factor gather (written once by XLA per group,
+re-read once by the kernel), the zero-fill + one write of A, and row ids
+streamed through SMEM one (chunk,)-block per grid step. No scatter over
+k² blocks, no (n,k,k) carry, no unbounded temp.
+
+Status: correctness-pinned against the XLA paths in interpret mode on
+CPU (tests/test_als_pallas.py); not yet hardware-benchmarked — the TPU
+tunnel was down for all of round 3 (eval/als_accum_bench.py runs the
+A/B when a chip is reachable). auto never selects it until then.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _ne_kernel(rows_ref,            # (chunk,) int32 SMEM block (this step)
+               y_ref,               # (1, chunk, W, K) VMEM block
+               wo_ref,              # (1, chunk, W)    outer weights
+               wr_ref,              # (1, chunk, W)    rhs weights
+               a_init_ref,          # aliased -> a_out (zero-filled)
+               b_init_ref,          # aliased -> b_out
+               a_out,               # (n_pad, K, K) HBM (aliased)
+               b_out,               # (n_pad, K) HBM (aliased)
+               trail_a,             # (K, K) VMEM block: group's open tail
+               trail_b,             # (1, K)
+               trail_row,           # (1,) int32 SMEM
+               acc_a,               # (K, K) f32 VMEM scratch
+               acc_b,               # (1, K) f32 VMEM scratch
+               cur_row,             # (1,) int32 SMEM scratch
+               dma_sem,
+               *, chunk: int):
+    """One grid step = `chunk` consecutive slots; the sequential TPU grid
+    + persistent scratch carry the open row segment across steps. Segments
+    that END inside the group DMA to A/b; the group's last open segment
+    goes to the trail outputs (folded across groups by the caller)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    step = pl.program_id(0)
+    n_steps = pl.num_programs(0)
+
+    @pl.when(step == 0)
+    def _init():
+        cur_row[0] = rows_ref[0]
+        acc_a[...] = jnp.zeros_like(acc_a)
+        acc_b[...] = jnp.zeros_like(acc_b)
+
+    def flush(row):
+        a_copy = pltpu.make_async_copy(acc_a, a_out.at[row], dma_sem)
+        a_copy.start()
+        a_copy.wait()
+        b_copy = pltpu.make_async_copy(
+            acc_b, b_out.at[pl.ds(row, 1)], dma_sem)
+        b_copy.start()
+        b_copy.wait()
+
+    def slot_body(i, _):
+        row = rows_ref[i]
+
+        @pl.when(row != cur_row[0])
+        def _new_segment():
+            flush(cur_row[0])
+            acc_a[...] = jnp.zeros_like(acc_a)
+            acc_b[...] = jnp.zeros_like(acc_b)
+            cur_row[0] = row
+
+        y = y_ref[0, i].astype(jnp.float32)          # (W, K)
+        wo = wo_ref[0, i].astype(jnp.float32)        # (W,)
+        wr = wr_ref[0, i].astype(jnp.float32)
+        yw = y * wo[:, None]
+        # HIGHEST: the default 1-pass bf16 MXU contraction loses ~3e-3
+        # relative on A, which the CG solve cannot recover (same rationale
+        # as _chunk_blocks' Precision.HIGH)
+        acc_a[...] += jax.lax.dot_general(
+            y, yw, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        acc_b[...] += jnp.sum(y * wr[:, None], axis=0)[None, :]
+        return ()
+
+    jax.lax.fori_loop(0, chunk, slot_body, (), unroll=False)
+
+    @pl.when(step == n_steps - 1)
+    def _emit_trail():  # the group's last open segment is NEVER flushed
+        trail_a[...] = acc_a[...]
+        trail_b[...] = acc_b[...]
+        trail_row[0] = cur_row[0]
+
+
+def _run_group(rows_g, y_g, wo_g, wr_g, a_buf, b_buf, *, chunk: int,
+               k: int, W: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_steps = rows_g.shape[0] // chunk
+    smem = pltpu.MemorySpace.SMEM
+    hbm = pltpu.MemorySpace.HBM
+    return pl.pallas_call(
+        functools.partial(_ne_kernel, chunk=chunk),
+        grid=(n_steps,),
+        in_specs=[
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=smem),
+            pl.BlockSpec((1, chunk, W, k), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
+            pl.BlockSpec(memory_space=hbm),         # a_init (aliased)
+            pl.BlockSpec(memory_space=hbm),         # b_init (aliased)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=hbm),         # a_out
+            pl.BlockSpec(memory_space=hbm),         # b_out
+            # trail blocks revisit the same VMEM tile every step: Mosaic
+            # writes them back once at grid end
+            pl.BlockSpec((k, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec(memory_space=smem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(a_buf.shape, jnp.float32),
+            jax.ShapeDtypeStruct(b_buf.shape, jnp.float32),
+            jax.ShapeDtypeStruct((k, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k, k), jnp.float32),
+            pltpu.VMEM((1, k), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        # A/b accumulate in place across groups (indices count ALL inputs)
+        input_output_aliases={4: 0, 5: 1},
+        interpret=interpret,
+    )(rows_g, y_g, wo_g, wr_g, a_buf, b_buf)
+
+
+def normal_equations_pallas(layout, other_factors, n_self: int,
+                            implicit: bool, alpha: float,
+                            chunk_slots: int = 128,
+                            group_slots: int = 65536,
+                            bf16_gather: bool = True,
+                            interpret: bool | None = None):
+    """Pallas segment-flush accumulation: -> A (n_self,k,k), b (n_self,k).
+
+    Same contract as ops/als._normal_equations minus the shared YtY /
+    reg terms (added by the caller for implicit mode, as there).
+
+    chunk_slots sizes the VMEM working set (y block = chunk·W·k·2 bytes,
+    128·128·64·2 = 2 MB double-buffered); group_slots bounds the XLA
+    factor-gather temp (group·W·k·2 = 1.07 GB at the defaults). Fully
+    traceable — no host synchronization — so it jits inside the training
+    scan like the XLA paths."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    rows, idx, val, lens = layout
+    k = other_factors.shape[1]
+    S, W = idx.shape
+    chunk = min(chunk_slots, S)
+    # pad the slot axis to a whole number of kernel chunks with sentinel
+    # slots (row n_self keeps the ids sorted; zero lens -> zero weights)
+    pad = -S % chunk
+    if pad:
+        rows = jnp.concatenate(
+            [rows, jnp.full((pad,), n_self, rows.dtype)])
+        idx = jnp.concatenate([idx, jnp.zeros((pad, W), idx.dtype)])
+        val = jnp.concatenate([val, jnp.zeros((pad, W), val.dtype)])
+        lens = jnp.concatenate([lens, jnp.zeros((pad,), lens.dtype)])
+        S += pad
+
+    src = (
+        other_factors.astype(jnp.bfloat16) if bf16_gather else other_factors
+    )
+    mask = (
+        jnp.arange(W, dtype=jnp.int32)[None, :] < lens[:, None]
+    ).astype(jnp.float32)
+    vf = val.astype(jnp.float32)
+    if implicit:
+        w_outer = alpha * vf * mask
+        w_rhs = (1.0 + alpha * vf) * mask
+    else:
+        w_outer = mask
+        w_rhs = vf * mask
+
+    # one padding row absorbs the sentinel segment's writes
+    n_pad = n_self + 1
+    a_buf = jnp.zeros((n_pad, k, k), jnp.float32)
+    b_buf = jnp.zeros((n_pad, k), jnp.float32)
+
+    g_slots = max(chunk, (group_slots // chunk) * chunk)
+    t_rows, t_as, t_bs = [], [], []
+    for lo in range(0, S, g_slots):
+        hi = min(S, lo + g_slots)
+        y_g = src[idx[lo:hi]]                   # bounded gather temp
+        n_steps = (hi - lo) // chunk
+        a_buf, b_buf, tr_a, tr_b, tr_row = _run_group(
+            rows[lo:hi],
+            y_g.reshape(n_steps, chunk, W, k),
+            w_outer[lo:hi].reshape(n_steps, chunk, W),
+            w_rhs[lo:hi].reshape(n_steps, chunk, W),
+            a_buf, b_buf, chunk=chunk, k=k, W=W, interpret=interpret,
+        )
+        t_rows.append(tr_row)
+        t_as.append(tr_a)
+        t_bs.append(tr_b)
+    # fold every group's trailing open segment: the flush is the ONLY
+    # in-kernel writer of a row (its segment ends in exactly one group),
+    # so flush + trail adds reconstruct rows spanning group boundaries
+    A = a_buf.at[jnp.concatenate(t_rows)].add(
+        jnp.stack(t_as), mode="drop")
+    b = b_buf.at[jnp.concatenate(t_rows)].add(
+        jnp.concatenate(t_bs), mode="drop")
+    return A[:n_self], b[:n_self]

@@ -1,0 +1,159 @@
+"""Pallas normal-equation kernel pinned against the XLA accumulation
+paths (interpret mode on CPU). Covers multi-slot rows, empty rows
+(zeros contract), sentinel padding slots, chunk boundaries splitting a
+row's slot run, and both implicit/explicit weightings."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pio_tpu.ops.als import (
+    ALSParams,
+    _device_slot_layout,
+    _normal_equations,
+    _slots_for,
+)
+from pio_tpu.ops.als_pallas import normal_equations_pallas
+
+
+def _layout_and_factors(n_self=37, n_other=23, nnz=600, width=8,
+                        chunk_slots=16, k=8, seed=0, heavy_rows=True):
+    rng = np.random.default_rng(seed)
+    if heavy_rows:
+        # skewed rows: several rows own many slots; rows 5,6 own none
+        probs = rng.dirichlet(np.full(n_self, 0.3))
+        probs[5] = probs[6] = 0.0
+        probs /= probs.sum()
+        u = rng.choice(n_self, size=nnz, p=probs).astype(np.int32)
+    else:
+        u = rng.integers(0, n_self, nnz).astype(np.int32)
+    o = rng.integers(0, n_other, nnz).astype(np.int32)
+    v = rng.random(nnz).astype(np.float32) * 4 + 1
+    su = _slots_for(nnz, n_self, width, chunk_slots)
+    layout = _device_slot_layout(
+        jnp.asarray(u), jnp.asarray(o), jnp.asarray(v), n_self, width, su
+    )
+    factors = jnp.asarray(
+        rng.normal(size=(n_other, k)).astype(np.float32))
+    return layout, factors, u
+
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_pallas_matches_xla_accumulation(implicit):
+    n_self = 37
+    cs = 16
+    layout, factors, u = _layout_and_factors(n_self=n_self, chunk_slots=cs)
+    A_ref, b_ref = _normal_equations(
+        layout, factors, n_self, implicit, 2.5, cs, accum="carry",
+        bf16_gather=False,
+    )
+    A_p, b_p = normal_equations_pallas(
+        layout, factors, n_self, implicit, 2.5, chunk_slots=cs,
+        bf16_gather=False, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(A_p), np.asarray(A_ref), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(b_p), np.asarray(b_ref), atol=1e-4, rtol=1e-4)
+    # empty rows honored the zeros contract
+    for empty in (5, 6):
+        assert empty not in set(u.tolist())
+        assert np.all(np.asarray(A_p)[empty] == 0)
+        assert np.all(np.asarray(b_p)[empty] == 0)
+
+
+def test_pallas_row_spanning_chunk_boundary():
+    """A single row whose slot run crosses a grid-step boundary must
+    accumulate across steps (the persistent-scratch carry)."""
+    width, cs, k, n_self, n_other = 4, 8, 8, 3, 11
+    # row 1 owns 60 ratings -> 15 slots, spanning several 8-slot chunks
+    u = np.array([0] * 3 + [1] * 60 + [2] * 5, np.int32)
+    rng = np.random.default_rng(1)
+    o = rng.integers(0, n_other, len(u)).astype(np.int32)
+    v = np.ones(len(u), np.float32)
+    su = _slots_for(len(u), n_self, width, cs)
+    layout = _device_slot_layout(
+        jnp.asarray(u), jnp.asarray(o), jnp.asarray(v), n_self, width, su
+    )
+    factors = jnp.asarray(rng.normal(size=(n_other, k)).astype(np.float32))
+    A_ref, b_ref = _normal_equations(
+        layout, factors, n_self, True, 1.5, cs, accum="stacked",
+        bf16_gather=False,
+    )
+    A_p, b_p = normal_equations_pallas(
+        layout, factors, n_self, True, 1.5, chunk_slots=cs,
+        bf16_gather=False, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(A_p), np.asarray(A_ref), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(b_p), np.asarray(b_ref), atol=1e-4, rtol=1e-4)
+
+
+def test_pallas_end_to_end_train_matches_carry():
+    """als_train with accum='pallas' (interpret on CPU, under the training
+    jit/scan) reaches the same solution quality as the carry path.
+    chunk_slots=192 makes the layout's S a multiple of 192 but not of the
+    kernel's 128-capped chunk, so the sentinel slot-padding branch runs."""
+    from pio_tpu.ops.als import als_train, rmse
+
+    rng = np.random.default_rng(3)
+    nu, ni, nnz = 50, 30, 700
+    u = rng.integers(0, nu, nnz).astype(np.int64)
+    i = rng.integers(0, ni, nnz).astype(np.int64)
+    v = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    kw = dict(rank=8, iterations=6, reg=0.1, chunk=256, width=8,
+              chunk_slots=192)
+    m_p = als_train(u, i, v, nu, ni, ALSParams(**kw, accum="pallas"))
+    m_c = als_train(u, i, v, nu, ni, ALSParams(**kw, accum="carry"))
+    e_p = rmse(m_p, u, i, v)
+    e_c = rmse(m_c, u, i, v)
+    assert abs(e_p - e_c) < 5e-3, (e_p, e_c)
+
+
+def test_pallas_row_spanning_group_boundary():
+    """A row whose slots span multiple GROUPS: every group emits a trail,
+    only the group where the segment ends flushes, and the final trail
+    fold reconstructs the row exactly."""
+    width, cs, k, n_self, n_other = 4, 8, 8, 3, 11
+    u = np.array([0] * 3 + [1] * 120 + [2] * 5, np.int32)  # row 1: 30 slots
+    rng = np.random.default_rng(2)
+    o = rng.integers(0, n_other, len(u)).astype(np.int32)
+    v = (rng.random(len(u)) * 2 + 0.5).astype(np.float32)
+    su = _slots_for(len(u), n_self, width, cs)
+    layout = _device_slot_layout(
+        jnp.asarray(u), jnp.asarray(o), jnp.asarray(v), n_self, width, su
+    )
+    factors = jnp.asarray(rng.normal(size=(n_other, k)).astype(np.float32))
+    A_ref, b_ref = _normal_equations(
+        layout, factors, n_self, False, 1.0, cs, accum="carry",
+        bf16_gather=False,
+    )
+    # group_slots=16 -> row 1's 30 slots span 2+ groups
+    A_p, b_p = normal_equations_pallas(
+        layout, factors, n_self, False, 1.0, chunk_slots=cs,
+        group_slots=16, bf16_gather=False, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(A_p), np.asarray(A_ref), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(b_p), np.asarray(b_ref), atol=1e-4, rtol=1e-4)
+
+
+def test_pallas_bf16_gather_close_to_f32():
+    n_self, cs = 21, 16
+    layout, factors, _ = _layout_and_factors(
+        n_self=n_self, chunk_slots=cs, heavy_rows=False, nnz=300)
+    A32, b32 = normal_equations_pallas(
+        layout, factors, n_self, False, 1.0, chunk_slots=cs,
+        bf16_gather=False, interpret=True,
+    )
+    A16, b16 = normal_equations_pallas(
+        layout, factors, n_self, False, 1.0, chunk_slots=cs,
+        bf16_gather=True, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(A16), np.asarray(A32), atol=5e-2, rtol=5e-2)
+    np.testing.assert_allclose(
+        np.asarray(b16), np.asarray(b32), atol=5e-2, rtol=5e-2)
