@@ -141,6 +141,26 @@ def test_pallas_row_spanning_group_boundary():
         np.asarray(b_p), np.asarray(b_ref), atol=1e-4, rtol=1e-4)
 
 
+def test_pallas_composes_with_shard_map():
+    """accum='pallas' inside als_train_sharded's shard_map (8 virtual
+    devices): the multi-chip path can use the fused kernel unchanged."""
+    from pio_tpu.ops.als import als_train, als_train_sharded, rmse
+    from pio_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    rng = np.random.default_rng(0)
+    nu, ni, nnz = 60, 40, 900
+    u = rng.integers(0, nu, nnz)
+    i = rng.integers(0, ni, nnz)
+    v = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    mesh = create_mesh(MeshConfig(data=8))
+    kw = dict(rank=8, iterations=5, reg=0.1, chunk=256, width=8,
+              chunk_slots=64)
+    m = als_train_sharded(
+        u, i, v, nu, ni, ALSParams(**kw, accum="pallas"), mesh)
+    m1 = als_train(u, i, v, nu, ni, ALSParams(**kw, accum="carry"))
+    assert abs(rmse(m, u, i, v) - rmse(m1, u, i, v)) < 5e-3
+
+
 def test_pallas_bf16_gather_close_to_f32():
     n_self, cs = 21, 16
     layout, factors, _ = _layout_and_factors(
