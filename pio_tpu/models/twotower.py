@@ -166,31 +166,78 @@ def train_two_tower(
         batch = max(n_data, batch - batch % n_data)  # divisible by dp
         p_shard = param_shardings(params, mesh)
         o_shard = param_shardings_for_opt(opt_state, params, p_shard, mesh)
-        batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
-        step = jax.jit(
-            train_step,
-            in_shardings=(p_shard, o_shard, batch_sharding, batch_sharding),
-            out_shardings=(p_shard, o_shard, NamedSharding(mesh, P())),
-        )
+        # step axis replicated, batch axis dp-sharded
+        xs_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
         params = jax.device_put(params, p_shard)
         opt_state = jax.device_put(opt_state, o_shard)
     else:
-        step = jax.jit(train_step)
+        p_shard = o_shard = xs_sharding = None
 
+    def run_span(params, opt_state, uu, ii):
+        """lax.scan over a span of steps — the whole span is ONE device
+        program: no per-step host round trip (dispatch-bound on a
+        remote/tunneled device) and no per-step transfer."""
+        def body(carry, xs):
+            params, opt_state = carry
+            u, i = xs
+            params, opt_state, _loss = train_step(params, opt_state, u, i)
+            return (params, opt_state), None
+
+        (params, opt_state), _ = jax.lax.scan(
+            body, (params, opt_state), (uu, ii))
+        return params, opt_state
+
+    if mesh is not None:
+        span = jax.jit(
+            run_span,
+            in_shardings=(p_shard, o_shard, xs_sharding, xs_sharding),
+            out_shardings=(p_shard, o_shard),
+        )
+    else:
+        span = jax.jit(run_span)
+
+    # (seed, step)-keyed sampling: the stream is identical whether the run
+    # is fresh or resumed from a checkpoint. Indices for a whole SPAN of
+    # steps are built host-side and cross to the device once — a span is
+    # one compiled program instead of span-many dispatches. Span ends are
+    # pinned to the checkpoint cadence (orbax saves only steps that are
+    # multiples of save_every) and capped so the staged index tensors stay
+    # bounded (~2 x SPAN_CAP x batch x 4 bytes).
     n = len(inter)
-    loss = None
-    for step_i in range(start_step, p.steps):
-        # (seed, step)-keyed sampling: the stream is identical whether the
-        # run is fresh or resumed from a checkpoint
-        idx = np.random.default_rng((p.seed, step_i)).integers(0, n, size=batch)
-        u = jnp.asarray(inter.user_idx[idx], jnp.int32)
-        i = jnp.asarray(inter.item_idx[idx], jnp.int32)
+    SPAN_CAP = 512
+
+    def batches_for(lo: int, hi: int):
+        idx = np.stack([
+            np.random.default_rng((p.seed, s)).integers(0, n, size=batch)
+            for s in range(lo, hi)
+        ])
+        uu = jnp.asarray(inter.user_idx[idx], jnp.int32)
+        ii = jnp.asarray(inter.item_idx[idx], jnp.int32)
         if mesh is not None:
-            u = jax.device_put(u, batch_sharding)
-            i = jax.device_put(i, batch_sharding)
-        params, opt_state, loss = step(params, opt_state, u, i)
-        if checkpoint is not None:
-            checkpoint.maybe_save(step_i, params, opt_state)
+            uu = jax.device_put(uu, xs_sharding)
+            ii = jax.device_put(ii, xs_sharding)
+        return uu, ii
+
+    every = (
+        max(1, checkpoint.config.save_every) if checkpoint is not None
+        else None
+    )
+    s = start_step
+    while s < p.steps:
+        e = min(p.steps, s + SPAN_CAP)
+        if every is not None:
+            # break the span right AFTER the next save-eligible step m
+            # (m % every == 0), mirroring the per-step loop's save points
+            m = s if s % every == 0 else (s // every + 1) * every
+            if m < e:
+                e = m + 1
+        uu, ii = batches_for(s, e)
+        params, opt_state = span(params, opt_state, uu, ii)
+        if every is not None and (e - 1) % every == 0:
+            # only save-eligible steps: maybe_save device_gets the full
+            # state, which a declined save would waste
+            checkpoint.maybe_save(e - 1, params, opt_state)
+        s = e
 
     # materialize all item embeddings for serving
     item_ids = jnp.arange(inter.n_items, dtype=jnp.int32)

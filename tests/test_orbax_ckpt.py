@@ -64,7 +64,18 @@ def test_interrupted_training_resumes_identically(tiny_inter, tmp_path):
         np.testing.assert_allclose(p1, p2, atol=1e-5)
 
 
-def test_resume_or_init_passthrough(tmp_path):
+def test_save_cadence_matches_per_step_loop(tiny_inter, tmp_path):
+    """The span-scanned trainer must hit the SAME save steps the original
+    per-step loop hit (orbax only accepts steps that are multiples of
+    save_every): 10 steps at save_every=3 -> saves at 0,3,6,9 (the last 3
+    kept at max_to_keep=3)."""
+    ckpt_dir = str(tmp_path / "cadence")
+    with StepCheckpointer(
+            StepCheckpointConfig(ckpt_dir, save_every=3, max_to_keep=3)
+    ) as ck:
+        train_two_tower(tiny_inter, _params(10), checkpoint=ck)
+        assert ck.latest_step() == 9
+        assert sorted(ck._mgr.all_steps()) == [3, 6, 9]
     params = {"w": np.ones(3)}
     opt = {"m": np.zeros(3)}
     # no checkpointer -> step 0, same objects
