@@ -363,7 +363,6 @@ def train_sequence_model(
             return params, opt_state, loss
 
         step = jax.jit(step)
-        batch_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
         batch = max(n_data, p.batch_size - p.batch_size % n_data)
     else:
         n_data = 1
@@ -394,18 +393,50 @@ def train_sequence_model(
     # the sampled batch must split evenly over the data mesh axis
     size = min(batch, max(8, n))
     size = max(n_data, size - size % n_data)
-    loss = None
-    for step_i in range(start_step, p.steps):
-        # (seed, step)-keyed sampling: identical stream fresh or resumed
-        idx = np.random.default_rng((p.seed, step_i)).integers(0, n, size=size)
-        inp = jnp.asarray(inp_all[idx])
-        tgt = jnp.asarray(tgt_all[idx])
+
+    # spans of steps scanned on device: one dispatch + one batch transfer
+    # per span instead of per step (workflow/spans.py owns the boundary
+    # math — bounded staging, checkpoint cadence preserved step-for-step)
+    from pio_tpu.workflow.spans import span_bounds
+
+    def run_span(params, opt_state, inps, tgts):
+        def body(carry, xs):
+            params, opt_state = carry
+            inp, tgt = xs
+            params, opt_state, loss = step_fn(params, opt_state, inp, tgt)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (inps, tgts))
+        return params, opt_state, losses[-1]
+
+    step_fn = step  # the (possibly shard_mapped) single-step update
+    span = jax.jit(run_span)
+
+    def batches_for(lo: int, hi: int):
+        idx = np.stack([
+            np.random.default_rng((p.seed, s)).integers(0, n, size=size)
+            for s in range(lo, hi)
+        ])
+        inps = jnp.asarray(inp_all[idx])
+        tgts = jnp.asarray(tgt_all[idx])
         if mesh is not None:
-            inp = jax.device_put(inp, batch_sharding)
-            tgt = jax.device_put(tgt, batch_sharding)
-        params, opt_state, loss = step(params, opt_state, inp, tgt)
-        if checkpoint is not None:
-            checkpoint.maybe_save(step_i, params, opt_state)
+            xs_sharding = NamedSharding(
+                mesh, P(None, DATA_AXIS, SEQ_AXIS))
+            inps = jax.device_put(inps, xs_sharding)
+            tgts = jax.device_put(tgts, xs_sharding)
+        return inps, tgts
+
+    every = (
+        max(1, checkpoint.config.save_every) if checkpoint is not None
+        else None
+    )
+    loss = None
+    for lo, hi, save_after in span_bounds(start_step, p.steps, every):
+        inps, tgts = batches_for(lo, hi)
+        params, opt_state, loss = span(params, opt_state, inps, tgts)
+        if save_after:
+            checkpoint.maybe_save(hi - 1, params, opt_state)
     return jax.device_get(params), encoder, float(loss)
 
 

@@ -199,12 +199,11 @@ def train_two_tower(
     # (seed, step)-keyed sampling: the stream is identical whether the run
     # is fresh or resumed from a checkpoint. Indices for a whole SPAN of
     # steps are built host-side and cross to the device once — a span is
-    # one compiled program instead of span-many dispatches. Span ends are
-    # pinned to the checkpoint cadence (orbax saves only steps that are
-    # multiples of save_every) and capped so the staged index tensors stay
-    # bounded (~2 x SPAN_CAP x batch x 4 bytes).
+    # one compiled program instead of span-many dispatches; boundaries
+    # come from workflow/spans.py (bounded staging + checkpoint cadence).
+    from pio_tpu.workflow.spans import span_bounds
+
     n = len(inter)
-    SPAN_CAP = 512
 
     def batches_for(lo: int, hi: int):
         idx = np.stack([
@@ -222,22 +221,13 @@ def train_two_tower(
         max(1, checkpoint.config.save_every) if checkpoint is not None
         else None
     )
-    s = start_step
-    while s < p.steps:
-        e = min(p.steps, s + SPAN_CAP)
-        if every is not None:
-            # break the span right AFTER the next save-eligible step m
-            # (m % every == 0), mirroring the per-step loop's save points
-            m = s if s % every == 0 else (s // every + 1) * every
-            if m < e:
-                e = m + 1
-        uu, ii = batches_for(s, e)
+    for lo, hi, save_after in span_bounds(start_step, p.steps, every):
+        uu, ii = batches_for(lo, hi)
         params, opt_state = span(params, opt_state, uu, ii)
-        if every is not None and (e - 1) % every == 0:
+        if save_after:
             # only save-eligible steps: maybe_save device_gets the full
             # state, which a declined save would waste
-            checkpoint.maybe_save(e - 1, params, opt_state)
-        s = e
+            checkpoint.maybe_save(hi - 1, params, opt_state)
 
     # materialize all item embeddings for serving
     item_ids = jnp.arange(inter.n_items, dtype=jnp.int32)

@@ -1,0 +1,44 @@
+"""Span scheduling for on-device training scans.
+
+Both neural trainers (models/twotower.py, models/sequence.py) replace
+their per-step host loops with `lax.scan` over SPANS of steps: one
+compiled program per span instead of one dispatch + batch transfer per
+step (the per-step loop is dispatch-bound on remote/tunneled devices).
+The span boundaries have to respect two constraints:
+
+ * bounded staging — a span's batch tensors are materialized host-side
+   and transferred once, so spans are capped;
+ * checkpoint cadence — orbax only accepts saves at steps that are
+   multiples of save_every, and resume correctness requires hitting
+   exactly the steps the original per-step loop hit (0, k, 2k, ...), so
+   a span must END right after a save-eligible step.
+
+This module owns that boundary math so the trainers share one tested
+implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+
+def span_bounds(start: int, steps: int, save_every: int | None,
+                cap: int = 512) -> Iterator[tuple[int, int, bool]]:
+    """Yield (lo, hi, save_after) spans covering [start, steps).
+
+    `save_after` is True when step hi-1 is save-eligible
+    ((hi-1) % save_every == 0) — the caller then invokes
+    checkpoint.maybe_save(hi-1, ...). With save_every=None no span ever
+    asks for a save."""
+    s = start
+    while s < steps:
+        e = min(steps, s + cap)
+        if save_every is not None:
+            m = s if s % save_every == 0 else (
+                s // save_every + 1) * save_every
+            if m < e:
+                e = m + 1
+        yield s, e, (
+            save_every is not None and (e - 1) % save_every == 0
+        )
+        s = e

@@ -1,0 +1,40 @@
+"""Span boundary math for the on-device training scans
+(workflow/spans.py): bounded staging + checkpoint cadence parity with
+the per-step loops the trainers replaced."""
+
+from pio_tpu.workflow.spans import span_bounds
+
+
+def covers(spans, start, steps):
+    pos = start
+    for lo, hi, _ in spans:
+        assert lo == pos and hi > lo
+        pos = hi
+    assert pos == steps
+
+
+def test_no_checkpoint_caps_spans():
+    spans = list(span_bounds(0, 1300, None, cap=512))
+    covers(spans, 0, 1300)
+    assert [s[:2] for s in spans] == [(0, 512), (512, 1024), (1024, 1300)]
+    assert not any(save for _, _, save in spans)
+
+
+def test_cadence_matches_per_step_loop():
+    """Save points must equal the original loop's: every step s with
+    s % every == 0 in [start, steps)."""
+    for start, steps, every, cap in [
+        (0, 10, 3, 512), (4, 10, 3, 512), (0, 10, 3, 2),
+        (0, 100, 7, 10), (5, 6, 5, 512), (0, 1, 1, 512),
+    ]:
+        spans = list(span_bounds(start, steps, every, cap=cap))
+        covers(spans, start, steps)
+        saves = [hi - 1 for lo, hi, save in spans if save]
+        want = [s for s in range(start, steps) if s % every == 0]
+        assert saves == want, (start, steps, every, cap, saves, want)
+        assert all(hi - lo <= cap for lo, hi, _ in spans)
+
+
+def test_empty_range():
+    assert list(span_bounds(5, 5, 3)) == []
+    assert list(span_bounds(7, 3, None)) == []
