@@ -84,6 +84,18 @@ class RecommendationDataSource(DataSource):
         )
 
 
+def _rank_candidates(cand: list, scores, num: int) -> dict:
+    """Candidate ids + their scores -> top-`num` PredictedResult shape
+    (shared by the single-query and batched whitelist paths so their
+    ranking semantics cannot drift)."""
+    order = np.argsort(-np.asarray(scores))[:num]
+    return {
+        "itemScores": [
+            {"item": cand[i], "score": float(scores[i])} for i in order
+        ]
+    }
+
+
 @dataclass(frozen=True)
 class ALSAlgorithmParams(Params):
     rank: int = 10
@@ -174,12 +186,7 @@ class ALSAlgorithm(PAlgorithm):
                     cidx,
                 )
             )
-            order = np.argsort(-scores)[:num]
-            return {
-                "itemScores": [
-                    {"item": cand[i], "score": float(scores[i])} for i in order
-                ]
-            }
+            return _rank_candidates(cand, scores, num)
         k = min(num + len(black), model.factors.item_factors.shape[0])
         scores, idx = als.recommend_topk(
             model.factors, np.array([uidx]), k
@@ -202,17 +209,40 @@ class ALSAlgorithm(PAlgorithm):
         included (over-fetch k = num + max blacklist, filter per row on
         host; unseen-item evaluation blacklists on every query, so routing
         them to the single-query path would collapse the batch API into
-        thousands of single-row dispatches). whiteList queries keep full
-        candidate-set semantics via the single-query path."""
+        thousands of single-row dispatches). whiteList queries batch too:
+        their ragged candidate sets flatten into ONE predict_pairs call
+        (user index repeated per candidate), ranked per query on host."""
         results: list[dict] = [{"itemScores": []} for _ in queries]
         known = []
+        white_q = []   # (query_index, uidx, [candidate ids])
         for i, q in enumerate(queries):
             if q["user"] not in model.users:
                 continue
             if q.get("whiteList"):
-                results[i] = self.predict(model, q)
+                black = set(q.get("blackList") or ())
+                cand = [c for c in q["whiteList"]
+                        if c in model.items and c not in black]
+                if cand:
+                    white_q.append(
+                        (i, model.users.index_of(q["user"]), cand))
             else:
                 known.append((i, model.users.index_of(q["user"])))
+        if white_q:
+            flat_u = np.concatenate([
+                np.full(len(cand), u, np.int32)
+                for _, u, cand in white_q
+            ])
+            flat_i = np.concatenate([
+                model.items.encode(cand) for _, _, cand in white_q
+            ]).astype(np.int32)
+            flat_s = np.asarray(
+                als.predict_pairs(model.factors, flat_u, flat_i))
+            off = 0
+            for qi, _, cand in white_q:
+                s = flat_s[off:off + len(cand)]
+                off += len(cand)
+                results[qi] = _rank_candidates(
+                    cand, s, int(queries[qi].get("num", 10)))
         if not known:
             return results
         n_items = model.factors.item_factors.shape[0]

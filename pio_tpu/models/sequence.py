@@ -431,12 +431,26 @@ def train_sequence_model(
         max(1, checkpoint.config.save_every) if checkpoint is not None
         else None
     )
+    # spans stage (span, batch, seq) token tensors: cap by BYTES so long
+    # sequences shrink the span instead of blowing up staging memory
+    # (2 arrays x cap x size x seq_len x 4B <= ~64 MB)
+    seq_len = inp_all.shape[1]
+    cap = max(1, min(512, (64 << 20) // max(1, 2 * size * seq_len * 4)))
     loss = None
-    for lo, hi, save_after in span_bounds(start_step, p.steps, every):
+    for lo, hi, save_after in span_bounds(start_step, p.steps, every,
+                                          cap=cap):
         inps, tgts = batches_for(lo, hi)
         params, opt_state, loss = span(params, opt_state, inps, tgts)
         if save_after:
             checkpoint.maybe_save(hi - 1, params, opt_state)
+    if loss is None:
+        # resumed a run whose final step is already checkpointed (or
+        # steps == 0): report the loss AT the restored params on the last
+        # step's batch — span's loss is pre-update, and the updated
+        # params/opt_state are discarded
+        inps, tgts = batches_for(max(start_step - 1, 0),
+                                 max(start_step, 1))
+        _, _, loss = span(params, opt_state, inps, tgts)
     return jax.device_get(params), encoder, float(loss)
 
 

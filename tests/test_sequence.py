@@ -106,6 +106,29 @@ def test_ulysses_sp_matches_single_device():
         train_sequence_model(data, p, bad_mesh)
 
 
+def test_resume_of_completed_run_returns_model(tmp_path):
+    """Re-running a fully-checkpointed training must return the restored
+    model with a real (finite) loss, not crash on float(None)."""
+    from pio_tpu.workflow.orbax_ckpt import (
+        StepCheckpointConfig, StepCheckpointer,
+    )
+
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=8)
+    data = SequenceData(seqs, users, items)
+    p = SequenceParams(max_len=8, embed_dim=16, num_heads=2, num_layers=1,
+                       ffn_dim=32, steps=4, batch_size=16)
+    d = str(tmp_path / "ck")
+    with StepCheckpointer(StepCheckpointConfig(d, save_every=1)) as ck:
+        _, _, loss1 = train_sequence_model(data, p, None, checkpoint=ck)
+    with StepCheckpointer(StepCheckpointConfig(d, save_every=1)) as ck:
+        params, _, loss2 = train_sequence_model(data, p, None,
+                                                checkpoint=ck)
+    import math
+
+    assert math.isfinite(loss2)
+    assert params is not None
+
+
 def test_moe_ffn_trains_and_serves():
     """moe_experts > 0: the Switch FFN replaces the dense FFN — the model
     must still learn the cyclic pattern under dp x sp sharding and serve

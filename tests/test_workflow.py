@@ -162,3 +162,31 @@ def test_batch_predict_vectorized(seeded_storage):
         single = algo.predict(models[0], q)
         assert [s["item"] for s in single["itemScores"]] == [
             s["item"] for s in b["itemScores"]]
+
+
+def test_batch_predict_mixed_lists_match_single(seeded_storage):
+    """whiteList/blackList/plain queries in ONE batch: the flattened
+    predict_pairs whitelist path and the over-fetch blacklist path must
+    reproduce the single-query results exactly."""
+    engine, ep = engine_and_params()
+    ctx = create_workflow_context(seeded_storage, use_mesh=False)
+    models = engine.train(ctx, ep)
+    algo = engine._doers(ep)[2][0]
+    queries = [
+        {"user": "u0", "num": 3, "whiteList": ["i0", "i2", "i4"]},
+        {"user": "u1", "num": 2, "whiteList": ["i1", "nope", "i3"]},
+        {"user": "u2", "num": 3, "blackList": ["i0", "i2"]},
+        {"user": "u3", "num": 4},
+        {"user": "ghost", "num": 3, "whiteList": ["i0"]},
+        {"user": "u4", "num": 2,
+         "whiteList": ["i0", "i2"], "blackList": ["i0"]},
+        {"user": "u5", "num": 2, "whiteList": ["nope"]},
+    ]
+    batch = algo.batch_predict(models[0], queries)
+    assert len(batch) == len(queries)
+    for q, b in zip(queries, batch):
+        single = algo.predict(models[0], q)
+        assert [s["item"] for s in single["itemScores"]] == [
+            s["item"] for s in b["itemScores"]], (q, single, b)
+        for sb, ss in zip(b["itemScores"], single["itemScores"]):
+            assert abs(sb["score"] - ss["score"]) < 1e-5
