@@ -589,24 +589,33 @@ class SequenceAlgorithm(PAlgorithm):
             np.asarray(seq, np.int32), (p.max_len - len(seq), 0)
         )
 
-    def _score_last(self, model: SequenceModel, seq_row: np.ndarray):
-        """Forward the last max_len-1 items of one history row; return
-        next-item scores (vocab,) from the tied head at the final position.
-        Training consumes inputs of length max_len-1 (positions
-        0..max_len-2), so serving must too — feeding all max_len items
-        would read the never-trained last position row. Serving path:
-        Pallas flash attention on TPU, reference on CPU."""
+    def _score_last_batch(self, model: SequenceModel, rows: np.ndarray):
+        """Forward the last max_len-1 items of a (B, max_len) batch of
+        history rows; return next-item scores (B, vocab) from the tied
+        head at the final position. Training consumes inputs of length
+        max_len-1 (positions 0..max_len-2), so serving must too — feeding
+        all max_len items would read the never-trained last position row.
+        The batch dim is bucketed to a power of two so the micro-batcher's
+        varying sizes compile O(log) programs. Serving path: Pallas flash
+        attention on TPU, reference on CPU."""
         p = model.config
         encoder = make_encoder(len(model.items), p)
         on_cpu = jax.devices()[0].platform == "cpu"
         attn = partial(
             attention_reference if on_cpu else flash_attention, causal=True,
         )
-        inp = seq_row[-(p.max_len - 1):]
+        from pio_tpu.ops.bucketing import pow2_bucket
+
+        b = rows.shape[0]
+        bucket = pow2_bucket(b)
+        inp = rows[:, -(p.max_len - 1):]
+        if bucket != b:
+            inp = np.concatenate(
+                [inp, np.zeros((bucket - b, inp.shape[1]), inp.dtype)])
         _, logits = encoder.apply(
-            {"params": model.params}, jnp.asarray(inp[None, :]), attn,
+            {"params": model.params}, jnp.asarray(inp), attn,
         )
-        return logits[0, -1]
+        return logits[:b, -1]
 
     def history_row(self, model: SequenceModel, query: dict):
         """The (max_len,) PAD-left row predict actually scores from: the
@@ -622,28 +631,47 @@ class SequenceAlgorithm(PAlgorithm):
         return row
 
     def predict(self, model: SequenceModel, query: dict) -> dict:
-        num = int(query.get("num", 10))
-        row = self.history_row(model, query)
-        if row is None:
-            return {"itemScores": []}
-        scores = np.array(self._score_last(model, row))  # writable copy
-        scores[PAD] = -np.inf
-        seen = (
-            set(int(i) for i in row if i != PAD)
-            if model.config.unseen_only else set()
-        )
-        black = {
-            model.items.index_of(b) + 1
-            for b in (query.get("blackList") or ())
-            if b in model.items
-        }
-        for i in seen | black:
-            scores[i] = -np.inf
-        order = np.argsort(-scores)[:num]
-        return {"itemScores": [
-            {"item": model.items.decode([i - 1])[0], "score": float(scores[i])}
-            for i in order if np.isfinite(scores[i])
-        ]}
+        return self.batch_predict(model, [query])[0]
+
+    def batch_predict(self, model: SequenceModel, queries) -> list:
+        """Vectorized serving (the micro-batcher's path): the history rows
+        of every resolvable user in the batch encode in ONE transformer
+        forward (batch bucketed to a power of two for compile-cache
+        bounds); per-query seen/blackList masking and ranking happen on
+        host over the (B, vocab) score matrix."""
+        results: list[dict] = [{"itemScores": []} for _ in queries]
+        resolved = []
+        for i, q in enumerate(queries):
+            row = self.history_row(model, q)
+            if row is not None:
+                resolved.append((i, row))
+        if not resolved:
+            return results
+        rows = np.stack([r for _, r in resolved])
+        all_scores = np.array(self._score_last_batch(model, rows))
+        for b, (qi, row) in enumerate(resolved):
+            q = queries[qi]
+            num = int(q.get("num", 10))
+            scores = all_scores[b]   # fresh host array: in-place is fine
+            scores[PAD] = -np.inf
+            seen = (
+                set(int(i) for i in row if i != PAD)
+                if model.config.unseen_only else set()
+            )
+            black = {
+                model.items.index_of(x) + 1
+                for x in (q.get("blackList") or ())
+                if x in model.items
+            }
+            for i in seen | black:
+                scores[i] = -np.inf
+            order = np.argsort(-scores)[:num]
+            results[qi] = {"itemScores": [
+                {"item": model.items.decode([i - 1])[0],
+                 "score": float(scores[i])}
+                for i in order if np.isfinite(scores[i])
+            ]}
+        return results
 
 
 class SequenceEngine(EngineFactory):

@@ -339,34 +339,59 @@ class TwoTowerAlgorithm(PAlgorithm):
             users=inter.users, items=inter.items, config=self.params,
         )
 
-    def _user_embedding(self, model: TwoTowerModel, uidx: int) -> jax.Array:
+    def predict(self, model: TwoTowerModel, query: dict) -> dict:
+        return self.batch_predict(model, [query])[0]
+
+    def batch_predict(self, model: TwoTowerModel, queries) -> list:
+        """Vectorized retrieval (the micro-batcher's path): ONE user-tower
+        forward + ONE cosine top-k for every known user in the batch
+        (blackList handled by over-fetch + host filter, like the
+        recommendation template's batched path)."""
+        results: list[dict] = [{"itemScores": []} for _ in queries]
+        known = [
+            (i, model.users.index_of(q["user"]))
+            for i, q in enumerate(queries)
+            if q.get("user", "") in model.users
+        ]
+        if not known:
+            return results
+        from pio_tpu.ops.bucketing import pow2_bucket
+
         tower = Tower(
             len(model.users), model.config.embed_dim,
             model.config.hidden_dim, model.config.out_dim,
         )
-        return tower.apply(
-            {"params": model.params["user"]},
-            jnp.asarray([uidx], jnp.int32),
+        # batch dim bucketed: the micro-batcher produces varying sizes and
+        # each distinct B would otherwise compile a fresh tower forward +
+        # top-k program
+        b = len(known)
+        uidx = np.zeros(pow2_bucket(b), np.int32)
+        uidx[:b] = [u for _, u in known]
+        uv = tower.apply(
+            {"params": model.params["user"]}, jnp.asarray(uidx),
+        )                                                   # (B', d)
+        n_items = model.item_embeddings.shape[0]
+        k = min(
+            max(int(queries[qi].get("num", 10))
+                + len(queries[qi].get("blackList") or ())
+                for qi, _ in known),
+            n_items,
         )
-
-    def predict(self, model: TwoTowerModel, query: dict) -> dict:
-        user = query.get("user", "")
-        num = int(query.get("num", 10))
-        if user not in model.users:
-            return {"itemScores": []}
-        uv = self._user_embedding(model, model.users.index_of(user))
-        black = set(query.get("blackList") or ())
-        k = min(num + len(black), model.item_embeddings.shape[0])
         scores, idx = cosine_topk(model.item_embeddings, uv, k)
-        scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
-        out = []
-        for item, s in zip(model.items.decode(idx), scores):
-            if item in black:
-                continue
-            out.append({"item": item, "score": float(s)})
-            if len(out) >= num:
-                break
-        return {"itemScores": out}
+        scores, idx = np.asarray(scores)[:b], np.asarray(idx)[:b]
+        for row, (qi, _) in enumerate(known):
+            q = queries[qi]
+            num = int(q.get("num", 10))
+            black = set(q.get("blackList") or ())
+            out = []
+            for item, s in zip(model.items.decode(idx[row]), scores[row]):
+                if item in black:
+                    continue
+                out.append({"item": item, "score": float(s)})
+                if len(out) >= num:
+                    break
+            results[qi] = {"itemScores": out}
+        return results
 
 
 class TwoTowerEngine(EngineFactory):

@@ -677,12 +677,14 @@ def recommend_topk(model: ALSModel, user_idx, k: int):
     jit, so per-query k values (e.g. num + len(blackList)) and the varying
     batch sizes the serving micro-batcher produces compile O(log) XLA
     programs instead of one per size; the exact trim happens on host."""
+    from pio_tpu.ops.bucketing import pow2_bucket
+
     n_items = model.item_factors.shape[0]
     k = max(1, min(int(k), n_items))
-    k_bucket = min(n_items, 1 << (k - 1).bit_length())
+    k_bucket = pow2_bucket(k, cap=n_items)
     user_idx = np.asarray(user_idx)
     b = len(user_idx)
-    b_bucket = max(1, 1 << (b - 1).bit_length())
+    b_bucket = pow2_bucket(b)
     if b_bucket != b:
         user_idx = np.concatenate(
             [user_idx, np.zeros(b_bucket - b, user_idx.dtype)]

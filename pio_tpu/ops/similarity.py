@@ -33,9 +33,11 @@ def cosine_topk(matrix: jax.Array, queries: jax.Array, k: int):
     """matrix: (I, d) item vectors; queries: (B, d). Returns (scores, idx)
     of the k most cosine-similar rows per query. k is bucketed to a power
     of two pre-jit (compile-cache bound), trimmed on host."""
+    from pio_tpu.ops.bucketing import pow2_bucket
+
     n = matrix.shape[0]
     k = max(1, min(int(k), n))
-    bucket = min(n, 1 << (k - 1).bit_length())
+    bucket = pow2_bucket(k, cap=n)
     matrix_n = normalize_rows(matrix)
     scores, idx = _cosine_topk_jit(matrix_n, queries, bucket)
     return scores[:, :k], idx[:, :k]

@@ -197,6 +197,27 @@ def test_learns_and_serves_next_item(trained):
     assert out["itemScores"][0]["item"] == "i8"
 
 
+def test_batch_predict_matches_single(trained):
+    """batch_predict encodes every history row in ONE forward; results
+    must match per-query predicts (mixed known/unknown, blackList)."""
+    from pio_tpu.models.sequence import SequenceAlgorithm
+
+    model, _ = trained
+    algo = SequenceAlgorithm(model.config)
+    users = model.users.ids()
+    queries = [
+        {"user": users[0], "num": 3},
+        {"user": users[1], "num": 5, "blackList": [model.items.ids()[0]]},
+        {"user": "ghost-user", "num": 3},
+        {"user": users[2], "num": 2},
+    ]
+    batch = algo.batch_predict(model, queries)
+    for q, b in zip(queries, batch):
+        single = algo.predict(model, q)
+        assert [s["item"] for s in single["itemScores"]] == [
+            s["item"] for s in b["itemScores"]], (q, single, b)
+
+
 def test_serving_respects_blacklist_and_unknown_user(trained):
     model, _ = trained
     algo = SequenceAlgorithm(model.config)

@@ -102,3 +102,27 @@ def test_two_tower_blacklist_and_unknown():
     assert algo.predict(model, {"user": "nope", "num": 3}) == {"itemScores": []}
     r = algo.predict(model, {"user": "u0", "num": 4, "blackList": ["i0"]})
     assert all(s["item"] != "i0" for s in r["itemScores"])
+
+
+def test_two_tower_batch_matches_single():
+    """batch_predict (one tower forward + one cosine top-k for the whole
+    batch) must reproduce per-query predicts, incl. blackList, varying
+    num, and unknown users."""
+    inter = clustered_interactions()
+    algo = TwoTowerAlgorithm(SMALL)
+
+    class Ctx:
+        mesh = None
+
+    model = algo.train(Ctx(), inter)
+    queries = [
+        {"user": "u0", "num": 3},
+        {"user": "u1", "num": 5, "blackList": ["i0", "i2"]},
+        {"user": "nope", "num": 3},
+        {"user": "u2", "num": 1},
+    ]
+    batch = algo.batch_predict(model, queries)
+    for q, b in zip(queries, batch):
+        single = algo.predict(model, q)
+        assert [s["item"] for s in single["itemScores"]] == [
+            s["item"] for s in b["itemScores"]], (q, single, b)
