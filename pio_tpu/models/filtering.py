@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pio_tpu.ops.similarity import normalize_rows
+from pio_tpu.ops.bucketing import pow2_bucket
 
 
 def invert_categories(item_categories: dict) -> dict:
@@ -110,12 +111,12 @@ def rank_candidates(
     n = len(cidx)
     if n == 0:
         return np.array([], np.int64), np.array([], np.float32)
-    bucket = 1 << (n - 1).bit_length()
+    bucket = pow2_bucket(n)
     pad = bucket - n
     cidx_p = np.concatenate([cidx, np.zeros(pad, np.int32)])
     valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
     k = min(num, n)
-    kb = min(bucket, 1 << (k - 1).bit_length())
+    kb = pow2_bucket(k, cap=bucket)
     scores, pos = _rank_jit(
         item_factors, jnp.asarray(qv), cidx_p, valid, normalize, kb
     )

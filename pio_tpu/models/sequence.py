@@ -49,6 +49,7 @@ from pio_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from pio_tpu.ops.bucketing import pow2_bucket
 from pio_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 
@@ -604,8 +605,6 @@ class SequenceAlgorithm(PAlgorithm):
         attn = partial(
             attention_reference if on_cpu else flash_attention, causal=True,
         )
-        from pio_tpu.ops.bucketing import pow2_bucket
-
         b = rows.shape[0]
         bucket = pow2_bucket(b)
         inp = rows[:, -(p.max_len - 1):]
@@ -652,7 +651,8 @@ class SequenceAlgorithm(PAlgorithm):
         for b, (qi, row) in enumerate(resolved):
             q = queries[qi]
             num = int(q.get("num", 10))
-            scores = all_scores[b]   # fresh host array: in-place is fine
+            scores = all_scores[b]   # view into all_scores: masked IN
+            # PLACE — each row is consumed exactly once, here
             scores[PAD] = -np.inf
             seen = (
                 set(int(i) for i in row if i != PAD)

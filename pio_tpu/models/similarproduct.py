@@ -17,6 +17,7 @@ from dataclasses import dataclass
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from pio_tpu.controller.base import (
@@ -36,6 +37,7 @@ from pio_tpu.models.filtering import (
     rank_candidates,
 )
 from pio_tpu.ops import als
+from pio_tpu.ops.bucketing import pow2_bucket
 from pio_tpu.ops.similarity import column_cosine_topk, cosine_topk, mean_vector
 
 
@@ -191,7 +193,11 @@ class ALSSimilarityAlgorithm(PAlgorithm):
             ]}
         k = min(num + len(exclude), model.item_factors.shape[0])
         scores, idx = cosine_topk(model.item_factors, qv, k)
-        scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
+        return self._format_topk(
+            model, np.asarray(scores)[0], np.asarray(idx)[0], exclude, num)
+
+    @staticmethod
+    def _format_topk(model, scores, idx, exclude, num) -> dict:
         out = []
         for i, s in zip(model.items.decode(idx), scores):
             if i in exclude:
@@ -200,6 +206,54 @@ class ALSSimilarityAlgorithm(PAlgorithm):
             if len(out) >= num:
                 break
         return {"itemScores": out}
+
+    def batch_predict(self, model: SimilarProductModel, queries) -> list:
+        """Vectorized batch scoring (the micro-batcher's path): plain
+        queries (no whiteList/categories filters) share ONE gather of all
+        query-item vectors, per-query means on host, and ONE cosine top-k
+        over the bucketed batch (over-fetch k = num + max excluded, host
+        filter). Selectively-filtered queries keep full candidate-set
+        semantics via the single-query path."""
+        results: list[dict] = [{"itemScores": []} for _ in queries]
+        plain = []   # (query_index, q_idx array, exclude set, num)
+        for i, q in enumerate(queries):
+            num, known, exclude, white, categories = \
+                _parse_similar_query(model.items, q)
+            if not known:
+                continue
+            if white or categories:
+                results[i] = self.predict(model, q)
+            else:
+                plain.append(
+                    (i, model.items.encode(known), exclude, num))
+        if not plain:
+            return results
+        # one device gather for every query's item vectors, means on host;
+        # flat length bucketed (varying per-batch totals must not compile
+        # one gather program per size)
+        flat = np.concatenate([qi for _, qi, _, _ in plain])
+        n_flat = len(flat)
+        flat = np.concatenate(
+            [flat, np.zeros(pow2_bucket(n_flat) - n_flat, flat.dtype)])
+        rows = np.asarray(
+            model.item_factors[jnp.asarray(flat)])[:n_flat]
+        d = rows.shape[1]
+        b = len(plain)
+        qv = np.zeros((pow2_bucket(b), d), rows.dtype)
+        off = 0
+        for r, (_, qi, _, _) in enumerate(plain):
+            qv[r] = rows[off:off + len(qi)].mean(axis=0)
+            off += len(qi)
+        k = min(
+            max(num + len(exclude) for _, _, exclude, num in plain),
+            model.item_factors.shape[0],
+        )
+        scores, idx = cosine_topk(model.item_factors, jnp.asarray(qv), k)
+        scores, idx = np.asarray(scores), np.asarray(idx)
+        for r, (qi_out, _, exclude, num) in enumerate(plain):
+            results[qi_out] = self._format_topk(
+                model, scores[r], idx[r], exclude, num)
+        return results
 
 
 @dataclass(frozen=True)

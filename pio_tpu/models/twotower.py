@@ -39,6 +39,7 @@ from pio_tpu.controller.base import (
 )
 from pio_tpu.controller.engine import Engine, EngineFactory
 from pio_tpu.data.eventstore import Interactions
+from pio_tpu.ops.bucketing import pow2_bucket
 from pio_tpu.ops.similarity import cosine_topk
 from pio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
@@ -355,8 +356,6 @@ class TwoTowerAlgorithm(PAlgorithm):
         ]
         if not known:
             return results
-        from pio_tpu.ops.bucketing import pow2_bucket
-
         tower = Tower(
             len(model.users), model.config.embed_dim,
             model.config.hidden_dim, model.config.out_dim,

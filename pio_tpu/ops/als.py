@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pio_tpu.ops.bucketing import pow2_bucket
 from pio_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -677,8 +678,6 @@ def recommend_topk(model: ALSModel, user_idx, k: int):
     jit, so per-query k values (e.g. num + len(blackList)) and the varying
     batch sizes the serving micro-batcher produces compile O(log) XLA
     programs instead of one per size; the exact trim happens on host."""
-    from pio_tpu.ops.bucketing import pow2_bucket
-
     n_items = model.item_factors.shape[0]
     k = max(1, min(int(k), n_items))
     k_bucket = pow2_bucket(k, cap=n_items)

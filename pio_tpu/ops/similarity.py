@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pio_tpu.ops.bucketing import pow2_bucket
+
 
 @jax.jit
 def normalize_rows(m: jax.Array, eps: float = 1e-9) -> jax.Array:
@@ -33,8 +35,6 @@ def cosine_topk(matrix: jax.Array, queries: jax.Array, k: int):
     """matrix: (I, d) item vectors; queries: (B, d). Returns (scores, idx)
     of the k most cosine-similar rows per query. k is bucketed to a power
     of two pre-jit (compile-cache bound), trimmed on host."""
-    from pio_tpu.ops.bucketing import pow2_bucket
-
     n = matrix.shape[0]
     k = max(1, min(int(k), n))
     bucket = pow2_bucket(k, cap=n)
@@ -128,7 +128,7 @@ def column_cosine_topk(
     """
     n_items_pad = max(256, -(-n_items // 256) * 256)
     k = max(1, min(int(k), n_items - 1))
-    k_bucket = min(n_items_pad, 1 << (k - 1).bit_length())
+    k_bucket = pow2_bucket(k, cap=n_items_pad)
 
     u = np.ascontiguousarray(user_idx, dtype=np.int64)
     i = np.ascontiguousarray(item_idx, dtype=np.int32)

@@ -170,6 +170,27 @@ def test_similarproduct_clusters(similar_storage):
     assert scores == sorted(scores, reverse=True)
 
 
+def test_similarproduct_batch_matches_single(similar_storage):
+    """batch_predict: plain queries share one gather+top-k; filtered
+    queries keep candidate semantics — all must equal per-query predicts."""
+    engine, ep = make_sim_engine()
+    ctx = create_workflow_context(similar_storage, use_mesh=False)
+    (model,) = engine.train(ctx, ep)
+    algo = engine._doers(ep)[2][0]
+    queries = [
+        {"items": ["i0", "i1"], "num": 4},
+        {"items": ["i12"], "num": 3, "blackList": ["i13"]},
+        {"items": ["unknown-item"], "num": 3},
+        {"items": ["i2"], "num": 2, "whiteList": ["i3", "i4", "i5"]},
+        {"items": ["i3"], "num": 6},
+    ]
+    batch = algo.batch_predict(model, queries)
+    for q, b in zip(queries, batch):
+        single = algo.predict(model, q)
+        assert [s["item"] for s in single["itemScores"]] == [
+            s["item"] for s in b["itemScores"]], (q, single, b)
+
+
 def test_similarproduct_filters(similar_storage):
     engine, ep = make_sim_engine()
     ctx = create_workflow_context(similar_storage, use_mesh=False)
