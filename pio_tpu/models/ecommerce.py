@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from pio_tpu.controller.base import (
@@ -38,6 +39,7 @@ from pio_tpu.models.filtering import (
     rank_candidates,
 )
 from pio_tpu.ops import als
+from pio_tpu.ops.bucketing import pow2_bucket
 from pio_tpu.ops.similarity import cosine_topk, mean_vector
 
 
@@ -225,12 +227,18 @@ class ECommAlgorithm(PAlgorithm):
         return mean_vector(model.factors.item_factors, np.array(idx))
 
     def predict(self, model: ECommerceModel, query: dict) -> dict:
+        self._bind_store()
+        return self._predict_impl(model, query, self._unavailable_items())
+
+    def _predict_impl(self, model: ECommerceModel, query: dict,
+                      unavailable: set) -> dict:
+        """predict with the query-independent unavailable-items read done
+        by the caller (batch_predict reads it once per batch)."""
         user = query.get("user", "")
         num = int(query.get("num", 10))
-        self._bind_store()
         exclude = set(query.get("blackList") or ())
         exclude |= self._seen_items(user)
-        exclude |= self._unavailable_items()
+        exclude |= unavailable
         white = set(query.get("whiteList") or ()) or None
         categories = set(query.get("categories") or ()) or None
         candidates = candidate_ids(
@@ -272,7 +280,11 @@ class ECommAlgorithm(PAlgorithm):
             )
         else:
             scores, idx = cosine_topk(model.factors.item_factors, qv, k)
-        scores, idx = np.asarray(scores)[0], np.asarray(idx)[0]
+        return self._format_topk(
+            model, np.asarray(scores)[0], np.asarray(idx)[0], exclude, num)
+
+    @staticmethod
+    def _format_topk(model, scores, idx, exclude, num) -> dict:
         out = []
         for item, s in zip(model.items.decode(idx), scores):
             if item in exclude:
@@ -281,6 +293,65 @@ class ECommAlgorithm(PAlgorithm):
             if len(out) >= num:
                 break
         return {"itemScores": out}
+
+    def batch_predict(self, model: ECommerceModel, queries) -> list:
+        """Vectorized batch scoring (the micro-batcher's path): the
+        query-independent unavailable-items constraint is read ONCE per
+        batch; plain known-user queries share one top-k matmul and plain
+        cold-start queries one cosine top-k (per-user seen/recent reads
+        stay live, as the reference's serve-time semantics require).
+        whiteList/categories queries keep candidate-set semantics via the
+        single-query path."""
+        self._bind_store()
+        unavailable = self._unavailable_items()
+        results: list[dict] = [{"itemScores": []} for _ in queries]
+        known_plain = []   # (i, uidx, exclude, num)
+        cold_plain = []    # (i, qv, exclude, num)
+        for i, q in enumerate(queries):
+            white = set(q.get("whiteList") or ()) or None
+            categories = set(q.get("categories") or ()) or None
+            if white or categories:
+                results[i] = self._predict_impl(model, q, unavailable)
+                continue
+            user = q.get("user", "")
+            exclude = (
+                set(q.get("blackList") or ())
+                | self._seen_items(user) | unavailable
+            )
+            num = int(q.get("num", 10))
+            if user in model.users:
+                known_plain.append(
+                    (i, model.users.index_of(user), exclude, num))
+            else:
+                qv = self._recent_item_vector(model, user)
+                if qv is not None:
+                    cold_plain.append(
+                        (i, np.asarray(qv).reshape(-1), exclude, num))
+        n_items = model.factors.item_factors.shape[0]
+        if known_plain:
+            k = min(
+                max(num + len(ex) for _, _, ex, num in known_plain),
+                n_items,
+            )
+            rows = np.array([u for _, u, _, _ in known_plain], np.int32)
+            scores, idx = als.recommend_topk(model.factors, rows, k)
+            scores, idx = np.asarray(scores), np.asarray(idx)
+            for r, (qi, _, exclude, num) in enumerate(known_plain):
+                results[qi] = self._format_topk(
+                    model, scores[r], idx[r], exclude, num)
+        if cold_plain:
+            k = min(
+                max(num + len(ex) for _, _, ex, num in cold_plain),
+                n_items,
+            )
+            qv = np.stack([v for _, v, _, _ in cold_plain])
+            scores, idx = cosine_topk(
+                model.factors.item_factors, jnp.asarray(qv), k)
+            scores, idx = np.asarray(scores), np.asarray(idx)
+            for r, (qi, _, exclude, num) in enumerate(cold_plain):
+                results[qi] = self._format_topk(
+                    model, scores[r], idx[r], exclude, num)
+        return results
 
 
 class ECommerceEngine(EngineFactory):

@@ -239,7 +239,7 @@ class ALSSimilarityAlgorithm(PAlgorithm):
             model.item_factors[jnp.asarray(flat)])[:n_flat]
         d = rows.shape[1]
         b = len(plain)
-        qv = np.zeros((pow2_bucket(b), d), rows.dtype)
+        qv = np.zeros((b, d), rows.dtype)
         off = 0
         for r, (_, qi, _, _) in enumerate(plain):
             qv[r] = rows[off:off + len(qi)].mean(axis=0)

@@ -33,14 +33,22 @@ def _cosine_topk_jit(matrix_n, queries, k: int):
 
 def cosine_topk(matrix: jax.Array, queries: jax.Array, k: int):
     """matrix: (I, d) item vectors; queries: (B, d). Returns (scores, idx)
-    of the k most cosine-similar rows per query. k is bucketed to a power
-    of two pre-jit (compile-cache bound), trimmed on host."""
+    of the k most cosine-similar rows per query. BOTH k and the batch dim
+    are bucketed to powers of two pre-jit (compile-cache bound — the
+    serving micro-batcher produces arbitrary B), trimmed on host; zero
+    padding rows are NaN-safe (normalize_rows' eps) and sliced away."""
     n = matrix.shape[0]
     k = max(1, min(int(k), n))
     bucket = pow2_bucket(k, cap=n)
+    b = queries.shape[0]
+    bb = pow2_bucket(b)
+    if bb != b:
+        queries = jnp.concatenate(
+            [queries, jnp.zeros((bb - b, queries.shape[1]),
+                                queries.dtype)])
     matrix_n = normalize_rows(matrix)
     scores, idx = _cosine_topk_jit(matrix_n, queries, bucket)
-    return scores[:, :k], idx[:, :k]
+    return scores[:b, :k], idx[:b, :k]
 
 
 def mean_vector(matrix: jax.Array, indices: np.ndarray) -> jax.Array:

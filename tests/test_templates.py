@@ -262,6 +262,41 @@ def make_ecomm(storage):
     return engine, ep, ctx, model, algo
 
 
+def test_ecommerce_batch_matches_single(ecommerce_storage):
+    """batch_predict: one unavailable-items read per batch, one top-k for
+    plain known users, one cosine top-k for cold users; filtered queries
+    keep candidate semantics — all equal to per-query predicts. The batch
+    carries cold-start users WITH recent views (the padded-cosine block)
+    and a live unavailableItems constraint."""
+    engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
+    app_id = ecommerce_storage.get_metadata_apps().get_by_name("shopapp").id
+    ev = ecommerce_storage.get_events()
+    # two cold-start users with recent views (exercise the batched
+    # cosine path with >1 row), plus a live constraint
+    ev.insert(_ev("view", "cold-a", "i15", 9000), app_id)
+    ev.insert(_ev("view", "cold-a", "i16", 9001), app_id)
+    ev.insert(_ev("view", "cold-b", "i2", 9002), app_id)
+    ev.insert(_set("constraint", "unavailableItems", {"items": ["i3"]},
+                   minute=9999), app_id)
+    queries = [
+        {"user": "u0", "num": 4},
+        {"user": "u2", "num": 3, "blackList": ["i1"]},
+        {"user": "cold-a", "num": 3},
+        {"user": "brand-new-user", "num": 3},        # no history at all
+        {"user": "u1", "num": 3, "categories": ["catA"]},
+        {"user": "cold-b", "num": 4},
+        {"user": "u3", "num": 5},
+    ]
+    batch = algo.batch_predict(model, queries)
+    assert batch[2]["itemScores"], "cold user with views must get results"
+    for q, b in zip(queries, batch):
+        single = algo.predict(model, q)
+        assert [s["item"] for s in single["itemScores"]] == [
+            s["item"] for s in b["itemScores"]], (q, single, b)
+        # the batch-shared constraint read applied everywhere
+        assert all(s["item"] != "i3" for s in b["itemScores"])
+
+
 def test_ecommerce_excludes_seen_items(ecommerce_storage):
     engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
     app_id = ecommerce_storage.get_metadata_apps().get_by_name("shopapp").id
