@@ -67,14 +67,12 @@ class ServingConfig:
     # (one big matmul beats many small ones on the MXU). 0 = off.
     batch_window_ms: float = 0.0
     batch_max: int = 64
-    # batches concurrently in flight. 0 = AUTO: pipelining only pays when
-    # a batch's wall-time is dominated by dispatch round-trip (remote /
-    # tunneled device) — overlapped batches then hide the RTT. When the
-    # device is local, execution itself is the bottleneck and overlapping
-    # batches just contend (measured co-located CPU, 16 clients: depth 1
-    # = 2657 qps / p99 70 ms vs depth 4 = 1040 qps / p99 226 ms — the
-    # round-2 "357 ms p99" artifact was this convoy), so auto resolves to
-    # 1 on a local device and 4 over a high-RTT link.
+    # batches concurrently in flight. 0 = AUTO from the measured dispatch
+    # RTT: 2 on a local device (double buffering — the collection window
+    # overlaps the in-flight batch; depth 1 idles the device through
+    # every window and deeper pipelines convoy, the round-2 "357 ms p99"
+    # artifact), 4 over a high-RTT link where in-flight batches hide the
+    # round trip. Medians over repeated runs in eval/SERVING_TAIL.md.
     batch_pipeline: int = 0
 
 
@@ -391,15 +389,21 @@ class QueryServer:
 
 def _depth_for_rtt(rtt_s: float) -> int:
     """Dispatch-RTT -> pipeline depth. High-RTT (remote/tunneled) devices
-    want several batches in flight to hide the link; local devices want
-    exactly one — overlap there is pure contention (see
-    ServingConfig.batch_pipeline). Note this sizes the pipeline GIVEN that
-    the operator enabled batching; whether batching pays at all over a
-    high-RTT link is a separate call (BASELINE.md measured the tunnel
-    pipelining per-query dispatches well enough that per-query serving
-    won end-to-end — the QueryBatcher docstring's 'batch when co-located'
-    note)."""
-    return 4 if rtt_s > 0.005 else 1
+    want several batches in flight to hide the link; local devices get
+    TWO. Evidence (eval/SERVING_TAIL.md, medians over repeated runs):
+    depth 1 is unstable across sessions — median p99 anywhere from ~10
+    to ~95 ms, because with one batch in flight any stall serializes the
+    whole queue behind it — while depths 2 and 4 both hold p99 ~10-15 ms
+    warm. 2 is the minimal depth that achieves that stability; it also
+    bounds how deep a queue can build behind a stalled batch, the
+    suspected mechanism of round-2's 357 ms p99 outlier (BENCH_r02
+    async_batched ran depth 4; the committed medians could not reproduce
+    that tail, so it is recorded as motivation, not proof). Note this
+    sizes the pipeline GIVEN that the operator enabled batching; whether
+    batching pays at all over a high-RTT link is a separate call
+    (BASELINE.md: the tunnel pipelines per-query dispatches well enough
+    that per-query serving won end-to-end)."""
+    return 4 if rtt_s > 0.005 else 2
 
 
 _auto_depth_cache: int | None = None

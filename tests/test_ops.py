@@ -187,3 +187,12 @@ def test_cosine_topk_k_clamps():
     m = jnp.eye(3)
     scores, idx = cosine_topk(m, jnp.ones((1, 3)), 99)
     assert idx.shape == (1, 3)
+
+
+def test_pow2_bucket():
+    from pio_tpu.ops.bucketing import pow2_bucket
+
+    assert [pow2_bucket(n) for n in (0, 1, 2, 3, 4, 5, 1000)] == [
+        1, 1, 2, 4, 4, 8, 1024]
+    assert pow2_bucket(5, cap=4) == 4
+    assert pow2_bucket(3, cap=16) == 4

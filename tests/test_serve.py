@@ -268,12 +268,13 @@ def test_adaptive_batching_backpressure(memory_storage):
 
 def test_pipeline_depth_rtt_mapping():
     """The RTT->depth mapping is deterministic: local (sub-ms dispatch)
-    runs one batch at a time — overlap there is pure contention (the
-    round-2 357 ms p99 convoy) — while a high-RTT tunnel overlaps 4."""
+    double-buffers (the collection window overlaps the in-flight batch;
+    deeper pipelines convoy — the round-2 357 ms p99), while a high-RTT
+    tunnel overlaps 4."""
     from pio_tpu.workflow.serve import _depth_for_rtt
 
-    assert _depth_for_rtt(0.0002) == 1   # co-located device
-    assert _depth_for_rtt(0.004) == 1
+    assert _depth_for_rtt(0.0002) == 2   # co-located device
+    assert _depth_for_rtt(0.004) == 2
     assert _depth_for_rtt(0.066) == 4    # the image's tunnel RTT
 
 

@@ -39,11 +39,3 @@ def test_empty_range():
     assert list(span_bounds(5, 5, 3)) == []
     assert list(span_bounds(7, 3, None)) == []
 
-
-def test_pow2_bucket():
-    from pio_tpu.ops.bucketing import pow2_bucket
-
-    assert [pow2_bucket(n) for n in (0, 1, 2, 3, 4, 5, 1000)] == [
-        1, 1, 2, 4, 4, 8, 1024]
-    assert pow2_bucket(5, cap=4) == 4
-    assert pow2_bucket(3, cap=16) == 4
