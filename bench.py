@@ -354,7 +354,7 @@ def phase_serving() -> dict:
         return {**pcts(lat), "qps": round(len(lat) / sum(lat), 1),
                 "n_requests": len(lat)}
 
-    def measure_concurrent(port, n_req, workers=16):
+    def _measure_concurrent_once(port, n_req, workers=16):
         """Keep-alive connection per worker, n_req total requests."""
         import http.client
 
@@ -389,6 +389,21 @@ def phase_serving() -> dict:
         wall = time.monotonic() - t_start
         return {**pcts(lat), "qps": round(len(lat) / wall, 1),
                 "n_requests": len(lat), "client_threads": workers}
+
+    def measure_concurrent(port, n_req, workers=16, reps=3):
+        """Median-of-`reps` by p99: the in-process 16-thread client harness
+        shares the box's core with the server, so any single run can catch
+        a scheduler stall that lands on whichever mode is measuring at
+        that moment (eval/SERVING_TAIL.md: 10x p99 swings at fixed
+        config). The per-rep tails are kept in the artifact."""
+        runs = [_measure_concurrent_once(port, n_req, workers)
+                for _ in range(reps)]
+        tails = [r["p99_ms"] for r in runs]   # run order, pre-sort
+        runs.sort(key=lambda r: r["p99_ms"])
+        med = dict(runs[len(runs) // 2])
+        med["reps"] = reps
+        med["p99_all"] = tails
+        return med
 
     def deploy(backend, batch_window_ms=0.0):
         # steady-state measurement: warm_query pre-compiles the single path
