@@ -244,11 +244,24 @@ def phase_train() -> dict:
     cg = p.resolved_cg_iters(N_USERS)
     # padded nnz is what the kernel actually crunches
     nnz_pad = nnz + (-nnz % CHUNK)
-    fl = als_flops_per_sweep(nnz_pad, n_users, n_items, RANK, cg)
+    # the trainer's warm-CG schedule (ops/als.py _cg_schedule) runs the
+    # first cg_warm_sweeps sweeps at full CG strength and the rest at
+    # cg_warm_iters; the FLOPs accounting must mirror the actual mix or
+    # MFU is inflated by phantom matvecs
+    from pio_tpu.ops.als import _cg_schedule
+
+    sched_p = ALSParams(rank=RANK, iterations=iters, cg_iters=cg)
+    n_full, n_warm, w_cg, _ = _cg_schedule(sched_p, cg, cg)
+    fl_full = als_flops_per_sweep(nnz_pad, n_users, n_items, RANK, cg)
+    fl_warm = als_flops_per_sweep(nnz_pad, n_users, n_items, RANK, w_cg)
+    fl_total = fl_full * n_full + fl_warm * n_warm
+    # sweeps 2..iters (what the dt-dt1 split measures): drop one full sweep
+    fl_split = fl_full * (n_full - 1) + fl_warm * n_warm
+    fl = fl_split / max(iters - 1, 1)        # per STEADY (post-split) sweep
     import jax
     kind = jax.devices()[0].device_kind
     peak = peak_for(kind)
-    flops_per_sec = fl * iters / dt
+    flops_per_sec = fl_total / dt
     split_ok = sweep_s is not None
     return {
         "rate": rate,
@@ -268,6 +281,8 @@ def phase_train() -> dict:
         "device_kind": kind,
         "rank": RANK,
         "cg_iters": cg,
+        "cg_warm_iters": w_cg if n_warm else None,
+        "cg_full_sweeps": n_full,
         "accum": ALSParams().resolved_accum(),
     }
 

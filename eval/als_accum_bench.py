@@ -74,9 +74,12 @@ def main() -> None:
         # (tests/test_als_pallas.py), meaningless to time
     ]
     for cell in cells:
+        # cg_warm_iters=-1: this A/B isolates the ACCUMULATION strategy,
+        # so every sweep must run the same full-strength CG or the
+        # carry/stacked delta is diluted by the warm-CG schedule
         p = ALSParams(
             rank=RANK, iterations=SWEEPS, reg=0.05, alpha=10.0,
-            implicit=True, chunk=8192,
+            implicit=True, chunk=8192, cg_warm_iters=-1,
             cg_iters=ALSParams(rank=RANK).resolved_cg_iters(N_USERS),
             **cell,
         )

@@ -251,9 +251,12 @@ def main() -> None:
 
     # --- whole sweep via the production path, both accum modes ---
     for accum in ("carry", "stacked"):
+        # cg_warm_iters=-1: the decomposition below compares against pure
+        # cg16 phase timings, so the production warm-CG schedule must be
+        # disabled or sweep_{accum} blends two different programs
         p = ALSParams(rank=RANK, iterations=REPS, reg=0.05, alpha=ALPHA,
                       implicit=True, chunk=8192, chunk_slots=CHUNK_SLOTS,
-                      accum=accum,
+                      accum=accum, cg_warm_iters=-1,
                       cg_iters=ALSParams(rank=RANK).resolved_cg_iters(N_USERS))
         p1 = ALSParams(**{**p.__dict__, "iterations": 1})
 

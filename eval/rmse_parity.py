@@ -107,9 +107,14 @@ def train_eval(users, items, vals, te_users, te_items, te_vals,
 
     out = []
     train_sec = 0.0
+    # cg_warm_iters=-1 in BOTH modes: trajectory mode re-enters
+    # als_train with iterations=1, which would otherwise never leave the
+    # full-strength phase of the warm-CG schedule (the schedule keys on
+    # the per-call sweep index) while one-shot mode would — the parity
+    # comparison must run one solver
     if trajectory:
         p = ALSParams(rank=RANK, iterations=1, reg=reg, chunk=chunk,
-                      cg_iters=cg_iters)
+                      cg_iters=cg_iters, cg_warm_iters=-1)
         model = None
         for _ in range(sweeps):
             t0 = time.monotonic()
@@ -123,7 +128,7 @@ def train_eval(users, items, vals, te_users, te_items, te_vals,
                 rmse(model, te_users, te_items, te_vals)), 5))
     else:
         p = ALSParams(rank=RANK, iterations=sweeps, reg=reg, chunk=chunk,
-                      cg_iters=cg_iters)
+                      cg_iters=cg_iters, cg_warm_iters=-1)
         t0 = time.monotonic()
         model = als_train(users, items, vals, n_users, n_items, p)
         float(jnp.sum(model.user_factors))

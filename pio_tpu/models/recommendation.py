@@ -105,6 +105,12 @@ class ALSAlgorithmParams(Params):
     implicit_prefs: bool = False
     seed: int | None = None
     chunk: int = 65536
+    # inner-solver knobs (ops/als.py): cg_iters -1 = auto per side;
+    # warm-sweep schedule drops to cg_warm_iters after cg_warm_sweeps
+    # full-strength sweeps (eval/ALS_ROOFLINE.md) — -1 disables
+    cg_iters: int = -1
+    cg_warm_iters: int = 8
+    cg_warm_sweeps: int = 2
 
 
 @jax.tree_util.register_pytree_node_class
@@ -143,6 +149,9 @@ class ALSAlgorithm(PAlgorithm):
             implicit=p.implicit_prefs,
             seed=p.seed if p.seed is not None else 3,
             chunk=p.chunk,
+            cg_iters=p.cg_iters,
+            cg_warm_iters=p.cg_warm_iters,
+            cg_warm_sweeps=p.cg_warm_sweeps,
         )
 
     def train(self, ctx, data: Interactions) -> RecommendationModel:

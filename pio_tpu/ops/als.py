@@ -80,6 +80,23 @@ class ALSParams:
     # batched Cholesky costs <~70ms (linear in batch; 1157ms at 138k on
     # v5e) so exactness is free; above it CG's MXU matvecs win big
     auto_cg_rows: int = 8192
+    # warm-sweep CG schedule: after `cg_warm_sweeps` full-strength sweeps,
+    # drop to `cg_warm_iters` CG iterations (-1 keeps the full count).
+    # Rationale from the v5e per-op profile (eval/ALS_ROOFLINE.md): the CG
+    # matvecs are the sweep's single largest term (134 ms of ~520 ms at
+    # the ML-20M shape) and the only one already running at HBM peak, so
+    # fewer iterations is the one lever that cuts REAL traffic instead of
+    # emitter overhead. ALS warm-starts each solve from the previous
+    # sweep's factors; once the outer iteration is near its fixed point
+    # the inner Krylov correction is small and half the iterations hold
+    # the heldout RMSE (measured: see eval/RMSE_PARITY.md).
+    # Default 8 (vs the cold cap of 16): measured on v5e at the ML-20M
+    # shape this is -61 ms/sweep (0.540 -> 0.479); explicit heldout RMSE
+    # 0.44463 vs 0.44485 (flat), implicit objective 1.2% BETTER than
+    # full-strength CG. cg_warm_iters=4 is faster still but costs 1.6%
+    # on the implicit objective; -1 disables the schedule.
+    cg_warm_iters: int = 8
+    cg_warm_sweeps: int = 2
     # normal-equation accumulation strategy:
     #   "carry":   scatter-add each chunk's blocks into the (n,k,k)
     #              accumulator inside the scan (the accumulator is a loop
@@ -436,6 +453,25 @@ def init_factors(n: int, rank: int, key) -> jax.Array:
 # single-device (one chip) path — layout build + train in one jitted program
 # ---------------------------------------------------------------------------
 
+def _cg_schedule(params: ALSParams, cg_u: int, cg_i: int):
+    """-> (n_full, n_warm, w_u, w_i): how many sweeps run at full CG
+    strength vs at the warm count, and the per-side warm iteration
+    counts (a side on the exact-Cholesky path, cg=0, stays exact).
+    Shared by the single-device and sharded trainers so both execute
+    the identical schedule."""
+    n_full = params.iterations
+    n_warm = 0
+    # >= 1: cg_iters=0 is the exact-Cholesky sentinel in _solve_factors,
+    # so a 0 here would make the "cheap" warm phase the expensive exact
+    # solve; 0 and negative both mean "schedule off"
+    if 1 <= params.cg_warm_iters < max(cg_u, cg_i):
+        n_full = min(params.iterations, max(0, params.cg_warm_sweeps))
+        n_warm = params.iterations - n_full
+    w_u = params.cg_warm_iters if cg_u > 0 else cg_u
+    w_i = params.cg_warm_iters if cg_i > 0 else cg_i
+    return n_full, n_warm, w_u, w_i
+
+
 @partial(jax.jit, static_argnames=("n_users", "n_items", "params"))
 def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
                user0, item0):
@@ -448,25 +484,37 @@ def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
     cg_u = params.resolved_cg_iters(n_users)
     cg_i = params.resolved_cg_iters(n_items)
 
-    def sweep(carry, _):
-        users, items = carry
-        users = _solve_factors(
-            by_user, items, n_users,
-            params.reg, params.implicit, params.alpha, cs,
-            x0=users, cg_iters=cg_u, bf16_gather=params.bf16_gather,
-            accum=params.accum, group_slots=params.group_slots,
-        )
-        items = _solve_factors(
-            by_item, users, n_items,
-            params.reg, params.implicit, params.alpha, cs,
-            x0=items, cg_iters=cg_i, bf16_gather=params.bf16_gather,
-            accum=params.accum, group_slots=params.group_slots,
-        )
-        return (users, items), None
+    def sweep_with(cg_u_n: int, cg_i_n: int):
+        def sweep(carry, _):
+            users, items = carry
+            users = _solve_factors(
+                by_user, items, n_users,
+                params.reg, params.implicit, params.alpha, cs,
+                x0=users, cg_iters=cg_u_n, bf16_gather=params.bf16_gather,
+                accum=params.accum, group_slots=params.group_slots,
+            )
+            items = _solve_factors(
+                by_item, users, n_items,
+                params.reg, params.implicit, params.alpha, cs,
+                x0=items, cg_iters=cg_i_n, bf16_gather=params.bf16_gather,
+                accum=params.accum, group_slots=params.group_slots,
+            )
+            return (users, items), None
+        return sweep
 
-    (users, items), _ = jax.lax.scan(
-        sweep, (user0, item0), None, length=params.iterations
-    )
+    # two-phase schedule: full-strength CG while cold, cg_warm_iters once
+    # the warm start carries most of the solution (see cg_warm_iters)
+    n_full, n_warm, w_u, w_i = _cg_schedule(params, cg_u, cg_i)
+    carry = (user0, item0)
+    if n_full:
+        carry, _ = jax.lax.scan(
+            sweep_with(cg_u, cg_i), carry, None, length=n_full
+        )
+    if n_warm:
+        carry, _ = jax.lax.scan(
+            sweep_with(w_u, w_i), carry, None, length=n_warm
+        )
+    users, items = carry
     return users, items
 
 
@@ -613,31 +661,45 @@ def als_train_sharded(
             i_r[0], i_c[0], i_v[0], ib, params.width, si
         )
 
-        def sweep(carry, _):
-            users, items = carry  # local blocks (ub, k) / (ib, k)
-            all_items = jax.lax.all_gather(
-                items, DATA_AXIS, tiled=True
-            )  # (ib*n_dev, k)
-            users = _solve_factors(
-                by_user, all_items, ub,
-                params.reg, params.implicit, params.alpha, cs,
-                x0=users, cg_iters=cg_u,
-                bf16_gather=params.bf16_gather,
-                accum=params.accum, group_slots=params.group_slots,
-            )
-            all_users = jax.lax.all_gather(users, DATA_AXIS, tiled=True)
-            items = _solve_factors(
-                by_item, all_users, ib,
-                params.reg, params.implicit, params.alpha, cs,
-                x0=items, cg_iters=cg_i,
-                bf16_gather=params.bf16_gather,
-                accum=params.accum, group_slots=params.group_slots,
-            )
-            return (users, items), None
+        def sweep_with(cg_u_n: int, cg_i_n: int):
+            def sweep(carry, _):
+                users, items = carry  # local blocks (ub, k) / (ib, k)
+                all_items = jax.lax.all_gather(
+                    items, DATA_AXIS, tiled=True
+                )  # (ib*n_dev, k)
+                users = _solve_factors(
+                    by_user, all_items, ub,
+                    params.reg, params.implicit, params.alpha, cs,
+                    x0=users, cg_iters=cg_u_n,
+                    bf16_gather=params.bf16_gather,
+                    accum=params.accum, group_slots=params.group_slots,
+                )
+                all_users = jax.lax.all_gather(
+                    users, DATA_AXIS, tiled=True
+                )
+                items = _solve_factors(
+                    by_item, all_users, ib,
+                    params.reg, params.implicit, params.alpha, cs,
+                    x0=items, cg_iters=cg_i_n,
+                    bf16_gather=params.bf16_gather,
+                    accum=params.accum, group_slots=params.group_slots,
+                )
+                return (users, items), None
+            return sweep
 
-        (users, items), _ = jax.lax.scan(
-            sweep, (u0[0], i0[0]), None, length=params.iterations
-        )
+        # same two-phase warm-CG schedule as _train_jit so the sharded
+        # path is numerically aligned with the single-device one
+        n_full, n_warm, w_u, w_i = _cg_schedule(params, cg_u, cg_i)
+        carry = (u0[0], i0[0])
+        if n_full:
+            carry, _ = jax.lax.scan(
+                sweep_with(cg_u, cg_i), carry, None, length=n_full
+            )
+        if n_warm:
+            carry, _ = jax.lax.scan(
+                sweep_with(w_u, w_i), carry, None, length=n_warm
+            )
+        users, items = carry
         return users[None], items[None]
 
     sharding = NamedSharding(mesh, dev_spec)

@@ -301,3 +301,44 @@ def test_predict_pairs_shapes():
     assert p.shape == (3,)
     scores, idx = recommend_topk(model, np.array([0]), 3)
     assert scores.shape == (1, 3) and idx.shape == (1, 3)
+
+
+def test_cg_warm_schedule_quality_and_off_switch():
+    """The two-phase warm-CG schedule (full-strength CG for the first
+    cg_warm_sweeps, cg_warm_iters after) must (a) reproduce the
+    single-phase path exactly when disabled, and (b) stay within a tight
+    RMSE band of full-strength CG when enabled — the warm start carries
+    convergence, so halving the late-sweep Krylov budget is quality-flat
+    (full-shape evidence: eval/ALS_ROOFLINE.md)."""
+    users, items, vals, nu, ni = synthetic(n_users=300, n_items=200,
+                                           rank=6, density=0.4)
+    # force the CG path on both sides despite the small batch
+    base = dict(rank=16, iterations=8, reg=0.05, chunk=1024,
+                cg_iters=16, chunk_slots=1024)
+    full = als_train(users, items, vals, nu, ni,
+                     ALSParams(**base, cg_warm_iters=-1))
+    off = als_train(users, items, vals, nu, ni,
+                    ALSParams(**base, cg_warm_iters=16))  # >= cap: no-op
+    sched = als_train(users, items, vals, nu, ni,
+                      ALSParams(**base, cg_warm_iters=8, cg_warm_sweeps=2))
+    np.testing.assert_array_equal(np.asarray(full.user_factors),
+                                  np.asarray(off.user_factors))
+    e_full = rmse(full, users, items, vals)
+    e_sched = rmse(sched, users, items, vals)
+    assert abs(e_full - e_sched) < 0.02, (e_full, e_sched)
+
+
+def test_cg_warm_schedule_sharded_matches_single():
+    """The sharded path applies the same warm-CG schedule, so sharded and
+    single-device factors stay aligned with the schedule active."""
+    users, items, vals, nu, ni = synthetic(n_users=256, n_items=128,
+                                           rank=4, density=0.3)
+    params = ALSParams(rank=8, iterations=6, reg=0.05, chunk=512,
+                       cg_iters=12, cg_warm_iters=6, cg_warm_sweeps=2,
+                       chunk_slots=512)
+    single = als_train(users, items, vals, nu, ni, params)
+    mesh = create_mesh(MeshConfig(data=4))
+    sharded = als_train_sharded(users, items, vals, nu, ni, params, mesh)
+    np.testing.assert_allclose(
+        np.asarray(single.user_factors), np.asarray(sharded.user_factors),
+        rtol=2e-3, atol=2e-3)
