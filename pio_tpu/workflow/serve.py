@@ -21,9 +21,16 @@ from __future__ import annotations
 
 import logging
 import queue
+from contextlib import nullcontext
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    Future,
+    ThreadPoolExecutor,
+    TimeoutError as FuturesTimeoutError,
+    wait as futures_wait,
+)
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -74,6 +81,18 @@ class ServingConfig:
     # artifact), 4 over a high-RTT link where in-flight batches hide the
     # round trip. Medians over repeated runs in eval/SERVING_TAIL.md.
     batch_pipeline: int = 0
+    # tail hedging for the predict dispatch: if a device dispatch has not
+    # returned after hedge_after x the rolling predict-stage MEDIAN, issue
+    # a duplicate dispatch and take whichever finishes first. predict is a
+    # pure function of (model, queries), so the duplicate is safe; it only
+    # costs device time on the rare stall. Motivated by measured transport
+    # hiccups on a tunneled TPU (~1 in 2000 dispatches takes ~1.9 s vs a
+    # 135 ms p50) that micro-batching amplifies into whole-batch p99
+    # convoys (eval/SERVING_TAIL.md). 0 disables. Hedging arms only after
+    # 20 recorded predict spans; warm-up calls record no spans at all
+    # (record=False skips the histograms), so compiles never skew the
+    # median the hedge timeout derives from.
+    hedge_after: float = 3.0
 
 
 class QueryServer:
@@ -105,6 +124,14 @@ class QueryServer:
         self._predict_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="predict"
         )
+        # separate pool for hedged device dispatches: _hedged may be
+        # CALLED from a _predict_pool worker (multi-algo path), so its
+        # inner submissions must not compete for the same workers or a
+        # full pool deadlocks on its own children
+        self._hedge_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="hedge"
+        )
+        self.hedged_dispatches = 0
         self._load(instance_id)
         self.batcher = (
             QueryBatcher(self, config.batch_window_ms / 1e3, config.batch_max,
@@ -171,6 +198,7 @@ class QueryServer:
         if self.batcher is not None:
             self.batcher.close()
         self._predict_pool.shutdown(wait=False)
+        self._hedge_pool.shutdown(wait=False)
         for algo in getattr(self, "algorithms", []):
             close = getattr(algo, "close", None)
             if callable(close):
@@ -232,13 +260,17 @@ class QueryServer:
     def query(self, q: dict, record: bool = True) -> Any:
         t0 = time.monotonic()
         tr = self.tracer
-        with tr.span("supplement"):
+        # warm-up calls (record=False) must not enter the stage
+        # histograms: their compile-heavy spans would pollute dashboard
+        # quantiles AND the hedge-arming median (_hedge_timeout)
+        span = tr.span if record else (lambda _n: nullcontext())
+        with span("supplement"):
             supplemented = self.serving.supplement(q)
         with self._lock:
             models = self.models
             algorithms = self.algorithms
             instance_id = self.instance.id
-        with tr.span("predict"):
+        with span("predict"):
             if len(algorithms) > 1:
                 # concurrent per-algo predict (the parallelization the
                 # reference left as TODO, CreateServer.scala:516); device
@@ -250,11 +282,57 @@ class QueryServer:
                 predictions = [f.result() for f in futures]
             else:
                 predictions = [algorithms[0].predict(models[0], supplemented)]
-        with tr.span("serve"):
+        with span("serve"):
             prediction = self.serving.serve(q, predictions)
         if record:
             self._auto_warm_buckets(q)
         return self._postprocess(q, prediction, instance_id, record, t0)
+
+    def _hedge_timeout(self) -> float | None:
+        """Seconds after which a predict dispatch gets a duplicate, or
+        None when hedging is off / not yet armed (needs 20 recorded spans
+        so warm-up compiles never count as stalls)."""
+        if self.config.hedge_after <= 0:
+            return None
+        h = self.tracer.histogram("predict")
+        if h.count < 20:
+            return None
+        p50 = h.quantiles((0.5,))["p50"]
+        if p50 <= 0:
+            return None
+        return max(0.05, self.config.hedge_after * p50)
+
+    def _hedged(self, fn, *args):
+        """Run fn on the predict pool; if it outlives the hedge timeout,
+        race a duplicate and return whichever finishes first. fn must be
+        pure (device predict is), so the loser is discarded harmlessly."""
+        timeout = self._hedge_timeout()
+        if timeout is None:
+            return fn(*args)
+        futs = [self._hedge_pool.submit(fn, *args)]
+        try:
+            return futs[0].result(timeout=timeout)
+        except FuturesTimeoutError:
+            with self._lock:
+                self.hedged_dispatches += 1
+            futs.append(self._hedge_pool.submit(fn, *args))
+        # first SUCCESS wins; an attempt's exception propagates only once
+        # every attempt has failed (a tunnel reset may fail the stalled
+        # original while the duplicate is still inbound with the answer)
+        pending = set(futs)
+        first_exc: BaseException | None = None
+        while pending:
+            done, pending = futures_wait(
+                pending, timeout=60.0, return_when=FIRST_COMPLETED
+            )
+            for f in done:
+                exc = f.exception()
+                if exc is None:
+                    for loser in pending:
+                        loser.cancel()  # free not-yet-started duplicates
+                    return f.result()
+                first_exc = first_exc or exc
+        raise first_exc
 
     def query_batch(self, queries: list[dict], record: bool = True) -> list:
         """Serve several queries as one batch_predict per algorithm (the
@@ -262,29 +340,33 @@ class QueryServer:
         /batch/queries.json)."""
         t0 = time.monotonic()
         tr = self.tracer
-        with tr.span("supplement"):
+        # see query(): warm-up spans stay out of the histograms
+        span = tr.span if record else (lambda _n: nullcontext())
+        with span("supplement"):
             supplemented = [self.serving.supplement(q) for q in queries]
         with self._lock:
             models = self.models
             algorithms = self.algorithms
             instance_id = self.instance.id
-        with tr.span("predict"):
+        with span("predict"):
             if len(algorithms) > 1:
                 futures = [
-                    self._predict_pool.submit(a.batch_predict, m, supplemented)
+                    self._predict_pool.submit(
+                        self._hedged, a.batch_predict, m, supplemented)
                     for a, m in zip(algorithms, models)
                 ]
                 per_algo = [f.result() for f in futures]
             else:
                 per_algo = [
-                    algorithms[0].batch_predict(models[0], supplemented)
+                    self._hedged(
+                        algorithms[0].batch_predict, models[0], supplemented)
                 ]
         if record and queries:
             # the batched path is the PRIMARY path when the batcher is on
             # (query() is bypassed), so auto-warm must hook here too; the
             # warm calls themselves pass record=False and cannot recurse
             self._auto_warm_buckets(queries[0])
-        with tr.span("serve"):
+        with span("serve"):
             predictions = [
                 self.serving.serve(q, [algo_out[i] for algo_out in per_algo])
                 for i, q in enumerate(queries)
@@ -384,6 +466,7 @@ class QueryServer:
         return {
             "startTime": format_time(self.start_time),
             "spans": self.tracer.snapshot(),
+            "hedgedDispatches": self.hedged_dispatches,
         }
 
 

@@ -514,3 +514,94 @@ def test_deploy_without_completed_instance(memory_storage):
             ServingConfig(ip="127.0.0.1", port=0, engine_id="ghost"),
             ctx=create_workflow_context(memory_storage, use_mesh=False),
         )
+
+
+def test_hedged_dispatch_tames_stalled_predict(memory_storage):
+    """Tail hedging: a predict dispatch that stalls (measured ~1-in-2000
+    transport hiccup on a tunneled TPU, ~14x the median) gets a duplicate
+    dispatch after hedge_after x the rolling median, and the request
+    completes at duplicate latency instead of stall latency."""
+    import time as _time
+
+    engine, ep, ctx, _ = seed_and_train(memory_storage)
+    http_srv, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      batch_window_ms=2.0, batch_max=16, hedge_after=3.0,
+                      warm_query={"user": "u0", "num": 3}),
+        ctx=ctx,
+    )
+    http_srv.start()
+    try:
+        algo = qs.algorithms[0]
+        real = algo.batch_predict
+        calls = {"n": 0}
+
+        def stalling_batch_predict(model, queries):
+            calls["n"] += 1
+            if calls["n"] == 30:   # one mid-traffic stall, after arming
+                _time.sleep(1.0)
+            return real(model, queries)
+
+        algo.batch_predict = stalling_batch_predict
+        try:
+            lat = []
+            for i in range(60):
+                t0 = _time.monotonic()
+                out = qs.batcher.query({"user": f"u{i % 20}", "num": 3})
+                lat.append(_time.monotonic() - t0)
+                assert out["itemScores"]
+            # the stalled call was hedged: no request saw the full 1s
+            # stall (duplicate completes at ~median, far below 0.9s)
+            assert max(lat) < 0.9, f"stall leaked to caller: {max(lat):.3f}s"
+            assert qs.hedged_dispatches >= 1
+        finally:
+            algo.batch_predict = real
+    finally:
+        http_srv.stop()
+        qs.close()
+
+
+def test_hedging_disabled_and_unarmed_paths(memory_storage):
+    """hedge_after=0 disables hedging entirely; with hedging ON but too
+    few recorded predict spans the hedge stays UNARMED (warm-up records
+    no spans), then arms once real traffic fills the histogram."""
+    engine, ep, ctx, _ = seed_and_train(memory_storage)
+    http_srv, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      batch_window_ms=2.0, batch_max=16, hedge_after=0.0,
+                      warm_query={"user": "u0", "num": 3}),
+        ctx=ctx,
+    )
+    http_srv.start()
+    try:
+        assert qs._hedge_timeout() is None      # disabled by config
+        out = qs.batcher.query({"user": "u1", "num": 3})
+        assert out["itemScores"]
+        assert qs.hedged_dispatches == 0
+    finally:
+        http_srv.stop()
+        qs.close()
+
+    http_srv, qs = create_query_server(
+        engine, ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      batch_window_ms=2.0, batch_max=16, hedge_after=3.0,
+                      warm_query={"user": "u0", "num": 3}),
+        ctx=ctx,
+    )
+    http_srv.start()
+    try:
+        # warm-up recorded no predict spans: cold histogram -> unarmed,
+        # exactly the state a broken arming guard would hedge compiles in
+        assert qs.tracer.histogram("predict").count == 0
+        assert qs._hedge_timeout() is None
+        for i in range(25):
+            qs.batcher.query({"user": f"u{i % 20}", "num": 3})
+        assert qs.tracer.histogram("predict").count >= 20
+        t = qs._hedge_timeout()
+        assert t is not None and t >= 0.05       # armed on real traffic
+    finally:
+        http_srv.stop()
+        qs.close()
