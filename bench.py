@@ -214,16 +214,22 @@ def phase_train() -> dict:
     import jax
     import numpy as np
 
+    # wire format: ids need int32, but MovieLens-class ratings fit uint8
+    # — ship the value column quantized and upcast on device (als_train
+    # casts device inputs to f32 itself), cutting the host->HBM volume
+    # 25%; on this image's ~30 MB/s tunnel that is ~2 s of the headline
     host = [np.ascontiguousarray(users, np.int32),
             np.ascontiguousarray(items, np.int32),
-            np.ascontiguousarray(vals, np.float32)]
+            np.ascontiguousarray(vals, np.uint8)
+            if float(vals.max()) <= 255 and np.all(vals == vals.astype(np.uint8))
+            else np.ascontiguousarray(vals, np.float32)]
     import jax.numpy as jnp
 
     float(jnp.sum(jax.device_put(np.ones(8))))  # backend up
     t0 = time.monotonic()
     dev = [jax.device_put(x) for x in host]
     # scalar readback: block_until_ready under-reports on the tunnel
-    float(jnp.sum(dev[2]))
+    float(jnp.sum(dev[2].astype(jnp.float32)))
     transfer_s = time.monotonic() - t0
     d_users, d_items, d_vals = dev
 

@@ -29,13 +29,21 @@ the case where neither XLA path reaches the memory bound
 
 Per-sweep traffic: the factor gather (written once by XLA per group,
 re-read once by the kernel), the zero-fill + one write of A, and row ids
-streamed through SMEM one (chunk,)-block per grid step. No scatter over
-k² blocks, no (n,k,k) carry, no unbounded temp.
+streamed through SMEM one (1,1,chunk)-block per grid step. No scatter
+over k² blocks, no (n,k,k) carry, no unbounded temp.
 
-Status: correctness-pinned against the XLA paths in interpret mode on
-CPU (tests/test_als_pallas.py); not yet hardware-benchmarked — the TPU
-tunnel was down for all of round 3 (eval/als_accum_bench.py runs the
-A/B when a chip is reachable). auto never selects it until then.
+Status: HARDWARE-VALIDATED on v5e (round 3): compiles through Mosaic
+after three portability fixes (LANE-wide accumulators/outputs — per-row
+(K,K) DMA slices of a lane-padded HBM memref are rejected; (1,1,chunk)
+SMEM row blocks — 1-d s32 operands tile T(1024) vs Mosaic's T(128);
+second-minor block dims must divide 8) and matches the XLA paths to
+~1e-7 relative on real hardware. Measured users-half ne at the ML-20M
+shape: pallas 0.249 s vs stacked 0.211 / carry 0.199 — the serial
+per-slot MXU dots (at forced HIGHEST precision: Mosaic lacks HIGH) and
+per-segment DMA flushes underrun XLA's batched einsum, so auto still
+never selects it; correctness stays pinned in interpret mode
+(tests/test_als_pallas.py) and eval/als_accum_bench.py carries the
+hardware A/B cell.
 """
 
 from __future__ import annotations
@@ -46,35 +54,43 @@ import jax
 import jax.numpy as jnp
 
 
-def _ne_kernel(rows_ref,            # (chunk,) int32 SMEM block (this step)
+def _ne_kernel(rows_ref,            # (1, 1, chunk) int32 SMEM block (this step)
                y_ref,               # (1, chunk, W, K) VMEM block
                wo_ref,              # (1, chunk, W)    outer weights
                wr_ref,              # (1, chunk, W)    rhs weights
                a_init_ref,          # aliased -> a_out (zero-filled)
                b_init_ref,          # aliased -> b_out
-               a_out,               # (n_pad, K, K) HBM (aliased)
-               b_out,               # (n_pad, K) HBM (aliased)
-               trail_a,             # (K, K) VMEM block: group's open tail
-               trail_b,             # (1, K)
-               trail_row,           # (1,) int32 SMEM
-               acc_a,               # (K, K) f32 VMEM scratch
-               acc_b,               # (1, K) f32 VMEM scratch
+               a_out,               # (n_pad, K, LANE) HBM (aliased)
+               b_out,               # (n_pad, LANE) HBM (aliased)
+               trail_a,             # (K, LANE) VMEM block: group's open tail
+               trail_b,             # (1, LANE)
+               trail_row,           # (1, 1) int32 SMEM
+               acc_a,               # (K, LANE) f32 VMEM scratch
+               acc_b,               # (1, LANE) f32 VMEM scratch
                cur_row,             # (1,) int32 SMEM scratch
                dma_sem,
                *, chunk: int):
     """One grid step = `chunk` consecutive slots; the sequential TPU grid
     + persistent scratch carry the open row segment across steps. Segments
     that END inside the group DMA to A/b; the group's last open segment
-    goes to the trail outputs (folded across groups by the caller)."""
+    goes to the trail outputs (folded across groups by the caller).
+
+    Accumulators/outputs are LANE(=128)-wide with columns [K:] zero:
+    Mosaic requires HBM memref slices to be lane-tile aligned (a (K,K)
+    row slice of a lane-padded (n,K,K) buffer is rejected with "Slice
+    shape along dimension 2 must be aligned to tiling (128)"), and the
+    physical HBM bytes are identical to XLA's padded layout anyway."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     step = pl.program_id(0)
     n_steps = pl.num_programs(0)
+    K = y_ref.shape[3]
+    LANE = acc_a.shape[1]
 
     @pl.when(step == 0)
     def _init():
-        cur_row[0] = rows_ref[0]
+        cur_row[0] = rows_ref[0, 0, 0]
         acc_a[...] = jnp.zeros_like(acc_a)
         acc_b[...] = jnp.zeros_like(acc_b)
 
@@ -88,7 +104,7 @@ def _ne_kernel(rows_ref,            # (chunk,) int32 SMEM block (this step)
         b_copy.wait()
 
     def slot_body(i, _):
-        row = rows_ref[i]
+        row = rows_ref[0, 0, i]
 
         @pl.when(row != cur_row[0])
         def _new_segment():
@@ -101,15 +117,26 @@ def _ne_kernel(rows_ref,            # (chunk,) int32 SMEM block (this step)
         wo = wo_ref[0, i].astype(jnp.float32)        # (W,)
         wr = wr_ref[0, i].astype(jnp.float32)
         yw = y * wo[:, None]
+        if LANE > K:  # zero-pad the rhs operand so the dot fills the lanes
+            yw = jnp.concatenate(
+                [yw, jnp.zeros((yw.shape[0], LANE - K), jnp.float32)], axis=1
+            )
         # HIGHEST: the default 1-pass bf16 MXU contraction loses ~3e-3
         # relative on A, which the CG solve cannot recover (same rationale
-        # as _chunk_blocks' Precision.HIGH)
+        # as _chunk_blocks' Precision.HIGH; Mosaic supports only
+        # DEFAULT|HIGHEST for dot_general, so XLA's 3-pass HIGH middle
+        # ground is unavailable in-kernel)
         acc_a[...] += jax.lax.dot_general(
             y, yw, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
-        acc_b[...] += jnp.sum(y * wr[:, None], axis=0)[None, :]
+        b_row = jnp.sum(y * wr[:, None], axis=0)     # (K,)
+        if LANE > K:
+            b_row = jnp.concatenate(
+                [b_row, jnp.zeros((LANE - K,), jnp.float32)]
+            )
+        acc_b[...] += b_row[None, :]
         return ()
 
     jax.lax.fori_loop(0, chunk, slot_body, (), unroll=False)
@@ -118,11 +145,11 @@ def _ne_kernel(rows_ref,            # (chunk,) int32 SMEM block (this step)
     def _emit_trail():  # the group's last open segment is NEVER flushed
         trail_a[...] = acc_a[...]
         trail_b[...] = acc_b[...]
-        trail_row[0] = cur_row[0]
+        trail_row[0, 0] = cur_row[0]
 
 
 def _run_group(rows_g, y_g, wo_g, wr_g, a_buf, b_buf, *, chunk: int,
-               k: int, W: int, interpret: bool):
+               k: int, W: int, lane: int, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -133,7 +160,12 @@ def _run_group(rows_g, y_g, wo_g, wr_g, a_buf, b_buf, *, chunk: int,
         functools.partial(_ne_kernel, chunk=chunk),
         grid=(n_steps,),
         in_specs=[
-            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=smem),
+            # (1, 1, chunk) SMEM block: 1-d s32 operands tile T(1024)
+            # on the XLA side vs Mosaic's T(128) and fail layout checks,
+            # and a (1, chunk) block trips the "second-minor divisible by
+            # 8" rule — a middle singleton dim satisfies both
+            pl.BlockSpec((1, 1, chunk), lambda i: (i, 0, 0),
+                         memory_space=smem),
             pl.BlockSpec((1, chunk, W, k), lambda i: (i, 0, 0, 0)),
             pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
             pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
@@ -145,27 +177,27 @@ def _run_group(rows_g, y_g, wo_g, wr_g, a_buf, b_buf, *, chunk: int,
             pl.BlockSpec(memory_space=hbm),         # b_out
             # trail blocks revisit the same VMEM tile every step: Mosaic
             # writes them back once at grid end
-            pl.BlockSpec((k, k), lambda i: (0, 0)),
-            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, lane), lambda i: (0, 0)),
+            pl.BlockSpec((1, lane), lambda i: (0, 0)),
             pl.BlockSpec(memory_space=smem),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(a_buf.shape, jnp.float32),
             jax.ShapeDtypeStruct(b_buf.shape, jnp.float32),
-            jax.ShapeDtypeStruct((k, k), jnp.float32),
-            jax.ShapeDtypeStruct((1, k), jnp.float32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((k, lane), jnp.float32),
+            jax.ShapeDtypeStruct((1, lane), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((k, k), jnp.float32),
-            pltpu.VMEM((1, k), jnp.float32),
+            pltpu.VMEM((k, lane), jnp.float32),
+            pltpu.VMEM((1, lane), jnp.float32),
             pltpu.SMEM((1,), jnp.int32),
             pltpu.SemaphoreType.DMA,
         ],
         # A/b accumulate in place across groups (indices count ALL inputs)
         input_output_aliases={4: 0, 5: 1},
         interpret=interpret,
-    )(rows_g, y_g, wo_g, wr_g, a_buf, b_buf)
+    )(rows_g.reshape(n_steps, 1, chunk), y_g, wo_g, wr_g, a_buf, b_buf)
 
 
 def normal_equations_pallas(layout, other_factors, n_self: int,
@@ -215,10 +247,14 @@ def normal_equations_pallas(layout, other_factors, n_self: int,
         w_outer = mask
         w_rhs = vf * mask
 
-    # one padding row absorbs the sentinel segment's writes
+    # one padding row absorbs the sentinel segment's writes; LANE(128)-
+    # wide buffers with zero columns [k:] — Mosaic's HBM slice alignment
+    # demands lane-tile-aligned row DMAs (see _ne_kernel), and the
+    # physical bytes equal XLA's lane-padded layout anyway
+    lane = max(128, -(-k // 128) * 128)  # round UP to a lane multiple
     n_pad = n_self + 1
-    a_buf = jnp.zeros((n_pad, k, k), jnp.float32)
-    b_buf = jnp.zeros((n_pad, k), jnp.float32)
+    a_buf = jnp.zeros((n_pad, k, lane), jnp.float32)
+    b_buf = jnp.zeros((n_pad, lane), jnp.float32)
 
     g_slots = max(chunk, (group_slots // chunk) * chunk)
     t_rows, t_as, t_bs = [], [], []
@@ -231,9 +267,10 @@ def normal_equations_pallas(layout, other_factors, n_self: int,
             y_g.reshape(n_steps, chunk, W, k),
             w_outer[lo:hi].reshape(n_steps, chunk, W),
             w_rhs[lo:hi].reshape(n_steps, chunk, W),
-            a_buf, b_buf, chunk=chunk, k=k, W=W, interpret=interpret,
+            a_buf, b_buf, chunk=chunk, k=k, W=W, lane=lane,
+            interpret=interpret,
         )
-        t_rows.append(tr_row)
+        t_rows.append(tr_row.reshape(1))
         t_as.append(tr_a)
         t_bs.append(tr_b)
     # fold every group's trailing open segment: the flush is the ONLY
@@ -243,4 +280,4 @@ def normal_equations_pallas(layout, other_factors, n_self: int,
         jnp.stack(t_as), mode="drop")
     b = b_buf.at[jnp.concatenate(t_rows)].add(
         jnp.concatenate(t_bs), mode="drop")
-    return A[:n_self], b[:n_self]
+    return A[:n_self, :, :k], b[:n_self, :k]
