@@ -228,8 +228,12 @@ def phase_train() -> dict:
     float(jnp.sum(jax.device_put(np.ones(8))))  # backend up
     t0 = time.monotonic()
     dev = [jax.device_put(x) for x in host]
-    # scalar readback: block_until_ready under-reports on the tunnel
-    float(jnp.sum(dev[2].astype(jnp.float32)))
+    # scalar readback touching ALL THREE columns: device_put is async and
+    # a fence on one array creates no dependency on the others — with the
+    # uint8 value column at 1/9 of the wire bytes, fencing it alone could
+    # stop the clock while the id columns are still in flight
+    float(jnp.sum(dev[0]) + jnp.sum(dev[1])
+          + jnp.sum(dev[2].astype(jnp.float32)))
     transfer_s = time.monotonic() - t0
     d_users, d_items, d_vals = dev
 

@@ -52,6 +52,9 @@ CELLS = [
     # fused segment-flush kernel (ops/als_pallas.py); its internal VMEM
     # chunk is capped at 128 regardless of the layout chunk
     {"accum": "pallas", "chunk_slots": 8192},
+    # XLA batched-MXU blocks + Pallas segment-flush scatter — auto's TPU
+    # pick since round 3 (beats the XLA scatter emitter by ~10%/sweep)
+    {"accum": "hybrid", "chunk_slots": 32768},
 ]
 
 
@@ -69,7 +72,8 @@ def main() -> None:
     results = []
     cells = [
         c for c in CELLS
-        if not (c["accum"] == "pallas" and dev.platform == "cpu")
+        if not (c["accum"] in ("pallas", "hybrid")
+                and dev.platform == "cpu")
         # pallas on CPU runs in interpret mode — a correctness tool
         # (tests/test_als_pallas.py), meaningless to time
     ]

@@ -107,6 +107,9 @@ class ALSParams:
     #              into A — bounded temp via group_slots;
     #   "pallas":  fused Pallas segment-flush kernel (ops/als_pallas.py):
     #              no scatter, no carry, each A row written once;
+    #   "hybrid":  XLA batched-MXU blocks + Pallas segment-flush scatter
+    #              (ops/als_pallas.py normal_equations_hybrid) — keeps
+    #              the fast einsum, replaces only the scatter emitter;
     #   "auto":    per-backend (see resolved_accum)
     accum: str = "auto"
     # stacked mode: max slots whose (k,k) blocks are materialized at once;
@@ -144,13 +147,17 @@ class ALSParams:
         here, next to resolved_cg_iters, so callers — bench artifacts
         included — can report the real mode, not the knob).
 
-        auto is per-backend: on TPU the scan-carry scatter re-streams the
-        (n,k,k) accumulator once per chunk (the round-2 ~0.35%-MFU wall),
-        so stacked wins; on CPU XLA updates the carry in place and carry
-        measured faster (eval/als_accum_bench.py)."""
+        auto is per-backend: on TPU "hybrid" (XLA batched-MXU blocks +
+        Pallas segment-flush scatter) measured 0.439 s/sweep at the
+        ML-20M shape vs stacked 0.485 / carry 0.499 — the XLA
+        scatter-add emitter runs at ~13% of streaming peak and the
+        kernel writes each A row exactly once instead
+        (eval/ALS_ROOFLINE.md, eval/als_accum_bench.py). On CPU the
+        Pallas kernel only exists in interpret mode, and carry measured
+        fastest of the XLA paths, so carry stays."""
         if self.accum != "auto":
             return self.accum
-        return "stacked" if _accelerator_backend() else "carry"
+        return "hybrid" if _accelerator_backend() else "carry"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -299,7 +306,7 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
     )
     if accum == "auto":
         # keep in sync with ALSParams.resolved_accum (per-backend choice)
-        accum = "stacked" if _accelerator_backend() else "carry"
+        accum = "hybrid" if _accelerator_backend() else "carry"
     # every caller pads S to a chunk_slots multiple via _slots_for
     assert S % chunk_slots == 0, (S, chunk_slots)
 
@@ -310,6 +317,18 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
         return normal_equations_pallas(
             layout, other_factors, n_self, implicit, alpha,
             chunk_slots=min(128, chunk_slots),
+            bf16_gather=bf16_gather,
+        )
+
+    if accum == "hybrid":
+        from pio_tpu.ops.als_pallas import normal_equations_hybrid
+
+        # XLA batched-MXU blocks + Pallas segment-flush in place of the
+        # XLA scatter-add (the 118 ms/sweep, ~13%-of-peak emitter —
+        # eval/ALS_ROOFLINE.md)
+        return normal_equations_hybrid(
+            layout, other_factors, n_self, implicit, alpha,
+            chunk_slots=chunk_slots, group_slots=group_slots,
             bf16_gather=bf16_gather,
         )
 

@@ -54,38 +54,43 @@ import jax
 import jax.numpy as jnp
 
 
-def _ne_kernel(rows_ref,            # (1, 1, chunk) int32 SMEM block (this step)
-               y_ref,               # (1, chunk, W, K) VMEM block
-               wo_ref,              # (1, chunk, W)    outer weights
-               wr_ref,              # (1, chunk, W)    rhs weights
-               a_init_ref,          # aliased -> a_out (zero-filled)
-               b_init_ref,          # aliased -> b_out
-               a_out,               # (n_pad, K, LANE) HBM (aliased)
-               b_out,               # (n_pad, LANE) HBM (aliased)
-               trail_a,             # (K, LANE) VMEM block: group's open tail
-               trail_b,             # (1, LANE)
-               trail_row,           # (1, 1) int32 SMEM
-               acc_a,               # (K, LANE) f32 VMEM scratch
-               acc_b,               # (1, LANE) f32 VMEM scratch
-               cur_row,             # (1,) int32 SMEM scratch
-               dma_sem,
-               *, chunk: int):
-    """One grid step = `chunk` consecutive slots; the sequential TPU grid
-    + persistent scratch carry the open row segment across steps. Segments
-    that END inside the group DMA to A/b; the group's last open segment
-    goes to the trail outputs (folded across groups by the caller).
+def _pad_lanes(x, lane: int):
+    """Zero-pad the last dim to LANE (see _segment_kernel docstring)."""
+    k = x.shape[-1]
+    if lane == k:
+        return x
+    return jnp.concatenate(
+        [x, jnp.zeros((*x.shape[:-1], lane - k), x.dtype)], axis=-1)
 
-    Accumulators/outputs are LANE(=128)-wide with columns [K:] zero:
-    Mosaic requires HBM memref slices to be lane-tile aligned (a (K,K)
-    row slice of a lane-padded (n,K,K) buffer is rejected with "Slice
-    shape along dimension 2 must be aligned to tiling (128)"), and the
-    physical HBM bytes are identical to XLA's padded layout anyway."""
+
+def _segment_kernel(*refs, chunk: int, slot_fn):
+    """Shared segment-flush kernel body. refs =
+    (rows_ref (1,1,chunk) SMEM, *data_refs, a_init, b_init,   <- inputs
+     a_out (n_pad,K,LANE) HBM, b_out (n_pad,LANE) HBM,        <- aliased
+     trail_a (K,LANE), trail_b (1,LANE), trail_row (1,1) SMEM,
+     acc_a, acc_b, cur_row, dma_sem)                          <- scratch
+
+    One grid step = `chunk` consecutive slots; the sequential TPU grid +
+    persistent scratch carry the open row segment across steps. Segments
+    that END inside the group DMA to A/b (each A row written exactly
+    once); the group's last open segment goes to the trail outputs,
+    folded across groups by the caller. `slot_fn(data_refs, i, K, LANE)`
+    -> (blk (K,LANE), b_row (LANE,)) produces slot i's contribution —
+    the only difference between the fused-ne and scatter-only variants.
+
+    Accumulators/outputs are LANE(=128-multiple)-wide with columns [K:]
+    zero: Mosaic requires HBM memref slices to be lane-tile aligned (a
+    (K,K) row slice of a lane-padded (n,K,K) buffer is rejected with
+    "Slice shape along dimension 2 must be aligned to tiling (128)"),
+    and the physical HBM bytes equal XLA's padded layout anyway."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    (rows_ref, *data_refs, _a_init, _b_init, a_out, b_out,
+     trail_a, trail_b, trail_row, acc_a, acc_b, cur_row, dma_sem) = refs
     step = pl.program_id(0)
     n_steps = pl.num_programs(0)
-    K = y_ref.shape[3]
+    K = acc_a.shape[0]
     LANE = acc_a.shape[1]
 
     @pl.when(step == 0)
@@ -113,29 +118,8 @@ def _ne_kernel(rows_ref,            # (1, 1, chunk) int32 SMEM block (this step)
             acc_b[...] = jnp.zeros_like(acc_b)
             cur_row[0] = row
 
-        y = y_ref[0, i].astype(jnp.float32)          # (W, K)
-        wo = wo_ref[0, i].astype(jnp.float32)        # (W,)
-        wr = wr_ref[0, i].astype(jnp.float32)
-        yw = y * wo[:, None]
-        if LANE > K:  # zero-pad the rhs operand so the dot fills the lanes
-            yw = jnp.concatenate(
-                [yw, jnp.zeros((yw.shape[0], LANE - K), jnp.float32)], axis=1
-            )
-        # HIGHEST: the default 1-pass bf16 MXU contraction loses ~3e-3
-        # relative on A, which the CG solve cannot recover (same rationale
-        # as _chunk_blocks' Precision.HIGH; Mosaic supports only
-        # DEFAULT|HIGHEST for dot_general, so XLA's 3-pass HIGH middle
-        # ground is unavailable in-kernel)
-        acc_a[...] += jax.lax.dot_general(
-            y, yw, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        b_row = jnp.sum(y * wr[:, None], axis=0)     # (K,)
-        if LANE > K:
-            b_row = jnp.concatenate(
-                [b_row, jnp.zeros((LANE - K,), jnp.float32)]
-            )
+        blk, b_row = slot_fn(data_refs, i, K, LANE)
+        acc_a[...] += blk
         acc_b[...] += b_row[None, :]
         return ()
 
@@ -148,16 +132,49 @@ def _ne_kernel(rows_ref,            # (1, 1, chunk) int32 SMEM block (this step)
         trail_row[0, 0] = cur_row[0]
 
 
-def _run_group(rows_g, y_g, wo_g, wr_g, a_buf, b_buf, *, chunk: int,
-               k: int, W: int, lane: int, interpret: bool):
+def _ne_slot_fn(data_refs, i, K, LANE):
+    """Fused variant: per-slot (K,W)x(W,LANE) MXU product from gathered
+    factors + weights. HIGHEST precision: the default 1-pass bf16 MXU
+    contraction loses ~3e-3 relative on A, which the CG solve cannot
+    recover (same rationale as _chunk_blocks' Precision.HIGH; Mosaic
+    supports only DEFAULT|HIGHEST for dot_general, so XLA's 3-pass HIGH
+    middle ground is unavailable in-kernel)."""
+    y_ref, wo_ref, wr_ref = data_refs
+    y = y_ref[0, i].astype(jnp.float32)          # (W, K)
+    wo = wo_ref[0, i].astype(jnp.float32)        # (W,)
+    wr = wr_ref[0, i].astype(jnp.float32)
+    yw = _pad_lanes(y * wo[:, None], LANE)       # dot fills the lanes
+    blk = jax.lax.dot_general(
+        y, yw, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    b_row = _pad_lanes(jnp.sum(y * wr[:, None], axis=0), LANE)
+    return blk, b_row
+
+
+def _flush_slot_fn(data_refs, i, K, LANE):
+    """Scatter-only variant (accum="hybrid"): blocks precomputed by
+    XLA's batched MXU einsum; the kernel only streams and flushes."""
+    ablk_ref, bblk_ref = data_refs
+    return (_pad_lanes(ablk_ref[0, i], LANE),
+            _pad_lanes(bblk_ref[0, i], LANE))
+
+
+def _run_segment_group(rows_g, data, data_specs, a_buf, b_buf, *,
+                       chunk: int, k: int, lane: int, slot_fn,
+                       interpret: bool):
+    """One pallas_call over a group: rows + variant-specific data blocks
+    in, aliased A/b buffers accumulated in place, trail emitted."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n_steps = rows_g.shape[0] // chunk
     smem = pltpu.MemorySpace.SMEM
     hbm = pltpu.MemorySpace.HBM
+    n_in = 1 + len(data) + 2
     return pl.pallas_call(
-        functools.partial(_ne_kernel, chunk=chunk),
+        functools.partial(_segment_kernel, chunk=chunk, slot_fn=slot_fn),
         grid=(n_steps,),
         in_specs=[
             # (1, 1, chunk) SMEM block: 1-d s32 operands tile T(1024)
@@ -166,9 +183,7 @@ def _run_group(rows_g, y_g, wo_g, wr_g, a_buf, b_buf, *, chunk: int,
             # 8" rule — a middle singleton dim satisfies both
             pl.BlockSpec((1, 1, chunk), lambda i: (i, 0, 0),
                          memory_space=smem),
-            pl.BlockSpec((1, chunk, W, k), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
+            *data_specs,
             pl.BlockSpec(memory_space=hbm),         # a_init (aliased)
             pl.BlockSpec(memory_space=hbm),         # b_init (aliased)
         ],
@@ -195,9 +210,52 @@ def _run_group(rows_g, y_g, wo_g, wr_g, a_buf, b_buf, *, chunk: int,
             pltpu.SemaphoreType.DMA,
         ],
         # A/b accumulate in place across groups (indices count ALL inputs)
-        input_output_aliases={4: 0, 5: 1},
+        input_output_aliases={n_in - 2: 0, n_in - 1: 1},
         interpret=interpret,
-    )(rows_g.reshape(n_steps, 1, chunk), y_g, wo_g, wr_g, a_buf, b_buf)
+    )(rows_g.reshape(n_steps, 1, chunk), *data, a_buf, b_buf)
+
+
+def _pad_slots(layout, pad: int, n_self: int):
+    """Append `pad` sentinel slots (row id n_self — keeps the sorted-rows
+    invariant; zero lens/idx/val contribute nothing) to a slot layout."""
+    rows, idx, val, lens = layout
+    if not pad:
+        return layout
+    W = idx.shape[1]
+    return (
+        jnp.concatenate([rows, jnp.full((pad,), n_self, rows.dtype)]),
+        jnp.concatenate([idx, jnp.zeros((pad, W), idx.dtype)]),
+        jnp.concatenate([val, jnp.zeros((pad, W), val.dtype)]),
+        jnp.concatenate([lens, jnp.zeros((pad,), lens.dtype)]),
+    )
+
+
+def _lane_for(k: int) -> int:
+    return max(128, -(-k // 128) * 128)  # round UP to a lane multiple
+
+
+def _chain_groups(n_self: int, k: int, groups):
+    """Run group thunks in sequence over aliased A/b buffers and fold
+    each group's trailing open segment: the in-kernel flush is the ONLY
+    writer of a row (its segment ends in exactly one group), so flush +
+    trail adds reconstruct rows spanning group boundaries exactly.
+    `groups` yields thunks (a_buf, b_buf) -> 5-tuple from
+    _run_segment_group. One padding row absorbs the sentinel segment."""
+    lane = _lane_for(k)
+    n_pad = n_self + 1
+    a_buf = jnp.zeros((n_pad, k, lane), jnp.float32)
+    b_buf = jnp.zeros((n_pad, lane), jnp.float32)
+    t_rows, t_as, t_bs = [], [], []
+    for run in groups:
+        a_buf, b_buf, tr_a, tr_b, tr_row = run(a_buf, b_buf, lane)
+        t_rows.append(tr_row.reshape(1))
+        t_as.append(tr_a)
+        t_bs.append(tr_b)
+    A = a_buf.at[jnp.concatenate(t_rows)].add(
+        jnp.stack(t_as), mode="drop")
+    b = b_buf.at[jnp.concatenate(t_rows)].add(
+        jnp.concatenate(t_bs), mode="drop")
+    return A[:n_self, :, :k], b[:n_self, :k]
 
 
 def normal_equations_pallas(layout, other_factors, n_self: int,
@@ -206,32 +264,27 @@ def normal_equations_pallas(layout, other_factors, n_self: int,
                             group_slots: int = 65536,
                             bf16_gather: bool = True,
                             interpret: bool | None = None):
-    """Pallas segment-flush accumulation: -> A (n_self,k,k), b (n_self,k).
-
-    Same contract as ops/als._normal_equations minus the shared YtY /
-    reg terms (added by the caller for implicit mode, as there).
+    """Fused Pallas segment-flush accumulation: -> A (n_self,k,k),
+    b (n_self,k). Same contract as ops/als._normal_equations minus the
+    shared YtY / reg terms (added by the caller for implicit mode).
 
     chunk_slots sizes the VMEM working set (y block = chunk·W·k·2 bytes,
     128·128·64·2 = 2 MB double-buffered); group_slots bounds the XLA
     factor-gather temp (group·W·k·2 = 1.07 GB at the defaults). Fully
     traceable — no host synchronization — so it jits inside the training
     scan like the XLA paths."""
+    from jax.experimental import pallas as pl
+
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     rows, idx, val, lens = layout
     k = other_factors.shape[1]
     S, W = idx.shape
     chunk = min(chunk_slots, S)
-    # pad the slot axis to a whole number of kernel chunks with sentinel
-    # slots (row n_self keeps the ids sorted; zero lens -> zero weights)
+    # pad the slot axis to a whole number of kernel chunks
     pad = -S % chunk
-    if pad:
-        rows = jnp.concatenate(
-            [rows, jnp.full((pad,), n_self, rows.dtype)])
-        idx = jnp.concatenate([idx, jnp.zeros((pad, W), idx.dtype)])
-        val = jnp.concatenate([val, jnp.zeros((pad, W), val.dtype)])
-        lens = jnp.concatenate([lens, jnp.zeros((pad,), lens.dtype)])
-        S += pad
+    rows, idx, val, lens = _pad_slots((rows, idx, val, lens), pad, n_self)
+    S += pad
 
     src = (
         other_factors.astype(jnp.bfloat16) if bf16_gather else other_factors
@@ -247,37 +300,105 @@ def normal_equations_pallas(layout, other_factors, n_self: int,
         w_outer = mask
         w_rhs = vf * mask
 
-    # one padding row absorbs the sentinel segment's writes; LANE(128)-
-    # wide buffers with zero columns [k:] — Mosaic's HBM slice alignment
-    # demands lane-tile-aligned row DMAs (see _ne_kernel), and the
-    # physical bytes equal XLA's lane-padded layout anyway
-    lane = max(128, -(-k // 128) * 128)  # round UP to a lane multiple
-    n_pad = n_self + 1
-    a_buf = jnp.zeros((n_pad, k, lane), jnp.float32)
-    b_buf = jnp.zeros((n_pad, lane), jnp.float32)
-
     g_slots = max(chunk, (group_slots // chunk) * chunk)
-    t_rows, t_as, t_bs = [], [], []
-    for lo in range(0, S, g_slots):
-        hi = min(S, lo + g_slots)
-        y_g = src[idx[lo:hi]]                   # bounded gather temp
-        n_steps = (hi - lo) // chunk
-        a_buf, b_buf, tr_a, tr_b, tr_row = _run_group(
-            rows[lo:hi],
-            y_g.reshape(n_steps, chunk, W, k),
-            w_outer[lo:hi].reshape(n_steps, chunk, W),
-            w_rhs[lo:hi].reshape(n_steps, chunk, W),
-            a_buf, b_buf, chunk=chunk, k=k, W=W, lane=lane,
-            interpret=interpret,
-        )
-        t_rows.append(tr_row.reshape(1))
-        t_as.append(tr_a)
-        t_bs.append(tr_b)
-    # fold every group's trailing open segment: the flush is the ONLY
-    # in-kernel writer of a row (its segment ends in exactly one group),
-    # so flush + trail adds reconstruct rows spanning group boundaries
-    A = a_buf.at[jnp.concatenate(t_rows)].add(
-        jnp.stack(t_as), mode="drop")
-    b = b_buf.at[jnp.concatenate(t_rows)].add(
-        jnp.concatenate(t_bs), mode="drop")
-    return A[:n_self, :, :k], b[:n_self, :k]
+
+    def group_thunk(lo, hi):
+        def run(a_buf, b_buf, lane):
+            y_g = src[idx[lo:hi]]               # bounded gather temp
+            n_steps = (hi - lo) // chunk
+            data = (y_g.reshape(n_steps, chunk, W, k),
+                    w_outer[lo:hi].reshape(n_steps, chunk, W),
+                    w_rhs[lo:hi].reshape(n_steps, chunk, W))
+            specs = (
+                pl.BlockSpec((1, chunk, W, k), lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, chunk, W), lambda i: (i, 0, 0)),
+            )
+            return _run_segment_group(
+                rows[lo:hi], data, specs, a_buf, b_buf, chunk=chunk,
+                k=k, lane=lane, slot_fn=_ne_slot_fn, interpret=interpret,
+            )
+        return run
+
+    groups = [group_thunk(lo, min(S, lo + g_slots))
+              for lo in range(0, S, g_slots)]
+    return _chain_groups(n_self, k, groups)
+
+
+# ---------------------------------------------------------------------------
+# accum="hybrid": XLA batched-MXU blocks + the shared segment-flush kernel
+# with the scatter-only slot_fn — no in-kernel dots, pure streaming adds
+# ---------------------------------------------------------------------------
+
+def normal_equations_hybrid(layout, other_factors, n_self: int,
+                            implicit: bool, alpha: float,
+                            chunk_slots: int = 32768,
+                            kernel_chunk: int = 128,
+                            group_slots: int = 65536,
+                            bf16_gather: bool = True,
+                            interpret: bool | None = None):
+    """accum="hybrid": XLA builds the per-slot blocks (batched MXU
+    einsum, _chunk_blocks — the hardware A/B showed it beats in-kernel
+    serial dots), the shared segment-flush kernel replaces only the
+    scatter-add into A (the ~13%-of-peak emitter, 118 ms/sweep in the
+    round-3 profile) so each A row is written exactly once. Same
+    contract/trail algebra and group chaining as
+    normal_equations_pallas."""
+    import math as _math
+
+    from jax.experimental import pallas as pl
+
+    from pio_tpu.ops.als import _chunk_blocks  # lazy: als imports us lazily
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    rows, idx, val, lens = layout
+    k = other_factors.shape[1]
+    S, W = idx.shape
+    chunk = min(kernel_chunk, S)
+    # every group must hold WHOLE XLA-scan chunks (chunk_slots) and WHOLE
+    # kernel chunks, or the scan collapses to one giant chunk and the
+    # gather temp that chunk_slots exists to bound becomes unbounded —
+    # pad S to the combined quantum so even the last group divides
+    quantum = chunk * chunk_slots // _math.gcd(chunk, chunk_slots)
+    pad = -S % quantum
+    rows, idx, val, lens = _pad_slots((rows, idx, val, lens), pad, n_self)
+    S += pad
+    src = (
+        other_factors.astype(jnp.bfloat16) if bf16_gather else other_factors
+    )
+    g_slots = max(quantum, (group_slots // quantum) * quantum)
+
+    def group_thunk(lo, hi):
+        def run(a_buf, b_buf, lane):
+            # blocks via the XLA scan exactly as accum="stacked"
+            # builds them; quantum padding guarantees divisibility
+            c_sz = chunk_slots
+            n_ch = (hi - lo) // c_sz
+            xs = (idx[lo:hi].reshape(n_ch, c_sz, W),
+                  val[lo:hi].reshape(n_ch, c_sz, W),
+                  lens[lo:hi].reshape(n_ch, c_sz))
+
+            def body(_, xs_c):
+                i_c, v_c, l_c = xs_c
+                return None, _chunk_blocks(src, i_c, v_c, l_c,
+                                           implicit, alpha)
+
+            _, (a_blks, b_blks) = jax.lax.scan(body, None, xs)
+            n_steps = (hi - lo) // chunk
+            data = (a_blks.reshape(n_steps, chunk, k, k),
+                    b_blks.reshape(n_steps, chunk, k))
+            specs = (
+                pl.BlockSpec((1, chunk, k, k), lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec((1, chunk, k), lambda i: (i, 0, 0)),
+            )
+            return _run_segment_group(
+                rows[lo:hi], data, specs, a_buf, b_buf, chunk=chunk,
+                k=k, lane=lane, slot_fn=_flush_slot_fn,
+                interpret=interpret,
+            )
+        return run
+
+    groups = [group_thunk(lo, min(S, lo + g_slots))
+              for lo in range(0, S, g_slots)]
+    return _chain_groups(n_self, k, groups)
