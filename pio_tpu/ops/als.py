@@ -188,6 +188,16 @@ def _accelerator_backend() -> bool:
     return dev.platform not in ("cpu", "gpu")
 
 
+def blocks_group_budget_slots(k: int) -> int:
+    """Max slots whose (k,k) f32 blocks may be materialized at once —
+    the ALSParams.group_slots default (73728) is k=64-tuned (1.2 GB);
+    the temp scales k^2, so group sizing caps by BYTES too or rank 128
+    OOMs HBM at the ML-20M shape (measured 22.6G of 15.75G). Shared by
+    the stacked (als.py) and hybrid (als_pallas.py) accumulation
+    paths."""
+    return max(1, (1_200 * 2**20) // (k * k * 4))
+
+
 def _slots_for(nnz: int, n_self: int, width: int, chunk_slots: int) -> int:
     """Static upper bound on slot count, padded to a chunk multiple.
 
@@ -320,6 +330,12 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
             bf16_gather=bf16_gather,
         )
 
+    if accum == "hybrid" and k > 256:
+        # the kernel's VMEM blocks block is >=8 slots x k^2 x 4 B double-
+        # buffered; beyond k=256 that exceeds the 16 MB scoped VMEM no
+        # matter the chunk, so high ranks take the XLA scatter path
+        accum = "stacked"
+
     if accum == "hybrid":
         from pio_tpu.ops.als_pallas import normal_equations_hybrid
 
@@ -362,8 +378,10 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
 
     if accum != "stacked":
         raise ValueError(f"unknown accum mode {accum!r}")
-    # group = as many whole chunks as fit the temp budget
-    ch_per_group = max(1, group_slots // chunk_slots)
+    # group = as many whole chunks as fit the temp budget (bytes-capped:
+    # see blocks_group_budget_slots)
+    ch_per_group = max(
+        1, min(group_slots, blocks_group_budget_slots(k)) // chunk_slots)
     g_slots = ch_per_group * chunk_slots
     n_groups = math.ceil(S / g_slots)
     A = jnp.zeros((n_self, k, k), dtype=jnp.float32)

@@ -355,7 +355,19 @@ def normal_equations_hybrid(layout, other_factors, n_self: int,
     rows, idx, val, lens = layout
     k = other_factors.shape[1]
     S, W = idx.shape
-    chunk = min(kernel_chunk, S)
+    # VMEM-budget the kernel chunk: the blocks block is chunk*k*k*4 bytes
+    # DOUBLE-buffered by the pallas pipeline, and the whole stack must fit
+    # the 16 MB scoped limit (measured: chunk=128 at k=128 overflows by
+    # 130 KB); 4 MB per buffer keeps headroom for b/trail/acc up to the
+    # k=256 cap (ops/als.py falls back to stacked above it). The chunk is
+    # then rounded DOWN to a power of two that divides chunk_slots: a
+    # non-divisor chunk makes quantum = lcm(chunk, chunk_slots) explode
+    # (k=96 -> chunk 113, lcm(113, 8192) = 925k slots of blocks temp).
+    vmem_chunk = max(8, (4 * 2**20) // (k * k * 4))
+    cap = max(1, min(kernel_chunk, vmem_chunk, S))
+    chunk = 1 << (cap.bit_length() - 1)
+    while chunk > 1 and chunk_slots % chunk:
+        chunk //= 2
     # every group must hold WHOLE XLA-scan chunks (chunk_slots) and WHOLE
     # kernel chunks, or the scan collapses to one giant chunk and the
     # gather temp that chunk_slots exists to bound becomes unbounded —
@@ -367,7 +379,10 @@ def normal_equations_hybrid(layout, other_factors, n_self: int,
     src = (
         other_factors.astype(jnp.bfloat16) if bf16_gather else other_factors
     )
-    g_slots = max(quantum, (group_slots // quantum) * quantum)
+    from pio_tpu.ops.als import blocks_group_budget_slots
+
+    g_eff = min(group_slots, blocks_group_budget_slots(k))
+    g_slots = max(quantum, (g_eff // quantum) * quantum)
 
     def group_thunk(lo, hi):
         def run(a_buf, b_buf, lane):
