@@ -50,7 +50,7 @@ def main() -> None:
                  "shape": {"n_users": NU, "n_items": NI, "nnz": NNZ},
                  "explicit": [], "implicit": []}
 
-    for warm in (-1, 8, 4):
+    for warm in (-1, 8, 6, 4):
         p = ALSParams(rank=64, iterations=10, reg=REG, implicit=False,
                       chunk=65536, chunk_slots=8192, cg_warm_iters=warm)
         m = als_train(uu[tr], ii[tr], r[tr], NU, NI, p)
@@ -77,7 +77,7 @@ def main() -> None:
                      + REG * (jnp.sum(X ** 2) + jnp.sum(Y ** 2)))
 
     base = None
-    for warm in (-1, 8, 4):
+    for warm in (-1, 8, 6, 4):
         p = ALSParams(rank=64, iterations=10, reg=REG, alpha=ALPHA,
                       implicit=True, chunk=65536, chunk_slots=8192,
                       cg_warm_iters=warm)

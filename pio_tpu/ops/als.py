@@ -90,12 +90,15 @@ class ALSParams:
     # sweep's factors; once the outer iteration is near its fixed point
     # the inner Krylov correction is small and half the iterations hold
     # the heldout RMSE (measured: see eval/RMSE_PARITY.md).
-    # Default 8 (vs the cold cap of 16): measured on v5e at the ML-20M
-    # shape this is -61 ms/sweep (0.540 -> 0.479); explicit heldout RMSE
-    # 0.44463 vs 0.44485 (flat), implicit objective 1.2% BETTER than
-    # full-strength CG. cg_warm_iters=4 is faster still but costs 1.6%
-    # on the implicit objective; -1 disables the schedule.
-    cg_warm_iters: int = 8
+    # Default 6 (vs the cold cap of 16): measured on v5e at the ML-20M
+    # shape the schedule is worth ~-75 ms/sweep; explicit heldout RMSE is
+    # flat-to-better at 8 and 6 (0.44459 / 0.44441 vs 0.44494 full), and
+    # the implicit objective is BETTER than full-strength CG at both
+    # (-1.2% at 8, -0.9% at 6 — the inexact inner solve mildly
+    # regularizes). cg_warm_iters=4 is faster still but costs 1.6-2.4%
+    # on the implicit objective, so 6 is the default; -1 disables the
+    # schedule. Grid artifact: eval/CG_WARM_QUALITY.json.
+    cg_warm_iters: int = 6
     cg_warm_sweeps: int = 2
     # normal-equation accumulation strategy:
     #   "carry":   scatter-add each chunk's blocks into the (n,k,k)
