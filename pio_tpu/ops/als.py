@@ -91,13 +91,13 @@ class ALSParams:
     # the inner Krylov correction is small and half the iterations hold
     # the heldout RMSE (measured: see eval/RMSE_PARITY.md).
     # Default 6 (vs the cold cap of 16): measured on v5e at the ML-20M
-    # shape the schedule is worth ~-75 ms/sweep; explicit heldout RMSE is
-    # flat-to-better at 8 and 6 (0.44459 / 0.44441 vs 0.44494 full), and
+    # shape the schedule is worth ~-75 ms/sweep; per the committed grid
+    # artifact (eval/CG_WARM_QUALITY.json) explicit heldout RMSE is
+    # flat-to-better at 8 and 6 (0.44459 / 0.44435 vs 0.44494 full) and
     # the implicit objective is BETTER than full-strength CG at both
-    # (-1.2% at 8, -0.9% at 6 — the inexact inner solve mildly
-    # regularizes). cg_warm_iters=4 is faster still but costs 1.6-2.4%
-    # on the implicit objective, so 6 is the default; -1 disables the
-    # schedule. Grid artifact: eval/CG_WARM_QUALITY.json.
+    # (-2.5% at 8, -3.3% at 6 — the inexact inner solve mildly
+    # regularizes), while 4 flips to +2.4% WORSE; 6 is the default, -1
+    # disables the schedule.
     cg_warm_iters: int = 6
     cg_warm_sweeps: int = 2
     # normal-equation accumulation strategy:
