@@ -45,6 +45,7 @@ from pio_tpu.controller.engine import Engine, EngineFactory
 from pio_tpu.data.bimap import EntityIdIndex
 from pio_tpu.ops.attention import (
     attention_reference,
+    chunked_attention,
     flash_attention,
     ring_attention,
     ulysses_attention,
@@ -68,12 +69,21 @@ class SequenceParams(Params):
     batch_size: int = 128
     steps: int = 300
     seed: int = 0
-    # "auto" | "reference" | "ring" | "ulysses" — auto picks ring when the
-    # mesh shards the sequence axis. ulysses = all-to-all head-sharded
+    # "auto" | "reference" | "chunked" | "ring" | "ulysses" — auto picks
+    # ring when the mesh shards the sequence axis; on a single device it
+    # picks chunked (memory-efficient online-softmax scan,
+    # ops/attention.py chunked_attention — logits memory O(S*chunk), so
+    # long contexts train single-chip) above chunked_threshold tokens and
+    # the naive reference below it. ulysses = all-to-all head-sharded
     # sequence parallelism (ops/attention.py ulysses_attention): two
     # collectives per layer vs ring's n-1 hops; requires num_heads
-    # divisible by the seq-axis size
+    # divisible by the seq-axis size. (The Pallas flash kernel has no
+    # backward and serves the PREDICT path only.)
     attention: str = "auto"
+    # single-device auto: sequences at/above this length train with
+    # chunked attention (naive logits at 1024 tokens are already
+    # B*H*1024^2*4 bytes)
+    chunked_threshold: int = 1024
     # mixture-of-experts FFN: 0 = dense (default). With > 0 experts each
     # block's FFN becomes a Switch-style MoE (ops/moe.py) — one-hot-matmul
     # dispatch, capacity-dropped tokens ride the residual, and the
@@ -269,20 +279,21 @@ def train_sequence_model(
     inp_all, tgt_all = seqs[:, :-1], seqs[:, 1:]
     s_global = inp_all.shape[1]
 
-    if p.attention not in ("auto", "reference", "ring", "ulysses"):
+    if p.attention not in ("auto", "reference", "chunked", "ring",
+                           "ulysses"):
         raise ValueError(
             f"unknown attention mode {p.attention!r}: expected "
-            "'auto' | 'reference' | 'ring' | 'ulysses'"
+            "'auto' | 'reference' | 'chunked' | 'ring' | 'ulysses'"
         )
     # once the sequence is sharded, attention MUST be sequence-parallel
     # (ring or ulysses) — a local-only attention would silently drop
     # cross-shard interactions
     use_sp = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
-    if use_sp and p.attention == "reference":
+    if use_sp and p.attention in ("reference", "chunked"):
         raise ValueError(
-            "attention='reference' cannot run with the sequence sharded "
-            "over the mesh seq axis; use 'auto'/'ring'/'ulysses' or a "
-            "seq=1 mesh"
+            f"attention={p.attention!r} is a local-only path and cannot "
+            "run with the sequence sharded over the mesh seq axis; use "
+            "'auto'/'ring'/'ulysses' or a seq=1 mesh"
         )
     if not use_sp and p.attention in ("ring", "ulysses"):
         raise ValueError(
@@ -296,10 +307,23 @@ def train_sequence_model(
                 f"divisible by the seq axis ({n_seq_axis})"
             )
 
+    # local (non-sequence-parallel) attention: chunked at/above the
+    # threshold (compared on max_len: the training inputs are one token
+    # shorter), naive reference below it
+    use_chunked_local = p.attention == "chunked" or (
+        p.attention == "auto" and p.max_len >= p.chunked_threshold
+    )
+    local_attn = partial(
+        chunked_attention if use_chunked_local else attention_reference,
+        causal=True,
+    )
+    # init with the SAME local attention: a naive-attention init forward
+    # would materialize the full (1,H,S,S) logits and OOM at exactly the
+    # long contexts the chunked path exists for
     params = encoder.init(
         jax.random.PRNGKey(p.seed),
         jnp.zeros((1, s_global), jnp.int32),
-        partial(attention_reference, causal=True),
+        local_attn,
     )["params"]
     opt_state = optimizer.init(params)
 
@@ -330,7 +354,7 @@ def train_sequence_model(
                     ring_attention, axis_name=SEQ_AXIS, causal=True,
                 )
             else:
-                attn = partial(attention_reference, causal=True)
+                attn = local_attn
             (_, logits), aux = _apply_with_aux(
                 encoder, params, inp, attn, pos_offset, p
             )
@@ -367,7 +391,7 @@ def train_sequence_model(
         batch = max(n_data, p.batch_size - p.batch_size % n_data)
     else:
         n_data = 1
-        attn = partial(attention_reference, causal=True)
+        attn = local_attn
 
         def loss_fn(params, inp, tgt):
             (_, logits), aux = _apply_with_aux(

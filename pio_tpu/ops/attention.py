@@ -234,6 +234,73 @@ def flash_attention(
 
 
 # ---------------------------------------------------------------------------
+# Chunked (memory-efficient) attention — the DIFFERENTIABLE long-context
+# path for single-device training
+# ---------------------------------------------------------------------------
+
+def chunked_attention(
+    q, k, v,
+    causal: bool = False,
+    scale: float | None = None,
+    chunk: int = 1024,
+):
+    """Online-softmax attention as a lax.scan over key/value chunks —
+    pure XLA, so it is reverse-differentiable (the Pallas flash kernel
+    has no backward and stays the serving/forward-only fast path). Peak
+    logits memory is O(B*H*Sq*chunk) instead of O(B*H*Sq*Sk), and
+    jax.checkpoint on the per-chunk stats recomputes them in the
+    backward pass instead of storing one residual per chunk — the same
+    memory shape that lets ring_attention train across devices, applied
+    within one device. q: (B, Sq, H, D); k/v: (B, Sk, H, D)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    chunk = min(chunk, sk)
+    pad = _pad_len(sk, chunk)
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    n_ch = (sk + pad) // chunk
+    ks = k.reshape(b, n_ch, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    vs = v.reshape(b, n_ch, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    offs = jnp.arange(n_ch) * chunk
+
+    @jax.checkpoint
+    def stats(k_c, v_c, off):
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", q, k_c) * scale
+        q_pos = jnp.arange(sq)
+        k_pos = off + jnp.arange(chunk)
+        keep = k_pos[None, :] < sk                  # padded keys drop
+        if causal:
+            keep = keep & (q_pos[:, None] >= k_pos[None, :])
+        s_ = jnp.where(keep[None, None], s_, NEG_INF)
+        m_ = jnp.max(s_, axis=-1, keepdims=True)
+        p_ = jnp.where(keep[None, None], jnp.exp(s_ - m_), 0.0)
+        l_ = jnp.sum(p_, axis=-1, keepdims=True)
+        o_ = jnp.einsum("bhqk,bkhd->bqhd", p_, v_c)
+        return o_, m_, l_
+
+    def step(carry, xs):
+        o, m, l = carry
+        k_c, v_c, off = xs
+        o_i, m_i, l_i = stats(k_c, v_c, off)
+        m_new = jnp.maximum(m, m_i)
+        a_prev = jnp.exp(m - m_new)
+        a_i = jnp.exp(m_i - m_new)
+        l_new = l * a_prev + l_i * a_i
+        o_new = (o * a_prev.transpose(0, 2, 1, 3)
+                 + o_i * a_i.transpose(0, 2, 1, 3))
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    (o, m, l), _ = jax.lax.scan(step, (o0, m0, l0), (ks, vs, offs))
+    o = o / jnp.maximum(l.transpose(0, 2, 1, 3), 1e-30)
+    return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
 # Ring attention (sequence parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
 
