@@ -47,6 +47,7 @@ from pio_tpu.ops.attention import (
     attention_reference,
     chunked_attention,
     flash_attention,
+    flash_attention_trainable,
     ring_attention,
     ulysses_attention,
 )
@@ -69,7 +70,10 @@ class SequenceParams(Params):
     batch_size: int = 128
     steps: int = 300
     seed: int = 0
-    # "auto" | "reference" | "chunked" | "ring" | "ulysses" — auto picks
+    # "auto" | "reference" | "chunked" | "flash" | "ring" | "ulysses" —
+    # "flash" trains with the Pallas forward + chunked backward
+    # (ops/attention.py flash_attention_trainable; fastest forward on
+    # TPU-class backends). auto picks
     # ring when the mesh shards the sequence axis; on a single device it
     # picks chunked (memory-efficient online-softmax scan,
     # ops/attention.py chunked_attention — logits memory O(S*chunk), so
@@ -77,8 +81,7 @@ class SequenceParams(Params):
     # the naive reference below it. ulysses = all-to-all head-sharded
     # sequence parallelism (ops/attention.py ulysses_attention): two
     # collectives per layer vs ring's n-1 hops; requires num_heads
-    # divisible by the seq-axis size. (The Pallas flash kernel has no
-    # backward and serves the PREDICT path only.)
+    # divisible by the seq-axis size.
     attention: str = "auto"
     # single-device auto: sequences at/above this length train with
     # chunked attention (naive logits at 1024 tokens are already
@@ -279,17 +282,18 @@ def train_sequence_model(
     inp_all, tgt_all = seqs[:, :-1], seqs[:, 1:]
     s_global = inp_all.shape[1]
 
-    if p.attention not in ("auto", "reference", "chunked", "ring",
-                           "ulysses"):
+    if p.attention not in ("auto", "reference", "chunked", "flash",
+                           "ring", "ulysses"):
         raise ValueError(
             f"unknown attention mode {p.attention!r}: expected "
-            "'auto' | 'reference' | 'chunked' | 'ring' | 'ulysses'"
+            "'auto' | 'reference' | 'chunked' | 'flash' | 'ring' | "
+            "'ulysses'"
         )
     # once the sequence is sharded, attention MUST be sequence-parallel
     # (ring or ulysses) — a local-only attention would silently drop
     # cross-shard interactions
     use_sp = mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
-    if use_sp and p.attention in ("reference", "chunked"):
+    if use_sp and p.attention in ("reference", "chunked", "flash"):
         raise ValueError(
             f"attention={p.attention!r} is a local-only path and cannot "
             "run with the sequence sharded over the mesh seq axis; use "
@@ -313,10 +317,16 @@ def train_sequence_model(
     use_chunked_local = p.attention == "chunked" or (
         p.attention == "auto" and p.max_len >= p.chunked_threshold
     )
-    local_attn = partial(
-        chunked_attention if use_chunked_local else attention_reference,
-        causal=True,
-    )
+    if p.attention == "flash":
+        # Pallas forward + chunked-XLA backward (custom_vjp): the fast
+        # training-forward option on TPU-class backends; on CPU the
+        # kernel runs in interpret mode, so prefer chunked/reference
+        local_attn = partial(flash_attention_trainable, causal=True)
+    else:
+        local_attn = partial(
+            chunked_attention if use_chunked_local else attention_reference,
+            causal=True,
+        )
     # init with the SAME local attention: a naive-attention init forward
     # would materialize the full (1,H,S,S) logits and OOM at exactly the
     # long contexts the chunked path exists for

