@@ -1,0 +1,167 @@
+"""TPU acquisition diagnostics (pio_tpu/utils/tpu_health.py).
+
+Rounds 1-3 of the driver bench missed the chip with artifacts that
+recorded nothing but "timeout after Ns"; these tests pin the evidence
+machinery that round 4 added: stage trails that survive SIGKILL,
+hang classification keyed on the deepest stage reached + relay TCP
+state, and the pre-flight's jax-free cheapness.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from pio_tpu.utils.tpu_health import (
+    StageWriter,
+    classify_hang,
+    preflight,
+    read_stages,
+    relay_reachable,
+    tcp_check,
+)
+
+
+def test_stage_writer_roundtrip(tmp_path):
+    p = tmp_path / "trail.jsonl"
+    w = StageWriter(str(p))
+    w.stage("start", pid=123)
+    w.stage("jax_imported", t_import=0.5)
+    stages = read_stages(str(p))
+    assert [s["stage"] for s in stages] == ["start", "jax_imported"]
+    assert stages[0]["pid"] == 123
+    assert all("t" in s and "ts" in s for s in stages)
+
+
+def test_stage_writer_none_path_is_noop():
+    w = StageWriter(None)
+    w.stage("start")  # must not raise
+
+
+def test_read_stages_missing_and_garbage(tmp_path):
+    assert read_stages(str(tmp_path / "nope")) == []
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"stage": "start", "t": 0}\nnot json\n')
+    assert [s["stage"] for s in read_stages(str(p))] == ["start"]
+
+
+def _pf(relay_open: bool) -> dict:
+    return {"relay_tcp": {"2024": "open" if relay_open else "refused",
+                          "2024_ms": 0.2}}
+
+
+@pytest.mark.parametrize("trail,expect", [
+    ([], "no-progress-recorded"),
+    ([{"stage": "start"}], "hang-at-jax-import"),
+    ([{"stage": "start"}, {"stage": "jax_imported"}],
+     "hang-at-device-claim"),
+    ([{"stage": "start"}, {"stage": "jax_imported"},
+      {"stage": "devices_ok"}], "hang-at-first-compile"),
+    ([{"stage": "start"}, {"stage": "jax_imported"},
+      {"stage": "devices_ok"}, {"stage": "compiled"}], "hang-at-first-run"),
+])
+def test_classify_hang_probe_stages(trail, expect):
+    assert classify_hang(trail, _pf(True)) == f"{expect}(relay-tcp-open)"
+    assert classify_hang(trail, _pf(False)) == f"{expect}(relay-tcp-down)"
+
+
+def test_classify_hang_completed_and_custom_stages():
+    done = [{"stage": "start"}, {"stage": "jax_imported"},
+            {"stage": "devices_ok"}, {"stage": "compiled"},
+            {"stage": "ran"}]
+    assert classify_hang(done, _pf(True)) == "completed"
+    # non-probe trail (train phase): report the last stage reached
+    custom = [{"stage": "train_start"}, {"stage": "transfer_done"}]
+    assert classify_hang(custom, _pf(True)) == \
+        "hang-after-transfer_done(relay-tcp-open)"
+    assert classify_hang(custom, None) == \
+        "hang-after-transfer_done(relay-unchecked)"
+
+
+def test_trail_survives_sigkill(tmp_path):
+    """The parent reads the trail after killing a hung child — the
+    writes must be durable at the moment of SIGKILL (flush+fsync)."""
+    p = tmp_path / "trail.jsonl"
+    code = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from pio_tpu.utils.tpu_health import StageWriter\n"
+        "w = StageWriter(%r)\n"
+        "w.stage('start')\n"
+        "w.stage('jax_imported')\n"
+        "print('staged', flush=True)\n"
+        "time.sleep(60)\n"
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         str(p))
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "staged"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert [s["stage"] for s in read_stages(str(p))] == [
+        "start", "jax_imported"]
+
+
+def test_tcp_check_against_live_and_dead_ports():
+    # live: a listener we control
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    # dead: bind-then-close guarantees an unused port
+    s2 = socket.socket()
+    s2.bind(("127.0.0.1", 0))
+    dead = s2.getsockname()[1]
+    s2.close()
+    try:
+        out = tcp_check(ports=(port, dead), timeout=2.0)
+        assert out[str(port)] == "open"
+        assert out[str(dead)] == "refused"
+        assert out[f"{port}_ms"] < 2000
+    finally:
+        srv.close()
+
+
+def test_preflight_fast_without_backend_init():
+    """preflight is called from the bench's orchestrating parent before
+    any probe subprocess. It must complete in seconds REGARDLESS of
+    tunnel state — i.e. it must never initialize a jax backend (the
+    thing that hangs when the tunnel is down). The jax MODULE may
+    already be in sys.modules (this image's sitecustomize imports it at
+    interpreter startup); what matters is that no PJRT client gets
+    created, which we check via jax's own backend cache."""
+    code = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from pio_tpu.utils.tpu_health import preflight, relay_reachable\n"
+        "pf = preflight()\n"
+        "if 'jax' in sys.modules:\n"
+        "    from jax._src import xla_bridge\n"
+        "    assert not xla_bridge._backends, 'preflight inited a backend'\n"
+        "import json; print(json.dumps(pf))\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.monotonic()
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    pf = json.loads(out.stdout)
+    assert "relay_tcp" in pf and "pjrt_lib_present" in pf
+    assert time.monotonic() - t0 < 30
+    assert isinstance(relay_reachable(pf), bool)
+
+
+def test_relay_reachable_ignores_ms_keys():
+    assert relay_reachable({"relay_tcp": {"2024": "refused",
+                                          "2024_ms": 0.1}}) is False
+    assert relay_reachable({"relay_tcp": {"2024": "open",
+                                          "2024_ms": 9999.0}}) is True
