@@ -309,7 +309,7 @@ def phase_train() -> dict:
         "cg_iters": cg,
         "cg_warm_iters": w_cg if n_warm else None,
         "cg_full_sweeps": n_full,
-        "accum": ALSParams().resolved_accum(),
+        "accum": ALSParams(rank=RANK).resolved_accum(),
     }
 
 

@@ -123,7 +123,10 @@ def main() -> None:
 
     ok = [r for r in results if "error" not in r]
     best = min(ok, key=lambda r: r["wall_sec"]) if ok else None
+    from pio_tpu.utils.tpu_health import telemetry
+
     summary = {
+        "transport": telemetry(),
         "device_kind": dev.device_kind,
         "platform": dev.platform,
         "shape": {"n_users": N_USERS, "n_items": N_ITEMS, "nnz": NNZ,

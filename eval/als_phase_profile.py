@@ -105,7 +105,10 @@ def main() -> None:
     float(jnp.sum(d_v))
 
     dev = jax.devices()[0]
+    from pio_tpu.utils.tpu_health import telemetry
+
     out: dict = {
+        "transport": telemetry(),
         "device_kind": dev.device_kind,
         "platform": dev.platform,
         "shape": {"n_users": N_USERS, "n_items": N_ITEMS, "nnz": NNZ,

@@ -89,6 +89,9 @@ def main() -> None:
         out["implicit"].append(row)
         print(json.dumps(row), flush=True)
 
+    from pio_tpu.utils.tpu_health import telemetry
+
+    out["transport"] = telemetry()
     if "--out" in sys.argv:
         with open(sys.argv[sys.argv.index("--out") + 1], "w") as f:
             json.dump(out, f, indent=1)

@@ -201,7 +201,10 @@ def flash_attention_throughput() -> dict:
 
 def main() -> None:
     dev = jax.devices()[0]
-    out = {"device_kind": dev.device_kind, "platform": dev.platform,
+    from pio_tpu.utils.tpu_health import telemetry
+
+    out = {"transport": telemetry(),
+           "device_kind": dev.device_kind, "platform": dev.platform,
            "note": ("single-invocation numbers through a shared, tunneled "
                     "chip: trainer rows swing with host/tunnel load "
                     "between invocations (2-12x observed on two_tower); "

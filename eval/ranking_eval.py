@@ -121,7 +121,10 @@ def main() -> int:
     import jax
 
     device = jax.devices()[0]
+    from pio_tpu.utils.tpu_health import telemetry
+
     out = {
+        "transport": telemetry(),
         "dataset": "examples/quickstart/events.jsonl.gz",
         "events": ok,
         "folds": FOLDS,

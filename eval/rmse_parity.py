@@ -203,12 +203,34 @@ def main() -> int:
     for s, r in enumerate(ch_traj):
         print(f"  sweep {s + 1:2d}: heldout RMSE {r:.5f}", flush=True)
 
+    # -- validation-driven best-sweep selection (round-4) -------------------
+    # als_train_validated picks the best sweep on the VALIDATION slice
+    # inside the compiled scan; the selected model is then scored once on
+    # the untouched TEST slice. No peeking: selection and reporting use
+    # different data. This is the shipped configuration when
+    # validation_fraction > 0 (models/recommendation.py).
+    print("best-sweep selection (validation-driven):", flush=True)
+    from pio_tpu.ops.als import als_train_validated, rmse as als_rmse
+
+    p_sel = ALSParams(rank=RANK, iterations=SWEEPS, reg=reg, chunk=chunk,
+                      cg_iters=-1)
+    t0 = time.monotonic()
+    model_sel, valinfo = als_train_validated(
+        tr_u, tr_i, tr_v, n_users, n_items, p_sel, va_u, va_i, va_v)
+    sel_sec = time.monotonic() - t0
+    sel_test = round(float(als_rmse(model_sel, te_u, te_i, te_v)), 5)
+    print(f"  val curve: {valinfo.curve}", flush=True)
+    print(f"  best sweep {valinfo.best_sweep}/{SWEEPS} "
+          f"(val {valinfo.best_rmse:.5f}); heldout-test RMSE of the "
+          f"SELECTED model: {sel_test:.5f}", flush=True)
+
     mean_base = float(np.sqrt(np.mean((te_v - tr_v.mean()) ** 2)))
     bias_base = bias_baseline_rmse(
         tr_u, tr_i, tr_v, te_u, te_i, te_v, n_users, n_items)
-    # the SHIPPED configuration's result (final sweep) — not min() over the
-    # trajectory, which would peek at the test set
-    als_final = cg_traj[-1]
+    # headline = the best-sweep-selected model's TEST score (what the
+    # framework ships with validation_fraction>0); the last-sweep figure
+    # stays alongside as the no-selection reference behavior
+    als_final = sel_test
     final_gap = (cg_traj[-1] - ch_traj[-1]) / ch_traj[-1]
     quality = als_final < 0.95 * mean_base and als_final < bias_base
     result = {
@@ -226,6 +248,32 @@ def main() -> int:
         "device_kind": device.device_kind,
         "heldout_rmse_cg": cg_traj,
         "heldout_rmse_cholesky": ch_traj,
+        "best_sweep_selection": {
+            "val_curve": list(valinfo.curve),
+            "best_sweep": valinfo.best_sweep,
+            "best_val_rmse": valinfo.best_rmse,
+            "final_val_rmse": valinfo.final_rmse,
+            "selected_test_rmse": sel_test,
+            "last_sweep_test_rmse": cg_traj[-1],
+            "train_sec": round(sel_sec, 2),
+            "note": "selection on the validation slice inside the "
+                    "compiled scan (ops/als.py ALSValidation); test slice "
+                    "untouched until the single final score",
+        },
+        "config_ties": {
+            "note": ("this artifact's tuned config (rank, reg, solver, "
+                     "warm-CG schedule) IS the perf-benchmark config: "
+                     "bench.py runs rank 64, auto solver, warm schedule "
+                     "at the same ML-20M shape; eval/RANKING_EVAL.md's "
+                     "rank-16 grid winner is the small quickstart "
+                     "dataset's tuning, not this shape's")
+            if args.scale == "full" else
+            ("scaled-down run (--scale %s): shape and solver mirror the "
+             "bench's structure but NOT its size — config-tie claims "
+             "apply only to the full-scale artifact" % args.scale),
+            "bench_rank": 64, "this_rank": RANK,
+            "is_bench_shape": args.scale == "full",
+        },
         "final_rel_gap": round(final_gap, 6),
         "mean_baseline_rmse": round(mean_base, 5),
         "bias_baseline_rmse": round(bias_base, 5),
@@ -236,6 +284,9 @@ def main() -> int:
         "parity": final_gap < 0.01,   # one-sided: auto must not be worse
         "beats_baselines": quality,
     }
+    from pio_tpu.utils.tpu_health import telemetry
+
+    result["transport"] = telemetry()
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "RMSE_PARITY.json"), "w") as f:
         json.dump(result, f, indent=2)
@@ -272,7 +323,12 @@ def main() -> int:
         "",
         f"- Global-mean baseline RMSE: **{mean_base:.5f}**",
         f"- Damped user/item-bias baseline RMSE: **{bias_base:.5f}**",
-        f"- ALS final heldout RMSE: **{als_final:.5f}** "
+        f"- Best-sweep selection: sweep **{valinfo.best_sweep}/{SWEEPS}** "
+        f"by validation RMSE {valinfo.best_rmse:.5f} (validation curve "
+        f"tail {valinfo.final_rmse:.5f}); last-sweep test RMSE would be "
+        f"{cg_traj[-1]:.5f}",
+        f"- ALS heldout RMSE (best-sweep-selected model): "
+        f"**{als_final:.5f}** "
         f"({(1 - als_final / mean_base) * 100:.1f}% below mean baseline, "
         f"{(1 - als_final / bias_base) * 100:.1f}% below bias baseline) — "
         f"{'QUALITY OK' if quality else 'QUALITY FAIL'}",

@@ -160,7 +160,10 @@ def main() -> int:
             "qps_all": [r["qps"] for r in rs],
         })
     best = min(rows, key=lambda r: r["p99_ms"])
+    from pio_tpu.utils.tpu_health import telemetry
+
     out = {
+        "transport": telemetry(),
         "platform": device.platform,
         "device_kind": device.device_kind,
         "mode": "async + fixed 2ms window, batch_max 16, 16 clients, "

@@ -109,21 +109,36 @@ class ALSAlgorithmParams(Params):
     # warm-sweep schedule drops to cg_warm_iters after cg_warm_sweeps
     # full-strength sweeps (eval/ALS_ROOFLINE.md) — -1 disables
     cg_iters: int = -1
-    cg_warm_iters: int = 8
+    # 6 = the ops-layer ALSParams default, so the engine path runs the
+    # exact schedule the tuning grid (eval/CG_WARM_QUALITY.json) and the
+    # bench measured; override per-engine in engine.json if needed
+    cg_warm_iters: int = 6
     cg_warm_sweeps: int = 2
+    # > 0: hold out this fraction of interactions, score heldout RMSE
+    # after every sweep inside the training scan, and keep the BEST
+    # sweep's factors instead of the last (ops/als.py ALSValidation —
+    # measured on ML-20M the final sweep is ~4.6% worse than the curve
+    # minimum). 0 disables (exact reference behavior: last sweep wins).
+    validation_fraction: float = 0.0
 
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class RecommendationModel:
-    """ALS factors + id indexes (reference ALSModel.scala:18-47)."""
+    """ALS factors + id indexes (reference ALSModel.scala:18-47).
+
+    `validation` (aux, optional): the ALSValidation trajectory when the
+    algorithm trained with validation_fraction > 0 — surfaces the
+    per-sweep heldout curve + chosen sweep to eval artifacts and the
+    dashboard."""
 
     factors: als.ALSModel
     users: EntityIdIndex
     items: EntityIdIndex
+    validation: als.ALSValidation | None = None
 
     def tree_flatten(self):
-        return (self.factors,), (self.users, self.items)
+        return (self.factors,), (self.users, self.items, self.validation)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -157,16 +172,36 @@ class ALSAlgorithm(PAlgorithm):
     def train(self, ctx, data: Interactions) -> RecommendationModel:
         data.sanity_check()
         ap = self._als_params()
+        vf = self.params.validation_fraction
         if ctx.mesh is not None and ctx.mesh.devices.size > 1:
+            # sharded path: best-sweep selection not yet threaded through
+            # shard_map (the curve would need a psum'd heldout metric);
+            # last-sweep factors, as the reference always does
             factors = als.als_train_sharded(
                 data.user_idx, data.item_idx, data.values,
                 data.n_users, data.n_items, ap, ctx.mesh,
             )
-        else:
-            factors = als.als_train(
-                data.user_idx, data.item_idx, data.values,
+            return RecommendationModel(factors, data.users, data.items)
+        if vf > 0.0:
+            nnz = len(data.values)
+            n_val = max(1, int(nnz * vf))
+            if nnz < 10:
+                raise ValueError(
+                    "validation_fraction needs >=10 interactions")
+            rng = np.random.default_rng(ap.seed)
+            perm = rng.permutation(nnz)
+            va, tr = perm[:n_val], perm[n_val:]
+            factors, validation = als.als_train_validated(
+                data.user_idx[tr], data.item_idx[tr], data.values[tr],
                 data.n_users, data.n_items, ap,
+                data.user_idx[va], data.item_idx[va], data.values[va],
             )
+            return RecommendationModel(
+                factors, data.users, data.items, validation)
+        factors = als.als_train(
+            data.user_idx, data.item_idx, data.values,
+            data.n_users, data.n_items, ap,
+        )
         return RecommendationModel(factors, data.users, data.items)
 
     def predict(self, model: RecommendationModel, query: dict) -> dict:
@@ -286,7 +321,8 @@ class ALSAlgorithm(PAlgorithm):
             jax.device_put(model.factors.user_factors),
             jax.device_put(model.factors.item_factors),
         )
-        return RecommendationModel(factors, model.users, model.items)
+        return RecommendationModel(
+            factors, model.users, model.items, model.validation)
 
 
 class RecommendationEngine(EngineFactory):

@@ -148,7 +148,11 @@ class ALSParams:
     def resolved_accum(self) -> str:
         """The accumulation strategy that actually runs ("auto" resolves
         here, next to resolved_cg_iters, so callers — bench artifacts
-        included — can report the real mode, not the knob).
+        included — can report the real mode, not the knob). Rank-aware:
+        _normal_equations falls back hybrid->stacked above k=256 (the
+        segment-flush kernel's VMEM blocks exceed the 16 MB scoped
+        budget), and this mirror applies the same rule so artifacts
+        never report a mode that did not run.
 
         auto is per-backend: on TPU "hybrid" (XLA batched-MXU blocks +
         Pallas segment-flush scatter) measured 0.439 s/sweep at the
@@ -158,9 +162,35 @@ class ALSParams:
         (eval/ALS_ROOFLINE.md, eval/als_accum_bench.py). On CPU the
         Pallas kernel only exists in interpret mode, and carry measured
         fastest of the XLA paths, so carry stays."""
-        if self.accum != "auto":
-            return self.accum
-        return "hybrid" if _accelerator_backend() else "carry"
+        mode = self.accum
+        if mode == "auto":
+            mode = "hybrid" if _accelerator_backend() else "carry"
+        if mode == "hybrid" and self.rank > 256:
+            mode = "stacked"   # keep in sync with _normal_equations
+        return mode
+
+
+@dataclass(frozen=True)
+class ALSValidation:
+    """Per-sweep heldout trajectory from `als_train_validated`.
+
+    The reference's eval workflow picks the best PARAMS
+    (MetricEvaluator.scala:138-161) but always keeps the LAST sweep's
+    model; measured on ML-20M the heldout RMSE curve bottoms at sweep
+    2-3 and then climbs (eval/RMSE_PARITY.json: 0.568 at sweep 2 ->
+    0.594 at 10), so "final" silently commits the worst point on its
+    own curve. The TPU-idiomatic fix is best-sweep SELECTION inside the
+    compiled scan — data-dependent early exit is not expressible under
+    jit's static control flow, but tracking argmin factors as a scan
+    carry costs one factor copy (~42 MB at the ML-20M shape) and two
+    jnp.where selects per sweep, so the full schedule runs at
+    unchanged throughput and the returned model is the curve's
+    minimum, not its tail."""
+
+    curve: tuple          # heldout RMSE after each sweep, in order
+    best_sweep: int       # 1-based sweep index of the minimum
+    best_rmse: float
+    final_rmse: float     # last sweep's RMSE (what "no selection" returns)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -512,18 +542,21 @@ def _cg_schedule(params: ALSParams, cg_u: int, cg_i: int):
     return n_full, n_warm, w_u, w_i
 
 
-@partial(jax.jit, static_argnames=("n_users", "n_items", "params"))
-def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
-               user0, item0):
+def _build_layouts(u, i, v, n_users: int, n_items: int, params: ALSParams):
+    """Slot layouts for both halves + the chunk size actually used."""
     nnz = u.shape[0]
     cs = min(params.chunk_slots, _slots_for(nnz, 0, params.width, 1))
     su = _slots_for(nnz, n_users, params.width, cs)
     si = _slots_for(nnz, n_items, params.width, cs)
     by_user = _device_slot_layout(u, i, v, n_users, params.width, su)
     by_item = _device_slot_layout(i, u, v, n_items, params.width, si)
-    cg_u = params.resolved_cg_iters(n_users)
-    cg_i = params.resolved_cg_iters(n_items)
+    return by_user, by_item, cs
 
+
+def _sweep_factory(by_user, by_item, n_users: int, n_items: int, cs: int,
+                   params: ALSParams):
+    """-> sweep_with(cg_u_n, cg_i_n): the scan body shared by the plain,
+    validated, and layout-resident trainers."""
     def sweep_with(cg_u_n: int, cg_i_n: int):
         def sweep(carry, _):
             users, items = carry
@@ -541,6 +574,17 @@ def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
             )
             return (users, items), None
         return sweep
+    return sweep_with
+
+
+@partial(jax.jit, static_argnames=("n_users", "n_items", "params"))
+def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
+               user0, item0):
+    by_user, by_item, cs = _build_layouts(u, i, v, n_users, n_items, params)
+    cg_u = params.resolved_cg_iters(n_users)
+    cg_i = params.resolved_cg_iters(n_items)
+    sweep_with = _sweep_factory(by_user, by_item, n_users, n_items, cs,
+                                params)
 
     # two-phase schedule: full-strength CG while cold, cg_warm_iters once
     # the warm start carries most of the solution (see cg_warm_iters)
@@ -556,6 +600,57 @@ def _train_jit(u, i, v, n_users: int, n_items: int, params: ALSParams,
         )
     users, items = carry
     return users, items
+
+
+@partial(jax.jit, static_argnames=("n_users", "n_items", "params"))
+def _train_val_jit(u, i, v, vu, vi, vv, n_users: int, n_items: int,
+                   params: ALSParams, user0, item0):
+    """Training scan with per-sweep heldout RMSE + best-sweep tracking.
+
+    The heldout slice rides the scan as three fixed-shape device arrays;
+    after each sweep the carry keeps the argmin factors via two scalar-
+    predicate selects (see ALSValidation). Returns
+    (best_users, best_items, curve) with curve (iterations,) f32."""
+    by_user, by_item, cs = _build_layouts(u, i, v, n_users, n_items, params)
+    cg_u = params.resolved_cg_iters(n_users)
+    cg_i = params.resolved_cg_iters(n_items)
+    sweep_with = _sweep_factory(by_user, by_item, n_users, n_items, cs,
+                                params)
+
+    def val_sweep_with(cg_u_n: int, cg_i_n: int):
+        inner = sweep_with(cg_u_n, cg_i_n)
+
+        def sweep(carry, _):
+            (users, items), (bu, bi, br) = carry
+            (users, items), _ = inner((users, items), None)
+            pred = jnp.einsum(
+                "nk,nk->n", users[vu], items[vi],
+                preferred_element_type=jnp.float32,
+            )
+            r = jnp.sqrt(jnp.mean((pred - vv) ** 2))
+            better = r < br
+            bu = jnp.where(better, users, bu)
+            bi = jnp.where(better, items, bi)
+            br = jnp.where(better, r, br)
+            return ((users, items), (bu, bi, br)), r
+        return sweep
+
+    n_full, n_warm, w_u, w_i = _cg_schedule(params, cg_u, cg_i)
+    carry = ((user0, item0),
+             (user0, item0, jnp.array(jnp.inf, jnp.float32)))
+    curves = []
+    if n_full:
+        carry, c = jax.lax.scan(
+            val_sweep_with(cg_u, cg_i), carry, None, length=n_full
+        )
+        curves.append(c)
+    if n_warm:
+        carry, c = jax.lax.scan(
+            val_sweep_with(w_u, w_i), carry, None, length=n_warm
+        )
+        curves.append(c)
+    (_, _), (bu, bi, _) = carry
+    return bu, bi, jnp.concatenate(curves)
 
 
 def als_train(
@@ -577,6 +672,18 @@ def als_train(
     skip the host conversion/padding copies entirely (pad concatenation
     happens on device), so retrain loops that keep the COO arrays in HBM
     pay the host->device transfer once, not per call."""
+    u, i, v = _prep_coo(user_idx, item_idx, values, n_users, n_items, params)
+    user0, item0 = _init_or(init, n_users, n_items, params)
+    users, items = _train_jit(
+        u, i, v, n_users, n_items, params, user0, item0
+    )
+    return ALSModel(users, items)
+
+
+def _prep_coo(user_idx, item_idx, values, n_users, n_items,
+              params: ALSParams):
+    """Dtype-normalize + sentinel-pad the COO arrays (host numpy or
+    device jax arrays alike — device inputs never round-trip to host)."""
     on_device = isinstance(user_idx, jax.Array)
     if on_device:
         u = user_idx.astype(jnp.int32)
@@ -596,18 +703,48 @@ def als_train(
         u = xp.concatenate([u, xp.full(pad, n_users, xp.int32)])
         i = xp.concatenate([i, xp.full(pad, n_items, xp.int32)])
         v = xp.concatenate([v, xp.zeros(pad, xp.float32)])
+    return u, i, v
 
+
+def _init_or(init: ALSModel | None, n_users: int, n_items: int,
+             params: ALSParams):
     if init is not None:
-        user0, item0 = init.user_factors, init.item_factors
-    else:
-        key = jax.random.PRNGKey(params.seed)
-        ku, ki = jax.random.split(key)
-        user0 = init_factors(n_users, params.rank, ku)
-        item0 = init_factors(n_items, params.rank, ki)
-    users, items = _train_jit(
-        u, i, v, n_users, n_items, params, user0, item0
+        return init.user_factors, init.item_factors
+    key = jax.random.PRNGKey(params.seed)
+    ku, ki = jax.random.split(key)
+    return (init_factors(n_users, params.rank, ku),
+            init_factors(n_items, params.rank, ki))
+
+
+def als_train_validated(
+    user_idx, item_idx, values,
+    n_users: int, n_items: int, params: ALSParams,
+    val_user_idx, val_item_idx, val_values,
+    init: ALSModel | None = None,
+) -> tuple[ALSModel, ALSValidation]:
+    """Train with a heldout slice scored after every sweep; return the
+    BEST-sweep model plus the full trajectory (see ALSValidation — the
+    TPU-shaped replacement for early stopping). The heldout slice must
+    be disjoint from the training triples; for implicit models the
+    curve is RMSE of raw scores against the heldout values — a proxy
+    (ranking metrics are the real objective there), but a monotone
+    regression on it still flags overfit sweeps."""
+    u, i, v = _prep_coo(user_idx, item_idx, values, n_users, n_items, params)
+    vu = jnp.asarray(np.asarray(val_user_idx), jnp.int32)
+    vi = jnp.asarray(np.asarray(val_item_idx), jnp.int32)
+    vv = jnp.asarray(np.asarray(val_values), jnp.float32)
+    user0, item0 = _init_or(init, n_users, n_items, params)
+    bu, bi, curve = _train_val_jit(
+        u, i, v, vu, vi, vv, n_users, n_items, params, user0, item0
     )
-    return ALSModel(users, items)
+    curve_h = tuple(round(float(x), 6) for x in np.asarray(curve))
+    best_sweep = int(np.argmin(curve_h)) + 1
+    return ALSModel(bu, bi), ALSValidation(
+        curve=curve_h,
+        best_sweep=best_sweep,
+        best_rmse=curve_h[best_sweep - 1],
+        final_rmse=curve_h[-1],
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -305,13 +305,34 @@ class QueryServer:
     def _hedged(self, fn, *args):
         """Run fn on the predict pool; if it outlives the hedge timeout,
         race a duplicate and return whichever finishes first. fn must be
-        pure (device predict is), so the loser is discarded harmlessly."""
+        pure (device predict is), so the loser is discarded harmlessly.
+
+        The hedge clock starts when the task actually STARTS on a pool
+        worker, not at submit: under >pool-width concurrent dispatches,
+        queue wait would otherwise read as a "stall" and fire spurious
+        duplicates into the already-saturated pool (round-3 advisor
+        finding). If the task hasn't even started within the timeout,
+        the pool is saturated — a duplicate could only queue behind the
+        original, so hedging is skipped entirely."""
         timeout = self._hedge_timeout()
         if timeout is None:
             return fn(*args)
-        futs = [self._hedge_pool.submit(fn, *args)]
+        started = threading.Event()
+        t_start: list[float] = []
+
+        def wrapped(*a):
+            t_start.append(time.monotonic())
+            started.set()
+            return fn(*a)
+
+        futs = [self._hedge_pool.submit(wrapped, *args)]
+        if not started.wait(timeout):
+            # saturated pool: no worker picked the task up within the
+            # hedge window — duplicates add load without cutting latency
+            return futs[0].result()
         try:
-            return futs[0].result(timeout=timeout)
+            remaining = t_start[0] + timeout - time.monotonic()
+            return futs[0].result(timeout=max(0.0, remaining))
         except FuturesTimeoutError:
             with self._lock:
                 self.hedged_dispatches += 1

@@ -342,3 +342,111 @@ def test_cg_warm_schedule_sharded_matches_single():
     np.testing.assert_allclose(
         np.asarray(single.user_factors), np.asarray(sharded.user_factors),
         rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# best-sweep selection (als_train_validated)
+# ---------------------------------------------------------------------------
+
+def _noisy_split(seed=11, noise=0.8, n_users=70, n_items=45, rank=3):
+    """Low-rank signal + heavy noise so extra sweeps overfit, split
+    train/val/test."""
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_users, rank)) / np.sqrt(rank)
+    V = rng.normal(size=(n_items, rank)) / np.sqrt(rank)
+    R = U @ V.T + 3.0 + rng.normal(0, noise, (n_users, n_items))
+    mask = rng.random((n_users, n_items)) < 0.4
+    users, items = np.nonzero(mask)
+    vals = R[users, items].astype(np.float32)
+    perm = rng.permutation(len(vals))
+    n_va = len(vals) // 5
+    va, tr = perm[:n_va], perm[n_va:]
+    return (users[tr], items[tr], vals[tr],
+            users[va], items[va], vals[va], n_users, n_items)
+
+
+def test_validated_returns_best_sweep_not_last():
+    from pio_tpu.ops.als import als_train_validated
+
+    tu, ti, tv, vu, vi, vv, nu, ni = _noisy_split()
+    p = ALSParams(rank=8, iterations=12, reg=0.01, chunk=0, seed=5)
+    model, val = als_train_validated(tu, ti, tv, nu, ni, p, vu, vi, vv)
+    assert len(val.curve) == 12
+    assert val.best_sweep == int(np.argmin(val.curve)) + 1
+    assert val.best_rmse == min(val.curve)
+    assert val.final_rmse == val.curve[-1]
+    # the returned model must score the BEST sweep's RMSE on the heldout
+    got = rmse(model, vu, vi, vv)
+    assert abs(got - val.best_rmse) < 1e-4
+    # on this noisy problem the curve really does climb past its minimum
+    # (the scenario the selection exists for) — guard the fixture stays
+    # representative, not a tautology about the implementation
+    assert val.final_rmse > val.best_rmse
+
+
+def test_validated_matches_plain_train_when_last_is_best():
+    """With identical data/params, the validated trainer's LAST-sweep
+    trajectory must describe the same optimization as als_train — and
+    when sweep N is the minimum, the returned factors equal the plain
+    trainer's."""
+    from pio_tpu.ops.als import als_train_validated
+
+    users, items, vals, nu, ni = synthetic(seed=7)
+    # clean low-rank data: more sweeps keep improving, so last == best
+    p = ALSParams(rank=6, iterations=4, reg=0.05, chunk=0, seed=5)
+    # validate on a slice of TRAIN data (improvement is monotone there)
+    model_v, val = als_train_validated(
+        users, items, vals, nu, ni, p, users[:50], items[:50], vals[:50])
+    assert val.best_sweep == p.iterations, val.curve
+    plain = als_train(users, items, vals, nu, ni, p)
+    np.testing.assert_allclose(
+        np.asarray(model_v.user_factors), np.asarray(plain.user_factors),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_validated_respects_warm_schedule():
+    """The curve spans both phases of the warm-CG schedule (full + warm
+    scans concatenate)."""
+    from pio_tpu.ops.als import als_train_validated
+
+    tu, ti, tv, vu, vi, vv, nu, ni = _noisy_split(seed=3)
+    p = ALSParams(rank=8, iterations=6, reg=0.05, chunk=0, seed=5,
+                  cg_iters=8, cg_warm_iters=2, cg_warm_sweeps=2,
+                  auto_cg_rows=1)  # force CG so the schedule engages
+    _, val = als_train_validated(tu, ti, tv, nu, ni, p, vu, vi, vv)
+    assert len(val.curve) == 6
+
+
+def test_model_layer_validation_fraction():
+    """ALSAlgorithm with validation_fraction > 0 returns the best-sweep
+    model and surfaces the trajectory."""
+    from pio_tpu.data.bimap import EntityIdIndex
+    from pio_tpu.data.eventstore import Interactions
+    from pio_tpu.models.recommendation import (
+        ALSAlgorithm, ALSAlgorithmParams,
+    )
+
+    tu, ti, tv, vu, vi, vv, nu, ni = _noisy_split(seed=9)
+    users = np.concatenate([tu, vu])
+    items = np.concatenate([ti, vi])
+    vals = np.concatenate([tv, vv])
+    data = Interactions(
+        user_idx=users, item_idx=items, values=vals,
+        users=EntityIdIndex([f"u{k}" for k in range(nu)]),
+        items=EntityIdIndex([f"i{k}" for k in range(ni)]),
+    )
+
+    class Ctx:
+        mesh = None
+
+    algo = ALSAlgorithm(ALSAlgorithmParams(
+        rank=8, num_iterations=10, lambda_=0.01, chunk=0,
+        validation_fraction=0.2))
+    model = algo.train(Ctx(), data)
+    assert model.validation is not None
+    assert len(model.validation.curve) == 10
+    assert model.validation.best_rmse <= model.validation.final_rmse
+    # validation off -> no trajectory, exact reference behavior
+    algo0 = ALSAlgorithm(ALSAlgorithmParams(
+        rank=8, num_iterations=3, lambda_=0.01, chunk=0))
+    assert algo0.train(Ctx(), data).validation is None
