@@ -38,6 +38,7 @@ normal-equation blocks scatter-add into the same row system.
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 from functools import partial
@@ -490,7 +491,7 @@ def _cg_solve(A, b, x0, n_iter: int):
 def _solve_factors(layout, other_factors, n_self, reg, implicit, alpha,
                    chunk_slots, x0=None, cg_iters: int = 0,
                    bf16_gather: bool = False, accum: str = "auto",
-                   group_slots: int = 73728):
+                   group_slots: int = 73728, yty=None):
     A, b = _normal_equations(
         layout, other_factors, n_self, implicit, alpha, chunk_slots,
         bf16_gather=bf16_gather, accum=accum, group_slots=group_slots,
@@ -498,11 +499,16 @@ def _solve_factors(layout, other_factors, n_self, reg, implicit, alpha,
     k = other_factors.shape[1]
     eye = jnp.eye(k, dtype=jnp.float32)
     if implicit:
-        # shared Y^T Y term (confidence-1 part handled in accumulation)
-        yty = jnp.matmul(
-            other_factors.T, other_factors,
-            precision=jax.lax.Precision.HIGH,
-        )
+        # shared Y^T Y term (confidence-1 part handled in accumulation).
+        # The sharded trainer passes a psum-reduced `yty` built from the
+        # LOCAL opposing block: recomputing it from the gathered matrix
+        # would be O(n_dev) redundant FLOPs on every device (measured as
+        # the dominant super-linear term in eval/WEAK_SCALING.json)
+        if yty is None:
+            yty = jnp.matmul(
+                other_factors.T, other_factors,
+                precision=jax.lax.Precision.HIGH,
+            )
         A = A + yty[None, :, :]
     A = A + reg * eye[None, :, :]
     if cg_iters > 0:
@@ -756,6 +762,95 @@ def _block(n: int, n_dev: int) -> int:
     return math.ceil(n / n_dev)
 
 
+@functools.lru_cache(maxsize=64)
+def _sharded_train_fn(mesh: Mesh, ub: int, ib: int, su: int, si: int,
+                      cs: int, params: ALSParams):
+    """Compiled sharded-train program, cached on its static config.
+
+    Building the shard_map closure inside als_train_sharded made every
+    retrain call re-trace the whole program (~13 s of fixed cost per
+    call on an 8-virtual-device CPU mesh — measured while building
+    eval/weak_scaling.py); Mesh and the frozen ALSParams are hashable,
+    so the program is constructed once per (mesh, shapes, params) and
+    jit keeps the executable across calls."""
+    dev_spec = P(DATA_AXIS)  # leading axis = device blocks
+    # each device solves its LOCAL block of rows, so the auto exact-vs-CG
+    # decision keys on the per-device batch size
+    cg_u = params.resolved_cg_iters(ub)
+    cg_i = params.resolved_cg_iters(ib)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(dev_spec,) * 8,
+        out_specs=dev_spec,
+        check_vma=False,
+    )
+    def run(u_r, u_c, u_v, i_r, i_c, i_v, u0, i0):
+        by_user = _device_slot_layout(
+            u_r[0], u_c[0], u_v[0], ub, params.width, su
+        )
+        by_item = _device_slot_layout(
+            i_r[0], i_c[0], i_v[0], ib, params.width, si
+        )
+
+        def gram_psum(block):
+            """Y^T Y of the full factor matrix from the LOCAL block:
+            per-device (b,k)x(k,b) matmul + one (k,k) psum over ICI —
+            O(1) per device instead of the O(n_dev) every device would
+            pay recomputing it from the gathered matrix."""
+            g = jnp.matmul(block.T, block,
+                           precision=jax.lax.Precision.HIGH)
+            return jax.lax.psum(g, DATA_AXIS)
+
+        def sweep_with(cg_u_n: int, cg_i_n: int):
+            def sweep(carry, _):
+                users, items = carry  # local blocks (ub, k) / (ib, k)
+                yty_i = gram_psum(items) if params.implicit else None
+                all_items = jax.lax.all_gather(
+                    items, DATA_AXIS, tiled=True
+                )  # (ib*n_dev, k)
+                users = _solve_factors(
+                    by_user, all_items, ub,
+                    params.reg, params.implicit, params.alpha, cs,
+                    x0=users, cg_iters=cg_u_n,
+                    bf16_gather=params.bf16_gather,
+                    accum=params.accum, group_slots=params.group_slots,
+                    yty=yty_i,
+                )
+                yty_u = gram_psum(users) if params.implicit else None
+                all_users = jax.lax.all_gather(
+                    users, DATA_AXIS, tiled=True
+                )
+                items = _solve_factors(
+                    by_item, all_users, ib,
+                    params.reg, params.implicit, params.alpha, cs,
+                    x0=items, cg_iters=cg_i_n,
+                    bf16_gather=params.bf16_gather,
+                    accum=params.accum, group_slots=params.group_slots,
+                    yty=yty_u,
+                )
+                return (users, items), None
+            return sweep
+
+        # same two-phase warm-CG schedule as _train_jit so the sharded
+        # path is numerically aligned with the single-device one
+        n_full, n_warm, w_u, w_i = _cg_schedule(params, cg_u, cg_i)
+        carry = (u0[0], i0[0])
+        if n_full:
+            carry, _ = jax.lax.scan(
+                sweep_with(cg_u, cg_i), carry, None, length=n_full
+            )
+        if n_warm:
+            carry, _ = jax.lax.scan(
+                sweep_with(w_u, w_i), carry, None, length=n_warm
+            )
+        users, items = carry
+        return users[None], items[None]
+
+    return jax.jit(run)
+
+
 def als_train_sharded(
     user_idx: np.ndarray,
     item_idx: np.ndarray,
@@ -816,69 +911,16 @@ def als_train_sharded(
     cs = min(params.chunk_slots, _slots_for(max(u_nnz, i_nnz), 0, params.width, 1))
     su = _slots_for(u_nnz, ub, params.width, cs)
     si = _slots_for(i_nnz, ib, params.width, cs)
-    # each device solves its LOCAL block of rows, so the auto exact-vs-CG
-    # decision keys on the per-device batch size
-    cg_u = params.resolved_cg_iters(ub)
-    cg_i = params.resolved_cg_iters(ib)
 
-    dev_spec = P(DATA_AXIS)  # leading axis = device blocks
+    # cache key: only program-relevant fields — seed and chunk are
+    # host-side (init RNG / padding quantum) and chunk_slots is already
+    # folded into cs, so varying them must not re-trace
+    import dataclasses
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(dev_spec,) * 8,
-        out_specs=dev_spec,
-        check_vma=False,
-    )
-    def run(u_r, u_c, u_v, i_r, i_c, i_v, u0, i0):
-        by_user = _device_slot_layout(
-            u_r[0], u_c[0], u_v[0], ub, params.width, su
-        )
-        by_item = _device_slot_layout(
-            i_r[0], i_c[0], i_v[0], ib, params.width, si
-        )
-
-        def sweep_with(cg_u_n: int, cg_i_n: int):
-            def sweep(carry, _):
-                users, items = carry  # local blocks (ub, k) / (ib, k)
-                all_items = jax.lax.all_gather(
-                    items, DATA_AXIS, tiled=True
-                )  # (ib*n_dev, k)
-                users = _solve_factors(
-                    by_user, all_items, ub,
-                    params.reg, params.implicit, params.alpha, cs,
-                    x0=users, cg_iters=cg_u_n,
-                    bf16_gather=params.bf16_gather,
-                    accum=params.accum, group_slots=params.group_slots,
-                )
-                all_users = jax.lax.all_gather(
-                    users, DATA_AXIS, tiled=True
-                )
-                items = _solve_factors(
-                    by_item, all_users, ib,
-                    params.reg, params.implicit, params.alpha, cs,
-                    x0=items, cg_iters=cg_i_n,
-                    bf16_gather=params.bf16_gather,
-                    accum=params.accum, group_slots=params.group_slots,
-                )
-                return (users, items), None
-            return sweep
-
-        # same two-phase warm-CG schedule as _train_jit so the sharded
-        # path is numerically aligned with the single-device one
-        n_full, n_warm, w_u, w_i = _cg_schedule(params, cg_u, cg_i)
-        carry = (u0[0], i0[0])
-        if n_full:
-            carry, _ = jax.lax.scan(
-                sweep_with(cg_u, cg_i), carry, None, length=n_full
-            )
-        if n_warm:
-            carry, _ = jax.lax.scan(
-                sweep_with(w_u, w_i), carry, None, length=n_warm
-            )
-        users, items = carry
-        return users[None], items[None]
-
+    key_params = dataclasses.replace(params, seed=0, chunk=0,
+                                     chunk_slots=cs)
+    run = _sharded_train_fn(mesh, ub, ib, su, si, cs, key_params)
+    dev_spec = P(DATA_AXIS)
     sharding = NamedSharding(mesh, dev_spec)
     put = lambda a: jax.device_put(a, sharding)  # noqa: E731
     users, items = run(
