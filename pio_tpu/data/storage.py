@@ -94,6 +94,8 @@ _BACKEND_REGISTRY: dict[str, str] = {
     # standard networked multi-writer DB (reference JDBC/PostgreSQL role)
     "postgres": "pio_tpu.data.backends.postgres:PostgresBackend",
     "postgresql": "pio_tpu.data.backends.postgres:PostgresBackend",
+    # second JDBC dialect, per the reference's StorageClient.scala:29-46
+    "mysql": "pio_tpu.data.backends.mysql:MySQLBackend",
 }
 
 
