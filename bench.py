@@ -142,16 +142,30 @@ def synth(nnz: int, n_users: int = None, n_items: int = None, seed=0):
     return users, items, vals
 
 
+def bench_params(iters: int, rank: int = None, chunk: int = None):
+    from pio_tpu.ops.als import ALSParams
+
+    # cg_iters pinned to the full-shape auto choice (16 at rank 64) so
+    # the scaled-down CPU proxy runs the SAME solver as the TPU shape
+    # (auto would flip the small proxy to exact Cholesky and turn
+    # vs_baseline into a cross-algorithm ratio)
+    return ALSParams(rank=rank or RANK, iterations=iters, reg=0.05,
+                     alpha=10.0, implicit=True, chunk=chunk or CHUNK,
+                     cg_iters=ALSParams(rank=rank or RANK)
+                     .resolved_cg_iters(N_USERS))
+
+
 def run_als(users, items, vals, iters: int,
             n_users: int = None, n_items: int = None,
-            rank: int = None, chunk: int = None, repeats: int = 3) -> float:
+            rank: int = None, chunk: int = None, repeats: int = 3,
+            layouts=None) -> float:
     """-> best wall seconds for `iters` sweeps over `repeats` runs, compile
     excluded (the warm-up runs the exact same program: iterations is a
     static scan length). Best-of-N because the tunneled device shows
-    +-0.3s run-to-run noise that would otherwise swamp per-sweep deltas."""
-    import jax
-
-    from pio_tpu.ops.als import ALSParams, als_train
+    +-0.3s run-to-run noise that would otherwise swamp per-sweep deltas.
+    With `layouts` (ops/als.py ALSLayouts) the runs measure the RETRAIN
+    path: slot layouts resident in HBM, no per-call rebuild."""
+    from pio_tpu.ops.als import als_train
 
     n_users = n_users or N_USERS
     n_items = n_items or N_ITEMS
@@ -159,15 +173,9 @@ def run_als(users, items, vals, iters: int,
     import jax.numpy as jnp
 
     def go():
-        # cg_iters pinned to the full-shape auto choice (16 at rank 64) so
-        # the scaled-down CPU proxy runs the SAME solver as the TPU shape
-        # (auto would flip the small proxy to exact Cholesky and turn
-        # vs_baseline into a cross-algorithm ratio)
-        p = ALSParams(rank=rank or RANK, iterations=iters, reg=0.05,
-                      alpha=10.0, implicit=True, chunk=chunk or CHUNK,
-                      cg_iters=ALSParams(rank=rank or RANK)
-                      .resolved_cg_iters(N_USERS))
-        model = als_train(users, items, vals, n_users, n_items, p)
+        p = bench_params(iters, rank, chunk)
+        model = als_train(users, items, vals, n_users, n_items, p,
+                          layouts=layouts)
         # a scalar READBACK, not block_until_ready: on the tunneled axon
         # backend block_until_ready returns before the execution finishes
         # (measured: identical program 1.2s "blocked" vs 24s to readback),
@@ -177,7 +185,7 @@ def run_als(users, items, vals, iters: int,
 
     go()  # compile (identical program: same static iterations)
     best = float("inf")
-    for _ in range(max(1, repeats)):
+    for _ in range(max(0, repeats)):   # repeats=0: warm-up/compile only
         t0 = time.monotonic()
         go()
         best = min(best, time.monotonic() - t0)
@@ -240,26 +248,71 @@ def phase_train() -> dict:
 
     float(jnp.sum(jax.device_put(np.ones(8))))  # backend up
     trail.stage("backend_up")
-    t0 = time.monotonic()
-    dev = [jax.device_put(x) for x in host]
-    # scalar readback touching ALL THREE columns: device_put is async and
-    # a fence on one array creates no dependency on the others — with the
-    # uint8 value column at 1/9 of the wire bytes, fencing it alone could
-    # stop the clock while the id columns are still in flight
+
+    from pio_tpu.ops.als import als_build_layouts
+
+    # ---- cold-start overlap: warm-up compiles run WHILE the COO columns
+    # are in flight. The compile of the layout+train programs (~20-40 s
+    # through the tunnel, milliseconds of dispatch to start) completely
+    # hides the ~4 s transfer, so a cold first train pays
+    # max(compile, transfer), not their sum. Warm-up runs on
+    # device-created zeros of the exact padded shapes (no host bytes).
+    t_put = time.monotonic()
+    dev = [jax.device_put(x) for x in host]          # async
+    nnz_pad0 = nnz + (-nnz % max(1, CHUNK))
+    zu = jnp.zeros((nnz_pad0,), jnp.int32)
+    zi = jnp.zeros((nnz_pad0,), jnp.int32)
+    zv = jnp.zeros((nnz_pad0,), jnp.float32)
+    p_w = bench_params(iters)
+    warm_lay = als_build_layouts(zu, zi, zv, n_users, n_items, p_w)
+    run_als(zu, zi, zv, iters, n_users=n_users, n_items=n_items,
+            layouts=warm_lay, repeats=0)
+    run_als(zu, zi, zv, 1, n_users=n_users, n_items=n_items,
+            layouts=warm_lay, repeats=0)
+    # pre-warm the fence expression at the real columns' shapes/dtypes so
+    # its own compile doesn't pollute the exposed-transfer measurement
+    fz = [jnp.zeros(h.shape, h.dtype) for h in host]
+    float(jnp.sum(fz[0]) + jnp.sum(fz[1])
+          + jnp.sum(fz[2].astype(jnp.float32)))
+    warm_s = time.monotonic() - t_put
+    del warm_lay, zu, zi, zv, fz
+    # fence: scalar readback touching ALL THREE columns — device_put is
+    # async and a fence on one array creates no dependency on the others
     float(jnp.sum(dev[0]) + jnp.sum(dev[1])
           + jnp.sum(dev[2].astype(jnp.float32)))
+    exposed_transfer_s = max(time.monotonic() - t_put - warm_s, 0.0)
+    # raw (un-overlapped) transfer, for cross-round comparability: the
+    # same host bytes again, fully fenced, nothing else in flight
+    t0 = time.monotonic()
+    dev2 = [jax.device_put(x) for x in host]
+    float(jnp.sum(dev2[0]) + jnp.sum(dev2[1])
+          + jnp.sum(dev2[2].astype(jnp.float32)))
     transfer_s = time.monotonic() - t0
-    trail.stage("transfer_done", transfer_sec=round(transfer_s, 2))
+    del dev2
+    trail.stage("transfer_done", transfer_sec=round(transfer_s, 2),
+                exposed_after_overlap=round(exposed_transfer_s, 2))
     d_users, d_items, d_vals = dev
 
+    # ---- layout build, measured directly (persisted across retrains)
+    t0 = time.monotonic()
+    lay = als_build_layouts(d_users, d_items, d_vals, n_users, n_items,
+                            bench_params(iters))
+    float(jnp.sum(lay.by_user[3]) + jnp.sum(lay.by_item[3]))
+    layout_s = time.monotonic() - t0
+    trail.stage("layout_done", layout_sec=round(layout_s, 2))
+
     dt = run_als(d_users, d_items, d_vals, iters,
-                 n_users=n_users, n_items=n_items)
+                 n_users=n_users, n_items=n_items, layouts=lay)
     trail.stage("train_done", train_sec=round(dt, 2))
-    rate = nnz * iters / (dt + transfer_s)   # end-to-end, incl. transfer
-    # split the one-time on-device slot-layout build from the per-sweep
-    # math with a 1-sweep run
+    # end-to-end first train: transfer + layout build + sweeps (compile
+    # excluded as before; with the overlap above a cold session hides the
+    # transfer under it anyway)
+    rate = nnz * iters / (dt + transfer_s + layout_s)
+    # the RETRAIN loop (device-resident COO + persisted layouts — the
+    # analogue of MLlib iterating on a cached RDD): sweeps only
+    retrain_rate = nnz * iters / dt
     dt1 = run_als(d_users, d_items, d_vals, 1,
-                  n_users=n_users, n_items=n_items)
+                  n_users=n_users, n_items=n_items, layouts=lay)
     # None when noise makes the split meaningless (dt <= dt1): garbage
     # rates must not masquerade as measurements
     sweep_s = (dt - dt1) / max(iters - 1, 1) if dt > dt1 else None
@@ -291,11 +344,18 @@ def phase_train() -> dict:
     split_ok = sweep_s is not None
     return {
         "rate": rate,
-        "wall_sec": round(dt + transfer_s, 3),
+        "retrain_rate": round(retrain_rate, 1),
+        "wall_sec": round(dt + transfer_s + layout_s, 3),
         "nnz": nnz,
         "sweeps": iters,
         "transfer_sec": round(transfer_s, 3),
-        "fixed_layout_sec": round(max(dt1 - sweep_s, 0.0), 3)
+        "exposed_transfer_after_overlap_sec": round(exposed_transfer_s, 3),
+        "warmup_compile_sec": round(warm_s, 3),
+        # DIRECTLY measured now (als_build_layouts, persisted across the
+        # timed retrain runs) — rounds 1-3 inferred it from the
+        # dt(N)-dt(1) split
+        "fixed_layout_sec": round(layout_s, 3),
+        "retrain_residual_sec": round(max(dt1 - sweep_s, 0.0), 3)
         if split_ok else None,
         "per_sweep_sec": round(sweep_s, 4) if split_ok else None,
         "per_sweep_rate": round(nnz / sweep_s, 1) if split_ok else None,
@@ -808,11 +868,14 @@ def main() -> int:
             value = round(train["rate"], 1)
             extra["train"] = {
                 k: train[k] for k in
-                ("wall_sec", "nnz", "sweeps", "transfer_sec",
-                 "fixed_layout_sec",
+                ("retrain_rate", "wall_sec", "nnz", "sweeps",
+                 "transfer_sec", "exposed_transfer_after_overlap_sec",
+                 "warmup_compile_sec", "fixed_layout_sec",
+                 "retrain_residual_sec",
                  "per_sweep_sec", "per_sweep_rate", "flops_per_sweep",
                  "flops_per_sec", "mfu_vs_bf16_peak",
-                 "sweep_mfu_vs_bf16_peak", "rank", "cg_iters", "accum")
+                 "sweep_mfu_vs_bf16_peak", "rank", "cg_iters",
+                 "cg_warm_iters", "cg_full_sweeps", "accum")
                 if k in train
             }
         elif err:

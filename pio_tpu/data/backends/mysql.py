@@ -86,22 +86,18 @@ class _MyDb:
     """sqlcommon.SqlDb over a MyPool (per-thread connections)."""
 
     nullsafe = "<=>"
+    # KEY is a reserved word in MySQL: the shared DAO bodies spell the
+    # access_keys column via this hook (sqlcommon.SqlDb.key_col)
+    key_col = "`key`"
 
     def __init__(self, pool: MyPool):
         self._pool = pool
 
-    @staticmethod
-    def _quote_cols(sql: str) -> str:
-        # `key` is reserved in MySQL; the shared DAO SQL names the
-        # access_keys column bare
-        return sql.replace(" key=?", " `key`=?").replace(
-            "(key,", "(`key`,").replace(" key,", " `key`,")
-
     def exec(self, sql: str, params: tuple = ()) -> int:
-        return self._pool.execute(self._quote_cols(sql), params).rowcount
+        return self._pool.execute(sql, params).rowcount
 
     def query(self, sql: str, params: tuple = ()) -> list[tuple]:
-        return self._pool.execute(self._quote_cols(sql), params).rows
+        return self._pool.execute(sql, params).rows
 
     def insert_auto_id(self, table, cols, params):
         sql = (

@@ -35,6 +35,10 @@ class SqlDb(Protocol):
     """What a relational driver provides to the shared DAO bodies."""
 
     nullsafe: str                      # e.g. "IS" / "IS NOT DISTINCT FROM"
+    # how the access_keys key column is spelled in SQL: "key" is a
+    # reserved word in MySQL, so its driver quotes it as `key`; sqlite
+    # and postgres use it bare
+    key_col: str
 
     def exec(self, sql: str, params: tuple = ()) -> int:
         """Run a write; -> affected rowcount."""
@@ -115,11 +119,13 @@ class SqlApps(d.AppsDAO):
 class SqlAccessKeys(d.AccessKeysDAO):
     def __init__(self, db: SqlDb):
         self.db = db
+        self.kc = getattr(db, "key_col", "key")
 
     def insert(self, k: d.AccessKey):
         key = k.key or self.generate_key()
         ok = self.db.try_exec(
-            "INSERT INTO access_keys (key, appid, events) VALUES (?,?,?)",
+            f"INSERT INTO access_keys ({self.kc}, appid, events) "
+            "VALUES (?,?,?)",
             (key, k.appid, json.dumps(list(k.events))),
         )
         return key if ok else None
@@ -129,27 +135,29 @@ class SqlAccessKeys(d.AccessKeysDAO):
 
     def get(self, key):
         rows = self.db.query(
-            "SELECT key, appid, events FROM access_keys WHERE key=?", (key,)
+            f"SELECT {self.kc}, appid, events FROM access_keys "
+            f"WHERE {self.kc}=?", (key,)
         )
         return self._row(rows[0]) if rows else None
 
     def get_all(self):
         return [self._row(r) for r in self.db.query(
-            "SELECT key, appid, events FROM access_keys")]
+            f"SELECT {self.kc}, appid, events FROM access_keys")]
 
     def get_by_appid(self, appid):
         return [self._row(r) for r in self.db.query(
-            "SELECT key, appid, events FROM access_keys WHERE appid=?",
-            (appid,))]
+            f"SELECT {self.kc}, appid, events FROM access_keys "
+            "WHERE appid=?", (appid,))]
 
     def update(self, k):
         self.db.exec(
-            "UPDATE access_keys SET appid=?, events=? WHERE key=?",
+            f"UPDATE access_keys SET appid=?, events=? WHERE {self.kc}=?",
             (k.appid, json.dumps(list(k.events)), k.key),
         )
 
     def delete(self, key):
-        self.db.exec("DELETE FROM access_keys WHERE key=?", (key,))
+        self.db.exec(
+            f"DELETE FROM access_keys WHERE {self.kc}=?", (key,))
 
 
 class SqlChannels(d.ChannelsDAO):

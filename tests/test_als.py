@@ -450,3 +450,40 @@ def test_model_layer_validation_fraction():
     algo0 = ALSAlgorithm(ALSAlgorithmParams(
         rank=8, num_iterations=3, lambda_=0.01, chunk=0))
     assert algo0.train(Ctx(), data).validation is None
+
+
+def test_layout_reuse_matches_fused_train():
+    """als_train(layouts=...) must produce exactly what the fused path
+    produces (same ops, same schedule — only the build location moves),
+    and continuation calls through the same layouts must keep working."""
+    from pio_tpu.ops.als import als_build_layouts
+
+    users, items, vals, nu, ni = synthetic(seed=13)
+    p = ALSParams(rank=6, iterations=4, reg=0.05, chunk=0, seed=5)
+    fused = als_train(users, items, vals, nu, ni, p)
+    lay = als_build_layouts(users, items, vals, nu, ni, p)
+    reused = als_train(users, items, vals, nu, ni, p, layouts=lay)
+    np.testing.assert_allclose(
+        np.asarray(fused.user_factors), np.asarray(reused.user_factors),
+        rtol=1e-6, atol=1e-7)
+    # trajectory-style continuation: 4 sweeps == 2+2 via init warm start
+    p1 = ALSParams(rank=6, iterations=2, reg=0.05, chunk=0, seed=5,
+                   cg_warm_iters=-1)
+    m = als_train(users, items, vals, nu, ni, p1, layouts=lay)
+    m = als_train(users, items, vals, nu, ni, p1, init=m, layouts=lay)
+    p4 = ALSParams(rank=6, iterations=4, reg=0.05, chunk=0, seed=5,
+                   cg_warm_iters=-1)
+    whole = als_train(users, items, vals, nu, ni, p4, layouts=lay)
+    np.testing.assert_allclose(
+        np.asarray(m.user_factors), np.asarray(whole.user_factors),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_layout_reuse_shape_guard():
+    from pio_tpu.ops.als import als_build_layouts
+
+    users, items, vals, nu, ni = synthetic(seed=2)
+    p = ALSParams(rank=4, iterations=1, chunk=0)
+    lay = als_build_layouts(users, items, vals, nu, ni, p)
+    with pytest.raises(ValueError, match="layouts built for shape"):
+        als_train(users, items, vals, nu + 1, ni, p, layouts=lay)

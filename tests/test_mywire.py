@@ -73,20 +73,24 @@ def text_row(*vals: bytes | None) -> bytes:
 
 
 class FakeMy:
-    """One-connection scripted MySQL server. Verifies the client's auth
-    token server-side; `handler(sql)` -> list of response payloads."""
+    """Scripted MySQL server (accepts `max_conns` sequential or
+    concurrent connections). Verifies the client's auth token
+    server-side; `handler(sql)` -> list of response payloads."""
 
     def __init__(self, plugin="mysql_native_password", password="sekret",
-                 handler=None):
+                 handler=None, max_conns=1):
         self.plugin = plugin
         self.password = password
         self.handler = handler or (lambda sql: [ok_packet()])
         self.seen: list[str] = []
         self.auth_ok: bool | None = None
         self.client_db: str | None = None
+        self.n_conns = 0
+        self._lock = threading.Lock()
         self.srv = socket.create_server(("127.0.0.1", 0))
         self.port = self.srv.getsockname()[1]
-        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread = threading.Thread(
+            target=self._run, args=(max_conns,), daemon=True)
         self.thread.start()
 
     def dsn(self, password=None, database="pio") -> MyDSN:
@@ -94,32 +98,43 @@ class FakeMy:
                      password=self.password if password is None else password,
                      database=database)
 
-    _buf = b""
-
-    def _recv_exact(self, c, n):
-        while len(self._buf) < n:
+    def _recv_exact(self, c, buf, n):
+        while len(buf[0]) < n:
             chunk = c.recv(65536)
             if not chunk:
                 raise ConnectionError("client gone")
-            self._buf += chunk
-        out, self._buf = self._buf[:n], self._buf[n:]
+            buf[0] += chunk
+        out, buf[0] = buf[0][:n], buf[0][n:]
         return out
 
-    def _read_packet(self, c) -> tuple[int, bytes]:
-        head = self._recv_exact(c, 4)
+    def _read_packet(self, c, buf) -> tuple[int, bytes]:
+        head = self._recv_exact(c, buf, 4)
         ln = int.from_bytes(head[:3], "little")
-        return head[3], self._recv_exact(c, ln)
+        return head[3], self._recv_exact(c, buf, ln)
 
-    def _run(self):
+    def _run(self, max_conns):
+        threads = []
+        for _ in range(max_conns):
+            try:
+                c, _ = self.srv.accept()
+            except OSError:
+                return
+            with self._lock:
+                self.n_conns += 1
+            t = threading.Thread(target=self._one, args=(c,), daemon=True)
+            t.start()
+            threads.append(t)
+
+    def _one(self, c):
+        buf = [b""]
         try:
-            c, _ = self.srv.accept()
             with c:
-                self._handshake(c)
-                self._serve(c)
+                self._handshake(c, buf)
+                self._serve(c, buf)
         except (ConnectionError, OSError):
             pass
 
-    def _handshake(self, c):
+    def _handshake(self, c, buf):
         greet = (
             bytes([10]) + b"8.0.99-fake\x00"
             + struct.pack("<I", 7) + NONCE[:8] + b"\x00"
@@ -131,7 +146,7 @@ class FakeMy:
             + self.plugin.encode() + b"\x00"
         )
         c.sendall(packet(0, greet))
-        _seq, resp = self._read_packet(c)
+        _seq, resp = self._read_packet(c, buf)
         # HandshakeResponse41: caps(4) maxpkt(4) charset(1) filler(23)
         off = 32
         end = resp.index(0, off)
@@ -159,9 +174,9 @@ class FakeMy:
         else:
             c.sendall(packet(2, ok_packet()))
 
-    def _serve(self, c):
+    def _serve(self, c, buf):
         while True:
-            _seq, pkt = self._read_packet(c)
+            _seq, pkt = self._read_packet(c, buf)
             if pkt[:1] == b"\x01":                 # COM_QUIT
                 return
             if pkt[:1] == b"\x0e":                 # COM_PING
@@ -172,7 +187,8 @@ class FakeMy:
                     1064, "42000", "unsupported command")))
                 continue
             sql = pkt[1:].decode()
-            self.seen.append(sql)
+            with self._lock:
+                self.seen.append(sql)
             for n, payload in enumerate(self.handler(sql)):
                 c.sendall(packet(1 + n, payload))
 
@@ -282,17 +298,37 @@ def test_err_packet_maps_dup_entry():
     conn.close()
 
 
-def test_ping_and_pool_per_thread():
-    calls = []
-
-    def handler(sql):
-        calls.append(sql)
-        return [ok_packet()]
-
-    srv = FakeMy(handler=handler)
+def test_ping():
+    srv = FakeMy()
     conn = MyConnection(srv.dsn())
     assert conn.ping() is True
     conn.close()
+
+
+def test_pool_hands_one_connection_per_thread():
+    """MyPool's concurrency contract: each thread gets its own
+    connection (the DAO layer is called from server handler pools), and
+    queries from N threads land over N distinct sockets."""
+    srv = FakeMy(max_conns=5, handler=lambda sql: [ok_packet()])
+    pool = MyPool(srv.dsn())           # main thread's connection
+    errs = []
+
+    def worker(n):
+        try:
+            pool.execute(f"SELECT {10 + n}")
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert srv.n_conns == 5            # 1 main + 4 workers
+    assert sorted(s for s in srv.seen if s != "SELECT 1") == [
+        f"SELECT {10 + n}" for n in range(4)]
+    pool.close()
 
 
 def test_unsupported_plugin_raises():
@@ -325,13 +361,17 @@ def test_dialect_upsert_and_quoting():
     sql = db.upsert_sql("models", ("id", "models"), ("id",))
     assert sql == ("INSERT INTO models (id,models) VALUES (?,?) "
                    "ON DUPLICATE KEY UPDATE models=VALUES(models)")
-    # reserved-word column quoting on the access_keys statements
-    db.exec("INSERT INTO access_keys (key, appid, events) VALUES (?,?,?)",
-            ("K", 1, "[]"))
-    assert db._pool.seen[-1].startswith(
-        "INSERT INTO access_keys (`key`, appid, events)")
-    db.query("SELECT key, appid, events FROM access_keys WHERE key=?",
-             ("K",))
+    # reserved-word column: the shared DAO bodies spell it via key_col
+    assert db.key_col == "`key`"
+    from pio_tpu.data.backends.sqlcommon import SqlAccessKeys
+
+    ak = SqlAccessKeys(db)
+    ak.insert(__import__("pio_tpu.data.dao", fromlist=["AccessKey"])
+              .AccessKey("K", 1, ()))
+    assert db._pool.seen[-1] == (
+        "INSERT INTO access_keys (`key`, appid, events) "
+        "VALUES ('K',1,'[]')")
+    ak.get("K")
     assert db._pool.seen[-1] == (
         "SELECT `key`, appid, events FROM access_keys WHERE `key`='K'")
     assert db.insert_auto_id("apps", ("name",), ("x",)) == 5
