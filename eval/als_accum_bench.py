@@ -55,6 +55,13 @@ CELLS = [
     # XLA batched-MXU blocks + Pallas segment-flush scatter — auto's TPU
     # pick since round 3 (beats the XLA scatter emitter by ~10%/sweep)
     {"accum": "hybrid", "chunk_slots": 32768},
+    # round-4 gather A/B: the slot gather is the second-largest sweep
+    # term (119 ms) and the small (items) table takes XLA's slow-emitter
+    # path (the 16 MB codegen cliff, eval/ALS_ROOFLINE.md); these cells
+    # time the VMEM-resident Pallas gather variants against it at the
+    # production accum. ALSParams.gather "auto" flips on a win here.
+    {"accum": "hybrid", "chunk_slots": 32768, "gather": "pallas-copy"},
+    {"accum": "hybrid", "chunk_slots": 32768, "gather": "pallas-take"},
 ]
 
 

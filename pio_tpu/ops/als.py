@@ -119,6 +119,17 @@ class ALSParams:
     # stacked mode: max slots whose (k,k) blocks are materialized at once;
     # temp bytes = group_slots * k * k * 4 (73k slots @ k=64 = 1.2 GB)
     group_slots: int = 73728
+    # slot-gather implementation for the normal-equation build:
+    #   "xla":         the plain src[idx] gather (XLA emitter);
+    #   "pallas-copy" / "pallas-take": VMEM-resident Pallas gather
+    #       (ops/als_pallas.py gather_rows_pallas) — XLA's emitter runs
+    #       ~10x off HBM peak for VMEM-sized tables and the decision is
+    #       out of reach from JAX (eval/ALS_ROOFLINE.md); applied only
+    #       when the table fits GATHER_VMEM_TABLE_BUDGET, XLA otherwise;
+    #   "auto":        currently "xla" — the Pallas variants are
+    #       interpret-mode-validated; flips only when the on-hardware
+    #       A/B (eval/als_accum_bench.py gather cells) shows a win
+    gather: str = "auto"
 
     def resolved_cg_iters(self, n_self: int | None = None) -> int:
         """-1 (default) = auto, decided per factor side by its row count:
@@ -290,14 +301,42 @@ def _device_slot_layout(u, o, v, n_self: int, width: int, slots_max: int):
     return rows, idx, val, lens
 
 
-def _chunk_blocks(src, i_c, v_c, l_c, implicit: bool, alpha: float):
+def _gather_pow2_rows(m: int, cap: int = 1024) -> int:
+    """Largest power of two <= cap dividing m (pallas grid step size)."""
+    r = 1
+    while r < cap and m % (r * 2) == 0:
+        r *= 2
+    return r
+
+
+def _chunk_blocks(src, i_c, v_c, l_c, implicit: bool, alpha: float,
+                  gather: str = "xla"):
     """One slot chunk -> per-slot normal-equation blocks
     a_blk (C,k,k), b_blk (C,k) via batched MXU matmuls."""
     W = i_c.shape[1]
     mask = (
         jnp.arange(W, dtype=jnp.int32)[None, :] < l_c[:, None]
     ).astype(jnp.float32)
-    y = src[i_c].astype(jnp.float32)  # (C, W, k) gather
+    if gather.startswith("pallas"):
+        from pio_tpu.ops.als_pallas import (
+            GATHER_VMEM_TABLE_BUDGET, gather_rows_pallas, gather_table_bytes,
+        )
+
+        n, k = src.shape
+        fits = gather_table_bytes(
+            n, k, src.dtype == jnp.bfloat16) <= GATHER_VMEM_TABLE_BUDGET
+        if fits:
+            C = i_c.shape[0]
+            flat = i_c.reshape(-1)
+            y = gather_rows_pallas(
+                src, flat,
+                rows_per_step=_gather_pow2_rows(flat.shape[0]),
+                variant=gather.split("-", 1)[1],
+            ).reshape(C, W, k).astype(jnp.float32)
+        else:
+            y = src[i_c].astype(jnp.float32)  # big table: fast emitter
+    else:
+        y = src[i_c].astype(jnp.float32)  # (C, W, k) gather
     if implicit:
         # c = 1 + alpha*v; A += (c-1) y y^T ; b += c * y   (p == 1)
         w_outer = alpha * v_c * mask
@@ -323,7 +362,7 @@ def _chunk_blocks(src, i_c, v_c, l_c, implicit: bool, alpha: float):
 def _normal_equations(layout, other_factors, n_self, implicit: bool,
                       alpha: float, chunk_slots: int,
                       bf16_gather: bool = False, accum: str = "auto",
-                      group_slots: int = 73728):
+                      group_slots: int = 73728, gather: str = "auto"):
     """Accumulate per-row normal equations A (n_self,k,k), b (n_self,k).
 
     Slots sharing a row (rows wider than `width`) scatter-add into the same
@@ -351,6 +390,8 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
     if accum == "auto":
         # keep in sync with ALSParams.resolved_accum (per-backend choice)
         accum = "hybrid" if _accelerator_backend() else "carry"
+    if gather == "auto":
+        gather = "xla"   # keep in sync with ALSParams.gather docstring
     # every caller pads S to a chunk_slots multiple via _slots_for
     assert S % chunk_slots == 0, (S, chunk_slots)
 
@@ -379,7 +420,7 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
         return normal_equations_hybrid(
             layout, other_factors, n_self, implicit, alpha,
             chunk_slots=chunk_slots, group_slots=group_slots,
-            bf16_gather=bf16_gather,
+            bf16_gather=bf16_gather, gather=gather,
         )
 
     if accum == "carry":
@@ -389,7 +430,7 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
             A, b = carry
             r_c, i_c, v_c, l_c = xs
             a_blk, b_blk = _chunk_blocks(
-                src, i_c, v_c, l_c, implicit, alpha
+                src, i_c, v_c, l_c, implicit, alpha, gather=gather
             )
             A = A.at[r_c].add(
                 a_blk, mode="drop", indices_are_sorted=True
@@ -434,7 +475,7 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
         def body(_, xs_c):
             i_c, v_c, l_c = xs_c
             return None, _chunk_blocks(
-                src, i_c, v_c, l_c, implicit, alpha
+                src, i_c, v_c, l_c, implicit, alpha, gather=gather
             )
 
         _, (a_blks, b_blks) = jax.lax.scan(body, None, xs)
@@ -491,10 +532,12 @@ def _cg_solve(A, b, x0, n_iter: int):
 def _solve_factors(layout, other_factors, n_self, reg, implicit, alpha,
                    chunk_slots, x0=None, cg_iters: int = 0,
                    bf16_gather: bool = False, accum: str = "auto",
-                   group_slots: int = 73728, yty=None):
+                   group_slots: int = 73728, yty=None,
+                   gather: str = "auto"):
     A, b = _normal_equations(
         layout, other_factors, n_self, implicit, alpha, chunk_slots,
         bf16_gather=bf16_gather, accum=accum, group_slots=group_slots,
+        gather=gather,
     )
     k = other_factors.shape[1]
     eye = jnp.eye(k, dtype=jnp.float32)
@@ -571,12 +614,14 @@ def _sweep_factory(by_user, by_item, n_users: int, n_items: int, cs: int,
                 params.reg, params.implicit, params.alpha, cs,
                 x0=users, cg_iters=cg_u_n, bf16_gather=params.bf16_gather,
                 accum=params.accum, group_slots=params.group_slots,
+                gather=params.gather,
             )
             items = _solve_factors(
                 by_item, users, n_items,
                 params.reg, params.implicit, params.alpha, cs,
                 x0=items, cg_iters=cg_i_n, bf16_gather=params.bf16_gather,
                 accum=params.accum, group_slots=params.group_slots,
+                gather=params.gather,
             )
             return (users, items), None
         return sweep
@@ -824,8 +869,11 @@ def als_train_validated(
     bu, bi, curve = _train_val_jit(
         u, i, v, vu, vi, vv, n_users, n_items, params, user0, item0
     )
-    curve_h = tuple(round(float(x), 6) for x in np.asarray(curve))
-    best_sweep = int(np.argmin(curve_h)) + 1
+    raw = np.asarray(curve)
+    # argmin on the UNROUNDED curve: the scan's strict `r < br` keeps the
+    # truly-lowest sweep, and ties after rounding must not relabel it
+    best_sweep = int(np.argmin(raw)) + 1
+    curve_h = tuple(round(float(x), 6) for x in raw)
     return ALSModel(bu, bi), ALSValidation(
         curve=curve_h,
         best_sweep=best_sweep,
@@ -897,7 +945,7 @@ def _sharded_train_fn(mesh: Mesh, ub: int, ib: int, su: int, si: int,
                     x0=users, cg_iters=cg_u_n,
                     bf16_gather=params.bf16_gather,
                     accum=params.accum, group_slots=params.group_slots,
-                    yty=yty_i,
+                    yty=yty_i, gather=params.gather,
                 )
                 yty_u = gram_psum(users) if params.implicit else None
                 all_users = jax.lax.all_gather(
@@ -909,7 +957,7 @@ def _sharded_train_fn(mesh: Mesh, ub: int, ib: int, su: int, si: int,
                     x0=items, cg_iters=cg_i_n,
                     bf16_gather=params.bf16_gather,
                     accum=params.accum, group_slots=params.group_slots,
-                    yty=yty_u,
+                    yty=yty_u, gather=params.gather,
                 )
                 return (users, items), None
             return sweep

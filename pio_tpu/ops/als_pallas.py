@@ -336,7 +336,8 @@ def normal_equations_hybrid(layout, other_factors, n_self: int,
                             kernel_chunk: int = 128,
                             group_slots: int = 65536,
                             bf16_gather: bool = True,
-                            interpret: bool | None = None):
+                            interpret: bool | None = None,
+                            gather: str = "xla"):
     """accum="hybrid": XLA builds the per-slot blocks (batched MXU
     einsum, _chunk_blocks — the hardware A/B showed it beats in-kernel
     serial dots), the shared segment-flush kernel replaces only the
@@ -397,7 +398,7 @@ def normal_equations_hybrid(layout, other_factors, n_self: int,
             def body(_, xs_c):
                 i_c, v_c, l_c = xs_c
                 return None, _chunk_blocks(src, i_c, v_c, l_c,
-                                           implicit, alpha)
+                                           implicit, alpha, gather=gather)
 
             _, (a_blks, b_blks) = jax.lax.scan(body, None, xs)
             n_steps = (hi - lo) // chunk
@@ -417,3 +418,115 @@ def normal_equations_hybrid(layout, other_factors, n_self: int,
     groups = [group_thunk(lo, min(S, lo + g_slots))
               for lo in range(0, S, g_slots)]
     return _chain_groups(n_self, k, groups)
+
+
+# ---------------------------------------------------------------------------
+# VMEM-resident factor gather (the round-4 lever on the slot-gather wall)
+# ---------------------------------------------------------------------------
+
+# table-size budget for keeping the whole factor matrix VMEM-resident:
+# 16 MB scoped VMEM minus the output block's double buffer and headroom
+GATHER_VMEM_TABLE_BUDGET = 10 * 2**20
+
+
+def gather_table_bytes(n_rows: int, k: int, bf16: bool) -> int:
+    """Physical VMEM bytes for an (n_rows, k) factor table at TPU lane
+    padding (minor dim padded to 128)."""
+    lane = max(128, k)
+    return n_rows * lane * (2 if bf16 else 4)
+
+
+def _gather_kernel_copy(idx_ref, table_ref, out_ref, *, rows_per_step,
+                        group):
+    """Row-copy variant: `group` dynamic (1,k) loads stacked into one
+    tile-aligned store. The table ref is VMEM-resident (constant index
+    map), so every load is a VMEM dynamic slice — no HBM traffic beyond
+    the one-time table load and the output writes."""
+    from jax.experimental import pallas as pl
+
+    def body(g, _):
+        base = g * group
+        rows = [
+            table_ref[pl.ds(idx_ref[0, 0, base + u], 1), :]
+            for u in range(group)
+        ]
+        out_ref[pl.ds(base, group), :] = jnp.concatenate(rows, axis=0)
+        return 0
+
+    jax.lax.fori_loop(0, rows_per_step // group, body, 0)
+
+
+def _gather_kernel_take(idx_ref, table_ref, out_ref, *, rows_per_step,
+                        group):
+    """jnp.take variant: materialize the VMEM table once per step and
+    let Mosaic lower the vector gather (tpu dynamic-gather path where
+    supported). A/B'd against the copy variant on hardware."""
+    del group
+    tbl = table_ref[:, :]
+    rows = idx_ref[0, 0, :rows_per_step]
+    out_ref[:, :] = jnp.take(tbl, rows, axis=0)
+
+
+_GATHER_KERNELS = {"copy": _gather_kernel_copy, "take": _gather_kernel_take}
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rows_per_step", "variant", "group",
+                              "interpret"))
+def gather_rows_pallas(table, idx, rows_per_step: int = 1024,
+                       variant: str = "copy", group: int = 8,
+                       interpret: bool | None = None):
+    """Gather rows of a SMALL factor table with the table pinned in VMEM.
+
+    table (N, k) f32/bf16, idx (M,) int32 -> (M, k) table[idx].
+
+    Why this exists: XLA's gather emitter runs ~10x off HBM peak when
+    the table is small enough to fit VMEM (eval/ALS_ROOFLINE.md /
+    als_kernel_lab.py: a 20x cliff keyed on the 16 MB boundary, decided
+    at codegen and unreachable from JAX — every padding trick fused
+    away). At the ML-20M shape the users-half gathers the ITEM factor
+    table (26,744 x 64 bf16 = 6.8 MB padded), squarely in the slow
+    regime; this kernel makes the VMEM residency explicit instead of
+    hoping for the emitter's fast path. Tables over
+    GATHER_VMEM_TABLE_BUDGET stay on the XLA path (they already take
+    the fast emitter).
+
+    M must divide by rows_per_step (callers pad; slot layouts already
+    quantize), and the idx values must be in-range (the ALS layouts
+    guarantee < n plus a zero-filled sentinel row).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    import math
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    n, k = table.shape
+    (m,) = idx.shape
+    assert m % rows_per_step == 0, (m, rows_per_step)
+    # the copy variant loops rows_per_step//group times — group must
+    # divide rows_per_step or trailing rows are silently dropped (and a
+    # group larger than the step would write nothing at all)
+    group = math.gcd(group, rows_per_step)
+    lane = max(128, k)
+    tbl = _pad_lanes(table, lane)
+    steps = m // rows_per_step
+    out = pl.pallas_call(
+        functools.partial(
+            _GATHER_KERNELS[variant], rows_per_step=rows_per_step,
+            group=group),
+        grid=(steps,),
+        in_specs=(
+            # (1,1,R) SMEM: 1-d s32 operands tile T(1024) vs Mosaic's
+            # T(128) (round-3 portability rule)
+            pl.BlockSpec((1, 1, rows_per_step), lambda i: (i, 0, 0),
+                         memory_space=pltpu.MemorySpace.SMEM),
+            # whole table, constant index map -> fetched once, resident
+            pl.BlockSpec((n, lane), lambda i: (0, 0)),
+        ),
+        out_specs=pl.BlockSpec((rows_per_step, lane), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, lane), table.dtype),
+        interpret=interpret,
+    )(idx.reshape(steps, 1, rows_per_step), tbl)
+    return out[:, :k]
