@@ -158,10 +158,11 @@ def bench_params(iters: int, rank: int = None, chunk: int = None):
 def run_als(users, items, vals, iters: int,
             n_users: int = None, n_items: int = None,
             rank: int = None, chunk: int = None, repeats: int = 3,
-            layouts=None) -> float:
+            layouts=None) -> float | None:
     """-> best wall seconds for `iters` sweeps over `repeats` runs, compile
     excluded (the warm-up runs the exact same program: iterations is a
-    static scan length). Best-of-N because the tunneled device shows
+    static scan length), or None when repeats<=0 (warm-up/compile-only
+    mode — not a measurement). Best-of-N because the tunneled device shows
     +-0.3s run-to-run noise that would otherwise swamp per-sweep deltas.
     With `layouts` (ops/als.py ALSLayouts) the runs measure the RETRAIN
     path: slot layouts resident in HBM, no per-call rebuild."""
@@ -184,8 +185,10 @@ def run_als(users, items, vals, iters: int,
         return float(jnp.sum(model.user_factors))
 
     go()  # compile (identical program: same static iterations)
+    if repeats <= 0:      # warm-up/compile-only mode: not a measurement —
+        return None       # never let inf masquerade as a timing
     best = float("inf")
-    for _ in range(max(0, repeats)):   # repeats=0: warm-up/compile only
+    for _ in range(repeats):
         t0 = time.monotonic()
         go()
         best = min(best, time.monotonic() - t0)

@@ -1,7 +1,9 @@
-"""Shared backend helpers: event filtering + id generation."""
+"""Shared backend helpers: event filtering, id generation, and the
+wire pools' per-thread connection reuse/reconnect policy."""
 
 from __future__ import annotations
 
+import time
 import uuid
 from datetime import datetime
 from typing import Sequence
@@ -58,3 +60,55 @@ def apply_limit(events: list[Event], limit: int | None, reversed_: bool) -> list
     if limit is not None and limit >= 0:
         events = events[:limit]
     return events
+
+
+PING_IDLE_SEC = 30.0
+
+
+def pooled_thread_conn(local, all_conns, lock, idle_sec: float, build):
+    """Per-thread connection reuse policy shared by the wire pools
+    (PgPool/MyPool): reuse the thread's cached connection, but after an
+    idle gap > idle_sec ping it and transparently rebuild if dead
+    (server restart / idle-timeout kill). Pinging every call would
+    double round trips; idle-timeout kills only happen across gaps.
+
+    The cached slot is cleared BEFORE rebuilding so a failed build()
+    (server still booting) leaves the thread with no stale closed
+    connection — the next call retries the build instead of failing on
+    a dead socket until the idle window re-elapses. A connection that
+    dies UNDER the idle window is recovered by the pools' execute
+    wrappers calling evict_thread_conn on socket-level errors.
+    """
+    c = getattr(local, "conn", None)
+    now = time.monotonic()
+    if (c is not None
+            and now - getattr(local, "last_use", now) > idle_sec
+            and not c.ping()):
+        evict_thread_conn(local, all_conns, lock)
+        c = None
+    if c is None:
+        c = build()
+        local.conn = c
+        with lock:
+            all_conns.append(c)
+    local.last_use = now
+    return c
+
+
+def evict_thread_conn(local, all_conns, lock) -> None:
+    """Drop the calling thread's cached connection after a socket-level
+    failure so the next acquisition rebuilds immediately instead of
+    retrying a dead socket until the idle-ping window elapses. Server
+    ERROR responses (PgError/MyError) must NOT evict — the connection
+    is fine; only transport errors mean it is gone."""
+    c = getattr(local, "conn", None)
+    if c is None:
+        return
+    local.conn = None
+    with lock:
+        if c in all_conns:
+            all_conns.remove(c)
+    try:
+        c.close()
+    except OSError:
+        pass

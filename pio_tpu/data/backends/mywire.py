@@ -43,6 +43,12 @@ import threading
 from dataclasses import dataclass
 from urllib.parse import parse_qs, unquote, urlparse
 
+from pio_tpu.data.backends.common import (
+    PING_IDLE_SEC,
+    evict_thread_conn,
+    pooled_thread_conn,
+)
+
 # capability flags (include/mysql_com.h)
 CLIENT_LONG_PASSWORD = 0x1
 CLIENT_LONG_FLAG = 0x4
@@ -341,7 +347,10 @@ class MyConnection:
                 n2 = max(13, auth_len - 8)
                 part2 = pkt[off:off + n2]
                 off += n2
-                nonce += part2.rstrip(b"\x00")[:12]
+                # positional slice: salt part 2 is auth_len-8 bytes followed
+                # by one NUL terminator; rstrip would truncate a salt whose
+                # own trailing bytes happen to be 0x00
+                nonce += part2[:12]
             if caps & CLIENT_PLUGIN_AUTH:
                 end = pkt.index(0, off) if 0 in pkt[off:] else len(pkt)
                 plugin = pkt[off:end].decode()
@@ -517,6 +526,9 @@ class MyConnection:
 class MyPool:
     """One MyConnection per thread (connections are not thread-safe)."""
 
+    # reconnect policy lives in backends.common (pooled_thread_conn /
+    # evict_thread_conn), shared with PgPool so the dialects cannot drift
+
     def __init__(self, dsn: MyDSN, timeout: float = 30.0):
         self.dsn = dsn
         self.timeout = timeout
@@ -528,21 +540,33 @@ class MyPool:
 
     def _conn(self) -> MyConnection:
         with self._lock:
-            if self._closed:
+            if self._closed:   # before reuse: cached sockets are closed too
                 raise MyProtocolError("pool is closed")
-        c = getattr(self._local, "conn", None)
-        if c is None:
-            c = MyConnection(self.dsn, self.timeout)
-            self._local.conn = c
-            with self._lock:
-                self._all.append(c)
-        return c
+
+        def build() -> MyConnection:
+            return MyConnection(self.dsn, self.timeout)
+
+        return pooled_thread_conn(self._local, self._all, self._lock,
+                                  PING_IDLE_SEC, build)
 
     def execute(self, sql: str, params: tuple = ()) -> MyResult:
-        return self._conn().execute(sql, params)
+        try:
+            return self._conn().execute(sql, params)
+        except (OSError, MyProtocolError, struct.error):
+            # transport death or stream desync under active use: evict so
+            # the NEXT call rebuilds instead of hammering a dead socket
+            # until the idle-ping window elapses (MyError = server said
+            # no, the connection is fine — no evict; a closed pool's
+            # cached socket is equally safe to drop)
+            evict_thread_conn(self._local, self._all, self._lock)
+            raise
 
     def execute_script(self, sql: str) -> None:
-        self._conn().execute_script(sql)
+        try:
+            self._conn().execute_script(sql)
+        except (OSError, MyProtocolError, struct.error):
+            evict_thread_conn(self._local, self._all, self._lock)
+            raise
 
     def close(self) -> None:
         with self._lock:

@@ -35,6 +35,12 @@ import threading
 from dataclasses import dataclass, field
 from urllib.parse import parse_qs, unquote, urlparse
 
+from pio_tpu.data.backends.common import (
+    PING_IDLE_SEC,
+    evict_thread_conn,
+    pooled_thread_conn,
+)
+
 
 class PgError(Exception):
     def __init__(self, fields: dict[str, str]):
@@ -351,6 +357,18 @@ class PgConnection:
         if err is not None:
             raise err
 
+    def ping(self) -> bool:
+        """Liveness check: Sync alone elicits ReadyForQuery with no
+        transaction side effects."""
+        try:
+            self._send(b"S", b"")
+            while True:
+                t, _ = self._recv_msg()
+                if t == b"Z":
+                    return True
+        except (OSError, PgProtocolError, struct.error):
+            return False
+
     def close(self) -> None:
         try:
             self._send(b"X", b"")  # Terminate
@@ -426,26 +444,44 @@ class PgPool:
         self._lock = threading.Lock()
         self._closed = False
 
+    # reconnect policy lives in backends.common (pooled_thread_conn /
+    # evict_thread_conn), shared with MyPool so the dialects cannot drift
+
     def conn(self) -> PgConnection:
-        c = getattr(self._local, "conn", None)
-        if c is None:
-            if self._closed:
-                raise PgProtocolError("pool is closed")
+        if self._closed:   # before reuse: cached sockets are closed too
+            raise PgProtocolError("pool is closed")
+
+        def build() -> PgConnection:
             c = PgConnection(self.dsn)
             if self.dsn.schema:
                 # every connection of the pool lands in the same schema
                 # (test isolation / multi-tenant deployments)
                 c.execute_script(f"SET search_path TO {self.dsn.schema}")
-            self._local.conn = c
-            with self._lock:
-                self._all.append(c)
-        return c
+            return c
+
+        return pooled_thread_conn(self._local, self._all, self._lock,
+                                  PING_IDLE_SEC, build)
+
+    def _evict(self) -> None:
+        evict_thread_conn(self._local, self._all, self._lock)
 
     def execute(self, sql: str, params: tuple = ()) -> PgResult:
-        return self.conn().execute(sql, params)
+        try:
+            return self.conn().execute(sql, params)
+        except (OSError, PgProtocolError, struct.error):
+            # transport death or stream desync under active use: evict so
+            # the NEXT call rebuilds instead of hammering a dead socket
+            # until the idle-ping window elapses (PgError = server said
+            # no, the connection is fine — no evict)
+            self._evict()
+            raise
 
     def execute_script(self, sql: str) -> None:
-        self.conn().execute_script(sql)
+        try:
+            self.conn().execute_script(sql)
+        except (OSError, PgProtocolError, struct.error):
+            self._evict()
+            raise
 
     def close(self) -> None:
         with self._lock:

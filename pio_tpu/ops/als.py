@@ -131,6 +131,17 @@ class ALSParams:
     #       A/B (eval/als_accum_bench.py gather cells) shows a win
     gather: str = "auto"
 
+    _GATHER_MODES = ("auto", "xla", "pallas-copy", "pallas-take")
+
+    def __post_init__(self):
+        # validate here, not in the kernel: "pallas" alone would pass a
+        # startswith check and then IndexError inside the jit trace, and
+        # any other typo would silently fall back to the XLA path
+        if self.gather not in self._GATHER_MODES:
+            raise ValueError(
+                f"ALSParams.gather={self.gather!r}; "
+                f"expected one of {self._GATHER_MODES}")
+
     def resolved_cg_iters(self, n_self: int | None = None) -> int:
         """-1 (default) = auto, decided per factor side by its row count:
 

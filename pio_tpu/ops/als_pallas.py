@@ -431,8 +431,10 @@ GATHER_VMEM_TABLE_BUDGET = 10 * 2**20
 
 def gather_table_bytes(n_rows: int, k: int, bf16: bool) -> int:
     """Physical VMEM bytes for an (n_rows, k) factor table at TPU lane
-    padding (minor dim padded to 128)."""
-    lane = max(128, k)
+    padding (minor dim padded UP to a multiple of 128, matching the
+    padding gather_rows_pallas applies — max(128, k) would under-count
+    e.g. k=192, which physically pads to 256)."""
+    lane = _lane_for(k)
     return n_rows * lane * (2 if bf16 else 4)
 
 
@@ -460,7 +462,10 @@ def _gather_kernel_take(idx_ref, table_ref, out_ref, *, rows_per_step,
                         group):
     """jnp.take variant: materialize the VMEM table once per step and
     let Mosaic lower the vector gather (tpu dynamic-gather path where
-    supported). A/B'd against the copy variant on hardware."""
+    supported). Interpret-mode-validated; the on-hardware A/B against
+    the copy variant is staged in eval/als_accum_bench.py (gather
+    cells) and had not landed as of round 4 — keep in sync with
+    ALSParams.gather's "auto" resolution in ops/als.py."""
     del group
     tbl = table_ref[:, :]
     rows = idx_ref[0, 0, :rows_per_step]
@@ -509,7 +514,7 @@ def gather_rows_pallas(table, idx, rows_per_step: int = 1024,
     # divide rows_per_step or trailing rows are silently dropped (and a
     # group larger than the step would write nothing at all)
     group = math.gcd(group, rows_per_step)
-    lane = max(128, k)
+    lane = _lane_for(k)   # 128 < k < 256 must pad to 256, not k itself
     tbl = _pad_lanes(table, lane)
     steps = m // rows_per_step
     out = pl.pallas_call(

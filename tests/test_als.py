@@ -487,3 +487,13 @@ def test_layout_reuse_shape_guard():
     lay = als_build_layouts(users, items, vals, nu, ni, p)
     with pytest.raises(ValueError, match="layouts built for shape"):
         als_train(users, items, vals, nu + 1, ni, p, layouts=lay)
+
+
+def test_gather_mode_validated_at_construction():
+    # "pallas" alone used to pass a startswith check and IndexError inside
+    # the jit trace; typos silently fell back to XLA (round-4 advisor)
+    for bad in ("pallas", "palas-copy", "Pallas-take", ""):
+        with pytest.raises(ValueError, match="gather"):
+            ALSParams(gather=bad)
+    for ok in ("auto", "xla", "pallas-copy", "pallas-take"):
+        assert ALSParams(gather=ok).gather == ok

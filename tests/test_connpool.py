@@ -1,0 +1,118 @@
+"""Shared per-thread connection reuse/reconnect policy (backends.common
+pooled_thread_conn / evict_thread_conn), used by PgPool and MyPool.
+
+The reference's scalikejdbc ConnectionPool delegates liveness to
+commons-dbcp (jdbc/StorageClient.scala:29); the wire pools implement the
+equivalent policy directly: idle-gap ping + transparent rebuild, and
+evict-on-transport-error for deaths under active use."""
+
+import threading
+
+import pytest
+
+from pio_tpu.data.backends.common import (
+    evict_thread_conn,
+    pooled_thread_conn,
+)
+
+
+class FakeConn:
+    def __init__(self):
+        self.alive = True
+        self.closed = False
+
+    def ping(self):
+        return self.alive
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def pool_state():
+    local = threading.local()
+    return local, [], threading.Lock()
+
+
+def acquire(state, build, idle=30.0):
+    local, all_c, lock = state
+    return pooled_thread_conn(local, all_c, lock, idle, build)
+
+
+def test_reuse_without_ping_inside_idle_window(pool_state):
+    built = []
+
+    def build():
+        c = FakeConn()
+        built.append(c)
+        return c
+
+    c1 = acquire(pool_state, build)
+    c2 = acquire(pool_state, build)
+    assert c1 is c2 and len(built) == 1
+
+
+def test_idle_gap_ping_rebuilds_dead_connection(pool_state):
+    local, all_c, _ = pool_state
+    built = []
+
+    def build():
+        c = FakeConn()
+        built.append(c)
+        return c
+
+    c1 = acquire(pool_state, build)
+    local.last_use -= 60          # simulate idle gap > window
+    c1.alive = False              # server killed it meanwhile
+    c2 = acquire(pool_state, build)
+    assert c2 is not c1 and c1.closed and all_c == [c2]
+
+
+def test_idle_gap_ping_keeps_live_connection(pool_state):
+    local, _, _ = pool_state
+    built = []
+
+    def build():
+        c = FakeConn()
+        built.append(c)
+        return c
+
+    c1 = acquire(pool_state, build)
+    local.last_use -= 60
+    assert acquire(pool_state, build) is c1 and len(built) == 1
+
+
+def test_failed_rebuild_leaves_no_stale_cached_conn(pool_state):
+    local, all_c, _ = pool_state
+    c1 = acquire(pool_state, FakeConn)
+    local.last_use -= 60
+    c1.alive = False
+
+    def bad_build():
+        raise OSError("connection refused")
+
+    with pytest.raises(OSError):
+        acquire(pool_state, bad_build)
+    # the dead conn must be fully gone: an immediate retry (no idle
+    # wait) builds fresh instead of failing on the closed socket
+    assert local.conn is None and c1.closed and all_c == []
+    c2 = acquire(pool_state, FakeConn)
+    assert c2 is not c1 and all_c == [c2]
+
+
+def test_evict_recovers_death_under_active_use(pool_state):
+    # a connection that dies INSIDE the idle window is invisible to the
+    # acquisition ping; the pools' execute wrappers evict on transport
+    # errors so the next acquisition rebuilds immediately
+    local, all_c, lock = pool_state
+    c1 = acquire(pool_state, FakeConn)
+    evict_thread_conn(local, all_c, lock)
+    assert c1.closed and local.conn is None and all_c == []
+    c2 = acquire(pool_state, FakeConn)
+    assert c2 is not c1
+
+
+def test_evict_with_no_cached_conn_is_noop(pool_state):
+    local, all_c, lock = pool_state
+    evict_thread_conn(local, all_c, lock)   # must not raise
+    assert getattr(local, "conn", None) is None
