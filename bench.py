@@ -86,25 +86,66 @@ SERVING_TIMEOUT = 2700
 INGEST_TIMEOUT = 600
 CPU_TIMEOUT = 1800
 
-# bf16/f32 MXU peaks per chip (FLOP/s) keyed by substring of device_kind.
-# The ALS kernel accumulates in f32; MFU is reported against the bf16 peak,
-# which is the conservative (lower) figure.
-PEAK_FLOPS = [
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+# per-chip peaks keyed by substring of device_kind: (bf16 MXU FLOP/s,
+# HBM bytes/s) in ONE table so a new device kind cannot land in one
+# lookup and silently vanish from the other. The ALS kernel accumulates
+# in f32; MFU is reported against the bf16 peak (the conservative
+# figure). The sweep is memory-bound (eval/ALS_ROOFLINE.md: ~166
+# GB/sweep ≈ 203 ms bound vs 0.47 s measured at the ML-20M shape), so
+# fraction-of-HBM-bound is the legible headline efficiency —
+# mfu_vs_bf16_peak reads as 0.003 for a kernel already at 40% of its
+# true (memory) roofline.
+PEAK_TABLE = [
+    ("v6", 918e12, 1640e9), ("trillium", 918e12, 1640e9),
+    ("v5p", 459e12, 2765e9),
+    ("v5 lite", 197e12, 819e9), ("v5e", 197e12, 819e9),
+    ("v5litepod", 197e12, 819e9),
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
 ]
 
 
-def peak_for(device_kind: str) -> float | None:
+def _peaks_for(device_kind: str) -> tuple[float | None, float | None]:
     dk = (device_kind or "").lower()
-    for sub, peak in PEAK_FLOPS:
+    for sub, flops, hbm in PEAK_TABLE:
         if sub in dk:
-            return peak
-    return None
+            return flops, hbm
+    return None, None
+
+
+def peak_for(device_kind: str) -> float | None:
+    return _peaks_for(device_kind)[0]
+
+
+def hbm_peak_for(device_kind: str) -> float | None:
+    return _peaks_for(device_kind)[1]
+
+
+def als_hbm_bytes_per_sweep(nnz: int, n_users: int, n_items: int,
+                            rank: int, cg_iters: int,
+                            width: int = 128) -> float:
+    """Analytic physical HBM traffic for one full ALS sweep (both
+    halves), mirroring eval/ALS_ROOFLINE.md's per-op accounting. All
+    minor dims are lane-padded to 128 on TPU — a 2x tax at rank 64 —
+    and slot layouts pad each entity's ratings to a multiple of
+    `width` (expected padding: width/2 per entity row). Terms:
+      - ne factor gather (bf16): written by the emitter, re-read by the
+        block build — 2 passes over the slot-padded rows, both halves
+      - per-slot (k,k) f32 blocks: written as scan outputs, re-read by
+        the scatter — 2 passes
+      - A (n,k,k) f32: zero-init + scatter write + one solve read
+      - CG: one pass over A per matvec iteration, both halves
+    At the ML-20M shape this sums to ~155 GB vs the trace-derived
+    ~166 GB (eval/ALS_ROOFLINE.md) — within 7%; the analytic form is
+    used so the bound scales with the benched shape."""
+    lane = max(128, -(-rank // 128) * 128)
+    slot_rows = nnz * 2 + (n_users + n_items) * width // 2
+    gather = 2 * slot_rows * lane * 2
+    blocks = 2 * (slot_rows // width) * rank * lane * 4
+    a_bytes = 3 * (n_users + n_items) * rank * lane * 4
+    cg = max(cg_iters, 1) * (n_users + n_items) * rank * lane * 4
+    return float(gather + blocks + a_bytes + cg)
 
 
 def als_flops_per_sweep(nnz: int, n_users: int, n_items: int, rank: int,
@@ -345,6 +386,17 @@ def phase_train() -> dict:
     peak = peak_for(kind)
     flops_per_sec = fl_total / dt
     split_ok = sweep_s is not None
+    # fraction-of-HBM-roofline for a steady sweep: analytic bound time /
+    # measured time, 1.0 = the kernel streams at memory peak. Same
+    # full/warm CG mix as the FLOPs split (sweeps 2..iters).
+    hbm_bw = hbm_peak_for(kind)
+    by_full = als_hbm_bytes_per_sweep(nnz_pad, n_users, n_items, RANK, cg)
+    by_warm = als_hbm_bytes_per_sweep(nnz_pad, n_users, n_items, RANK, w_cg)
+    by_split = (by_full * (n_full - 1) + by_warm * n_warm) \
+        / max(iters - 1, 1)
+    hbm_bound_sweep_s = by_split / hbm_bw if hbm_bw else None
+    frac_roofline = round(hbm_bound_sweep_s / sweep_s, 4) \
+        if hbm_bound_sweep_s and split_ok else None
     return {
         "rate": rate,
         "retrain_rate": round(retrain_rate, 1),
@@ -367,6 +419,12 @@ def phase_train() -> dict:
         "mfu_vs_bf16_peak": round(flops_per_sec / peak, 4) if peak else None,
         "sweep_mfu_vs_bf16_peak": round(fl / sweep_s / peak, 4)
         if peak and split_ok else None,
+        # the legible efficiency metric for this memory-bound kernel
+        # (VERDICT r4 item 9): 1.0 = steady sweep streams at HBM peak
+        "hbm_bytes_per_sweep": by_split,
+        "hbm_bound_sweep_sec": round(hbm_bound_sweep_s, 4)
+        if hbm_bound_sweep_s else None,
+        "frac_of_hbm_roofline": frac_roofline,
         "device_kind": kind,
         "rank": RANK,
         "cg_iters": cg,
@@ -841,6 +899,71 @@ def probe_with_retry(errors: dict, extra: dict) -> tuple[dict | None, dict]:
     return None, {}
 
 
+def snapshot_main() -> int:
+    """Cheap opportunistic TPU-evidence capture (round-4 verdict item 2:
+    the tunnel has been dead at round end 4/4 rounds — grab hardware
+    numbers WHENEVER it serves, not only when the driver runs). Probe +
+    train phase only, few attempts, NO CPU fallback: the sole point is
+    a driver-protocol TPU artifact. On success writes the artifact to
+    --out (default eval/TPU_BENCH_r05.json) and prints it; on a dead
+    tunnel prints the diagnosis and exits quickly."""
+    import datetime
+
+    errors: dict[str, str] = {}
+    extra: dict = {"errors": errors, "small": SMALL, "snapshot": True,
+                   "ts": datetime.datetime.now().isoformat(
+                       timespec="seconds")}
+    # --small gets its own default file: a quick small-shape tunnel
+    # check must never clobber captured full-shape evidence
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "eval",
+        "TPU_BENCH_r05_small.json" if SMALL else "TPU_BENCH_r05.json")
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    from pio_tpu.utils.tpu_health import preflight
+
+    probe = None
+    for attempt in range(2):
+        pf = preflight()
+        rec = {"attempt": attempt, "relay_tcp": pf["relay_tcp"],
+               "ts": pf["ts"]}
+        extra.setdefault("acquisition", []).append(rec)
+        res, err = run_phase("probe", PROBE_TIMEOUT, diagnose=True)
+        if res and res.get("ok"):
+            rec["outcome"] = "ok"
+            probe = res
+            break
+        rec["outcome"] = err or f"probe: {res}"
+    result = {"metric": "ALS implicit ratings/sec/chip (ML-20M shape, "
+                        "rank 64)" if not SMALL else
+                        "ALS implicit ratings/sec/chip (small)",
+              "value": None, "unit": "ratings/sec", "vs_baseline": None,
+              "extra": extra}
+    if probe is None:
+        errors["probe"] = "snapshot: TPU unreachable; no CPU fallback"
+        print(json.dumps(result))
+        return 0
+    extra["platform"] = probe.get("platform")
+    extra["device_kind"] = probe.get("device_kind")
+    extra["backend_init_sec"] = probe.get("init_sec")
+    train, err = run_phase("train", TRAIN_TIMEOUT, diagnose=True)
+    if train:
+        result["value"] = round(train["rate"], 1)
+        extra["train"] = train
+    elif err:
+        errors["train"] = err
+    if not errors:
+        del extra["errors"]
+    line = json.dumps(result)
+    if train and "cpu" not in str(extra.get("platform", "")).lower():
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+        extra["written_to"] = out_path
+        line = json.dumps(result)
+    print(line)
+    return 0
+
+
 def main() -> int:
     errors: dict[str, str] = {}
     extra: dict = {"errors": errors, "small": SMALL}
@@ -877,7 +1000,9 @@ def main() -> int:
                  "retrain_residual_sec",
                  "per_sweep_sec", "per_sweep_rate", "flops_per_sweep",
                  "flops_per_sec", "mfu_vs_bf16_peak",
-                 "sweep_mfu_vs_bf16_peak", "rank", "cg_iters",
+                 "sweep_mfu_vs_bf16_peak", "hbm_bytes_per_sweep",
+                 "hbm_bound_sweep_sec", "frac_of_hbm_roofline",
+                 "rank", "cg_iters",
                  "cg_warm_iters", "cg_full_sweeps", "accum")
                 if k in train
             }
@@ -934,4 +1059,6 @@ if __name__ == "__main__":
         name = sys.argv[sys.argv.index("--phase") + 1]
         print(json.dumps(PHASES[name]()))
         sys.exit(0)
+    if "--snapshot" in sys.argv:
+        sys.exit(snapshot_main())
     sys.exit(main())
