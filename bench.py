@@ -946,6 +946,12 @@ def snapshot_main() -> int:
     extra["platform"] = probe.get("platform")
     extra["device_kind"] = probe.get("device_kind")
     extra["backend_init_sec"] = probe.get("init_sec")
+    if "cpu" in str(probe.get("platform", "")).lower():
+        # snapshot exists ONLY for TPU evidence: bail before spending
+        # the 50-min train budget on a CPU result we would discard
+        errors["probe"] = "snapshot: default backend is CPU, not TPU"
+        print(json.dumps(result))
+        return 0
     train, err = run_phase("train", TRAIN_TIMEOUT, diagnose=True)
     if train:
         result["value"] = round(train["rate"], 1)

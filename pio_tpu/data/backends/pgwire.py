@@ -384,13 +384,17 @@ class _ScramClient:
     """SCRAM-SHA-256 client (RFC 5802/7677), gs2 'n,,' (no channel
     binding: TLS termination is external to this client)."""
 
-    def __init__(self, user: str, password: str):
+    def __init__(self, user: str, password: str,
+                 nonce: str | None = None, username: str = ""):
         # PostgreSQL ignores the SCRAM username field (it uses the startup
-        # user), and SASLprep of the password is the identity for ASCII
+        # user), and SASLprep of the password is the identity for ASCII.
+        # nonce/username are overridable ONLY so the RFC 7677 §3 test
+        # vector can drive the exchange (tests/test_pgwire.py) — the
+        # production path always uses a fresh random nonce.
         self.password = password
-        self.nonce = base64.b64encode(os.urandom(18)).decode()
+        self.nonce = nonce or base64.b64encode(os.urandom(18)).decode()
         self.gs2 = "n,,"
-        self.client_first_bare = f"n=,r={self.nonce}"
+        self.client_first_bare = f"n={username},r={self.nonce}"
         self.server_signature: bytes | None = None
 
     def client_first(self) -> bytes:

@@ -1,0 +1,234 @@
+"""Horizontally sharded event store: N storage servers, entity-hash routing.
+
+The reference's horizontal-scale story for events is HBase: rowkeys are
+prefixed with a hash of the entity so events spread evenly across region
+servers and time-range scans run in parallel per region
+(/root/reference/data/src/main/scala/org/apache/predictionio/data/storage/
+hbase/HBEventsUtil.scala:74-142, HBPEvents.scala region-split reads). The
+TPU-native deployment has no HBase; its scale-out unit is the storage
+server (server/storageserver.py) — one process per host, each owning a
+local durable backend (eventlog/sqlite). This backend composes N of them
+into one EventsDAO:
+
+ * writes route by a stable hash of (entity_type, entity_id) — the same
+   distribution key as the reference's rowkey prefix — so one entity's
+   history lives on exactly one shard and per-entity reads touch one host;
+ * serve-time reads with both entity filters push down to that one shard;
+ * bulk reads (training's find, aggregate_properties) scatter to all
+   shards in parallel threads and merge — the analogue of the reference's
+   region-parallel scan, with the per-shard `limit` pushed down so the
+   merge never materializes more than n_shards * limit events;
+ * event_id gets/deletes scatter (ids are uuid4 hex: shard-blind, exactly
+   like HBase's rowkey-by-entity design where an eventId lookup also
+   cannot be routed — HBEventsUtil builds rowkeys from entity, not id).
+
+Events only, by design (the reference's HBase backend is events-only too);
+metadata/models stay on a (small, rarely-written) unsharded source.
+
+Config:
+    PIO_STORAGE_SOURCES_SH_TYPE=sharded
+    PIO_STORAGE_SOURCES_SH_URLS=http://host1:7072,http://host2:7072
+    PIO_STORAGE_SOURCES_SH_KEY=...        # shared server key (optional)
+    PIO_STORAGE_SOURCES_SH_TIMEOUT=30
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime
+from typing import Iterable, Iterator, Sequence
+
+from pio_tpu.data import dao as daomod
+from pio_tpu.data.backends.common import DEFAULT_FIND_LIMIT
+from pio_tpu.data.event import Event
+from pio_tpu.data.storage import Backend, StorageClientConfig, StorageError
+
+
+def shard_for(entity_type: str, entity_id: str, n_shards: int) -> int:
+    """Stable entity -> shard routing (the rowkey-prefix hash of
+    HBEventsUtil.scala:74-142, modulo instead of prefix-bucketed). sha1
+    rather than Python hash(): stable across processes and runs — every
+    writer and reader in the fleet must agree."""
+    h = hashlib.sha1(
+        entity_type.encode() + b"\x00" + entity_id.encode()).digest()
+    return int.from_bytes(h[:8], "big") % n_shards
+
+
+class ShardedEventsDAO(daomod.EventsDAO):
+    def __init__(self, shards: list[daomod.EventsDAO]):
+        if not shards:
+            raise StorageError("sharded backend needs at least one shard")
+        self.shards = shards
+        self._pool = ThreadPoolExecutor(
+            max_workers=len(shards), thread_name_prefix="shardfan")
+
+    # -- fan-out helpers ----------------------------------------------------
+
+    def _all(self, fn, *args, **kwargs) -> list:
+        """Run fn(shard, ...) on every shard in parallel; surface the
+        first failure (a partial scatter answer is a wrong answer)."""
+        futs = [self._pool.submit(fn, s, *args, **kwargs)
+                for s in self.shards]
+        return [f.result() for f in futs]
+
+    def _route(self, event: Event) -> daomod.EventsDAO:
+        return self.shards[
+            shard_for(event.entity_type, event.entity_id, len(self.shards))]
+
+    # -- namespace lifecycle ------------------------------------------------
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        return all(self._all(lambda s: s.init(app_id, channel_id)))
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        return all(self._all(lambda s: s.remove(app_id, channel_id)))
+
+    def close(self) -> None:
+        for s in self.shards:
+            s.close()
+        self._pool.shutdown(wait=False)
+
+    # -- writes (entity-routed) ---------------------------------------------
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: int | None = None) -> str:
+        return self._route(event).insert(event, app_id, channel_id)
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: int | None = None) -> list[str]:
+        # group by shard, one parallel bulk write per shard, then stitch
+        # the returned ids back into input order
+        groups: dict[int, list[int]] = {}
+        for pos, e in enumerate(events):
+            groups.setdefault(
+                shard_for(e.entity_type, e.entity_id, len(self.shards)),
+                []).append(pos)
+        futs = {
+            si: self._pool.submit(
+                self.shards[si].insert_batch,
+                [events[p] for p in positions], app_id, channel_id)
+            for si, positions in groups.items()
+        }
+        out: list[str | None] = [None] * len(events)
+        for si, positions in groups.items():
+            for p, eid in zip(positions, futs[si].result()):
+                out[p] = eid
+        return out  # type: ignore[return-value]
+
+    # -- id-keyed ops (scatter: uuid ids carry no shard) ---------------------
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: int | None = None) -> Event | None:
+        for ev in self._all(lambda s: s.get(event_id, app_id, channel_id)):
+            if ev is not None:
+                return ev
+        return None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: int | None = None) -> bool:
+        return any(self._all(
+            lambda s: s.delete(event_id, app_id, channel_id)))
+
+    # -- queries ------------------------------------------------------------
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        kw = dict(
+            channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            entity_id=entity_id, event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id, limit=limit,
+            reversed=reversed,
+        )
+        if entity_type is not None and entity_id is not None:
+            # serve-time read: one entity lives on exactly one shard
+            shard = self.shards[
+                shard_for(entity_type, entity_id, len(self.shards))]
+            yield from shard.find(app_id, **kw)
+            return
+        # scatter with the limit pushed down (each shard returns its own
+        # top-`limit` in time order, so the merged top-`limit` is exact),
+        # then a heap-merge on event time preserving the DAO ordering
+        per_shard = self._all(lambda s: list(s.find(app_id, **kw)))
+        eff_limit = DEFAULT_FIND_LIMIT if limit is None else limit
+        merged = heapq.merge(
+            *per_shard, key=lambda e: e.event_time, reverse=reversed)
+        for n, ev in enumerate(merged):
+            if eff_limit >= 0 and n >= eff_limit:
+                break
+            yield ev
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        required: Iterable[str] | None = None,
+    ) -> dict:
+        # entities of one type spread across all shards, but each ENTITY
+        # is wholly on one shard (the routing key), so the per-shard
+        # aggregates have disjoint keys and a dict-merge is exact
+        parts = self._all(
+            lambda s: s.aggregate_properties(
+                app_id, entity_type, channel_id,
+                start_time=start_time, until_time=until_time,
+                required=required))
+        out: dict = {}
+        for part in parts:
+            out.update(part)
+        return out
+
+
+class ShardedBackend(Backend):
+    """Events-only composite over N remote storage servers."""
+
+    def __init__(self, config: StorageClientConfig):
+        super().__init__(config)
+        from pio_tpu.data.backends.remote import RemoteBackend
+
+        urls = [u.strip() for u in
+                config.properties.get("URLS", "").split(",") if u.strip()]
+        if not urls:
+            raise StorageError(
+                "sharded backend: set PIO_STORAGE_SOURCES_<N>_URLS to a "
+                "comma-separated list of storage-server URLs")
+        self._children = [
+            RemoteBackend(StorageClientConfig(
+                properties={
+                    "URL": u,
+                    "KEY": config.properties.get("KEY", ""),
+                    "TIMEOUT": config.properties.get("TIMEOUT", "30"),
+                    "VERIFY_TLS": config.properties.get(
+                        "VERIFY_TLS", "true"),
+                },
+                test=config.test,
+            ))
+            for u in urls
+        ]
+        self._events = ShardedEventsDAO(
+            [c.events() for c in self._children])
+
+    def events(self) -> daomod.EventsDAO:
+        return self._events
+
+    def close(self) -> None:
+        self._events.close()
+        for c in self._children:
+            c.close()

@@ -91,6 +91,9 @@ _BACKEND_REGISTRY: dict[str, str] = {
     "hbase": "pio_tpu.data.backends.eventlog:EventLogBackend",  # operational alias
     # networked client for the storage server (multi-host shared store)
     "remote": "pio_tpu.data.backends.remote:RemoteBackend",
+    # entity-hash-sharded composite over N storage servers (the
+    # reference's HBase region-distribution role, HBEventsUtil.scala:74)
+    "sharded": "pio_tpu.data.backends.sharded:ShardedBackend",
     # standard networked multi-writer DB (reference JDBC/PostgreSQL role)
     "postgres": "pio_tpu.data.backends.postgres:PostgresBackend",
     "postgresql": "pio_tpu.data.backends.postgres:PostgresBackend",

@@ -88,6 +88,51 @@ def remote_storage(tmp_path):
 
 
 @pytest.fixture()
+def sharded_storage(tmp_path):
+    """The horizontal-scale deployment: TWO live storage-server shards
+    (each owning its own sqlite store) composed by the entity-hash
+    sharded backend for events, with metadata/models on shard 0 —
+    the reference's HBase region-distribution role
+    (HBEventsUtil.scala:74-142) run through the same spec bodies."""
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.server.storageserver import (
+        StorageServerConfig, create_storage_server,
+    )
+
+    backings, servers = [], []
+    for i in range(2):
+        b = Storage(env={
+            "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / f"shard{i}.db"),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+        })
+        s = create_storage_server(
+            b, StorageServerConfig(ip="127.0.0.1", port=0))
+        s.start()
+        backings.append(b)
+        servers.append(s)
+    urls = ",".join(f"http://127.0.0.1:{s.port}" for s in servers)
+    client = Storage(env={
+        "PIO_STORAGE_SOURCES_SH_TYPE": "sharded",
+        "PIO_STORAGE_SOURCES_SH_URLS": urls,
+        "PIO_STORAGE_SOURCES_META_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_META_URL":
+            f"http://127.0.0.1:{servers[0].port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "META",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SH",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "META",
+    })
+    yield client
+    client.close()
+    for s in servers:
+        s.stop()
+    for b in backings:
+        b.close()
+
+
+@pytest.fixture()
 def cli(memory_storage, capsys):
     """Invoke the CLI in-process with its global storage pointed at the
     test's memory store: cli("verb", ...) -> (exit_code, captured)."""
@@ -180,7 +225,8 @@ def mysql_storage():
         admin.close()
 
 
-@pytest.fixture(params=["memory", "sqlite", "remote", "postgres", "mysql"])
+@pytest.fixture(params=["memory", "sqlite", "remote", "sharded",
+                        "postgres", "mysql"])
 def any_storage(request):
     """Parameterized over backends — including the networked remote backend
     and (when PIO_TEST_PG_DSN points at a server) live PostgreSQL —
