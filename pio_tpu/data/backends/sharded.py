@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import hashlib
 import heapq
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from datetime import datetime
 from typing import Iterable, Iterator, Sequence
 
@@ -121,7 +121,13 @@ class ShardedEventsDAO(daomod.EventsDAO):
 
     def get(self, event_id: str, app_id: int,
             channel_id: int | None = None) -> Event | None:
-        for ev in self._all(lambda s: s.get(event_id, app_id, channel_id)):
+        # return on the FIRST shard that has it — ids are unique, so a
+        # hit is authoritative and need not wait for a slow sibling; a
+        # full miss still awaits every shard so errors surface
+        futs = [self._pool.submit(s.get, event_id, app_id, channel_id)
+                for s in self.shards]
+        for f in as_completed(futs):
+            ev = f.result()
             if ev is not None:
                 return ev
         return None
@@ -130,6 +136,15 @@ class ShardedEventsDAO(daomod.EventsDAO):
                channel_id: int | None = None) -> bool:
         return any(self._all(
             lambda s: s.delete(event_id, app_id, channel_id)))
+
+    def delete_many(self, event_ids: Sequence[str], app_id: int,
+                    channel_id: int | None = None) -> int:
+        # one parallel bulk delete per shard instead of the inherited
+        # ids x shards sequential loop; exact because event ids are
+        # disjoint across shards (each id exists on at most one)
+        ids = list(event_ids)
+        return sum(self._all(
+            lambda s: s.delete_many(ids, app_id, channel_id)))
 
     # -- queries ------------------------------------------------------------
 

@@ -17,13 +17,48 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import re
 import threading
+import time
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
+
+log = logging.getLogger("pio_tpu.http")
+
+# fixed-port binds retry briefly before giving up (reference
+# CreateServer.scala:365-375): a just-stopped predecessor's socket can
+# linger in TIME_WAIT across a redeploy. port=0 never collides, so
+# ephemeral binds fail fast.
+BIND_ATTEMPTS = 3
+BIND_RETRY_DELAY_S = 1.0
+
+
+def _bind_retry_continues(port: int, err: OSError, attempt: int) -> bool:
+    """Shared retry policy for both transports: True = log + retry,
+    False = out of attempts (caller re-raises). One place so the sync
+    and async servers cannot drift."""
+    attempts = BIND_ATTEMPTS if port else 1
+    if attempt >= attempts - 1:
+        return False
+    log.warning("bind to port %d failed (%s); retry %d/%d in %.0fs",
+                port, err, attempt + 1, attempts - 1, BIND_RETRY_DELAY_S)
+    return True
+
+
+def bind_with_retry(make, port: int):
+    """Call make() (which binds a socket), retrying OSError up to
+    BIND_ATTEMPTS times for fixed ports."""
+    for attempt in range(BIND_ATTEMPTS):
+        try:
+            return make()
+        except OSError as e:
+            if not _bind_retry_continues(port, e, attempt):
+                raise
+            time.sleep(BIND_RETRY_DELAY_S)
 
 
 @dataclass
@@ -154,7 +189,8 @@ class HttpServer:
 
             do_GET = do_POST = do_DELETE = do_PUT = _handle
 
-        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server = bind_with_retry(
+            lambda: ThreadingHTTPServer((host, port), _Handler), port)
         if ssl_context is not None:
             self._server.socket = ssl_context.wrap_socket(
                 self._server.socket, server_side=True
@@ -318,10 +354,17 @@ class AsyncHttpServer:
     # -- lifecycle -----------------------------------------------------------
     async def _amain(self):
         self._main_task = asyncio.current_task()
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port, ssl=self._ssl,
-            limit=_MAX_HEADER,
-        )
+        for attempt in range(BIND_ATTEMPTS):
+            try:
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self.host, self.port, ssl=self._ssl,
+                    limit=_MAX_HEADER,
+                )
+                break
+            except OSError as e:
+                if not _bind_retry_continues(self.port, e, attempt):
+                    raise
+                await asyncio.sleep(BIND_RETRY_DELAY_S)
         self.port = self._server.sockets[0].getsockname()[1]
         self._ready.set()
         async with self._server:

@@ -95,3 +95,80 @@ def test_connection_close_honored(server):
             assert r.getheader("Connection", "").lower() == "close"
     finally:
         conn.close()
+
+
+def test_fixed_port_bind_retries_then_succeeds(monkeypatch):
+    """CreateServer.scala:365-375 parity: a fixed-port bind colliding with
+    a lingering predecessor retries instead of dying. Simulated by holding
+    the port during construction and releasing it from a timer."""
+    import socket
+    import threading
+
+    from pio_tpu.server import http as httpmod
+
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", 0))
+    port = blocker.getsockname()[1]
+    blocker.listen(1)
+    monkeypatch.setattr(httpmod, "BIND_RETRY_DELAY_S", 0.5)
+    # release well before the final attempt at t=1.0 (CI scheduling margin)
+    threading.Timer(0.6, blocker.close).start()
+    app = HttpApp("retry")
+
+    @app.route("GET", r"/ping")
+    def ping(req):
+        return 200, {"ok": True}
+
+    srv = HttpServer(app, host="127.0.0.1", port=port)
+    try:
+        srv.start()
+        assert srv.port == port
+    finally:
+        srv.stop()
+
+
+def test_fixed_port_bind_gives_up_after_attempts(monkeypatch):
+    import socket
+
+    from pio_tpu.server import http as httpmod
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    port = blocker.getsockname()[1]
+    blocker.listen(1)
+    monkeypatch.setattr(httpmod, "BIND_RETRY_DELAY_S", 0.05)
+    app = HttpApp("retry2")
+    try:
+        with pytest.raises(OSError):
+            HttpServer(app, host="127.0.0.1", port=port)
+    finally:
+        blocker.close()
+
+
+def test_async_fixed_port_bind_retries(monkeypatch):
+    import socket
+    import threading
+
+    from pio_tpu.server import http as httpmod
+
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", 0))
+    port = blocker.getsockname()[1]
+    blocker.listen(1)
+    monkeypatch.setattr(httpmod, "BIND_RETRY_DELAY_S", 0.5)
+    # release well before the final attempt at t=1.0 (CI scheduling margin)
+    threading.Timer(0.6, blocker.close).start()
+    app = HttpApp("retry3")
+
+    @app.route("GET", r"/ping")
+    def ping(req):
+        return 200, {"ok": True}
+
+    srv = AsyncHttpServer(app, host="127.0.0.1", port=port)
+    try:
+        srv.start()
+        assert srv.port == port
+    finally:
+        srv.stop()

@@ -121,3 +121,11 @@ def test_sharded_backend_requires_urls():
 def test_zero_shards_rejected():
     with pytest.raises(StorageError, match="at least one"):
         ShardedEventsDAO([])
+
+
+def test_delete_many_fans_out_and_counts(sharded_storage):
+    dao = sharded_storage.get_events()
+    dao.init(1)
+    ids = dao.insert_batch([ev(f"u{i}", i) for i in range(14)], 1)
+    assert dao.delete_many(ids[:10] + ["missing"], 1) == 10
+    assert len(list(dao.find(1, limit=-1))) == 4
