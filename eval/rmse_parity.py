@@ -295,7 +295,10 @@ def main() -> int:
 
     result["transport"] = telemetry()
     here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "RMSE_PARITY.json"), "w") as f:
+    # non-full scales get their own files: a CPU fallback run must not
+    # clobber committed full-shape evidence
+    suffix = "" if args.scale == "full" else f"_{args.scale}"
+    with open(os.path.join(here, f"RMSE_PARITY{suffix}.json"), "w") as f:
         json.dump(result, f, indent=2)
 
     lines = [
@@ -345,7 +348,7 @@ def main() -> int:
         f"- Train wall-clock: auto {cg_sec:.1f}s vs Cholesky {ch_sec:.1f}s "
         f"for {SWEEPS} sweeps",
     ]
-    with open(os.path.join(here, "RMSE_PARITY.md"), "w") as f:
+    with open(os.path.join(here, f"RMSE_PARITY{suffix}.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     print(json.dumps({"final_rel_gap": result["final_rel_gap"],
                       "parity": result["parity"],

@@ -290,6 +290,14 @@ class _RemoteEvents(_Remote, d.EventsDAO):
             "delete", event_id=event_id, app_id=app_id, channel_id=channel_id
         ))
 
+    def delete_many(self, event_ids, app_id, channel_id=None):
+        # one round trip; the server delegates to its local DAO, which
+        # may have a bulk primitive (eventlog tombstones) or loop locally
+        return int(self.call(
+            "delete_many", event_ids=list(event_ids), app_id=app_id,
+            channel_id=channel_id,
+        ))
+
     def find(
         self,
         app_id: int,

@@ -138,6 +138,8 @@ _METHODS = {
             dao.get(kw["event_id"], kw["app_id"], kw.get("channel_id"))),
         "delete": lambda dao, kw: dao.delete(
             kw["event_id"], kw["app_id"], kw.get("channel_id")),
+        "delete_many": lambda dao, kw: dao.delete_many(
+            kw["event_ids"], kw["app_id"], kw.get("channel_id")),
         "find": lambda dao, kw: [
             w.event_to_wire(e) for e in dao.find(
                 kw["app_id"], kw.get("channel_id"),

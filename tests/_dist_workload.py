@@ -29,7 +29,59 @@ from pio_tpu.parallel.mesh import DATA_AXIS
 N_USERS, N_ITEMS, NNZ = 64, 50, 2000
 
 
-def run_workload(mesh):
+def seed_shared_storage(storage, app_name: str = "distapp") -> None:
+    """Populate a storage backing with the workload's ratings as events
+    (called by the test parent on the server's own Storage)."""
+    from pio_tpu.data import DataMap, Event
+    from pio_tpu.data.dao import App
+
+    app_id = storage.get_metadata_apps().insert(App(0, app_name))
+    dao = storage.get_events()
+    dao.init(app_id)
+    rng = np.random.RandomState(0)
+    u = rng.randint(0, N_USERS, NNZ)
+    i = rng.randint(0, N_ITEMS, NNZ)
+    v = (rng.rand(NNZ) * 4 + 1).astype(np.float32)
+    dao.insert_batch([
+        Event(event="rate", entity_type="user", entity_id=f"u{u[m]:03d}",
+              target_entity_type="item", target_entity_id=f"i{i[m]:03d}",
+              properties=DataMap({"rating": float(v[m])}))
+        for m in range(NNZ)
+    ], app_id)
+
+
+def _load_coo(storage_port: int | None):
+    """The training read. With a port: every process mounts the SHARED
+    storage server over HTTP and reads the same columnarized COO — the
+    multi-host data plane the reference delegates to Spark+HBase. The
+    id->dense-index mapping is deterministic because all readers see one
+    server's single scan order. Without a port: in-process synth."""
+    if storage_port is None:
+        rng = np.random.RandomState(0)
+        u = rng.randint(0, N_USERS, NNZ)
+        i = rng.randint(0, N_ITEMS, NNZ)
+        v = (rng.rand(NNZ) * 4 + 1).astype(np.float32)
+        return u, i, v, N_USERS, N_ITEMS
+    from pio_tpu.data.eventstore import EventStore
+    from pio_tpu.data.storage import Storage
+
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{storage_port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+    })
+    inter = EventStore(storage).interactions("distapp")
+    # duplicates of a (user,item) pair dedup to the last rating, so the
+    # COO is somewhat smaller than NNZ; what matters for the parity
+    # check is that every process reads the identical columns
+    assert inter.user_idx.shape[0] > NNZ // 2, inter.user_idx.shape
+    return (inter.user_idx, inter.item_idx, inter.values,
+            inter.n_users, inter.n_items)
+
+
+def run_workload(mesh, storage_port: int | None = None):
     """-> (user_factors, item_factors, losses) as host numpy.
 
     Works in single- and multi-process mode: results are fetched with
@@ -37,10 +89,7 @@ def run_workload(mesh):
     The mesh must have data axis 2 and model axis 2 for the cross-topology
     agreement guarantee above to hold.
     """
-    rng = np.random.RandomState(0)
-    u = rng.randint(0, N_USERS, NNZ)
-    i = rng.randint(0, N_ITEMS, NNZ)
-    v = (rng.rand(NNZ) * 4 + 1).astype(np.float32)
+    u, i, v, N_USERS, N_ITEMS = _load_coo(storage_port)
     model = als_train_sharded(
         u, i, v, N_USERS, N_ITEMS,
         ALSParams(rank=8, iterations=3, reg=0.1, implicit=False, seed=7),
@@ -70,7 +119,7 @@ def run_workload(mesh):
     losses = []
     for s in range(p.steps):
         idx = np.random.default_rng((p.seed, s)).integers(
-            0, NNZ, size=p.batch_size
+            0, u.shape[0], size=p.batch_size
         )
         ub = jax.device_put(u[idx].astype(np.int32), b_shard)
         ib = jax.device_put(i[idx].astype(np.int32), b_shard)

@@ -64,6 +64,7 @@ def test_mixed_env_and_args_is_complete(monkeypatch):
 _CHILD = """
 import os, sys
 port, pid, expected_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+storage_port = int(sys.argv[4]) if len(sys.argv) > 4 else None
 os.environ["PIO_TPU_COORDINATOR"] = "127.0.0.1:" + port
 os.environ["PIO_TPU_NUM_PROCESSES"] = "2"
 os.environ["PIO_TPU_PROCESS_ID"] = str(pid)
@@ -83,7 +84,7 @@ from pio_tpu.parallel.mesh import MeshConfig, create_mesh
 from _dist_workload import run_workload
 
 mesh = create_mesh(MeshConfig(data=2, seq=1, model=2))
-uf, itf, losses = run_workload(mesh)
+uf, itf, losses = run_workload(mesh, storage_port=storage_port)
 exp = np.load(expected_path)
 np.testing.assert_allclose(uf, exp["uf"], atol=2e-4)
 np.testing.assert_allclose(itf, exp["itf"], atol=2e-4)
@@ -135,6 +136,71 @@ def test_two_process_collectives_match_single_process(tmp_path):
         outs.append((out, err))
     for pid, (out, err) in enumerate(outs):
         assert f"CHILD_OK {pid}" in out, f"process {pid} failed:\n{err}"
+
+
+def test_two_process_training_from_shared_storage_server(tmp_path):
+    """The full multi-host data plane, ours end to end: a storage server
+    owns the events; TWO OS processes join one jax.distributed runtime,
+    each mounts the server over HTTP, reads the same columnarized COO
+    (EventStore.interactions), and trains sharded ALS + dp x tp
+    two-tower with cross-process collectives — results must match a
+    single-process 4-device run reading from the SAME server. The
+    reference leans on Spark+HBase for exactly this (SURVEY §4: no
+    multi-node tests upstream); here it is tested for real."""
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.parallel.mesh import MeshConfig, create_mesh
+    from pio_tpu.server.storageserver import (
+        StorageServerConfig, create_storage_server,
+    )
+    from _dist_workload import run_workload, seed_shared_storage
+
+    backing = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    seed_shared_storage(backing)
+    server = create_storage_server(
+        backing, StorageServerConfig(ip="127.0.0.1", port=0))
+    server.start()
+    try:
+        import jax
+
+        ref_mesh = create_mesh(
+            MeshConfig(data=2, seq=1, model=2), devices=jax.devices()[:4]
+        )
+        uf, itf, losses = run_workload(ref_mesh, storage_port=server.port)
+        expected = tmp_path / "expected_shared.npz"
+        np.savez(expected, uf=uf, itf=itf, losses=losses)
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord_port = s.getsockname()[1]
+        code = _CHILD.format(repo="/root/repo")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", code, str(coord_port), str(pid),
+                 str(expected), str(server.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd="/root/repo",
+            )
+            for pid in range(2)
+        ]
+        outs = []
+        for pid, proc in enumerate(procs):
+            try:
+                out, err = proc.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                raise
+            outs.append((out, err))
+        for pid, (out, err) in enumerate(outs):
+            assert f"CHILD_OK {pid}" in out, f"process {pid} failed:\n{err}"
+    finally:
+        server.stop()
+        backing.close()
 
 
 def test_real_coordinator_single_process():
