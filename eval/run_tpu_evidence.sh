@@ -1,24 +1,34 @@
 #!/usr/bin/env bash
 # One-shot TPU evidence capture, in priority order — run the moment the
-# axon tunnel answers (every probe hung for the whole of round 3). Each
-# step is independently committed-worthy; later steps are gravy if the
-# tunnel dies again mid-run.
+# axon tunnel answers (every round-end probe has hung, rounds 1-4; round
+# 4's diagnosis: relay TCP open, device claim never granted). Each step
+# is independently committed-worthy; later steps are gravy if the tunnel
+# dies again mid-run. Ordered cheapest-highest-value first so a brief
+# tunnel window still lands the round-defining artifacts.
 #
 #   bash eval/run_tpu_evidence.sh          # writes eval/TPU_* artifacts
 #
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/4 headline bench (full shape, probe ladder) =="
-python bench.py | tee eval/TPU_BENCH_r03.json
+echo "== 1/6 snapshot (probe + train only -> eval/TPU_BENCH_r05.json) =="
+python bench.py --snapshot
 
-echo "== 2/4 accumulation A/B (picks carry/stacked/pallas on hardware) =="
+echo "== 2/6 accumulation + GATHER A/B (flips ALSParams.gather auto on a win) =="
 python eval/als_accum_bench.py --out eval/ALS_ACCUM_BENCH.json || true
 
-echo "== 3/4 serving tail on-device =="
-python eval/serving_tail.py || true
+echo "== 3/6 per-phase profile (feeds the roofline accounting) =="
+python eval/als_phase_profile.py || true
 
-echo "== 4/4 full-shape quality artifact on TPU =="
+echo "== 4/6 serving decomposition on-device (tunnel RTT vs dispatch) =="
+python eval/serving_decomposition.py || true
+
+echo "== 5/6 full headline bench (all phases, probe ladder) =="
+python bench.py | tee eval/TPU_BENCH_full_r05.json || true
+
+echo "== 6/6 full-shape quality artifact on TPU (longest; best-sweep curve) =="
 python eval/rmse_parity.py --scale full || true
 
-echo "== done; commit eval/TPU_BENCH_r03.json + regenerated artifacts =="
+echo "== done; commit eval/TPU_BENCH_r05.json, eval/TPU_BENCH_full_r05.json"
+echo "== and every regenerated artifact =="
+echo "== if the gather A/B showed a win, flip ALSParams.gather auto =="

@@ -17,6 +17,7 @@ as the "DB query inside the predict path" hazard — reads are bounded by
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -102,6 +103,19 @@ class ECommAlgorithmParams(Params):
     seen_events: tuple[str, ...] = ("view", "buy")
     recent_events: tuple[str, ...] = ("view",)   # cold-start signal
     recent_count: int = 10
+    # TTL (seconds) for the serve-time "unavailableItems" constraint read
+    # — a GLOBAL aggregate that otherwise runs once per query, the
+    # "DB query inside the predict path" hazard SURVEY §7 flags. Default
+    # 0 = live read per query (reference behavior,
+    # ALSAlgorithm.scala:232-260 — except that on a storage outage the
+    # last successfully-read set serves instead of the reference's
+    # empty set, which would UN-filter unavailable items mid-outage);
+    # production deployments set e.g. 1-5 s
+    # to keep the hot predict path off storage, trading bounded
+    # staleness of the unavailable-items set. The per-user seen-items
+    # read stays live either way: a just-bought item must drop out of
+    # the very next recommendation.
+    constraint_cache_ttl_s: float = 0.0
 
 
 @jax.tree_util.register_pytree_node_class
@@ -132,6 +146,8 @@ class ECommAlgorithm(PAlgorithm):
     def __init__(self, params: ECommAlgorithmParams):
         self.params = params
         self._event_store = None  # bound at predict time via ctx-free reads
+        # (expiry_monotonic, frozenset) for _unavailable_items
+        self._constraint_cache: tuple[float, set[str]] | None = None
 
     def train(self, ctx, data: ECommerceData) -> ECommerceModel:
         data.sanity_check()
@@ -189,17 +205,33 @@ class ECommAlgorithm(PAlgorithm):
 
     def _unavailable_items(self) -> set[str]:
         """Constraint entity 'unavailableItems' (reference
-        ALSAlgorithm.scala:232-260: latest $set on constraint entity)."""
+        ALSAlgorithm.scala:232-260: latest $set on constraint entity),
+        TTL-cached per ECommAlgorithmParams.constraint_cache_ttl_s so the
+        hot predict path is not gated on a storage aggregate per query."""
         if self._event_store is None:
             return set()
+        ttl = self.params.constraint_cache_ttl_s
+        now = time.monotonic()
+        cached = self._constraint_cache
+        if ttl > 0 and cached is not None and now < cached[0]:
+            return cached[1]
         try:
             props = self._event_store.aggregate_properties(
                 app_name=self.params.app_name, entity_type="constraint"
             )
             pm = props.get("unavailableItems")
-            return set(pm.get_or_else("items", [])) if pm else set()
+            out = set(pm.get_or_else("items", [])) if pm else set()
         except Exception:  # noqa: BLE001
-            return set()
+            # storage outage must not kill serving: serve the stale set
+            # if we have one (bounded by the outage, not the TTL) and
+            # RE-ARM a short expiry so a hanging backend gates one query
+            # per second, not every query for the whole outage
+            stale = cached[1] if cached is not None else set()
+            if ttl > 0:
+                self._constraint_cache = (now + min(ttl, 1.0), stale)
+            return stale
+        self._constraint_cache = (now + ttl, out)
+        return out
 
     def _recent_item_vector(self, model: ECommerceModel, user: str):
         """Cold start: average factors of recently-viewed items (reference

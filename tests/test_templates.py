@@ -360,3 +360,45 @@ def test_ecommerce_category_filter(ecommerce_storage):
     }
     expected = min(5, 10 - sum(1 for s in seen if int(s[1:]) >= 10))
     assert len(r["itemScores"]) == expected
+
+
+def test_ecommerce_constraint_cache_ttl(ecommerce_storage, monkeypatch):
+    """Opt-in TTL cache for the global unavailableItems aggregate (the
+    SURVEY §7 'DB query inside the predict path' hazard): within the TTL
+    the cached set serves (no storage read); after expiry the next query
+    refreshes. Default ttl=0 is the live-read reference behavior, covered
+    by test_ecommerce_unavailable_constraint above."""
+    from pio_tpu.models import ecommerce as ec
+
+    engine, ep, ctx, model, algo = make_ecomm(ecommerce_storage)
+    import dataclasses
+
+    algo.params = dataclasses.replace(
+        algo.params, constraint_cache_ttl_s=60.0)
+    app_id = ecommerce_storage.get_metadata_apps().get_by_name("shopapp").id
+
+    before = [s["item"] for s in
+              algo.predict(model, {"user": "u1", "num": 5})["itemScores"]]
+    assert before
+    ecommerce_storage.get_events().insert(
+        _set("constraint", "unavailableItems", {"items": [before[0]]},
+             minute=9999), app_id)
+    # within the TTL: the stale (empty) cached set serves — and storage
+    # is not consulted at all
+    calls = {"n": 0}
+    real = algo._event_store.aggregate_properties
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(algo._event_store, "aggregate_properties", counting)
+    stale = [s["item"] for s in
+             algo.predict(model, {"user": "u1", "num": 5})["itemScores"]]
+    assert before[0] in stale and calls["n"] == 0
+    # expire the cache: next query refreshes and the item drops out
+    t_exp, cached_set = algo._constraint_cache
+    algo._constraint_cache = (ec.time.monotonic() - 1, cached_set)
+    fresh = [s["item"] for s in
+             algo.predict(model, {"user": "u1", "num": 5})["itemScores"]]
+    assert before[0] not in fresh and calls["n"] == 1
