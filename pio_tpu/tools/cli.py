@@ -473,6 +473,40 @@ def cmd_deploy(args) -> int:
     return 0
 
 
+def cmd_batchpredict(args) -> int:
+    """Offline bulk scoring through the full serving composition
+    (workflow/batchpredict.py); no HTTP server involved."""
+    import contextlib
+    import sys as _sys
+
+    from pio_tpu.workflow.batchpredict import run_batch_predict
+    from pio_tpu.workflow.context import create_workflow_context
+
+    variant = _load_variant(args.engine_dir)
+    engine, ep = _engine_from_variant(variant, args.engine_dir)
+    engine_id, engine_version, engine_variant = _engine_ids(
+        variant, args.engine_dir
+    )
+    storage = get_storage()
+    ctx = create_workflow_context(storage, use_mesh=not args.no_mesh)
+    with contextlib.ExitStack() as stack:
+        inp = (_sys.stdin if args.input == "-"
+               else stack.enter_context(open(args.input)))
+        out = (_sys.stdout if args.output == "-"
+               else stack.enter_context(open(args.output, "w")))
+        report = run_batch_predict(
+            engine, ep, storage, inp, out,
+            engine_id=engine_id, engine_version=engine_version,
+            engine_variant=engine_variant,
+            instance_id=args.engine_instance_id,
+            batch_size=args.batch_size, ctx=ctx,
+        )
+    print(f"Batch predict done: {report.n_queries} queries"
+          + (f", {report.n_errors} malformed" if report.n_errors else ""),
+          file=_sys.stderr)
+    return 0
+
+
 def cmd_undeploy(args) -> int:
     """POST /stop to a running deploy server (reference Console.undeploy)."""
     url = f"http://{args.ip}:{args.port}/stop"
@@ -858,6 +892,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "whatever queued during the previous execution); "
                         "0 = off")
     x.set_defaults(fn=cmd_deploy)
+
+    x = sub.add_parser(
+        "batchpredict",
+        help="offline bulk scoring: JSON-lines queries in, "
+             "{query, prediction} JSON-lines out (0.13-era verb; device "
+             "batches amortize the per-query dispatch)")
+    engine_dir_arg(x)
+    x.add_argument("--input", required=True,
+                   help="queries file, one JSON object per line "
+                        "('-' = stdin)")
+    x.add_argument("--output", required=True,
+                   help="predictions file ('-' = stdout)")
+    x.add_argument("--engine-instance-id")
+    x.add_argument("--batch-size", type=int, default=256,
+                   help="queries per device batch")
+    x.add_argument("--no-mesh", action="store_true")
+    x.set_defaults(fn=cmd_batchpredict)
 
     x = sub.add_parser("undeploy")
     x.add_argument("--ip", default="127.0.0.1")

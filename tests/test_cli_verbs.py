@@ -283,3 +283,45 @@ def test_deploy_and_undeploy_subprocess(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+def test_batchpredict_verb(cli, memory_storage, tmp_path):
+    """`pio batchpredict`: train, then bulk-score a JSON-lines file through
+    the full serving composition — outputs preserve order, malformed lines
+    become error records without aborting (0.13-era verb; this incubator
+    reference predates it, migrating users expect it)."""
+    _seed(memory_storage, "batchapp")
+    eng = tmp_path / "eng"
+    eng.mkdir()
+    (eng / "engine.json").write_text(json.dumps({
+        "id": "batchrec",
+        "engineFactory":
+            "pio_tpu.models.recommendation.RecommendationEngine",
+        "datasource": {"params": {"app_name": "batchapp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "num_iterations": 2, "lambda_": 0.05, "chunk": 512}}],
+    }))
+    code, _ = cli("train", "--engine-dir", str(eng), "--no-mesh")
+    assert code == 0
+
+    queries = tmp_path / "queries.jsonl"
+    queries.write_text(
+        json.dumps({"user": "u0", "num": 3}) + "\n"
+        + "this is not json\n"
+        + "\n"                                          # blank: skipped
+        + json.dumps({"usr": "oops", "num": 1}) + "\n"  # engine-rejected
+        + json.dumps({"user": "u1", "num": 2}) + "\n")
+    outfile = tmp_path / "preds.jsonl"
+    code, cap = cli("batchpredict", "--engine-dir", str(eng),
+                    "--input", str(queries), "--output", str(outfile),
+                    "--no-mesh", "--batch-size", "2")
+    assert code == 0
+    lines = [json.loads(x) for x in outfile.read_text().splitlines()]
+    assert len(lines) == 4
+    # order preserved; both failure kinds isolated as error records
+    assert lines[0]["query"] == {"user": "u0", "num": 3}
+    assert len(lines[0]["prediction"]["itemScores"]) == 3
+    assert "error" in lines[1] and lines[1]["query"] == "this is not json"
+    assert "error" in lines[2]      # valid JSON the ENGINE rejects
+    assert lines[2]["query"] == {"usr": "oops", "num": 1}
+    assert lines[3]["query"] == {"user": "u1", "num": 2}
+    assert len(lines[3]["prediction"]["itemScores"]) == 2
