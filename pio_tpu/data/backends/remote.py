@@ -290,6 +290,51 @@ class _RemoteEvents(_Remote, d.EventsDAO):
             "delete", event_id=event_id, app_id=app_id, channel_id=channel_id
         ))
 
+    def columnarize(
+        self,
+        app_id,
+        channel_id=None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        event_names=None,
+        target_entity_type=...,
+        value_key="rating",
+        default_value=1.0,
+        dedup="last",
+        value_event=None,
+    ):
+        """Server-side training read: the scan/value-extract/dedup/encode
+        fold runs on the storage server (its native C++ sweep when the
+        backing store is the eventlog), and only compact COO columns
+        cross the wire — the region-side scan of HBPEvents.scala, not a
+        client-side fold over event JSON. Returns native.eventlog.Columns
+        with times_us always empty (not shipped: no remote consumer
+        reads it and it would be ~25% of the payload)."""
+        import numpy as np
+
+        from pio_tpu.native.eventlog import Columns
+
+        q = w.find_kwargs_to_wire(
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+        r = self.call(
+            "columnarize", app_id=app_id, channel_id=channel_id, query=q,
+            valueKey=value_key, defaultValue=default_value, dedup=dedup,
+            valueEvent=value_event,
+        )
+        return Columns(
+            user_idx=np.asarray(r["userIdx"], dtype=np.uint32),
+            item_idx=np.asarray(r["itemIdx"], dtype=np.uint32),
+            values=np.asarray(r["values"], dtype=np.float32),
+            # not on the wire by design (~25% payload, zero consumers)
+            times_us=np.empty(0, dtype=np.int64),
+            users=list(r["users"]),
+            items=list(r["items"]),
+        )
+
     def delete_many(self, event_ids, app_id, channel_id=None):
         # one round trip; the server delegates to its local DAO, which
         # may have a bulk primitive (eventlog tombstones) or loop locally

@@ -188,6 +188,81 @@ class ShardedEventsDAO(daomod.EventsDAO):
                 break
             yield ev
 
+    def columnarize(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        value_key: str | None = "rating",
+        default_value: float = 1.0,
+        dedup: str = "last",
+        value_event: str | None = None,
+    ):
+        """Region-parallel training read (HBPEvents.scala role): every
+        shard columnarizes ITS events server-side concurrently, then the
+        per-shard dense codes are remapped into one global id space and
+        concatenated. Dedup correctness is structural — but ONLY when
+        entity_type is pinned: the routing key is (entity_type,
+        entity_id) while the dedup key is (entity_id, target_id), so
+        with entity_type=None two types sharing an id can land on
+        different shards and their per-shard folds would both survive.
+        That case falls back to a global find+fold. times_us is dropped
+        in the merge (shards' clocks interleave; no consumer reads it
+        from the composite)."""
+        import numpy as np
+
+        from pio_tpu.native.eventlog import Columns
+
+        if entity_type is None:
+            from pio_tpu.data.eventstore import (
+                columnarize_via_find, interactions_to_columns,
+            )
+
+            return interactions_to_columns(columnarize_via_find(
+                self, app_id, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+                entity_type=entity_type, event_names=event_names,
+                target_entity_type=target_entity_type,
+                value_key=value_key, default_value=default_value,
+                dedup=dedup, value_event=value_event))
+        parts = self._all(
+            lambda s: s.columnarize(
+                app_id, channel_id=channel_id, start_time=start_time,
+                until_time=until_time, entity_type=entity_type,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                value_key=value_key, default_value=default_value,
+                dedup=dedup, value_event=value_event))
+        users: dict[str, int] = {}
+        items: dict[str, int] = {}
+        u_cols, i_cols, v_cols = [], [], []
+        for part in parts:
+            if not len(part.values):
+                continue
+            u_map = np.fromiter(
+                (users.setdefault(u, len(users)) for u in part.users),
+                dtype=np.int64, count=len(part.users))
+            i_map = np.fromiter(
+                (items.setdefault(i, len(items)) for i in part.items),
+                dtype=np.int64, count=len(part.items))
+            u_cols.append(u_map[part.user_idx].astype(np.uint32))
+            i_cols.append(i_map[part.item_idx].astype(np.uint32))
+            v_cols.append(part.values)
+        cat = (lambda xs, dt: np.concatenate(xs) if xs
+               else np.empty(0, dtype=dt))
+        return Columns(
+            user_idx=cat(u_cols, np.uint32),
+            item_idx=cat(i_cols, np.uint32),
+            values=cat(v_cols, np.float32),
+            times_us=np.empty(0, dtype=np.int64),
+            users=list(users),
+            items=list(items),
+        )
+
     def aggregate_properties(
         self,
         app_id: int,

@@ -141,13 +141,6 @@ class EventStore:
                 items=EntityIdIndex(cols.items),
             )
 
-        def value_fn(e: Event) -> float:
-            if value_key is not None and (
-                value_event is None or e.event == value_event
-            ):
-                return float(e.properties.get_or_else(value_key, default_value))
-            return default_value
-
         events = self.find(
             app_name=app_name,
             channel_name=channel_name,
@@ -157,7 +150,11 @@ class EventStore:
             target_entity_type=target_entity_type,
             event_names=event_names,
         )
-        return to_interactions(events, value_fn=value_fn, dedup=dedup)
+        return to_interactions(
+            events,
+            value_fn=make_value_fn(value_key, default_value, value_event),
+            dedup=dedup,
+        )
 
     def find_by_entity(
         self,
@@ -222,6 +219,70 @@ class Interactions:
                 "Interactions is empty. Please check if DataSource generates"
                 " TrainingData and eventWindow is set properly."
             )
+
+
+def make_value_fn(value_key: str | None, default_value: float,
+                  value_event: str | None):
+    """THE value-extraction semantics of the training read, shared by
+    every columnarize fold (EventStore.interactions' client fallback,
+    the storage server's RPC fallback, the sharded cross-type fallback)
+    so the dialects cannot drift: `value_key` reads a numeric property
+    (None = always default), `value_event` restricts that read to one
+    event name (others take default) — the reference recommendation
+    template's rate-vs-buy rule."""
+
+    def value_fn(e: Event) -> float:
+        if value_key is not None and (
+            value_event is None or e.event == value_event
+        ):
+            return float(e.properties.get_or_else(value_key, default_value))
+        return default_value
+
+    return value_fn
+
+
+def columnarize_via_find(dao, app_id: int, channel_id: int | None = None,
+                         start_time: datetime | None = None,
+                         until_time: datetime | None = None,
+                         entity_type: str | None = None,
+                         event_names: Sequence[str] | None = None,
+                         target_entity_type=...,
+                         value_key: str | None = "rating",
+                         default_value: float = 1.0,
+                         dedup: str = "last",
+                         value_event: str | None = None) -> Interactions:
+    """Generic columnarize over a bare EventsDAO (by app_id, not app
+    name): find + fold. The shared fallback for DAOs without a native
+    columnarize — used by the storage server's RPC handler and the
+    sharded backend's cross-type path."""
+    events = dao.find(
+        app_id, channel_id,
+        start_time=start_time, until_time=until_time,
+        entity_type=entity_type, event_names=event_names,
+        target_entity_type=target_entity_type, limit=-1,
+    )
+    return to_interactions(
+        events,
+        value_fn=make_value_fn(value_key, default_value, value_event),
+        dedup=dedup,
+    )
+
+
+def interactions_to_columns(inter: Interactions):
+    """Interactions -> native.eventlog.Columns (times_us empty: the
+    fold dedups before times could be aligned)."""
+    import numpy as np
+
+    from pio_tpu.native.eventlog import Columns
+
+    return Columns(
+        user_idx=inter.user_idx.astype(np.uint32),
+        item_idx=inter.item_idx.astype(np.uint32),
+        values=inter.values,
+        times_us=np.empty(0, dtype=np.int64),
+        users=inter.users.ids(),
+        items=inter.items.ids(),
+    )
 
 
 def to_interactions(

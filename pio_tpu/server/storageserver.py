@@ -144,6 +144,7 @@ _METHODS = {
             w.event_to_wire(e) for e in dao.find(
                 kw["app_id"], kw.get("channel_id"),
                 **w.find_kwargs_from_wire(kw.get("query", {})))],
+        "columnarize": lambda dao, kw: _columnarize_rpc(dao, kw),
         "aggregate_properties": lambda dao, kw: {
             eid: w.property_map_to_wire(p)
             for eid, p in dao.aggregate_properties(
@@ -154,6 +155,46 @@ _METHODS = {
             ).items()},
     },
 }
+
+
+def _columnarize_rpc(dao, kw: dict) -> dict:
+    """Server-side training read: filter + value-extract + dedup + dict-
+    encode happen HERE, so a remote trainer receives compact COO columns
+    (5 scalars/row) instead of full event JSON — the reference's
+    region-side scan (HBPEvents.scala) rather than a client-side fold.
+    Delegates to the backing DAO's native columnarize when it has one
+    (eventlog: one C++ sweep); otherwise folds via find. times_us is
+    only available on the native path (the generic fold dedups before
+    times could be aligned) — empty means "not provided"."""
+    from pio_tpu.data.eventstore import (
+        columnarize_via_find, interactions_to_columns,
+    )
+
+    q = kw.get("query") or {}
+    fkw = w.find_kwargs_from_wire(q)
+    common = dict(
+        app_id=kw["app_id"], channel_id=kw.get("channel_id"),
+        start_time=fkw["start_time"], until_time=fkw["until_time"],
+        entity_type=fkw["entity_type"], event_names=fkw["event_names"],
+        target_entity_type=fkw["target_entity_type"],
+        value_key=kw.get("valueKey", "rating"),
+        default_value=float(kw.get("defaultValue", 1.0)),
+        dedup=kw.get("dedup", "last"),
+        value_event=kw.get("valueEvent"),
+    )
+    if hasattr(dao, "columnarize"):
+        cols = dao.columnarize(**common)
+    else:
+        cols = interactions_to_columns(columnarize_via_find(dao, **common))
+    # timesUs deliberately not shipped: no remote consumer reads it, and
+    # at 200k+ rows an extra int64 column is ~25% of the RPC payload
+    return {
+        "userIdx": cols.user_idx.tolist(),
+        "itemIdx": cols.item_idx.tolist(),
+        "values": cols.values.tolist(),
+        "users": list(cols.users),
+        "items": list(cols.items),
+    }
 
 
 def _dao_for(storage: Storage, family: str):

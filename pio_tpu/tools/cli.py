@@ -502,7 +502,8 @@ def cmd_batchpredict(args) -> int:
             batch_size=args.batch_size, ctx=ctx,
         )
     print(f"Batch predict done: {report.n_queries} queries"
-          + (f", {report.n_errors} malformed" if report.n_errors else ""),
+          + (f", {report.n_errors} failed (malformed or engine-rejected; "
+             "see the output's error records)" if report.n_errors else ""),
           file=_sys.stderr)
     return 0
 

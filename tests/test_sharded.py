@@ -129,3 +129,67 @@ def test_delete_many_fans_out_and_counts(sharded_storage):
     ids = dao.insert_batch([ev(f"u{i}", i) for i in range(14)], 1)
     assert dao.delete_many(ids[:10] + ["missing"], 1) == 10
     assert len(list(dao.find(1, limit=-1))) == 4
+
+
+def test_columnarize_region_parallel_merge(sharded_storage):
+    """The sharded training read: per-shard server-side columnarize +
+    global id remap must equal the client-side find+fold path exactly
+    (HBPEvents.scala region-scan role)."""
+    from pio_tpu.data.eventstore import EventStore
+    from pio_tpu.data.dao import App
+    from pio_tpu.data.datamap import DataMap
+
+    apps = sharded_storage.get_metadata_apps()
+    app_id = apps.insert(App(0, "colapp"))
+    dao = sharded_storage.get_events()
+    dao.init(app_id)
+    evs = []
+    for m in range(60):
+        u, i = m % 13, (m * 7) % 9
+        evs.append(Event(
+            event="rate", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id=f"i{i}",
+            properties=DataMap({"rating": float(1 + m % 5)}),
+            event_time=T0 + timedelta(seconds=m)))
+    dao.insert_batch(evs, app_id)
+
+    store = EventStore(sharded_storage)
+    inter = store.interactions("colapp")   # hits ShardedEventsDAO.columnarize
+    # reference result: the generic find + to_interactions fold
+    from pio_tpu.data.eventstore import to_interactions
+
+    ref = to_interactions(
+        dao.find(app_id, entity_type="user", limit=-1),
+        value_fn=lambda e: float(e.properties.get_or_else("rating", 1.0)))
+    # same triples regardless of id-code assignment order
+    def triples(it):
+        return sorted(
+            (it.users.decode([u])[0], it.items.decode([i])[0], round(v, 5))
+            for u, i, v in zip(it.user_idx, it.item_idx, it.values))
+
+    assert triples(inter) == triples(ref)
+    assert len(inter.user_idx) == len(ref.user_idx)
+
+
+def test_columnarize_cross_type_dedup_falls_back(sharded_storage):
+    """entity_type=None breaks the routing/dedup-key alignment (two
+    entity TYPES sharing an id can shard apart while the dedup key
+    ignores type) — the composite must fall back to a global fold and
+    match the find+fold reference exactly."""
+    from pio_tpu.data.datamap import DataMap
+    from pio_tpu.data.eventstore import to_interactions
+
+    dao = sharded_storage.get_events()
+    dao.init(1)
+    evs = []
+    for etype, t_off, rating in [("user", 0, 1.0), ("account", 1, 5.0)]:
+        evs.append(Event(
+            event="rate", entity_type=etype, entity_id="x",
+            target_entity_type="item", target_entity_id="i1",
+            properties=DataMap({"rating": rating}),
+            event_time=T0 + timedelta(seconds=t_off)))
+    dao.insert_batch(evs, 1)
+    cols = dao.columnarize(1, entity_type=None, dedup="last")
+    ref = to_interactions(dao.find(1, limit=-1))
+    assert len(cols.values) == len(ref.values) == 1   # deduped to last
+    assert float(cols.values[0]) == float(ref.values[0]) == 5.0

@@ -189,3 +189,69 @@ def test_train_and_deploy_through_shared_store(server):
     )
     out = qs.query({"user": "u0", "num": 3})
     assert len(out["itemScores"]) == 3
+
+
+def test_columnarize_rpc_native_and_fallback(tmp_path):
+    """events.columnarize over RPC: with an eventlog backing the server
+    answers from ONE native C++ sweep; with sqlite it folds server-side.
+    Both must match the client-side find+fold exactly, and only compact
+    columns cross the wire either way."""
+    import numpy as np
+
+    from pio_tpu.data.datamap import DataMap
+    from pio_tpu.data.eventstore import EventStore, to_interactions
+
+    for backing_env in (
+        {"PIO_STORAGE_SOURCES_B_TYPE": "eventlog",
+         "PIO_STORAGE_SOURCES_B_PATH": str(tmp_path / "log"),
+         "PIO_STORAGE_SOURCES_M_TYPE": "memory"},
+        {"PIO_STORAGE_SOURCES_B_TYPE": "sqlite",
+         "PIO_STORAGE_SOURCES_B_PATH": str(tmp_path / "sq.db"),
+         "PIO_STORAGE_SOURCES_M_TYPE": "memory"},
+    ):
+        backing = Storage(env={
+            **backing_env,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        srv = create_storage_server(
+            backing, StorageServerConfig(ip="127.0.0.1", port=0))
+        srv.start()
+        try:
+            client = Storage(env={
+                "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+                "PIO_STORAGE_SOURCES_NET_URL":
+                    f"http://127.0.0.1:{srv.port}",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+            })
+            app_id = client.get_metadata_apps().insert(App(0, "colapp"))
+            dao = client.get_events()
+            dao.init(app_id)
+            dao.insert_batch([
+                Event(event="rate", entity_type="user",
+                      entity_id=f"u{m % 7}", target_entity_type="item",
+                      target_entity_id=f"i{(m * 3) % 5}",
+                      properties=DataMap({"rating": float(1 + m % 4)}),
+                      event_time=T0 + timedelta(seconds=m))
+                for m in range(40)
+            ], app_id)
+            inter = EventStore(client).interactions("colapp")
+            ref = to_interactions(
+                dao.find(app_id, entity_type="user", limit=-1),
+                value_fn=lambda e: float(
+                    e.properties.get_or_else("rating", 1.0)))
+
+            def triples(it):
+                return sorted(
+                    (it.users.decode([u])[0], it.items.decode([i])[0],
+                     round(float(v), 5))
+                    for u, i, v in zip(it.user_idx, it.item_idx, it.values))
+
+            assert triples(inter) == triples(ref), backing_env
+            assert len(inter.user_idx) == len(ref.user_idx) > 0
+        finally:
+            srv.stop()
+            backing.close()
