@@ -131,8 +131,23 @@ def dispatch_safe(app: HttpApp, req: Request) -> tuple[int, Any]:
         return 500, {"message": f"{type(e).__name__}: {e}"}
 
 
+@dataclass
+class RawResponse:
+    """Handler payload with an explicit content type (plain str/bytes
+    default to text/html — wrong for e.g. Prometheus exposition, whose
+    strict scrapers reject unknown content types)."""
+
+    body: bytes | str
+    content_type: str = "text/plain; charset=utf-8"
+
+
 def encode_payload(payload: Any) -> tuple[bytes, str]:
-    """-> (body bytes, content-type). str/bytes pass through as HTML."""
+    """-> (body bytes, content-type). str/bytes pass through as HTML;
+    RawResponse carries its own content type."""
+    if isinstance(payload, RawResponse):
+        body = (payload.body.encode()
+                if isinstance(payload.body, str) else payload.body)
+        return body, payload.content_type
     if isinstance(payload, (bytes, str)):
         data = payload.encode() if isinstance(payload, str) else payload
         return data, "text/html; charset=utf-8"

@@ -160,3 +160,37 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+def prometheus_text(spans: dict[str, dict], counters: dict[str, float],
+                    prefix: str = "pio") -> str:
+    """Prometheus text exposition of the tracer's span histograms plus
+    scalar counters — the scrape surface every monitoring stack expects
+    next to the JSON `/metrics.json`. Quantiles map to the summary-type
+    convention; `_count` is all-time, quantiles are over the recent
+    window (same semantics as LatencyHistogram.snapshot)."""
+    lines = [f"# TYPE {prefix}_span_latency_seconds summary"]
+    for name in sorted(spans):
+        h = spans[name]
+        if not h.get("count"):
+            continue
+        for q in ("p50", "p90", "p95", "p99"):
+            if q in h:
+                lines.append(
+                    f'{prefix}_span_latency_seconds'
+                    f'{{span="{name}",quantile="0.{q[1:]}"}} {h[q]:.6g}')
+        lines.append(
+            f'{prefix}_span_latency_seconds_count{{span="{name}"}} '
+            f'{h["count"]}')
+        lines.append(
+            f'{prefix}_span_latency_seconds_sum{{span="{name}"}} '
+            f'{h["count"] * h["avg"]:.6g}')
+    for cname in sorted(counters):
+        lines.append(f"# TYPE {prefix}_{cname} "
+                     + ("counter" if cname.endswith("_total") else "gauge"))
+        v = counters[cname]
+        # integers verbatim: %.6g would turn a 7-digit counter into
+        # lossy scientific notation and freeze increase()/rate()
+        sval = str(int(v)) if float(v).is_integer() else f"{v:.6g}"
+        lines.append(f"{prefix}_{cname} {sval}")
+    return "\n".join(lines) + "\n"

@@ -718,6 +718,23 @@ def build_serving_app(server: QueryServer) -> HttpApp:
     def metrics(req: Request):
         return 200, server.metrics()
 
+    @app.route("GET", r"/metrics")
+    def metrics_prometheus(req: Request):
+        """Prometheus text exposition of the same data as /metrics.json
+        (span latency summaries + counters) for scrape-based stacks."""
+        from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.tracing import prometheus_text
+
+        return 200, RawResponse(
+            prometheus_text(
+                server.tracer.snapshot(),
+                {"hedged_dispatches_total": float(server.hedged_dispatches),
+                 "uptime_seconds":
+                     (utcnow() - server.start_time).total_seconds()}),
+            # the official exposition content type: Prometheus 3.x
+            # rejects scrapes with an unrecognized one
+            "text/plain; version=0.0.4; charset=utf-8")
+
     @app.route("POST", r"/profile/start")
     def profile_start(req: Request):
         """Capture a device (XLA/TPU) trace while serving — the TPU

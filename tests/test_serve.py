@@ -605,3 +605,22 @@ def test_hedging_disabled_and_unarmed_paths(memory_storage):
     finally:
         http_srv.stop()
         qs.close()
+
+
+def test_prometheus_metrics_endpoint(deployed):
+    import urllib.request
+
+    http, qs, *_ = deployed
+    call(http.port, "POST", "/queries.json", body={"user": "u0", "num": 2})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/metrics") as resp:
+        assert resp.status == 200
+        # Prometheus 3.x rejects scrapes with an unrecognized content type
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "# TYPE pio_span_latency_seconds summary" in text
+    assert 'span="predict"' in text and 'quantile="0.99"' in text
+    assert "pio_uptime_seconds" in text
+    # the JSON surface is unchanged alongside it
+    status, m = call(http.port, "GET", "/metrics.json")
+    assert status == 200 and "spans" in m

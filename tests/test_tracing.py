@@ -54,3 +54,25 @@ def test_span_records_on_exception():
     except ValueError:
         pass
     assert tr.histogram("boom").count == 1
+
+
+def test_prometheus_text_exposition():
+    from pio_tpu.utils.tracing import Tracer, prometheus_text
+
+    tr = Tracer()
+    for v in (0.01, 0.02, 0.03):
+        tr.record("predict", v)
+    text = prometheus_text(tr.snapshot(),
+                           {"hedged_dispatches_total": 2.0,
+                            "uptime_seconds": 12.5})
+    assert "# TYPE pio_span_latency_seconds summary" in text
+    assert 'pio_span_latency_seconds{span="predict",quantile="0.50"} 0.02' \
+        in text
+    assert 'pio_span_latency_seconds_count{span="predict"} 3' in text
+    assert "# TYPE pio_hedged_dispatches_total counter" in text
+    assert "pio_hedged_dispatches_total 2\n" in text
+    # large integer counters must stay exact, never scientific notation
+    big = prometheus_text({}, {"hedged_dispatches_total": 1234567.0})
+    assert "pio_hedged_dispatches_total 1234567\n" in big
+    assert "# TYPE pio_uptime_seconds gauge" in text
+    assert text.endswith("\n")
