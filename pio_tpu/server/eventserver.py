@@ -308,6 +308,34 @@ def build_event_app(
             }
         return 200, stats.get(ak.appid)
 
+    @app.route("GET", r"/metrics")
+    def get_metrics(req: Request):
+        """Prometheus text exposition of lifetime ingest counters
+        (monotonic, unlike /stats.json's hourly windows). Gated on
+        --stats like /stats.json; intended for private scrape networks
+        — labels carry app ids."""
+        if not config.stats:
+            return 404, {
+                "message": "To see metrics, launch Event Server with --stats"
+            }
+        from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.tracing import escape_label_value as esc
+
+        lines = ["# TYPE pio_events_ingested_total counter"]
+        for k, n in sorted(stats.totals().items(),
+                           key=lambda kv: (kv[0].app_id, kv[0].event,
+                                           kv[0].status)):
+            # event/entity_type are client-supplied strings: escape, or
+            # one stray quote/newline corrupts the whole scrape
+            lines.append(
+                f'pio_events_ingested_total{{app_id="{k.app_id}",'
+                f'event="{esc(k.event)}",'
+                f'entity_type="{esc(k.entity_type)}",'
+                f'status="{k.status}"}} {n}')
+        return 200, RawResponse(
+            "\n".join(lines) + "\n",
+            "text/plain; version=0.0.4; charset=utf-8")
+
     # -- webhooks (reference api/Webhooks.scala:44-151) ---------------------
     @app.route("POST", r"/webhooks/([^/]+)\.json")
     @authed

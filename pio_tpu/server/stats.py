@@ -29,6 +29,18 @@ class Stats:
         self._hour_start = self._floor_hour(utcnow())
         self._current: Counter = Counter()
         self._previous: Counter = Counter()
+        # lifetime totals: the hourly windows above serve /stats.json
+        # (reference parity), but Prometheus counters must be monotonic.
+        # Keys are client-controlled (event/entity_type strings), so the
+        # table is CAPPED: past TOTAL_KEY_CAP distinct keys, new ones
+        # fold into one overflow bucket — without it, unique event names
+        # (IDs/timestamps embedded by a buggy integration, or a hostile
+        # client) grow memory and scrape size without bound, where the
+        # hourly windows were naturally pruned.
+        self._total: Counter = Counter()
+
+    TOTAL_KEY_CAP = 10_000
+    OVERFLOW_KEY = KV(-1, 0, "_overflow", "_overflow")
 
     @staticmethod
     def _floor_hour(dt: datetime) -> datetime:
@@ -47,7 +59,17 @@ class Stats:
     def update(self, app_id: int, status: int, event: str, entity_type: str):
         with self._lock:
             self._cutoff(utcnow())
-            self._current[KV(app_id, status, event, entity_type)] += 1
+            kv = KV(app_id, status, event, entity_type)
+            self._current[kv] += 1
+            if kv in self._total or len(self._total) < self.TOTAL_KEY_CAP:
+                self._total[kv] += 1
+            else:
+                self._total[self.OVERFLOW_KEY] += 1
+
+    def totals(self) -> dict:
+        """Lifetime (KV -> count) snapshot for the Prometheus surface."""
+        with self._lock:
+            return dict(self._total)
 
     def get(self, app_id: int) -> dict:
         """Counts for the previous full hour + current hour so far."""

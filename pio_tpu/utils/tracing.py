@@ -72,6 +72,9 @@ class LatencyHistogram:
         out = {
             "count": count,
             "avg": total / count if count else 0.0,
+            # exact cumulative seconds: the Prometheus _sum must not be
+            # reconstructed from avg (precision loss freezes rate())
+            "total": total,
             "last": last,
             "min": 0.0 if mn == float("inf") else mn,
             "max": mx,
@@ -162,6 +165,14 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus exposition label-value escaping (backslash, quote,
+    newline) — REQUIRED for any user-controlled string (event names,
+    entity types): one bad value otherwise corrupts the whole scrape."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prometheus_text(spans: dict[str, dict], counters: dict[str, float],
                     prefix: str = "pio") -> str:
     """Prometheus text exposition of the tracer's span histograms plus
@@ -174,17 +185,21 @@ def prometheus_text(spans: dict[str, dict], counters: dict[str, float],
         h = spans[name]
         if not h.get("count"):
             continue
+        esc = escape_label_value(name)
         for q in ("p50", "p90", "p95", "p99"):
             if q in h:
                 lines.append(
                     f'{prefix}_span_latency_seconds'
-                    f'{{span="{name}",quantile="0.{q[1:]}"}} {h[q]:.6g}')
+                    f'{{span="{esc}",quantile="0.{q[1:]}"}} {h[q]:.6g}')
         lines.append(
-            f'{prefix}_span_latency_seconds_count{{span="{name}"}} '
+            f'{prefix}_span_latency_seconds_count{{span="{esc}"}} '
             f'{h["count"]}')
+        # exact cumulative sum at full precision: .6g on a week-old
+        # server quantizes the sum and freezes rate() over it
+        total = h.get("total", h["count"] * h["avg"])
         lines.append(
-            f'{prefix}_span_latency_seconds_sum{{span="{name}"}} '
-            f'{h["count"] * h["avg"]:.6g}')
+            f'{prefix}_span_latency_seconds_sum{{span="{esc}"}} '
+            f'{total!r}')
     for cname in sorted(counters):
         lines.append(f"# TYPE {prefix}_{cname} "
                      + ("counter" if cname.endswith("_total") else "gauge"))

@@ -279,3 +279,48 @@ def test_webhook_mailchimp_form(server):
     assert got["entityId"] == "8a25ff1d98"
     assert got["properties"]["merges"]["FNAME"] == "MailChimp"
     assert got["eventTime"].startswith("2026-01-02T21:31:18")
+
+
+def test_prometheus_metrics_monotonic(server):
+    """GET /metrics: lifetime ingest counters with app/event/status
+    labels and the official exposition content type (monotonic, unlike
+    /stats.json's hourly windows)."""
+    import urllib.request
+
+    for _ in range(3):
+        call(server, "POST", "/events.json", body=RATE, accessKey="KEY")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics") as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "# TYPE pio_events_ingested_total counter" in text
+    rows = [ln for ln in text.splitlines()
+            if 'event="rate"' in ln and 'status="201"' in ln]
+    assert rows and rows[0].endswith(" 3")
+
+
+def test_metrics_label_escaping_and_cap(server):
+    """Client-supplied event names with quotes/newlines must not corrupt
+    the exposition, and the lifetime table folds past its cardinality
+    cap instead of growing unboundedly."""
+    import urllib.request
+
+    from pio_tpu.server.stats import Stats
+
+    evil = dict(RATE, event='a"b\\c')
+    status, _ = call(server, "POST", "/events.json", body=evil,
+                     accessKey="KEY")
+    assert status == 201
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics") as resp:
+        text = resp.read().decode()
+    assert 'event="a\\"b\\\\c"' in text
+
+    st = Stats()
+    cap = Stats.TOTAL_KEY_CAP
+    for i in range(cap + 50):
+        st.update(1, 201, f"e{i}", "user")
+    totals = st.totals()
+    assert len(totals) == cap + 1   # cap distinct + one overflow bucket
+    assert totals[Stats.OVERFLOW_KEY] == 50
