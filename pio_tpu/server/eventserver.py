@@ -40,6 +40,12 @@ class EventServerConfig:
     ip: str = "0.0.0.0"
     port: int = 7070
     stats: bool = False
+    # shared secret for GET /metrics. The event server faces untrusted
+    # clients, and the cross-app Prometheus counters would let any of
+    # them enumerate every tenant's app ids and event vocabulary (data
+    # /stats.json deliberately gates per-app) — so /metrics is OFF
+    # unless a key is configured, and then requires it.
+    metrics_key: str = ""
     certfile: str | None = None   # TLS cert (PEM); with keyfile -> HTTPS
     keyfile: str | None = None
     backend: str = "async"        # "async" (event loop) | "threaded"
@@ -311,30 +317,33 @@ def build_event_app(
     @app.route("GET", r"/metrics")
     def get_metrics(req: Request):
         """Prometheus text exposition of lifetime ingest counters
-        (monotonic, unlike /stats.json's hourly windows). Gated on
-        --stats like /stats.json; intended for private scrape networks
-        — labels carry app ids."""
-        if not config.stats:
+        (monotonic, unlike /stats.json's hourly windows). Requires
+        --stats AND a configured metrics key: the counters span every
+        app, so /stats.json's per-app accessKey gate cannot apply, and
+        an open endpoint would leak tenant app ids + event vocabulary
+        to any ingest client."""
+        if not (config.stats and config.metrics_key):
             return 404, {
-                "message": "To see metrics, launch Event Server with --stats"
+                "message": "To see metrics, launch Event Server with "
+                           "--stats and --metrics-key"
             }
+        if req.params.get("accessKey", "") != config.metrics_key:
+            return 401, {"message": "Invalid accessKey."}
         from pio_tpu.server.http import RawResponse
-        from pio_tpu.utils.tracing import escape_label_value as esc
+        from pio_tpu.utils.tracing import (
+            PROMETHEUS_CONTENT_TYPE, prometheus_labeled_counter,
+        )
 
-        lines = ["# TYPE pio_events_ingested_total counter"]
-        for k, n in sorted(stats.totals().items(),
-                           key=lambda kv: (kv[0].app_id, kv[0].event,
-                                           kv[0].status)):
-            # event/entity_type are client-supplied strings: escape, or
-            # one stray quote/newline corrupts the whole scrape
-            lines.append(
-                f'pio_events_ingested_total{{app_id="{k.app_id}",'
-                f'event="{esc(k.event)}",'
-                f'entity_type="{esc(k.entity_type)}",'
-                f'status="{k.status}"}} {n}')
-        return 200, RawResponse(
-            "\n".join(lines) + "\n",
-            "text/plain; version=0.0.4; charset=utf-8")
+        rows = [
+            ({"app_id": k.app_id, "event": k.event,
+              "entity_type": k.entity_type, "status": k.status}, float(n))
+            for k, n in sorted(stats.totals().items(),
+                               key=lambda kv: (kv[0].app_id, kv[0].event,
+                                               kv[0].status))
+        ]
+        lines = prometheus_labeled_counter("events_ingested_total", rows)
+        return 200, RawResponse("\n".join(lines) + "\n",
+                                PROMETHEUS_CONTENT_TYPE)
 
     # -- webhooks (reference api/Webhooks.scala:44-151) ---------------------
     @app.route("POST", r"/webhooks/([^/]+)\.json")

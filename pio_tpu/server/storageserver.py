@@ -217,9 +217,13 @@ def build_storage_app(
     storage: Storage | None = None,
     config: StorageServerConfig | None = None,
 ) -> HttpApp:
+    from pio_tpu.utils.tracing import Tracer
+
     storage = storage or get_storage()
     config = config or StorageServerConfig()
     app = HttpApp("storage")
+    tracer = Tracer()   # span per family.method: cardinality is bounded
+    app.tracer = tracer  # exposed for tests / embedding processes
 
     @app.route("GET", r"/health")
     def health(req: Request):
@@ -227,6 +231,21 @@ def build_storage_app(
         status = 200 if not errors else 503
         return status, {"status": "ok" if not errors else "degraded",
                         "errors": errors}
+
+    @app.route("GET", r"/metrics")
+    def metrics(req: Request):
+        """Prometheus text exposition of per-RPC latency summaries —
+        the storage server is the multi-host hub, so its scrape surface
+        matters most under load. Span names come from the fixed method
+        table (never client data): no escaping or cardinality concerns."""
+        from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.tracing import (
+            PROMETHEUS_CONTENT_TYPE, prometheus_text,
+        )
+
+        return 200, RawResponse(
+            prometheus_text(tracer.snapshot(), {}, prefix="pio_storage"),
+            PROMETHEUS_CONTENT_TYPE)
 
     @app.route("POST", r"/rpc")
     def rpc(req: Request):
@@ -248,7 +267,8 @@ def build_storage_app(
             return 404, {"message": f"unknown method {family}.{method}"}
         dao = _dao_for(storage, family)
         try:
-            result = fn(dao, kwargs)
+            with tracer.span(f"{family}.{method}"):
+                result = fn(dao, kwargs)
         except StorageError as e:
             return 409, {"message": str(e), "error": "StorageError"}
         except (KeyError, TypeError, ValueError) as e:

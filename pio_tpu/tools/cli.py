@@ -545,6 +545,7 @@ def cmd_eventserver(args) -> int:
     srv = create_event_server(
         get_storage(),
         EventServerConfig(ip=args.ip, port=args.port, stats=args.stats,
+                          metrics_key=args.metrics_key or "",
                           certfile=args.cert, keyfile=args.key,
                           backend=args.server_backend),
     )
@@ -921,6 +922,9 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ip", default="0.0.0.0")
     x.add_argument("--port", type=int, default=7070)
     x.add_argument("--stats", action="store_true")
+    x.add_argument("--metrics-key",
+                   help="with --stats: enable GET /metrics (Prometheus "
+                        "ingest counters, cross-app) guarded by this key")
     x.add_argument("--cert", help="TLS certificate (PEM) -> serve HTTPS")
     x.add_argument("--key", help="TLS private key (PEM)")
     x.add_argument("--server-backend", choices=["async", "threaded"],

@@ -165,12 +165,39 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# Prometheus 3.x rejects scrapes whose Content-Type is not a known
+# exposition format; every /metrics endpoint must send this constant.
+
+
 def escape_label_value(v: str) -> str:
     """Prometheus exposition label-value escaping (backslash, quote,
     newline) — REQUIRED for any user-controlled string (event names,
     entity types): one bad value otherwise corrupts the whole scrape."""
     return (v.replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _prom_value(v: float) -> str:
+    """Integers verbatim (a %.6g 7-digit counter would freeze
+    increase()/rate() in lossy scientific notation); floats at full
+    precision."""
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def prometheus_labeled_counter(
+    name: str, rows, prefix: str = "pio",
+) -> list[str]:
+    """One `# TYPE` header + one sample per (labels, value) row, with
+    every label value escaped. The single renderer for labeled counters
+    so callers cannot drift on quoting/format details."""
+    lines = [f"# TYPE {prefix}_{name} counter"]
+    for labels, value in rows:
+        lab = ",".join(
+            f'{k}="{escape_label_value(str(v))}"'
+            for k, v in labels.items())
+        lines.append(f"{prefix}_{name}{{{lab}}} {_prom_value(value)}")
+    return lines
 
 
 def prometheus_text(spans: dict[str, dict], counters: dict[str, float],
@@ -195,17 +222,14 @@ def prometheus_text(spans: dict[str, dict], counters: dict[str, float],
             f'{prefix}_span_latency_seconds_count{{span="{esc}"}} '
             f'{h["count"]}')
         # exact cumulative sum at full precision: .6g on a week-old
-        # server quantizes the sum and freezes rate() over it
-        total = h.get("total", h["count"] * h["avg"])
+        # server quantizes the sum and freezes rate() over it. KeyError
+        # on a dict without "total" is deliberate — a silent count*avg
+        # fallback would reintroduce exactly that bug
         lines.append(
             f'{prefix}_span_latency_seconds_sum{{span="{esc}"}} '
-            f'{total!r}')
+            f'{h["total"]!r}')
     for cname in sorted(counters):
         lines.append(f"# TYPE {prefix}_{cname} "
                      + ("counter" if cname.endswith("_total") else "gauge"))
-        v = counters[cname]
-        # integers verbatim: %.6g would turn a 7-digit counter into
-        # lossy scientific notation and freeze increase()/rate()
-        sval = str(int(v)) if float(v).is_integer() else f"{v:.6g}"
-        lines.append(f"{prefix}_{cname} {sval}")
+        lines.append(f"{prefix}_{cname} {_prom_value(counters[cname])}")
     return "\n".join(lines) + "\n"

@@ -723,7 +723,9 @@ def build_serving_app(server: QueryServer) -> HttpApp:
         """Prometheus text exposition of the same data as /metrics.json
         (span latency summaries + counters) for scrape-based stacks."""
         from pio_tpu.server.http import RawResponse
-        from pio_tpu.utils.tracing import prometheus_text
+        from pio_tpu.utils.tracing import (
+            PROMETHEUS_CONTENT_TYPE, prometheus_text,
+        )
 
         return 200, RawResponse(
             prometheus_text(
@@ -731,9 +733,7 @@ def build_serving_app(server: QueryServer) -> HttpApp:
                 {"hedged_dispatches_total": float(server.hedged_dispatches),
                  "uptime_seconds":
                      (utcnow() - server.start_time).total_seconds()}),
-            # the official exposition content type: Prometheus 3.x
-            # rejects scrapes with an unrecognized one
-            "text/plain; version=0.0.4; charset=utf-8")
+            PROMETHEUS_CONTENT_TYPE)
 
     @app.route("POST", r"/profile/start")
     def profile_start(req: Request):

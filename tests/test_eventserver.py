@@ -36,7 +36,8 @@ def server(memory_storage):
 
     srv = create_event_server(
         memory_storage,
-        EventServerConfig(ip="127.0.0.1", port=0, stats=True),
+        EventServerConfig(ip="127.0.0.1", port=0, stats=True,
+                          metrics_key="MK"),
         PluginContext([Blocker()]),
     ).start()
     yield srv
@@ -289,8 +290,11 @@ def test_prometheus_metrics_monotonic(server):
 
     for _ in range(3):
         call(server, "POST", "/events.json", body=RATE, accessKey="KEY")
+    # cross-app counters leak tenant app ids/event names: key required
+    status, _ = call(server, "GET", "/metrics")
+    assert status == 401
     with urllib.request.urlopen(
-            f"http://127.0.0.1:{server.port}/metrics") as resp:
+            f"http://127.0.0.1:{server.port}/metrics?accessKey=MK") as resp:
         assert resp.status == 200
         assert resp.headers["Content-Type"].startswith("text/plain")
         text = resp.read().decode()
@@ -313,7 +317,7 @@ def test_metrics_label_escaping_and_cap(server):
                      accessKey="KEY")
     assert status == 201
     with urllib.request.urlopen(
-            f"http://127.0.0.1:{server.port}/metrics") as resp:
+            f"http://127.0.0.1:{server.port}/metrics?accessKey=MK") as resp:
         text = resp.read().decode()
     assert 'event="a\\"b\\\\c"' in text
 

@@ -255,3 +255,21 @@ def test_columnarize_rpc_native_and_fallback(tmp_path):
         finally:
             srv.stop()
             backing.close()
+
+
+def test_storage_server_metrics(server):
+    import urllib.request
+
+    srv, backing = server
+    client = Storage(env=_client_env(srv.port))
+    client.get_metadata_apps().insert(App(0, "mapp"))
+    client.get_metadata_apps().get_all()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics") as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "# TYPE pio_storage_span_latency_seconds summary" in text
+    assert 'span="apps.insert"' in text and 'span="apps.get_all"' in text
+    assert 'pio_storage_span_latency_seconds_count{span="apps.insert"} 1' \
+        in text
