@@ -30,6 +30,16 @@ from pio_tpu.data.event import Event
 from pio_tpu.data.storage import Backend, StorageError
 from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
 
+# page size for unbounded (limit=-1) remote finds; bounds each RPC
+# response while keeping round trips rare (10k events ≈ a few MB JSON)
+FIND_PAGE = 10_000
+# ceiling on the boundary-tie exclusion set. The cursor is (time, ids
+# seen at that time); a dataset where one timestamp carries this many
+# events would make each request ship the whole set and the server
+# re-filter it (quadratic in the tie group) — fail loudly and point at
+# time-windowed export instead of degrading into that.
+EXCLUDE_IDS_CAP = 50_000
+
 
 class RemoteBackend(Backend):
     def __init__(self, config):
@@ -357,16 +367,75 @@ class _RemoteEvents(_Remote, d.EventsDAO):
         limit: int | None = None,
         reversed: bool = False,
     ) -> Iterator[Event]:
-        query = w.find_kwargs_to_wire(
-            start_time=start_time, until_time=until_time,
-            entity_type=entity_type, entity_id=entity_id,
-            event_names=event_names,
-            target_entity_type=target_entity_type,
-            target_entity_id=target_entity_id,
-            limit=limit, reversed=reversed,
-        )
+        def q(lim, page_start=None, exclude_ids=None):
+            return w.find_kwargs_to_wire(
+                start_time=page_start if page_start is not None
+                else start_time,
+                until_time=until_time,
+                entity_type=entity_type, entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                limit=lim, reversed=reversed, exclude_ids=exclude_ids,
+            )
+
+        if limit == -1 and not reversed:
+            # unbounded read: KEYSET-page so an export of millions of
+            # events streams in bounded responses instead of one giant
+            # JSON body. Cursor = the last page's final event_time
+            # (inclusive start_time) + the ids already seen AT that
+            # time (server-side excludeIds) — exact regardless of how
+            # the backend orders equal-time ties, and each page is an
+            # indexed start_time scan, not an O(offset) re-read.
+            # (reversed unbounded reads stay a single call: until_time
+            # is exclusive, so a descending cursor cannot re-include
+            # its boundary ties.)
+            def pages() -> Iterator[Event]:
+                # boundary_t/_ids persist ACROSS pages: when several
+                # consecutive pages sit at one timestamp, the exclusion
+                # set keeps growing — resetting per page would let page
+                # 3 re-return page 1's ties
+                boundary_t = None
+                boundary_ids: list[str] = []
+                seen_at_boundary: set[str] = set()
+                while True:
+                    rows = self.call(
+                        "find", app_id=app_id, channel_id=channel_id,
+                        query=q(FIND_PAGE, boundary_t, boundary_ids),
+                    )
+                    for r in rows:
+                        e = w.event_from_wire(r)
+                        if (e.event_time == boundary_t
+                                and e.event_id in seen_at_boundary):
+                            # the server returned an id we told it to
+                            # exclude: it predates the excludeIds
+                            # protocol — fail fast, silent paging here
+                            # means duplicated exports or an infinite
+                            # page loop
+                            raise StorageError(
+                                f"storage server {self.b._url} ignored "
+                                "the excludeIds find cursor "
+                                "(pre-pagination server?) — upgrade it "
+                                "or read with an explicit limit")
+                        if e.event_time != boundary_t:
+                            boundary_t = e.event_time
+                            boundary_ids = []
+                            seen_at_boundary = set()
+                        boundary_ids.append(e.event_id)
+                        seen_at_boundary.add(e.event_id)
+                        yield e
+                    if len(boundary_ids) > EXCLUDE_IDS_CAP:
+                        raise StorageError(
+                            f"more than {EXCLUDE_IDS_CAP} events share "
+                            f"event_time {boundary_t}: the keyset cursor "
+                            "would go quadratic — page manually with "
+                            "start_time/until_time windows")
+                    if len(rows) < FIND_PAGE:
+                        return
+
+            return pages()
         rows = self.call(
-            "find", app_id=app_id, channel_id=channel_id, query=query
+            "find", app_id=app_id, channel_id=channel_id, query=q(limit)
         )
         return iter(w.event_from_wire(r) for r in rows)
 

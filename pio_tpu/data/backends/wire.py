@@ -163,11 +163,18 @@ def property_map_from_wire(w: dict) -> PropertyMap:
 def find_kwargs_to_wire(
     start_time=None, until_time=None, entity_type=None, entity_id=None,
     event_names=None, target_entity_type=..., target_entity_id=...,
-    limit=None, reversed=False,
+    limit=None, reversed=False, exclude_ids=None,
 ) -> dict:
     """Encode EventsDAO.find keyword args. The `...` don't-care sentinel for
     target entity filters (the reference's Option[Option[String]]) is
-    encoded by OMITTING the key; an explicit null means "must be absent"."""
+    encoded by OMITTING the key; an explicit null means "must be absent".
+    `exclude_ids` is a wire-protocol-only extension (not part of the DAO
+    surface): the keyset-pagination cursor's boundary-tie exclusion set —
+    the remote client pages unbounded reads with start_time = the last
+    page's final event_time plus the ids already seen AT that time, so
+    paging is exact regardless of how a backend orders equal-time ties
+    (ids are unique), and each page is an indexed start_time scan, not
+    an O(offset) re-read."""
     w: dict = {}
     if start_time is not None:
         w["startTime"] = format_time(start_time)
@@ -185,6 +192,8 @@ def find_kwargs_to_wire(
         w["targetEntityId"] = target_entity_id
     if limit is not None:
         w["limit"] = limit
+    if exclude_ids:
+        w["excludeIds"] = list(exclude_ids)
     if reversed:
         w["reversed"] = True
     return w

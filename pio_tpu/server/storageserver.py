@@ -140,10 +140,7 @@ _METHODS = {
             kw["event_id"], kw["app_id"], kw.get("channel_id")),
         "delete_many": lambda dao, kw: dao.delete_many(
             kw["event_ids"], kw["app_id"], kw.get("channel_id")),
-        "find": lambda dao, kw: [
-            w.event_to_wire(e) for e in dao.find(
-                kw["app_id"], kw.get("channel_id"),
-                **w.find_kwargs_from_wire(kw.get("query", {})))],
+        "find": lambda dao, kw: _find_rpc(dao, kw),
         "columnarize": lambda dao, kw: _columnarize_rpc(dao, kw),
         "aggregate_properties": lambda dao, kw: {
             eid: w.property_map_to_wire(p)
@@ -155,6 +152,33 @@ _METHODS = {
             ).items()},
     },
 }
+
+
+def _find_rpc(dao, kw: dict) -> list:
+    """find with a wire-only `excludeIds` keyset cursor: remote clients
+    page unbounded reads (an export of millions of events must not
+    arrive as one JSON response) by re-issuing find with start_time =
+    last page's final event_time and the ids already seen AT that
+    boundary time excluded here. Exact regardless of tie ordering (ids
+    are unique), and each page costs an indexed start_time scan — not
+    the O(offset) re-read + unstable-tie drop/dup of offset paging."""
+    q = dict(kw.get("query") or {})
+    exclude = set(q.pop("excludeIds", None) or ())
+    fkw = w.find_kwargs_from_wire(q)
+    limit = fkw.get("limit")
+    if exclude and limit is not None and limit >= 0:
+        # the backing DAO's limit applies BEFORE exclusion; widen so a
+        # full page survives the boundary-tie filter, then truncate
+        fkw["limit"] = limit + len(exclude)
+    it = dao.find(kw["app_id"], kw.get("channel_id"), **fkw)
+    out = []
+    for e in it:
+        if exclude and e.event_id in exclude:
+            continue
+        if limit is not None and 0 <= limit <= len(out):
+            break   # before append: limit=0 + excludeIds must return []
+        out.append(w.event_to_wire(e))
+    return out
 
 
 def _columnarize_rpc(dao, kw: dict) -> dict:

@@ -273,3 +273,91 @@ def test_storage_server_metrics(server):
     assert 'span="apps.insert"' in text and 'span="apps.get_all"' in text
     assert 'pio_storage_span_latency_seconds_count{span="apps.insert"} 1' \
         in text
+
+
+def test_unbounded_find_pages_transparently(server, monkeypatch):
+    """limit=-1 over the remote backend must arrive as multiple bounded
+    RPC responses (keyset paging) with the SAME events in the same
+    order as the backing store — an export of millions of events cannot
+    be one JSON body."""
+    from pio_tpu.data.backends import remote as remote_mod
+    from pio_tpu.data.datamap import DataMap
+
+    srv, backing = server
+    monkeypatch.setattr(remote_mod, "FIND_PAGE", 7)   # force many pages
+    client = Storage(env=_client_env(srv.port))
+    app_id = client.get_metadata_apps().insert(App(0, "pageapp"))
+    dao = client.get_events()
+    dao.init(app_id)
+    dao.insert_batch([
+        Event(event="rate", entity_type="user", entity_id=f"u{m}",
+              properties=DataMap({"rating": m}),
+              event_time=T0 + timedelta(seconds=m))
+        for m in range(23)
+    ], app_id)
+    got = list(dao.find(app_id, limit=-1))          # 4 pages: 7+7+7+2
+    ref = list(backing.get_events().find(app_id, limit=-1))
+    assert [e.entity_id for e in got] == [e.entity_id for e in ref]
+    assert len(got) == 23
+    # bounded + offset-free reads unchanged
+    assert len(list(dao.find(app_id, limit=5))) == 5
+    assert len(list(dao.find(app_id))) == 20        # default page size
+
+
+
+def test_paging_exact_across_timestamp_ties(server, monkeypatch):
+    """The keyset cursor's hard case: MORE tied-time events than a page.
+    Exclusion-set accumulation across pages must return every event
+    exactly once — offset paging provably drops/dups here when a
+    backend reorders ties between queries."""
+    from pio_tpu.data.backends import remote as remote_mod
+    from pio_tpu.data.datamap import DataMap
+
+    srv, backing = server
+    monkeypatch.setattr(remote_mod, "FIND_PAGE", 5)
+    client = Storage(env=_client_env(srv.port))
+    app_id = client.get_metadata_apps().insert(App(0, "tieapp"))
+    dao = client.get_events()
+    dao.init(app_id)
+    # 13 events at ONE timestamp + 4 after it: pages 5+5+3(ties) then 4
+    dao.insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=f"t{m}",
+               event_time=T0) for m in range(13)]
+        + [Event(event="rate", entity_type="user", entity_id=f"a{m}",
+                 event_time=T0 + timedelta(seconds=1 + m))
+           for m in range(4)], app_id)
+    got = [e.entity_id for e in dao.find(app_id, limit=-1)]
+    ref = [e.entity_id for e in backing.get_events().find(app_id, limit=-1)]
+    assert sorted(got) == sorted(ref) and len(got) == 17
+    assert len(set(got)) == 17          # no duplicates
+    assert got == ref                   # order preserved too
+
+
+def test_paging_detects_pre_pagination_server(server, monkeypatch):
+    """Version-skew guard: a server that ignores excludeIds (predates
+    the pagination protocol) must fail the read LOUDLY — silent paging
+    would duplicate exports or loop forever on tie-heavy data."""
+    from pio_tpu.data.backends import remote as remote_mod
+    from pio_tpu.data.storage import StorageError
+    from pio_tpu.server import storageserver as ss
+
+    srv, backing = server
+    monkeypatch.setattr(remote_mod, "FIND_PAGE", 4)
+
+    def old_find(dao, kw):     # old server: drops the cursor key
+        q = dict(kw.get("query") or {})
+        q.pop("excludeIds", None)
+        return ss._find_rpc(dao, {**kw, "query": q})
+
+    monkeypatch.setitem(ss._METHODS["events"], "find", old_find)
+    client = Storage(env=_client_env(srv.port))
+    app_id = client.get_metadata_apps().insert(App(0, "skewapp"))
+    dao = client.get_events()
+    dao.init(app_id)
+    dao.insert_batch([
+        Event(event="rate", entity_type="user", entity_id=f"s{m}",
+              event_time=T0)          # one timestamp: worst case
+        for m in range(9)
+    ], app_id)
+    with pytest.raises(StorageError, match="excludeIds"):
+        list(dao.find(app_id, limit=-1))
