@@ -396,17 +396,16 @@ class _RemoteEvents(_Remote, d.EventsDAO):
                 # set keeps growing — resetting per page would let page
                 # 3 re-return page 1's ties
                 boundary_t = None
-                boundary_ids: list[str] = []
-                seen_at_boundary: set[str] = set()
+                boundary_ids: set[str] = set()
                 while True:
                     rows = self.call(
                         "find", app_id=app_id, channel_id=channel_id,
-                        query=q(FIND_PAGE, boundary_t, boundary_ids),
+                        query=q(FIND_PAGE, boundary_t, sorted(boundary_ids)),
                     )
                     for r in rows:
                         e = w.event_from_wire(r)
                         if (e.event_time == boundary_t
-                                and e.event_id in seen_at_boundary):
+                                and e.event_id in boundary_ids):
                             # the server returned an id we told it to
                             # exclude: it predates the excludeIds
                             # protocol — fail fast, silent paging here
@@ -419,19 +418,18 @@ class _RemoteEvents(_Remote, d.EventsDAO):
                                 "or read with an explicit limit")
                         if e.event_time != boundary_t:
                             boundary_t = e.event_time
-                            boundary_ids = []
-                            seen_at_boundary = set()
-                        boundary_ids.append(e.event_id)
-                        seen_at_boundary.add(e.event_id)
+                            boundary_ids = set()
+                        boundary_ids.add(e.event_id)
                         yield e
+                    if len(rows) < FIND_PAGE:
+                        return   # complete: no further request carries
+                                 # the exclusion set, cap is moot
                     if len(boundary_ids) > EXCLUDE_IDS_CAP:
                         raise StorageError(
                             f"more than {EXCLUDE_IDS_CAP} events share "
                             f"event_time {boundary_t}: the keyset cursor "
                             "would go quadratic — page manually with "
                             "start_time/until_time windows")
-                    if len(rows) < FIND_PAGE:
-                        return
 
             return pages()
         rows = self.call(

@@ -449,3 +449,73 @@ def test_dashboard(memory_storage):
                 base + "/engine_instances/nope/evaluator_results.html")
     finally:
         srv.stop()
+
+
+def test_import_batches_and_isolates_bad_batch(cli, memory_storage,
+                                               tmp_path, monkeypatch):
+    """Imports flush in IMPORT_BATCH bulk writes (one RPC per batch on a
+    remote store); a bulk write that fails retries singly so exactly the
+    bad events count as failures."""
+    import json as _json
+
+    from pio_tpu.tools import export_import as ei
+
+    monkeypatch.setattr(ei, "IMPORT_BATCH", 3)
+    cli("app", "new", "batchimp")
+    app_id = memory_storage.get_metadata_apps().get_by_name("batchimp").id
+    f = tmp_path / "in.jsonl"
+    f.write_text("".join(
+        _json.dumps({"event": "rate", "entityType": "user",
+                     "entityId": f"u{i}"}) + "\n"
+        for i in range(8)))
+    code, out = cli("import", "--appid", str(app_id), "--input", str(f))
+    assert code == 0 and "Imported 8" in out.out
+    ev = memory_storage.get_events()
+    assert len(list(ev.find(app_id, limit=-1))) == 8
+
+    # a poisoned batch (insert_batch raises) falls back to per-event
+    calls = {"batch": 0}
+
+    def bad_batch(self, events, app_id_, channel_id=None):
+        calls["batch"] += 1
+        raise RuntimeError("bulk path down")
+
+    monkeypatch.setattr(type(ev), "insert_batch", bad_batch)
+    cli("app", "new", "fallbackimp")
+    app2 = memory_storage.get_metadata_apps().get_by_name("fallbackimp").id
+    code, out = cli("import", "--appid", str(app2), "--input", str(f))
+    assert code == 0 and "Imported 8" in out.out and calls["batch"] >= 1
+    assert len(list(ev.find(app2, limit=-1))) == 8
+
+
+def test_import_partial_batch_failure_no_duplicates(cli, memory_storage,
+                                                    tmp_path, monkeypatch):
+    """The hard case: insert_batch persists PART of a batch then dies
+    (a remote RPC can time out after the server committed). The
+    per-event retry must skip what already landed — ids are minted
+    client-side so the check is exact — never duplicate it."""
+    import json as _json
+
+    from pio_tpu.tools import export_import as ei
+
+    monkeypatch.setattr(ei, "IMPORT_BATCH", 4)
+    cli("app", "new", "partialimp")
+    app_id = memory_storage.get_metadata_apps().get_by_name("partialimp").id
+    ev = memory_storage.get_events()
+    real_batch = type(ev).insert_batch
+
+    def half_then_die(self, events, app_id_, channel_id=None):
+        real_batch(self, events[: len(events) // 2], app_id_, channel_id)
+        raise RuntimeError("died mid-batch")
+
+    monkeypatch.setattr(type(ev), "insert_batch", half_then_die)
+    f = tmp_path / "in.jsonl"
+    f.write_text("".join(
+        _json.dumps({"event": "rate", "entityType": "user",
+                     "entityId": f"u{i}"}) + "\n"
+        for i in range(8)))
+    code, out = cli("import", "--appid", str(app_id), "--input", str(f))
+    assert code == 0 and "Imported 8" in out.out
+    got = list(ev.find(app_id, limit=-1))
+    assert len(got) == 8                                   # no duplicates
+    assert len({e.entity_id for e in got}) == 8

@@ -283,8 +283,18 @@ def test_unbounded_find_pages_transparently(server, monkeypatch):
     from pio_tpu.data.backends import remote as remote_mod
     from pio_tpu.data.datamap import DataMap
 
+    from pio_tpu.server import storageserver as ss
+
     srv, backing = server
     monkeypatch.setattr(remote_mod, "FIND_PAGE", 7)   # force many pages
+    calls = {"n": 0}
+    real_find = ss._METHODS["events"]["find"]
+
+    def counting(dao, kw):
+        calls["n"] += 1
+        return real_find(dao, kw)
+
+    monkeypatch.setitem(ss._METHODS["events"], "find", counting)
     client = Storage(env=_client_env(srv.port))
     app_id = client.get_metadata_apps().insert(App(0, "pageapp"))
     dao = client.get_events()
@@ -299,6 +309,7 @@ def test_unbounded_find_pages_transparently(server, monkeypatch):
     ref = list(backing.get_events().find(app_id, limit=-1))
     assert [e.entity_id for e in got] == [e.entity_id for e in ref]
     assert len(got) == 23
+    assert calls["n"] >= 4      # paging actually happened
     # bounded + offset-free reads unchanged
     assert len(list(dao.find(app_id, limit=5))) == 5
     assert len(list(dao.find(app_id))) == 20        # default page size
