@@ -198,7 +198,6 @@ def test_columnarize_rpc_native_and_fallback(tmp_path):
     columns cross the wire either way."""
     import numpy as np
 
-    from pio_tpu.data.datamap import DataMap
     from pio_tpu.data.eventstore import EventStore, to_interactions
 
     for backing_env in (
@@ -281,7 +280,6 @@ def test_unbounded_find_pages_transparently(server, monkeypatch):
     order as the backing store — an export of millions of events cannot
     be one JSON body."""
     from pio_tpu.data.backends import remote as remote_mod
-    from pio_tpu.data.datamap import DataMap
 
     from pio_tpu.server import storageserver as ss
 
@@ -322,7 +320,6 @@ def test_paging_exact_across_timestamp_ties(server, monkeypatch):
     exactly once — offset paging provably drops/dups here when a
     backend reorders ties between queries."""
     from pio_tpu.data.backends import remote as remote_mod
-    from pio_tpu.data.datamap import DataMap
 
     srv, backing = server
     monkeypatch.setattr(remote_mod, "FIND_PAGE", 5)
@@ -372,3 +369,48 @@ def test_paging_detects_pre_pagination_server(server, monkeypatch):
     ], app_id)
     with pytest.raises(StorageError, match="excludeIds"):
         list(dao.find(app_id, limit=-1))
+
+
+@pytest.mark.parametrize("backing_type", ["memory", "eventlog"])
+def test_columnarize_value_event_rule_over_rpc(tmp_path, backing_type):
+    """The recommendation template's rate-vs-buy rule (value_event
+    restricts the property read to one event name; others take the
+    default) must survive the server-side fold on BOTH server paths:
+    the generic find+fold fallback (memory backing, shared
+    eventstore.make_value_fn) and the native C++ sweep (eventlog
+    backing, which implements value_event independently)."""
+    from pio_tpu.data.eventstore import EventStore
+
+    env = {"PIO_STORAGE_SOURCES_B_TYPE": backing_type,
+           "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+           "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+           "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+           "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M"}
+    if backing_type == "eventlog":
+        env["PIO_STORAGE_SOURCES_B_PATH"] = str(tmp_path / "log")
+    backing = Storage(env=env)
+    srv = create_storage_server(
+        backing, StorageServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        client = Storage(env=_client_env(srv.port))
+        app_id = client.get_metadata_apps().insert(App(0, "vevapp"))
+        dao = client.get_events()
+        dao.init(app_id)
+        dao.insert_batch([
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 4.0}), event_time=T0),
+            Event(event="buy", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i2",
+                  properties=DataMap({"rating": 99.0}),  # must be IGNORED
+                  event_time=T0 + timedelta(seconds=1)),
+        ], app_id)
+        inter = EventStore(client).interactions(
+            "vevapp", value_event="rate", default_value=1.0)
+        vals = {inter.items.decode([i])[0]: float(v)
+                for i, v in zip(inter.item_idx, inter.values)}
+        assert vals == {"i1": 4.0, "i2": 1.0}  # buy takes default, not 99
+    finally:
+        srv.stop()
+        backing.close()
