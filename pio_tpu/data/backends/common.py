@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import time
 import uuid
+from contextlib import contextmanager
 from datetime import datetime
 from typing import Sequence
 
@@ -112,3 +113,19 @@ def evict_thread_conn(local, all_conns, lock) -> None:
         c.close()
     except OSError:
         pass
+
+
+@contextmanager
+def guard_parse(error_cls):
+    """Normalize parse failures on SERVER-controlled bytes into the
+    dialect's ProtocolError — the type the pools' evict logic catches.
+    A leaked ValueError/IndexError/UnicodeDecodeError (int()/decode()/
+    base64 on a corrupted or desynced stream) would leave the poisoned
+    connection cached per-thread (found by tests/test_wire_fuzz.py).
+    One shared implementation so the dialects' caught-exception sets
+    cannot drift."""
+    try:
+        yield
+    except (ValueError, IndexError, KeyError, UnicodeDecodeError) as e:
+        raise error_cls(
+            f"malformed server response: {type(e).__name__}: {e}") from e

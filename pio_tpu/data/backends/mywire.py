@@ -46,6 +46,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 from pio_tpu.data.backends.common import (
     PING_IDLE_SEC,
     evict_thread_conn,
+    guard_parse,
     pooled_thread_conn,
 )
 
@@ -317,9 +318,17 @@ class MyConnection:
                 break
         self.sock.sendall(out)
 
+    def _guard_parse(self):
+        """See backends.common.guard_parse (shared with pgwire)."""
+        return guard_parse(MyProtocolError)
+
     # -- handshake ----------------------------------------------------------
 
     def _handshake(self) -> None:
+        with self._guard_parse():
+            self._handshake_inner()
+
+    def _handshake_inner(self) -> None:
         pkt = self._read_packet()
         if pkt[0] == 0xFF:
             raise self._err(pkt)
@@ -424,12 +433,13 @@ class MyConnection:
             sql = interpolate(sql, params, self.no_backslash_escapes)
         self._seq = 0
         self._send_packet(b"\x03" + sql.encode("utf-8"))
-        res, more = self._read_result()
-        # defensively drain trailing resultsets (possible only if the
-        # server ignored our capability mask); the FIRST statement's
-        # result is the caller's
-        while more:
-            _extra, more = self._read_result()
+        with self._guard_parse():
+            res, more = self._read_result()
+            # defensively drain trailing resultsets (possible only if
+            # the server ignored our capability mask); the FIRST
+            # statement's result is the caller's
+            while more:
+                _extra, more = self._read_result()
         return res
 
     def execute_script(self, sql: str) -> None:
@@ -507,9 +517,10 @@ class MyConnection:
 
     def ping(self) -> bool:
         try:
-            self._seq = 0
-            self._send_packet(b"\x0e")             # COM_PING
-            return self._read_packet()[0] == 0x00
+            with self._guard_parse():   # a 0-length reply -> IndexError
+                self._seq = 0
+                self._send_packet(b"\x0e")         # COM_PING
+                return self._read_packet()[0] == 0x00
         except (OSError, MyProtocolError):
             return False
 

@@ -38,6 +38,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 from pio_tpu.data.backends.common import (
     PING_IDLE_SEC,
     evict_thread_conn,
+    guard_parse,
     pooled_thread_conn,
 )
 
@@ -165,6 +166,10 @@ class PgConnection:
 
     # -- framing ------------------------------------------------------------
 
+    def _guard_parse(self):
+        """See backends.common.guard_parse (shared with mywire)."""
+        return guard_parse(PgProtocolError)
+
     def _send(self, type_byte: bytes, payload: bytes) -> None:
         msg = type_byte + struct.pack("!I", len(payload) + 4) + payload
         self._sock.sendall(msg)
@@ -210,6 +215,10 @@ class PgConnection:
         )
         payload = struct.pack("!I", 196608) + params  # protocol 3.0
         self._sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+        with self._guard_parse():
+            self._auth_loop()
+
+    def _auth_loop(self) -> None:
         scram = None
         while True:
             t, body = self._recv_msg()
@@ -292,52 +301,58 @@ class PgConnection:
         oids: list[int] = []
         rowcount = 0
         err: PgError | None = None
-        while True:
-            t, body = self._recv_msg()
-            if t == b"E":
-                err = PgError(self._err_fields(body))
-            elif t == b"T":                    # RowDescription
-                (nf,) = struct.unpack("!H", body[:2])
-                off = 2
-                for _ in range(nf):
-                    name, off = self._cstr(body, off)
-                    _tbl, _att, oid, _sz, _mod, _fmt = struct.unpack(
-                        "!IHIhih", body[off:off + 18])
-                    off += 18
-                    columns.append(name)
-                    oids.append(oid)
-            elif t == b"D":                    # DataRow
-                (nf,) = struct.unpack("!H", body[:2])
-                off = 2
-                vals = []
-                for f in range(nf):
-                    (ln,) = struct.unpack("!i", body[off:off + 4])
-                    off += 4
-                    if ln < 0:
-                        vals.append(None)
-                    else:
-                        raw = body[off:off + ln]
-                        off += ln
-                        vals.append(_decode_text(
-                            raw, oids[f] if f < len(oids) else 0))
-                rows.append(tuple(vals))
-            elif t == b"C":                    # CommandComplete
-                tag, _ = self._cstr(body, 0)
-                parts = tag.split()
-                if parts and parts[-1].isdigit():
-                    rowcount = int(parts[-1])
-            elif t in (b"1", b"2", b"n", b"s"):  # Parse/BindComplete, NoData
-                continue
-            elif t == b"Z":                    # ReadyForQuery
-                break
-            elif t in (b"N", b"A"):            # Notice / Notification
-                continue
-            elif t == b"S":                    # async ParameterStatus
-                k, off2 = self._cstr(body, 0)
-                v, _ = self._cstr(body, off2)
-                self.parameters[k] = v
-            else:
-                raise PgProtocolError(f"unexpected message {t!r}")
+        # the parse below runs on SERVER-controlled bytes: any decode
+        # failure on a corrupted/desynced stream must surface as a
+        # PgProtocolError (the pool's evict set) — a leaked ValueError/
+        # UnicodeDecodeError would leave the poisoned connection cached
+        # (found by tests/test_wire_fuzz.py); _guard_parse re-raises
+        with self._guard_parse():
+            while True:
+                t, body = self._recv_msg()
+                if t == b"E":
+                    err = PgError(self._err_fields(body))
+                elif t == b"T":                    # RowDescription
+                    (nf,) = struct.unpack("!H", body[:2])
+                    off = 2
+                    for _ in range(nf):
+                        name, off = self._cstr(body, off)
+                        _tbl, _att, oid, _sz, _mod, _fmt = struct.unpack(
+                            "!IHIhih", body[off:off + 18])
+                        off += 18
+                        columns.append(name)
+                        oids.append(oid)
+                elif t == b"D":                    # DataRow
+                    (nf,) = struct.unpack("!H", body[:2])
+                    off = 2
+                    vals = []
+                    for f in range(nf):
+                        (ln,) = struct.unpack("!i", body[off:off + 4])
+                        off += 4
+                        if ln < 0:
+                            vals.append(None)
+                        else:
+                            raw = body[off:off + ln]
+                            off += ln
+                            vals.append(_decode_text(
+                                raw, oids[f] if f < len(oids) else 0))
+                    rows.append(tuple(vals))
+                elif t == b"C":                    # CommandComplete
+                    tag, _ = self._cstr(body, 0)
+                    parts = tag.split()
+                    if parts and parts[-1].isdigit():
+                        rowcount = int(parts[-1])
+                elif t in (b"1", b"2", b"n", b"s"):  # Parse/BindComplete, NoData
+                    continue
+                elif t == b"Z":                    # ReadyForQuery
+                    break
+                elif t in (b"N", b"A"):            # Notice / Notification
+                    continue
+                elif t == b"S":                    # async ParameterStatus
+                    k, off2 = self._cstr(body, 0)
+                    v, _ = self._cstr(body, off2)
+                    self.parameters[k] = v
+                else:
+                    raise PgProtocolError(f"unexpected message {t!r}")
         if err is not None:
             raise err
         return PgResult(rows=rows, columns=columns,
@@ -347,13 +362,14 @@ class PgConnection:
         """Simple-query protocol: multi-statement DDL, no params."""
         self._send(b"Q", sql.encode() + b"\x00")
         err: PgError | None = None
-        while True:
-            t, body = self._recv_msg()
-            if t == b"E":
-                err = PgError(self._err_fields(body))
-            elif t == b"Z":
-                break
-            # T/D/C/N/I(EmptyQueryResponse) all skipped: DDL scripts
+        with self._guard_parse():
+            while True:
+                t, body = self._recv_msg()
+                if t == b"E":
+                    err = PgError(self._err_fields(body))
+                elif t == b"Z":
+                    break
+                # T/D/C/N/I(EmptyQueryResponse) all skipped: DDL scripts
         if err is not None:
             raise err
 

@@ -1,0 +1,175 @@
+"""Wire-parser robustness fuzz for the SQL clients.
+
+The contract under test is NOT "parse anything" — it is that a
+malformed or corrupted SERVER response always surfaces as one of the
+exception types the connection pools' evict logic catches
+(OSError / {Pg,My}ProtocolError / {Pg,My}Error / struct.error,
+pgwire.PgPool.execute / mywire.MyPool.execute). A parser that leaks,
+say, IndexError or UnicodeDecodeError on a desynced stream would leave
+a poisoned connection cached in the pool (the evict wrapper would not
+fire) and every later query on that thread would misparse.
+
+Two layers, both seeded/deterministic:
+ * handshake fuzz: raw sockets serving random bytes where the protocol
+   greeting belongs;
+ * result-phase fuzz: a VALID handshake (the scripted fakes from
+   test_pgwire/test_mywire), then corrupted bytes where the query
+   response belongs — the deeper parse paths (row descriptions, lenenc
+   framing, column counts).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+
+import pytest
+
+from pio_tpu.data.backends.mywire import (
+    MyConnection,
+    MyDSN,
+    MyError,
+    MyProtocolError,
+)
+from pio_tpu.data.backends.pgwire import (
+    PgConnection,
+    PgDSN,
+    PgError,
+    PgProtocolError,
+)
+
+# what the pools catch (keep in sync with PgPool/MyPool execute)
+POOL_CATCHABLE = (OSError, PgProtocolError, MyProtocolError, PgError,
+                  MyError, struct.error)
+
+N_TRIALS = 40
+
+
+def _serve_bytes(payload: bytes, server_first: bool) -> int:
+    """One-shot server: optionally swallow the client's opener, write
+    `payload`, shut down. Bounded by socket timeouts on both sides."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    ready = threading.Event()
+
+    def run():
+        ready.set()
+        try:
+            c, _ = srv.accept()
+            c.settimeout(5)
+            if not server_first:
+                try:
+                    c.recv(65536)
+                except OSError:
+                    pass
+            try:
+                c.sendall(payload)
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
+        except OSError:
+            pass
+        finally:
+            srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    ready.wait()
+    return srv.getsockname()[1]
+
+
+def test_pg_handshake_fuzz():
+    rng = random.Random(11)
+    for _ in range(N_TRIALS):
+        payload = bytes(rng.randrange(256)
+                        for _ in range(rng.randrange(1, 64)))
+        port = _serve_bytes(payload, server_first=False)
+        with pytest.raises(POOL_CATCHABLE):
+            c = PgConnection(PgDSN("127.0.0.1", port, "u", "p", "db"),
+                             connect_timeout=3)
+            c.execute("SELECT 1")   # only if the garbage "authenticated"
+
+
+def test_my_handshake_fuzz():
+    rng = random.Random(12)
+    for _ in range(N_TRIALS):
+        payload = bytes(rng.randrange(256)
+                        for _ in range(rng.randrange(1, 64)))
+        port = _serve_bytes(payload, server_first=True)
+        with pytest.raises(POOL_CATCHABLE):
+            c = MyConnection(
+                MyDSN(host="127.0.0.1", port=port, user="u", password="p"),
+                timeout=3)
+            c.execute("SELECT 1")
+
+
+def _corrupt(rng: random.Random, b: bytes) -> bytes:
+    """Mutate a valid response: truncate, flip bytes, or splice noise."""
+    b = bytearray(b)
+    op = rng.randrange(3)
+    if op == 0 and len(b) > 1:
+        return bytes(b[: rng.randrange(1, len(b))])       # truncate
+    if op == 1:
+        for _ in range(rng.randrange(1, 5)):
+            b[rng.randrange(len(b))] = rng.randrange(256)  # bit rot
+        return bytes(b)
+    pos = rng.randrange(len(b))                            # splice
+    noise = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 9)))
+    return bytes(b[:pos]) + noise + bytes(b[pos:])
+
+
+def test_pg_result_phase_fuzz():
+    """Valid handshake, corrupted query response: the extended-protocol
+    result parse (RowDescription/DataRow/CommandComplete) must fail
+    into the pool-catchable set, never hang past the socket timeout."""
+    from tests.test_pgwire import FakePg, data_row, msg, ready, row_desc
+
+    rng = random.Random(13)
+    valid = b"".join([
+        row_desc(("a", 23)), data_row(b"1"),
+        msg(b"C", b"SELECT 1\x00"), ready(),
+    ])
+    for _ in range(N_TRIALS):
+        corrupted = _corrupt(rng, valid)
+        srv = FakePg(auth="trust",
+                     handler=lambda kind, d, c=corrupted: [c])
+        conn = PgConnection(
+            PgDSN("127.0.0.1", srv.port, "u", "", "db"), connect_timeout=3)
+        conn._sock.settimeout(3)
+        try:
+            conn.execute("SELECT 1")   # surviving benign corruption is fine
+        except POOL_CATCHABLE:
+            pass
+        finally:
+            conn.close()
+
+
+def test_my_result_phase_fuzz():
+    """Valid handshake, corrupted resultset (column count / coldefs /
+    lenenc rows / EOF framing)."""
+    from tests.test_mywire import FakeMy, coldef, eof_packet, lenenc_str
+
+    rng = random.Random(14)
+    valid_payloads = [
+        b"\x01", coldef(b"a", 0x03), eof_packet(), lenenc_str(b"1"),
+        eof_packet(),
+    ]
+    for _ in range(N_TRIALS):
+        idx = rng.randrange(len(valid_payloads))
+        payloads = list(valid_payloads)
+        payloads[idx] = _corrupt(rng, payloads[idx]) or b"\x00"
+        srv = FakeMy(handler=lambda sql, p=payloads: p)
+        try:
+            conn = MyConnection(srv.dsn(), timeout=3)
+        except POOL_CATCHABLE:
+            continue   # handshake path already covered above
+        try:
+            conn.execute("SELECT 1")
+        except POOL_CATCHABLE:
+            pass
+        finally:
+            try:
+                conn.close()
+            except POOL_CATCHABLE:
+                pass
