@@ -251,10 +251,20 @@ class PgConnection:
                         + struct.pack("!I", len(first)) + first,
                     )
                 elif code == 11:         # SASL continue
-                    assert scram is not None
+                    if scram is None:   # SASL continue/final before
+                        # start: desynced server — normalize, never
+                        # assert (stripped under -O; AssertionError is
+                        # outside every catch set)
+                        raise PgProtocolError(
+                            "out-of-order SASL message from server")
                     self._send(b"p", scram.client_final(body[4:]))
                 elif code == 12:         # SASL final
-                    assert scram is not None
+                    if scram is None:   # SASL continue/final before
+                        # start: desynced server — normalize, never
+                        # assert (stripped under -O; AssertionError is
+                        # outside every catch set)
+                        raise PgProtocolError(
+                            "out-of-order SASL message from server")
                     scram.verify_server(body[4:])
                 else:
                     raise PgProtocolError(f"unsupported auth method {code}")

@@ -55,7 +55,18 @@ class JsonHttpClient:
                 req, timeout=self.timeout, context=self._ctx
             ) as resp:
                 payload = resp.read()
-                return json.loads(payload) if payload else None
+                try:
+                    return json.loads(payload) if payload else None
+                except ValueError as e:
+                    # a corrupted 200 body must surface as the client's
+                    # error type, not leak past callers that catch
+                    # HttpClientError (RemoteBackend.call's StorageError
+                    # mapping). ValueError covers JSONDecodeError AND
+                    # the UnicodeDecodeError json.loads raises on a
+                    # non-UTF-8 body
+                    raise HttpClientError(
+                        resp.status,
+                        f"malformed JSON response body: {e}") from e
         except urllib.error.HTTPError as e:
             raw = e.read().decode(errors="replace")
             msg = raw or str(e)

@@ -414,3 +414,45 @@ def test_columnarize_value_event_rule_over_rpc(tmp_path, backing_type):
     finally:
         srv.stop()
         backing.close()
+
+
+def test_malformed_json_response_maps_to_storage_error():
+    """A 200 response with a corrupted body must surface as StorageError
+    (the remote backend's contract), not leak json.JSONDecodeError."""
+    import threading
+    import socket as sk
+
+    from pio_tpu.data.storage import StorageError
+
+    srv = sk.create_server(("127.0.0.1", 0))
+
+    def run():
+        c, _ = srv.accept()
+        c.settimeout(5)
+        try:
+            # drain the FULL request (headers + Content-Length body)
+            # before responding/closing: closing with unread data in
+            # the buffer RSTs the socket and discards our response
+            req = b""
+            while b"\r\n\r\n" not in req:
+                req += c.recv(65536)
+            head, _, rest = req.partition(b"\r\n\r\n")
+            import re as _re
+
+            m = _re.search(rb"content-length:\s*(\d+)", head.lower())
+            need = int(m.group(1)) if m else 0
+            while len(rest) < need:
+                rest += c.recv(65536)
+            body = b"{not json"
+            c.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: application/json"
+                      b"\r\nContent-Length: " + str(len(body)).encode()
+                      + b"\r\nConnection: close\r\n\r\n" + body)
+        finally:
+            c.close()
+            srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    port = srv.getsockname()[1]
+    client = Storage(env=_client_env(port))
+    with pytest.raises(StorageError, match="malformed JSON"):
+        client.get_metadata_apps().get_all()

@@ -136,7 +136,9 @@ def test_pg_result_phase_fuzz():
                      handler=lambda kind, d, c=corrupted: [c])
         conn = PgConnection(
             PgDSN("127.0.0.1", srv.port, "u", "", "db"), connect_timeout=3)
-        conn._sock.settimeout(3)
+        # sub-second timeout: truncation trials otherwise idle the
+        # full read timeout waiting for bytes the fake never sends
+        conn._sock.settimeout(0.4)
         try:
             conn.execute("SELECT 1")   # surviving benign corruption is fine
         except POOL_CATCHABLE:
@@ -164,6 +166,7 @@ def test_my_result_phase_fuzz():
             conn = MyConnection(srv.dsn(), timeout=3)
         except POOL_CATCHABLE:
             continue   # handshake path already covered above
+        conn.sock.settimeout(0.4)   # bound truncation-trial idling
         try:
             conn.execute("SELECT 1")
         except POOL_CATCHABLE:
