@@ -250,22 +250,17 @@ class PgConnection:
                         b"SCRAM-SHA-256\x00"
                         + struct.pack("!I", len(first)) + first,
                     )
-                elif code == 11:         # SASL continue
-                    if scram is None:   # SASL continue/final before
-                        # start: desynced server — normalize, never
-                        # assert (stripped under -O; AssertionError is
-                        # outside every catch set)
+                elif code in (11, 12):   # SASL continue / final
+                    if scram is None:
+                        # before SASL start: desynced server — raise the
+                        # normalized type, never assert (stripped under
+                        # -O; AssertionError escapes every catch set)
                         raise PgProtocolError(
                             "out-of-order SASL message from server")
-                    self._send(b"p", scram.client_final(body[4:]))
-                elif code == 12:         # SASL final
-                    if scram is None:   # SASL continue/final before
-                        # start: desynced server — normalize, never
-                        # assert (stripped under -O; AssertionError is
-                        # outside every catch set)
-                        raise PgProtocolError(
-                            "out-of-order SASL message from server")
-                    scram.verify_server(body[4:])
+                    if code == 11:
+                        self._send(b"p", scram.client_final(body[4:]))
+                    else:
+                        scram.verify_server(body[4:])
                 else:
                     raise PgProtocolError(f"unsupported auth method {code}")
             elif t == b"S":              # ParameterStatus

@@ -61,6 +61,14 @@ def bind_with_retry(make, port: int):
             time.sleep(BIND_RETRY_DELAY_S)
 
 
+def _reject_nonfinite(token: str):
+    # JSONDecodeError (a ValueError subclass) so dispatch_safe's 400
+    # mapping applies on EVERY server, not only handlers that catch
+    # ValueError themselves — a NaN body must never 500
+    raise json.JSONDecodeError(
+        f"non-finite JSON constant {token!r} is not valid JSON", token, 0)
+
+
 @dataclass
 class Request:
     method: str
@@ -73,7 +81,13 @@ class Request:
     def json(self) -> Any:
         if not self.body:
             return None
-        return json.loads(self.body.decode("utf-8"))
+        # strict JSON: NaN/Infinity are not valid JSON and the
+        # reference's json4s rejects them; accepting NaN here would let
+        # it flow into stored properties and poison downstream math and
+        # re-serialization (found by the event-server garbage fuzz)
+        return json.loads(
+            self.body.decode("utf-8"),
+            parse_constant=_reject_nonfinite)
 
     def form(self) -> dict[str, str]:
         parsed = urllib.parse.parse_qs(

@@ -45,7 +45,11 @@ class JsonHttpClient:
             qs = {k: v for k, v in params.items() if v is not None}
             if qs:
                 url += "?" + urllib.parse.urlencode(qs)
-        data = json.dumps(body).encode() if body is not None else None
+        # allow_nan=False: the servers reject the non-standard NaN token
+        # (server/http.py Request.json), so fail at the SENDER with a
+        # clear error instead of a 400/500 round trip
+        data = (json.dumps(body, allow_nan=False).encode()
+                if body is not None else None)
         req = urllib.request.Request(
             url, data=data, method=method,
             headers={"Content-Type": "application/json"},

@@ -328,3 +328,39 @@ def test_metrics_label_escaping_and_cap(server):
     totals = st.totals()
     assert len(totals) == cap + 1   # cap distinct + one overflow bucket
     assert totals[Stats.OVERFLOW_KEY] == 50
+
+
+def test_garbage_bodies_never_500(server):
+    """Input-validation contract: arbitrary client garbage on the ingest
+    routes maps to 4xx, never 500 (500 = an exception class the handler
+    does not catch — the event server faces untrusted clients)."""
+    import random
+
+    rng = random.Random(7)
+    garbage = [
+        b"\xff\xfe\x00binary",
+        b"[1,2,3]",
+        b'"just a string"',
+        b"{}",
+        b'{"event": null}',
+        b'{"event": 42, "entityType": [], "entityId": {}}',
+        b'{"event": "e", "entityType": "t", "entityId": "i", '
+        b'"eventTime": "not-a-time"}',
+        b'{"event": "e", "entityType": "t", "entityId": "i", '
+        b'"properties": "not-a-dict"}',
+        b'{"event": "$set", "entityType": "t", "entityId": "i", '
+        b'"properties": {"a": NaN}}',
+        b'{"event": "pio_reserved", "entityType": "t", "entityId": "i"}',
+    ] + [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 60)))
+         for _ in range(20)]
+    import http.client as hc
+
+    for body in garbage:
+        conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            conn.request("POST", "/events.json?accessKey=KEY", body=body,
+                         headers={"Content-Type": "application/json"})
+            status = conn.getresponse().status
+        finally:
+            conn.close()
+        assert 400 <= status < 500, (status, body[:40])
