@@ -282,3 +282,24 @@ def test_server_routes_use_fast_path(tmp_path):
     evs = list(storage.get_events().find(app_id, limit=-1))
     assert len(evs) == 2
     storage.close()
+
+
+def test_nonfinite_json_rejected_like_python_path(tmp_path):
+    """Both ingest implementations must speak the same JSON dialect:
+    the C++ parser's strict number grammar already rejected the
+    non-standard NaN/Infinity tokens; server/http.py Request.json was
+    aligned in round 5 (parse_constant rejection). This pins the
+    native side so neither can silently drift liberal again."""
+    from pio_tpu.native.eventlog import EventLog
+
+    log = EventLog(str(tmp_path / "l.log"))
+    now = datetime.now(timezone.utc)
+    for tok in (b"NaN", b"Infinity", b"-Infinity"):
+        with pytest.raises(ValueError, match="well-formed"):
+            log.ingest_batch(
+                b'[{"event":"e","entityType":"t","entityId":"i",'
+                b'"properties":{"a":' + tok + b"}}]", None, now)
+    ok = log.ingest_batch(
+        b'[{"event":"e","entityType":"t","entityId":"i",'
+        b'"properties":{"a":1.5}}]', None, now)
+    assert ok[0][0] == 0
