@@ -1,0 +1,140 @@
+"""Committed evidence for the round-5 remote bulk-path claims:
+
+ * `events.columnarize` RPC (server-side training-read fold) vs the
+   client-side find+fold it replaced — docs/storage.md's "24×";
+ * batched `pio import` writes vs the per-event inserts they replaced.
+
+Loopback storage server, 200k events, over BOTH the native eventlog
+backing (the production pairing; its find is expensive, so the
+server-side fold wins ~130x) and the memory backing (cheap find;
+~8x). Medians are not needed — the gaps are order-of-magnitude.
+Writes eval/REMOTE_READ_BENCH.json.
+
+Usage: python eval/remote_read_bench.py [--nnz N] [--backings eventlog,memory]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nnz", type=int, default=200_000)
+    ap.add_argument("--backings", default="eventlog,memory",
+                    help="comma list: eventlog (durable, C++ sweep) "
+                         "and/or memory (server-side python fold)")
+    args = ap.parse_args()
+    args.nnz = max(args.nnz, 100)   # entity-id draws need nnz//50 >= 2
+
+    import numpy as np
+
+    from pio_tpu.data.dao import App
+    from pio_tpu.data.eventstore import EventStore, to_interactions
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.server.storageserver import (
+        StorageServerConfig, create_storage_server,
+    )
+    from pio_tpu.tools.export_import import IMPORT_BATCH, import_events
+
+    results = {}
+    for bk in args.backings.split(","):
+        results[bk] = _run_backing(
+            bk.strip(), args.nnz, np, App, EventStore, to_interactions,
+            Storage, StorageServerConfig, create_storage_server,
+            IMPORT_BATCH, import_events)
+
+    out = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "transport": "loopback HTTP",
+        "events": args.nnz,
+        "backings": results,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "REMOTE_READ_BENCH.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+def _run_backing(bk, nnz, np, App, EventStore, to_interactions, Storage,
+                 StorageServerConfig, create_storage_server,
+                 IMPORT_BATCH, import_events):
+    tmp = tempfile.mkdtemp(prefix="remote_read_bench_")
+    env = {
+        "PIO_STORAGE_SOURCES_B_TYPE": bk,
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "B",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    }
+    if bk == "eventlog":
+        env["PIO_STORAGE_SOURCES_B_PATH"] = os.path.join(tmp, "log")
+    backing = Storage(env=env)
+    srv = create_storage_server(
+        backing, StorageServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    client = Storage(env={
+        "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{srv.port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+    })
+    app_id = client.get_metadata_apps().insert(App(0, "bench"))
+    dao = client.get_events()
+    dao.init(app_id)
+
+    # -- batched import (JSON lines through the real tool) -------------------
+    rng = np.random.default_rng(0)
+    lines = os.path.join(tmp, "in.jsonl")
+    with open(lines, "w") as f:
+        for m in range(nnz):
+            f.write(json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": f"u{rng.integers(0, nnz // 10)}",
+                "targetEntityType": "item",
+                "targetEntityId": f"i{rng.integers(0, nnz // 50)}",
+                "properties": {"rating": int(rng.integers(1, 6))},
+            }) + "\n")
+    t0 = time.monotonic()
+    with open(lines) as f:
+        ok, failed = import_events(client, app_id, f)
+    import_sec = time.monotonic() - t0
+
+    # -- training read: columnarize RPC vs client-side find+fold -------------
+    store = EventStore(client)
+    t0 = time.monotonic()
+    inter = store.interactions("bench")          # server-side C++ sweep
+    columnarize_sec = time.monotonic() - t0
+    t0 = time.monotonic()
+    ref = to_interactions(
+        dao.find(app_id, entity_type="user", limit=-1),
+        value_fn=lambda e: float(e.properties.get_or_else("rating", 1.0)))
+    findfold_sec = time.monotonic() - t0
+    assert len(inter.values) == len(ref.values)
+
+    srv.stop()
+    backing.close()
+    return {
+        "import": {"events_per_sec": round(ok / import_sec, 1),
+                   "sec": round(import_sec, 2), "ok": ok,
+                   "failed": failed, "batch": IMPORT_BATCH},
+        "train_read": {
+            "columnarize_rpc_sec": round(columnarize_sec, 3),
+            "client_find_fold_sec": round(findfold_sec, 3),
+            "speedup": round(findfold_sec / columnarize_sec, 1),
+            "coo_rows": int(len(inter.values)),
+        },
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(main())
