@@ -1,0 +1,17 @@
+# Developer/CI entry points. `make lint` is the static gate CI runs
+# alongside the tier-1 pytest suite (ROADMAP.md); see docs/lint.md.
+
+PY ?= python
+
+.PHONY: lint test check
+
+lint:
+	$(PY) -m pio_tpu.tools.cli lint pio_tpu/ tests/ bench.py eval/ examples/
+	$(PY) -m compileall -q pio_tpu tests eval examples bench.py
+
+# tier-1 verify (ROADMAP.md): CPU-only, not-slow subset
+test:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+check: lint test

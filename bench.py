@@ -1060,8 +1060,10 @@ if __name__ == "__main__":
             # axon sitecustomize before this code runs (see module docstring)
             import jax
 
+            from pio_tpu.utils.jaxcompat import set_cpu_device_count
+
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 1)
+            set_cpu_device_count(1)
         name = sys.argv[sys.argv.index("--phase") + 1]
         print(json.dumps(PHASES[name]()))
         sys.exit(0)

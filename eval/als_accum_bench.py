@@ -24,8 +24,10 @@ import time
 if os.environ.get("PIO_BENCH_PLATFORM") == "cpu":
     import jax
 
+    from pio_tpu.utils.jaxcompat import set_cpu_device_count
+
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    set_cpu_device_count(1)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

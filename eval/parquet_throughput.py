@@ -51,13 +51,13 @@ def main() -> dict:
     dao.insert_batch(events, 1)
 
     path = tempfile.mktemp(suffix=".parquet")
-    t0 = time.time()
+    t0 = time.perf_counter()
     n = export_events_parquet(storage, 1, path)
-    t1 = time.time()
+    t1 = time.perf_counter()
     size_mb = os.path.getsize(path) / 1e6
     dao.init(2)
     ok, failed = import_events_parquet(storage, 2, path)
-    t2 = time.time()
+    t2 = time.perf_counter()
     os.unlink(path)
     assert n == N and ok == N and failed == 0
 

@@ -1,0 +1,28 @@
+"""`pio lint` — AST-based trace-safety & concurrency analysis.
+
+The static stand-in for the type-level guarantees the reference gets
+from Scala (SURVEY §1): five rule families catch the jax_graft failure
+modes — host syncs inside jit, shard specs naming undeclared mesh axes,
+unlocked shared state in server handlers, un-synced benchmark timing,
+and DASE stage classes missing their contract methods — before they
+surface at runtime under load.
+
+API:
+    from pio_tpu.analysis import run_lint, lint_text
+    report = run_lint(["pio_tpu/"])
+    report.exit_code        # 0 = clean (info findings never fail)
+    report.findings         # list[Finding]
+
+CLI:  pio lint [paths ...]   (pio_tpu/tools/cli.py)
+Docs: docs/lint.md (rule catalogue + suppression syntax)
+"""
+
+from pio_tpu.analysis.engine import (
+    ProjectInfo, lint_text, load_project_info, run_lint,
+)
+from pio_tpu.analysis.findings import Finding, LintReport, Severity
+
+__all__ = [
+    "Finding", "LintReport", "ProjectInfo", "Severity",
+    "lint_text", "load_project_info", "run_lint",
+]

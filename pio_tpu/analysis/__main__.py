@@ -1,0 +1,8 @@
+"""`python -m pio_tpu.analysis [paths ...]` — same as `pio lint`."""
+
+import sys
+
+from pio_tpu.tools.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main(["lint"] + sys.argv[1:]))

@@ -1,0 +1,190 @@
+"""Shared AST machinery for the lint rules.
+
+The load-bearing piece is `ImportMap`: every rule matches calls by their
+*canonical* dotted name (`jax.jit`, `time.time`, `numpy.asarray`), not by
+whatever alias the module happens to use — so `from functools import
+partial`, `import jax.numpy as jnp`, and `from jax.sharding import
+PartitionSpec as P` all resolve to the same canonical targets the rules
+key on. Parent links (`attach_parents`) give rules cheap "am I under a
+`with lock:`" / "am I inside __init__" ancestry queries that plain
+ast.walk cannot answer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+# canonical names that create a traced (jit/pjit/shard_map) function
+JIT_NAMES = frozenset({
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+})
+SHARD_MAP_NAMES = frozenset({
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+})
+TRACE_WRAPPERS = JIT_NAMES | SHARD_MAP_NAMES
+PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+
+
+class ImportMap:
+    """Alias -> canonical dotted origin, from every import in the module
+    (module-level and function-level alike: the repo lazily imports jax
+    inside functions throughout)."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def canonical(self, node: ast.AST) -> str | None:
+        """Dotted canonical name of a Name/Attribute chain, or None for
+        anything dynamic (subscripts, calls, etc.)."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+def attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._pio_parent = node  # type: ignore[attr-defined]
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "_pio_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_pio_parent", None)
+
+
+def enclosing_function(node: ast.AST) -> ast.AST | None:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _mentions_lock(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        name = None
+        if isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Name):
+            name = n.id
+        if name and ("lock" in name.lower() or "mutex" in name.lower()):
+            return True
+    return False
+
+
+def under_lock(node: ast.AST) -> bool:
+    """True when any enclosing `with` statement's context expression
+    mentions a lock-like name (`self._lock`, `lock`, `state_mutex`, ...)."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if _mentions_lock(item.context_expr):
+                    return True
+    return False
+
+
+def in_async_function(node: ast.AST) -> bool:
+    fn = enclosing_function(node)
+    return isinstance(fn, ast.AsyncFunctionDef)
+
+
+def is_self_attr(node: ast.AST) -> bool:
+    """`self.x` / `cls.x` (peeling subscripts: `self.x[k]`)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls"))
+
+
+def local_function_defs(tree: ast.AST) -> dict[str, list[ast.FunctionDef]]:
+    """name -> FunctionDefs anywhere in the module (nested included), for
+    one-level resolution of helper calls in timed regions."""
+    out: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _is_trace_wrapper(imports: ImportMap, expr: ast.AST) -> str | None:
+    """If `expr` denotes jit/pjit/shard_map (directly or via
+    functools.partial), return which canonical wrapper; else None."""
+    name = imports.canonical(expr)
+    if name in TRACE_WRAPPERS:
+        return name
+    if isinstance(expr, ast.Call):
+        fname = imports.canonical(expr.func)
+        if fname in TRACE_WRAPPERS:
+            # e.g. jax.jit(static_argnames=...) used as a decorator factory
+            return fname
+        if fname in PARTIAL_NAMES and expr.args:
+            inner = imports.canonical(expr.args[0])
+            if inner in TRACE_WRAPPERS:
+                return inner
+    return None
+
+
+def traced_functions(
+    tree: ast.AST, imports: ImportMap
+) -> dict[ast.AST, str]:
+    """FunctionDef/Lambda -> wrapper canonical name, for every function
+    that ends up inside jax tracing:
+
+      * decorated: @jax.jit / @partial(jax.jit, ...) / @jax.shard_map /
+        @partial(jax.shard_map, ...)
+      * wrapped by call: jax.jit(fn) / jax.jit(lambda ...) anywhere in
+        the module marks the local def(s) named `fn` (the repo idiom:
+        build a closure, `return jax.jit(run)`)
+    """
+    traced: dict[ast.AST, str] = {}
+    by_name = local_function_defs(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                wrapper = _is_trace_wrapper(imports, deco)
+                if wrapper:
+                    traced[node] = wrapper
+        elif isinstance(node, ast.Call):
+            fname = imports.canonical(node.func)
+            if fname in TRACE_WRAPPERS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Lambda):
+                    traced[arg] = fname
+                elif isinstance(arg, ast.Name):
+                    for fn in by_name.get(arg.id, []):
+                        traced[fn] = fname
+            elif fname in PARTIAL_NAMES and len(node.args) >= 2:
+                inner = imports.canonical(node.args[0])
+                if inner in TRACE_WRAPPERS:
+                    arg = node.args[1]
+                    if isinstance(arg, ast.Lambda):
+                        traced[arg] = inner
+                    elif isinstance(arg, ast.Name):
+                        for fn in by_name.get(arg.id, []):
+                            traced[fn] = inner
+    return traced

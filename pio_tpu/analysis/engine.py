@@ -1,0 +1,335 @@
+"""Lint engine: file discovery, parsing, suppression handling, rule
+dispatch.
+
+Suppression syntax (checked per line, against the comment on the finding's
+own line or a standalone comment on the line directly above):
+
+    x = model.scores.item()  # pio: lint-ok[trace-host-sync] reduced on host
+    # pio: lint-ok[attr-no-lock] route table is sealed before serve starts
+    self.routes.append(entry)
+
+`lint-ok[*]` suppresses every rule on that line. The justification text
+after the bracket is free-form but strongly encouraged — the point of a
+suppression is to document WHY the hazard does not apply.
+
+Project awareness: rules that need repo-specific vocabulary (the mesh
+axis names, the DASE contracts) get them from `ProjectInfo`, which parses
+`pio_tpu/parallel/mesh.py` and `pio_tpu/controller/base.py` when the
+linted tree contains them and falls back to the built-in defaults when
+linting standalone snippets (fixtures, other repos).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from pio_tpu.analysis.astutil import ImportMap, attach_parents
+from pio_tpu.analysis.findings import Finding, LintReport, Severity
+
+_SUPPRESS_RE = re.compile(r"#\s*pio:\s*lint-ok\[([^\]]*)\]")
+
+# fallbacks when the linted tree is not this repo (fixtures, snippets)
+DEFAULT_MESH_AXES = frozenset({"data", "seq", "model"})
+DEFAULT_CONTRACTS: dict[str, frozenset[str]] = {
+    "DataSource": frozenset({"read_training"}),
+    "Preparator": frozenset({"prepare"}),
+    "Algorithm": frozenset({"train", "predict"}),
+    "LAlgorithm": frozenset({"train", "predict"}),
+    "P2LAlgorithm": frozenset({"train", "predict"}),
+    "PAlgorithm": frozenset({"train", "predict"}),
+    "Serving": frozenset({"serve"}),
+}
+
+
+@dataclass
+class ProjectInfo:
+    """Repo-level vocabulary shared by all rules."""
+
+    mesh_axes: frozenset[str] = DEFAULT_MESH_AXES
+    # DASE stage class name -> method names its contract requires
+    contracts: dict[str, frozenset[str]] = field(
+        default_factory=lambda: dict(DEFAULT_CONTRACTS))
+
+
+def _parse_mesh_axes(path: str) -> frozenset[str] | None:
+    """Axis vocabulary from `*_AXIS = "name"` assignments in mesh.py."""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return None
+    axes = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_AXIS")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            axes.add(node.value.value)
+    return frozenset(axes) or None
+
+
+def _parse_contracts(path: str) -> dict[str, frozenset[str]] | None:
+    """Abstract-method contracts from controller/base.py: for each class,
+    the abstractmethods it declares plus those inherited from other
+    classes in the same file, minus concrete overrides."""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return None
+    classes = {n.name: n for n in tree.body if isinstance(n, ast.ClassDef)}
+    if not classes:
+        return None
+
+    def is_abstract(fn: ast.AST) -> bool:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        for d in fn.decorator_list:
+            name = d.attr if isinstance(d, ast.Attribute) else (
+                d.id if isinstance(d, ast.Name) else "")
+            if name == "abstractmethod":
+                return True
+        return False
+
+    def required(name: str, seen: frozenset[str] = frozenset()) -> set[str]:
+        node = classes.get(name)
+        if node is None or name in seen:
+            return set()
+        req: set[str] = set()
+        for base in node.bases:
+            base_name = base.attr if isinstance(base, ast.Attribute) else (
+                base.id if isinstance(base, ast.Name) else "")
+            req |= required(base_name, seen | {name})
+        defined = {
+            b.name for b in node.body
+            if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        req -= {d for d in defined
+                if not is_abstract(next(b for b in node.body
+                                        if getattr(b, "name", None) == d))}
+        req |= {b.name for b in node.body if is_abstract(b)}
+        return req
+
+    out = {}
+    for name in classes:
+        req = required(name)
+        if req:
+            out[name] = frozenset(req)
+    return out or None
+
+
+def load_project_info(paths: list[str]) -> ProjectInfo:
+    """Locate this repo's mesh.py / controller/base.py relative to the
+    linted paths (walking up at most 4 levels), falling back to defaults."""
+    info = ProjectInfo()
+    roots = []
+    for p in paths:
+        d = os.path.abspath(p if os.path.isdir(p) else os.path.dirname(p))
+        for _ in range(5):
+            roots.append(d)
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    for root in roots:
+        mesh = os.path.join(root, "pio_tpu", "parallel", "mesh.py")
+        base = os.path.join(root, "pio_tpu", "controller", "base.py")
+        if os.path.exists(mesh):
+            axes = _parse_mesh_axes(mesh)
+            if axes:
+                info.mesh_axes = axes
+        if os.path.exists(base):
+            contracts = _parse_contracts(base)
+            if contracts:
+                info.contracts = contracts
+        if os.path.exists(mesh) or os.path.exists(base):
+            break
+    return info
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule needs about one parsed file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    imports: ImportMap
+    project: ProjectInfo
+    # line -> rule ids suppressed on that line ('*' = all)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+    # lines that are nothing but a comment (suppression blocks above a
+    # statement apply to it through these)
+    comment_lines: set[int] = field(default_factory=set)
+
+    def imports_any(self, *modules: str) -> bool:
+        roots = {origin.split(".")[0]
+                 for origin in self.imports.aliases.values()}
+        return any(m in roots for m in modules)
+
+
+def _parse_suppressions(
+        source: str) -> tuple[dict[int, set[str]], set[int]]:
+    """-> ({line: suppressed rule ids}, {comment-only lines})."""
+    out: dict[int, set[str]] = {}
+    comment_only: set[int] = set()
+    lines = source.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            line_no, col = tok.start
+            if not lines[line_no - 1][:col].strip():
+                comment_only.add(line_no)
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(line_no, set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out, comment_only
+
+
+def build_context(path: str, source: str,
+                  project: ProjectInfo) -> ModuleContext:
+    tree = ast.parse(source, filename=path)
+    attach_parents(tree)
+    suppressions, comment_lines = _parse_suppressions(source)
+    return ModuleContext(
+        path=path, source=source, tree=tree,
+        imports=ImportMap(tree), project=project,
+        suppressions=suppressions, comment_lines=comment_lines,
+    )
+
+
+def iter_python_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def _is_suppressed(ctx: ModuleContext, f: Finding) -> bool:
+    """Suppressed by a comment on the finding's line, or anywhere in the
+    contiguous standalone-comment block directly above it (so a
+    justification can span lines)."""
+
+    def match(line: int) -> bool:
+        rules = ctx.suppressions.get(line)
+        return bool(rules and (f.rule in rules or "*" in rules))
+
+    if match(f.line):
+        return True
+    line = f.line - 1
+    while line >= 1 and line in ctx.comment_lines:
+        if match(line):
+            return True
+        line -= 1
+    return False
+
+
+def _rule_matches(rule, selectors: set[str]) -> bool:
+    """A selector matches a rule by prefix of its family id OR of any
+    concrete finding id it emits — so both `--select trace` and
+    `--select trace-host-sync` (the id the tool prints and suppressions
+    use) work."""
+    names = (rule.id, *rule.ids)
+    return any(n.startswith(s) for s in selectors for n in names)
+
+
+def _rule_ignored(rule, ignore: set[str]) -> bool:
+    """Skip the whole rule only when the ignore set covers its family id
+    or every concrete id it emits; partial ignores are applied per
+    finding by _keep_finding."""
+    if any(rule.id.startswith(s) for s in ignore):
+        return True
+    return all(any(i.startswith(s) for s in ignore) for i in rule.ids)
+
+
+def _keep_finding(rule, f: Finding, select: set[str] | None,
+                  ignore: set[str] | None) -> bool:
+    """Finding-level filter: a family selector (`concurrency`) covers all
+    of the rule's findings; a concrete selector (`donate-hint`) covers
+    only matching finding ids — so `--ignore donate-hint` drops the hint
+    without silencing shard-axis, its family-mate."""
+    def covers(s: str) -> bool:
+        return rule.id.startswith(s) or f.rule.startswith(s)
+
+    if select and not any(covers(s) for s in select):
+        return False
+    if ignore and any(covers(s) for s in ignore):
+        return False
+    return True
+
+
+def run_lint(paths: list[str], select: set[str] | None = None,
+             ignore: set[str] | None = None,
+             project: ProjectInfo | None = None) -> LintReport:
+    """Lint every .py file under `paths`. select/ignore filter by rule id
+    prefix: a family (`trace`) or a concrete finding id
+    (`trace-host-sync`) both work."""
+    from pio_tpu.analysis.rules import ALL_RULES
+
+    project = project or load_project_info(paths)
+    rules = [r for r in ALL_RULES
+             if (not select or _rule_matches(r, select))
+             and not (ignore and _rule_ignored(r, ignore))]
+    report = LintReport()
+    for path in iter_python_files(paths):
+        try:
+            source = open(path, encoding="utf-8").read()
+        except OSError as e:
+            report.findings.append(Finding(
+                "parse-error", Severity.ERROR, path, 1, 0, str(e)))
+            continue
+        report.n_files += 1
+        try:
+            ctx = build_context(path, source, project)
+        except SyntaxError as e:
+            report.findings.append(Finding(
+                "parse-error", Severity.ERROR, path,
+                e.lineno or 1, e.offset or 0, f"syntax error: {e.msg}"))
+            continue
+        for rule in rules:
+            for f in rule.check(ctx):
+                if not _keep_finding(rule, f, select, ignore):
+                    continue
+                (report.suppressed if _is_suppressed(ctx, f)
+                 else report.findings).append(f)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return report
+
+
+def lint_text(source: str, path: str = "<snippet>.py",
+              select: set[str] | None = None,
+              project: ProjectInfo | None = None) -> list[Finding]:
+    """Lint a source string (the tests' fixture entry point)."""
+    from pio_tpu.analysis.rules import ALL_RULES
+
+    project = project or ProjectInfo()
+    ctx = build_context(path, source, project)
+    rules = [r for r in ALL_RULES
+             if not select or _rule_matches(r, select)]
+    findings = []
+    for rule in rules:
+        for f in rule.check(ctx):
+            if _keep_finding(rule, f, select, None) \
+                    and not _is_suppressed(ctx, f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings

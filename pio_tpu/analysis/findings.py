@@ -1,0 +1,87 @@
+"""Finding model for `pio lint` — what a rule reports and how it prints.
+
+The reference PredictionIO leans on scalac: a mis-wired DASE stage or a
+bad partitioner is a compile error. This Python port has no compiler
+pass, so the analysis engine (engine.py) fills that slot and rules
+communicate exclusively through `Finding` records defined here.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class Severity(enum.IntEnum):
+    """Ordered so `max(findings)` and threshold comparisons read naturally.
+
+    INFO findings are advisory (e.g. a donate_argnums hint) and never
+    fail the lint run; WARNING and ERROR both make `pio lint` exit 1.
+    """
+
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    def label(self) -> str:
+        return self.name.lower()
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic, anchored to a source location.
+
+    `rule` is the stable kebab-case id used in suppression comments
+    (`# pio: lint-ok[rule]`) and in --select/--ignore.
+    """
+
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity.label()} [{self.rule}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.label(),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class LintReport:
+    """Aggregate result of a lint run over many files."""
+
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    n_files: int = 0
+
+    @property
+    def failing(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity >= Severity.WARNING]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.failing else 0
+
+    def counts(self) -> dict[str, int]:
+        out = {"error": 0, "warning": 0, "info": 0}
+        for f in self.findings:
+            out[f.severity.label()] += 1
+        return out
+
+    def summary(self) -> str:
+        c = self.counts()
+        return (f"{len(self.findings)} finding(s) "
+                f"({c['error']} error, {c['warning']} warning, "
+                f"{c['info']} info; {len(self.suppressed)} suppressed) "
+                f"in {self.n_files} file(s)")

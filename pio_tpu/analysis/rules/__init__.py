@@ -1,0 +1,25 @@
+"""Rule registry for `pio lint`.
+
+Each rule object exposes:
+  * `id`  — family prefix (used by --select/--ignore prefix matching)
+  * `ids` — the concrete finding ids it can emit (suppression keys)
+  * `check(ctx: ModuleContext) -> Iterable[Finding]`
+"""
+
+from __future__ import annotations
+
+from pio_tpu.analysis.rules.bench_hygiene import BenchHygieneRule
+from pio_tpu.analysis.rules.concurrency import ConcurrencyRule
+from pio_tpu.analysis.rules.shard_spec import ShardSpecRule
+from pio_tpu.analysis.rules.trace_purity import TracePurityRule
+from pio_tpu.analysis.rules.workflow_contract import WorkflowContractRule
+
+ALL_RULES = [
+    TracePurityRule(),
+    ShardSpecRule(),
+    ConcurrencyRule(),
+    BenchHygieneRule(),
+    WorkflowContractRule(),
+]
+
+ALL_RULE_IDS = tuple(i for r in ALL_RULES for i in r.ids)

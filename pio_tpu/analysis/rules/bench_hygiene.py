@@ -1,0 +1,168 @@
+"""Benchmark-hygiene rules: timing that measures the wrong thing.
+
+  * `bench-clock` — `time.time()` for duration measurement: the wall
+    clock is not monotonic (NTP slews it mid-measurement) and has coarse
+    resolution on some platforms; use time.perf_counter() (or
+    time.monotonic() for deadlines).
+  * `bench-no-sync` — a timed region that dispatches jax work but never
+    forces completion (`jax.block_until_ready`, a scalar readback via
+    `float()` / `.item()`, or `np.asarray`). jax dispatch is async: the
+    stopwatch stops when the work is *enqueued*, not when it finishes,
+    so the "measurement" is the dispatch overhead — exactly the bug this
+    repo's own BENCH history records (bench.py round-1/2 postmortem:
+    timings that were silently dispatch times).
+
+Timed regions are matched structurally: `t = <clock>()` ... any later
+statement in the same suite containing `<clock>() - t`. Helper calls are
+resolved one level deep through module-local defs, so the repo idiom
+
+    def go(): return float(jnp.sum(model.x))   # forces completion
+    t0 = time.monotonic(); go(); dt = time.monotonic() - t0
+
+counts as synced.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pio_tpu.analysis.astutil import local_function_defs
+from pio_tpu.analysis.engine import ModuleContext
+from pio_tpu.analysis.findings import Finding, Severity
+
+_CLOCKS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time",
+})
+_SYNC_ATTRS = frozenset({"block_until_ready", "item", "tolist"})
+_SYNC_CALLS = frozenset({
+    "jax.block_until_ready", "jax.device_get",
+    "numpy.asarray", "numpy.array",
+})
+_SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+# jax APIs that are host-synchronous (no async dispatch to wait on):
+# timing around these is legitimate — backend init, device enumeration,
+# AOT lowering/compilation, and wrapper construction all complete before
+# returning
+_SYNCHRONOUS_JAX = frozenset({
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.default_backend", "jax.process_index",
+    "jax.jit", "jax.pjit", "jax.shard_map", "jax.config.update",
+    "jax.ShapeDtypeStruct",
+})
+
+
+class BenchHygieneRule:
+    id = "bench"
+    ids = ("bench-clock", "bench-no-sync")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and ctx.imports.canonical(node.func) == "time.time"):
+                yield Finding(
+                    "bench-clock", Severity.WARNING, ctx.path,
+                    node.lineno, node.col_offset,
+                    "time.time() is wall-clock (NTP can slew it "
+                    "mid-measurement); use time.perf_counter() for "
+                    "timing, time.monotonic() for deadlines")
+        if ctx.imports_any("jax"):
+            defs = local_function_defs(ctx.tree)
+            for fn in ast.walk(ctx.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_regions(ctx, fn, defs)
+
+    # -- un-synced timed regions ---------------------------------------------
+    def _check_regions(self, ctx: ModuleContext, fn: ast.AST,
+                       defs: dict) -> Iterator[Finding]:
+        for suite in self._suites(fn):
+            starts: dict[str, int] = {}  # clock var -> stmt index
+            for i, stmt in enumerate(suite):
+                tvar = self._clock_assign(ctx, stmt)
+                if tvar:
+                    starts[tvar] = i
+                    continue
+                for tvar2 in self._clock_reads(ctx, stmt, set(starts)):
+                    region = suite[starts[tvar2] + 1: i] + [stmt]
+                    if (self._has_jax_call(ctx, region, defs, depth=2)
+                            and not self._has_sync(ctx, region, defs,
+                                                   depth=2)):
+                        yield Finding(
+                            "bench-no-sync", Severity.WARNING, ctx.path,
+                            stmt.lineno, stmt.col_offset,
+                            "timed region dispatches jax work but never "
+                            "syncs (jax.block_until_ready or a scalar "
+                            "readback): async dispatch means this "
+                            "measures enqueue time, not execution time")
+                    del starts[tvar2]
+
+    @staticmethod
+    def _suites(fn: ast.AST):
+        """Every statement list in the function (body, loop/with/if
+        bodies), so `t0 = clock()` and its read match within one suite."""
+        for node in ast.walk(fn):
+            for attr in ("body", "orelse", "finalbody"):
+                suite = getattr(node, attr, None)
+                if isinstance(suite, list) and suite \
+                        and isinstance(suite[0], ast.stmt):
+                    yield suite
+
+    def _clock_assign(self, ctx: ModuleContext, stmt: ast.stmt) -> str | None:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and ctx.imports.canonical(stmt.value.func) in _CLOCKS):
+            return stmt.targets[0].id
+        return None
+
+    def _clock_reads(self, ctx: ModuleContext, stmt: ast.stmt,
+                     tvars: set[str]) -> list[str]:
+        """tvars read as `<clock>() - tvar` anywhere inside stmt."""
+        out = []
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                    and isinstance(node.right, ast.Name)
+                    and node.right.id in tvars
+                    and isinstance(node.left, ast.Call)
+                    and ctx.imports.canonical(node.left.func) in _CLOCKS):
+                out.append(node.right.id)
+        return out
+
+    def _has_jax_call(self, ctx, region, defs, depth: int) -> bool:
+        return self._scan(ctx, region, defs, depth, self._is_jax_call)
+
+    def _has_sync(self, ctx, region, defs, depth: int) -> bool:
+        return self._scan(ctx, region, defs, depth, self._is_sync_call)
+
+    def _scan(self, ctx, region, defs, depth, pred) -> bool:
+        for stmt in region:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if pred(ctx, node):
+                    return True
+                # one-level helper resolution: go() defined locally
+                if depth > 0 and isinstance(node.func, ast.Name):
+                    for helper in defs.get(node.func.id, []):
+                        if self._scan(ctx, helper.body, defs, depth - 1,
+                                      pred):
+                            return True
+        return False
+
+    @staticmethod
+    def _is_jax_call(ctx: ModuleContext, node: ast.Call) -> bool:
+        name = ctx.imports.canonical(node.func) or ""
+        if name in _SYNCHRONOUS_JAX:
+            return False
+        return name == "jax" or name.startswith("jax.")
+
+    @staticmethod
+    def _is_sync_call(ctx: ModuleContext, node: ast.Call) -> bool:
+        name = ctx.imports.canonical(node.func)
+        if name in _SYNC_CALLS:
+            return True
+        if name in _SYNC_BUILTINS:
+            return True
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_ATTRS)

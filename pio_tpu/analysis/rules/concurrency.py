@@ -1,0 +1,204 @@
+"""Concurrency rules: shared mutable state and blocking calls in the
+server stack.
+
+The reference event/deploy servers inherit thread-safety from akka's
+actor model; this port runs real threads (ThreadingHTTPServer, worker
+pools) and an asyncio event loop side by side, so the hazards are:
+
+  * `attr-no-lock`   — `self.x += 1` or `self.xs.append(...)` outside a
+    `with <lock>:` block in a module that spins up threads: a classic
+    lost-update under the request pool. Code confined to one thread
+    (asyncio loop callbacks, setup-time registration) suppresses with a
+    justification, which doubles as documentation of the confinement.
+  * `global-no-lock` — writes to module-level state from functions,
+    unguarded: two importers/requests race the same slot.
+  * `async-blocking` — time.sleep / sync HTTP / subprocess inside an
+    `async def` stalls the whole event loop (every connection, not just
+    the offender's).
+
+Scope gate: modules that import threading/asyncio/concurrent.futures/
+multiprocessing — shared-state writes in single-threaded scripts are not
+hazards.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pio_tpu.analysis.astutil import (
+    ancestors, enclosing_function, in_async_function, is_self_attr,
+    under_lock,
+)
+from pio_tpu.analysis.engine import ModuleContext
+from pio_tpu.analysis.findings import Finding, Severity
+
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "extend", "insert", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "__setitem__",
+})
+_MUTABLE_FACTORIES = frozenset({
+    "dict", "list", "set", "collections.Counter", "collections.deque",
+    "collections.defaultdict", "collections.OrderedDict", "queue.Queue",
+})
+_BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "urllib.request.urlopen", "urllib.request.urlretrieve",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "os.system", "os.waitpid",
+    "socket.create_connection",
+    "requests.get", "requests.post", "requests.put", "requests.request",
+    # this repo's sync HTTP client (utils/httpclient.py)
+    "pio_tpu.utils.httpclient.JsonHttpClient",
+})
+
+
+class ConcurrencyRule:
+    id = "concurrency"
+    ids = ("attr-no-lock", "global-no-lock", "async-blocking")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._async_blocking(ctx)
+        if not ctx.imports_any("threading", "asyncio", "multiprocessing",
+                               "concurrent"):
+            return
+        module_mutables = self._module_mutables(ctx)
+        global_names = self._global_declared(ctx)
+        for node in ast.walk(ctx.tree):
+            yield from self._check_write(ctx, node, module_mutables,
+                                         global_names)
+
+    # -- shared-state writes ------------------------------------------------
+    def _module_mutables(self, ctx: ModuleContext) -> set[str]:
+        out = set()
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            v = node.value
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                out.add(node.targets[0].id)
+            elif (isinstance(v, ast.Call)
+                  and ctx.imports.canonical(v.func) in _MUTABLE_FACTORIES):
+                out.add(node.targets[0].id)
+        return out
+
+    def _global_declared(self, ctx: ModuleContext) -> set[str]:
+        out = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Global):
+                out.update(node.names)
+        return out
+
+    def _check_write(self, ctx: ModuleContext, node: ast.AST,
+                     module_mutables: set[str],
+                     global_names: set[str]) -> Iterator[Finding]:
+        fn = enclosing_function(node)
+        if fn is None:
+            return  # module-level init runs once, single-threaded
+        in_init = fn.name in ("__init__", "__new__", "__post_init__")
+        # asyncio callbacks are loop-confined by construction: mutating
+        # self state from an `async def` needs no lock (flagged only for
+        # blocking calls, below)
+        if isinstance(node, ast.AugAssign):
+            target = node.target
+            if (is_self_attr(target) and not in_init
+                    and not in_async_function(node)
+                    and not under_lock(node)):
+                yield self._f("attr-no-lock", ctx, node,
+                              f"`{ast.unparse(target)} {_op(node)}= ...` "
+                              "outside a lock: concurrent requests lose "
+                              "updates; guard with the owning object's "
+                              "lock or document thread-confinement")
+            elif (isinstance(self._root_name(target), str)
+                  and self._root_name(target) in
+                  (module_mutables | global_names)
+                  and not under_lock(node)):
+                yield self._f("global-no-lock", ctx, node,
+                              f"module-level `{self._root_name(target)}` "
+                              "mutated without a lock")
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                name = target.id if isinstance(target, ast.Name) else None
+                if (name and name in global_names
+                        and name in self._fn_globals(fn)
+                        and not under_lock(node)):
+                    yield self._f("global-no-lock", ctx, node,
+                                  f"write to module-level `{name}` without "
+                                  "a lock: concurrent callers race the "
+                                  "slot")
+                root = self._root_name(target) if not name else None
+                if (root and root in module_mutables
+                        and isinstance(target, ast.Subscript)
+                        and not under_lock(node)):
+                    yield self._f("global-no-lock", ctx, node,
+                                  f"module-level `{root}` mutated without "
+                                  "a lock")
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATORS):
+                return
+            recv = func.value
+            if (is_self_attr(recv) and not in_init
+                    and not in_async_function(node)
+                    and not under_lock(node)):
+                yield self._f("attr-no-lock", ctx, node,
+                              f"`{ast.unparse(recv)}.{func.attr}(...)` "
+                              "outside a lock: shared container mutation "
+                              "races under the request pool")
+            elif (isinstance(recv, ast.Name)
+                  and recv.id in module_mutables
+                  and not under_lock(node)):
+                yield self._f("global-no-lock", ctx, node,
+                              f"module-level `{recv.id}.{func.attr}(...)` "
+                              "without a lock")
+
+    @staticmethod
+    def _fn_globals(fn: ast.AST) -> set[str]:
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                out.update(node.names)
+        return out
+
+    @staticmethod
+    def _root_name(node: ast.AST) -> str | None:
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    # -- blocking calls on the event loop ------------------------------------
+    def _async_blocking(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not in_async_function(node):
+                continue
+            # calls inside nested *sync* defs execute wherever that def
+            # is eventually called (often an executor) — only flag calls
+            # lexically in the async frame itself
+            fn = enclosing_function(node)
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            name = ctx.imports.canonical(node.func)
+            if name in _BLOCKING_CALLS:
+                yield self._f(
+                    "async-blocking", ctx, node,
+                    f"{name}() blocks the event loop — every connection "
+                    "on this server stalls; use the async equivalent or "
+                    "run_in_executor")
+
+    @staticmethod
+    def _f(rule: str, ctx: ModuleContext, node: ast.AST,
+           msg: str) -> Finding:
+        return Finding(rule, Severity.WARNING, ctx.path, node.lineno,
+                       node.col_offset, msg)
+
+
+def _op(node: ast.AugAssign) -> str:
+    return {"Add": "+", "Sub": "-", "Mult": "*", "Div": "/",
+            "FloorDiv": "//", "Mod": "%", "BitOr": "|",
+            "BitAnd": "&"}.get(type(node.op).__name__, "?")

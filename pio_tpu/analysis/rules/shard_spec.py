@@ -1,0 +1,187 @@
+"""Shard-spec rules: PartitionSpec / collective axis names must come from
+the mesh vocabulary declared in pio_tpu/parallel/mesh.py.
+
+A `PartitionSpec("bath")` typo or a `psum(x, "dp")` against a mesh whose
+axes are ("data", "seq", "model") compiles fine in isolation and dies at
+run time with an unbound-axis error — or worse, silently replicates a
+tensor that was meant to be sharded (the partitioning mistakes arxiv
+1612.01437 measures as the dominant distributed-ML slowdown). The axis
+vocabulary is parsed from mesh.py's `*_AXIS = "..."` declarations, so a
+new axis added there is automatically legal everywhere.
+
+Also in this family: `donate-hint` (INFO) — a jit-wrapped function that
+rebuilds one of its array arguments with `.at[...]` and returns it wants
+`donate_argnums`, or the update keeps two copies of the buffer live in
+HBM.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pio_tpu.analysis.astutil import (
+    JIT_NAMES, PARTIAL_NAMES, ancestors,
+)
+from pio_tpu.analysis.engine import ModuleContext
+from pio_tpu.analysis.findings import Finding, Severity
+
+_PSPEC_NAMES = frozenset({
+    "jax.sharding.PartitionSpec",
+    "jax.interpreters.pxla.PartitionSpec",
+})
+# collective -> index of the positional axis-name argument
+_COLLECTIVES = {
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1, "jax.lax.all_gather": 1, "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1, "jax.lax.psum_scatter": 1,
+    "jax.lax.axis_index": 0, "jax.lax.pshuffle": 1,
+}
+_MESH_CONST_PREFIX = "pio_tpu.parallel.mesh."
+
+
+class ShardSpecRule:
+    id = "shard"
+    ids = ("shard-axis", "collective-axis", "donate-hint")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        axes = ctx.project.mesh_axes
+        module_strs = _module_string_constants(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.imports.canonical(node.func)
+            if name in _PSPEC_NAMES:
+                for bad, where in _bad_axes(ctx, node.args, axes,
+                                            module_strs):
+                    yield Finding(
+                        "shard-axis", Severity.ERROR, ctx.path,
+                        where.lineno, where.col_offset,
+                        f"PartitionSpec axis {bad!r} is not declared in "
+                        f"the mesh (known axes: {sorted(axes)}); an "
+                        "undeclared axis fails at run time or silently "
+                        "replicates the tensor")
+            elif name in _COLLECTIVES:
+                idx = _COLLECTIVES[name]
+                axis_args = []
+                if len(node.args) > idx:
+                    axis_args.append(node.args[idx])
+                axis_args += [kw.value for kw in node.keywords
+                              if kw.arg == "axis_name"]
+                for bad, where in _bad_axes(ctx, axis_args, axes,
+                                            module_strs):
+                    yield Finding(
+                        "collective-axis", Severity.ERROR, ctx.path,
+                        where.lineno, where.col_offset,
+                        f"collective {name.rsplit('.', 1)[-1]}() names "
+                        f"axis {bad!r}, not declared in the mesh (known "
+                        f"axes: {sorted(axes)})")
+        yield from self._donate_hints(ctx)
+
+    # -- donate_argnums hint ------------------------------------------------
+    def _donate_hints(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jit_deco = None
+            for deco in node.decorator_list:
+                if ctx.imports.canonical(deco) in JIT_NAMES:
+                    jit_deco = deco
+                    break
+                if (isinstance(deco, ast.Call)
+                        and (ctx.imports.canonical(deco.func) in JIT_NAMES
+                             or (ctx.imports.canonical(deco.func)
+                                 in PARTIAL_NAMES and deco.args
+                                 and ctx.imports.canonical(deco.args[0])
+                                 in JIT_NAMES))):
+                    jit_deco = deco
+                    break
+            if jit_deco is None:
+                continue
+            if isinstance(jit_deco, ast.Call) and any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in jit_deco.keywords):
+                continue
+            params = {a.arg for a in node.args.args}
+            updated = _params_rebuilt_inplace(node, params)
+            returned = _returned_names(node)
+            hot = sorted(updated & returned)
+            if hot:
+                yield Finding(
+                    "donate-hint", Severity.INFO, ctx.path,
+                    node.lineno, node.col_offset,
+                    f"jit function {node.name!r} rebuilds argument(s) "
+                    f"{hot} with .at[] and returns them; donate_argnums "
+                    "would let XLA reuse the input buffer instead of "
+                    "holding both copies in HBM")
+
+
+def _module_string_constants(tree: ast.Module) -> dict[str, str]:
+    out = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _bad_axes(ctx: ModuleContext, exprs, axes: frozenset[str],
+              module_strs: dict[str, str]):
+    """(bad_axis_name, node) for every resolvable axis reference in
+    `exprs` that is not in the declared vocabulary. Unresolvable
+    expressions (call results, parameters) are skipped — this rule only
+    reports what it can prove."""
+    for expr in exprs:
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            yield from _bad_axes(ctx, expr.elts, axes, module_strs)
+            continue
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, str) and expr.value not in axes:
+                yield expr.value, expr
+            continue
+        if isinstance(expr, ast.Name):
+            origin = ctx.imports.aliases.get(expr.id, "")
+            if origin.startswith(_MESH_CONST_PREFIX):
+                continue  # DATA_AXIS & co. imported from mesh.py
+            if expr.id in module_strs:
+                val = module_strs[expr.id]
+                if val not in axes:
+                    yield val, expr
+
+
+def _params_rebuilt_inplace(fn: ast.AST, params: set[str]) -> set[str]:
+    """Parameter names reassigned as `p = p.at[...].set/add(...)` (the
+    in-place-update idiom XLA can only fuse with donation)."""
+    out = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if target not in params:
+            continue
+        for sub in ast.walk(node.value):
+            if (isinstance(sub, ast.Attribute) and sub.attr == "at"
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == target):
+                out.add(target)
+    return out
+
+
+def _returned_names(fn: ast.AST) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            # skip returns of nested functions
+            for anc in ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if anc is not fn:
+                        break
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name):
+                            out.add(sub.id)
+                    break
+    return out

@@ -1,0 +1,123 @@
+"""Trace-purity rules: host operations inside jit/pjit/shard_map.
+
+Why this family exists (the jax_graft failure modes that only show up
+under load on real hardware):
+
+  * a host readback (`.item()`, `float()`, `np.asarray`, `device_get`)
+    inside a traced function forces a device->host sync per call — on a
+    TPU behind a network link that is a full round trip per step, the
+    exact per-step host hop SparkNet (arxiv 1511.06051) architects
+    around;
+  * `print` / host clocks inside the trace fire once at TRACE time and
+    then never again — the log line or timestamp silently lies;
+  * host RNG (np.random / random) seeded or drawn inside the trace bakes
+    one sample into the compiled program: every "random" step replays it;
+  * `global`/`nonlocal` mutation from traced code runs at trace time
+    only, so state updates vanish after compilation caches the program.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pio_tpu.analysis.astutil import traced_functions
+from pio_tpu.analysis.engine import ModuleContext
+from pio_tpu.analysis.findings import Finding, Severity
+
+# device->host readbacks / host-array escapes
+_READBACK_CALLS = frozenset({
+    "jax.device_get",
+    "numpy.asarray", "numpy.array", "numpy.copy",
+})
+# host clocks (any wall/monotonic read is trace-time-only inside jit)
+_CLOCK_CALLS = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.time_ns", "time.perf_counter_ns",
+    "time.monotonic_ns", "time.sleep",
+})
+# host RNG: seeding or drawing outside jax.random
+_RNG_CALLS = frozenset({
+    "numpy.random.seed", "numpy.random.default_rng",
+    "numpy.random.rand", "numpy.random.randn", "numpy.random.randint",
+    "numpy.random.normal", "numpy.random.uniform",
+    "random.seed", "random.random", "random.randint", "random.gauss",
+})
+_CAST_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+_READBACK_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+class TracePurityRule:
+    """Reports one finding per host operation found inside a traced
+    function (ids: trace-host-sync, trace-print, trace-clock, trace-rng,
+    trace-global)."""
+
+    id = "trace"
+    ids = ("trace-host-sync", "trace-print", "trace-clock",
+           "trace-rng", "trace-global")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        traced = traced_functions(ctx.tree, ctx.imports)
+        seen: set[tuple[int, int, str]] = set()
+        for fn, wrapper in traced.items():
+            short = wrapper.rsplit(".", 1)[-1]
+            for f in self._scan(ctx, fn, short):
+                key = (f.line, f.col, f.rule)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+    def _scan(self, ctx: ModuleContext, fn: ast.AST,
+              wrapper: str) -> Iterator[Finding]:
+        def finding(rule, node, msg, sev=Severity.ERROR):
+            return Finding(rule, sev, ctx.path, node.lineno,
+                           node.col_offset, f"{msg} (inside @{wrapper})")
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+                yield finding(
+                    "trace-global", node,
+                    f"{kw} {', '.join(node.names)}: mutation of enclosing "
+                    "state from traced code runs at trace time only — the "
+                    "compiled program never updates it")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.imports.canonical(node.func)
+            if name in _READBACK_CALLS:
+                yield finding(
+                    "trace-host-sync", node,
+                    f"{name}() forces a device->host readback on every "
+                    "step; keep data on-device (jnp) or hoist to the host "
+                    "side of the jit boundary")
+            elif name in _CLOCK_CALLS:
+                yield finding(
+                    "trace-clock", node,
+                    f"{name}() executes once at trace time; the compiled "
+                    "program reuses that value forever — time around the "
+                    "jit call, not inside it")
+            elif name in _RNG_CALLS:
+                yield finding(
+                    "trace-rng", node,
+                    f"{name}() is host RNG: one draw is baked into the "
+                    "compiled program and replayed every step — use "
+                    "jax.random with an explicit key")
+            elif name == "print":
+                yield finding(
+                    "trace-print", node,
+                    "print() fires at trace time only; use "
+                    "jax.debug.print for runtime values")
+            elif (name in _CAST_BUILTINS and node.args
+                  and not isinstance(node.args[0], ast.Constant)):
+                yield finding(
+                    "trace-host-sync", node,
+                    f"{name}() on a traced value blocks on a device->host "
+                    "transfer (ConcretizationError on abstract values); "
+                    "return the array and cast outside the jit boundary")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _READBACK_METHODS):
+                yield finding(
+                    "trace-host-sync", node,
+                    f".{node.func.attr}() inside traced code forces a "
+                    "device->host sync per step")

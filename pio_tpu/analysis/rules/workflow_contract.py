@@ -1,0 +1,109 @@
+"""Workflow-contract rule: DASE stage classes must implement the methods
+their controller/base.py contract declares.
+
+The reference gets this from the type system — a DataSource that forgets
+readTraining simply does not compile against BaseDataSource. Here the
+abstract methods only explode when the workflow first *calls* them,
+which for a DataSource is minutes into `pio train`. This rule reports
+the omission at lint time instead.
+
+Contracts are parsed from controller/base.py's @abc.abstractmethod
+declarations (engine.ProjectInfo), so adding a stage method there
+automatically propagates to the check. A subclass that is itself
+abstract (declares abstractmethods, subclasses ABC, or is named like a
+base/mixin) is exempt — it is a contract, not an implementation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pio_tpu.analysis.engine import ModuleContext
+from pio_tpu.analysis.findings import Finding, Severity
+
+
+class WorkflowContractRule:
+    id = "dase"
+    ids = ("dase-contract",)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        contracts = ctx.project.contracts
+        local_classes = {
+            n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.ClassDef)
+        }
+        for cls in local_classes.values():
+            required = self._required(ctx, cls, contracts, local_classes,
+                                      set())
+            if not required:
+                continue
+            if self._is_abstract(ctx, cls):
+                continue
+            missing = sorted(required)
+            stages = sorted({
+                base for base in self._base_names(ctx, cls)
+                if base in contracts
+            })
+            yield Finding(
+                "dase-contract", Severity.ERROR, ctx.path,
+                cls.lineno, cls.col_offset,
+                f"class {cls.name!r} subclasses {'/'.join(stages)} but "
+                f"does not implement {missing}; the workflow will crash "
+                "when the stage is invoked (reference: these are compile "
+                "errors against Base* in Scala)")
+
+    def _base_names(self, ctx: ModuleContext, cls: ast.ClassDef):
+        for base in cls.bases:
+            if isinstance(base, ast.Attribute):
+                yield base.attr
+            elif isinstance(base, ast.Name):
+                # a local import alias still resolves to the right tail
+                yield (ctx.imports.aliases.get(base.id, base.id)
+                       .rsplit(".", 1)[-1])
+
+    def _required(self, ctx, cls: ast.ClassDef, contracts,
+                  local_classes, seen: set[str]) -> set[str]:
+        if cls.name in seen:
+            return set()
+        seen = seen | {cls.name}
+        required: set[str] = set()
+        for base_name in self._base_names(ctx, cls):
+            if base_name in local_classes:
+                # intermediate class in the same module: requirements
+                # flow through whatever it leaves unimplemented
+                required |= self._required(ctx, local_classes[base_name],
+                                           contracts, local_classes, seen)
+            elif base_name in contracts:
+                required |= set(contracts[base_name])
+        defined = {
+            b.name for b in cls.body
+            if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # assignments like `predict = _predict_impl` count as definitions
+        defined |= {
+            t.id for b in cls.body if isinstance(b, ast.Assign)
+            for t in b.targets if isinstance(t, ast.Name)
+        }
+        return required - defined
+
+    def _is_abstract(self, ctx: ModuleContext, cls: ast.ClassDef) -> bool:
+        name = cls.name
+        if name.startswith("_") or "Base" in name or "Mixin" in name \
+                or "Abstract" in name:
+            return True
+        for base in cls.bases:
+            canonical = ctx.imports.canonical(base) or ""
+            if canonical in ("abc.ABC", "ABC") or "abc." in canonical:
+                return True
+        for kw in cls.keywords:
+            if kw.arg == "metaclass":
+                return True
+        for b in cls.body:
+            if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in b.decorator_list:
+                    dname = (d.attr if isinstance(d, ast.Attribute)
+                             else d.id if isinstance(d, ast.Name) else "")
+                    if dname == "abstractmethod":
+                        return True
+        return False

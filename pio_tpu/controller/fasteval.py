@@ -159,8 +159,11 @@ class FastEvalEngine(Engine):
         return results
 
     def clear_cache(self):
-        self._ds_cache.clear()
-        self._prep_cache.clear()
-        self._algo_cache.clear()
-        self.cache_hits.clear()
-        self.cache_misses.clear()
+        # under the memo lock: a worker mid-_memo must not observe a
+        # half-cleared cache (found by `pio lint`, attr-no-lock)
+        with self._lock:
+            self._ds_cache.clear()
+            self._prep_cache.clear()
+            self._algo_cache.clear()
+            self.cache_hits.clear()
+            self.cache_misses.clear()

@@ -84,7 +84,9 @@ class _Namespace:
         blob = pack_tombstones([event_id])
         with open(self.tomb_path, "ab") as f:
             f.write(blob)
+        # pio: lint-ok[attr-no-lock] only called under _EventLogEvents._lock
         self._tomb_blob += blob
+        # pio: lint-ok[attr-no-lock] only called under _EventLogEvents._lock
         self.tombstones.add(event_id)
 
     @property

@@ -288,6 +288,8 @@ class MyConnection:
             chunk = self.sock.recv(65536)
             if not chunk:
                 raise MyProtocolError("server closed connection")
+            # pio: lint-ok[attr-no-lock] conn is pool-confined: one
+            # checkout owns it at a time (MyPool hands it to one thread)
             self._buf += chunk
         out, self._buf = self._buf[:n], self._buf[n:]
         return out

@@ -179,6 +179,8 @@ class PgConnection:
             chunk = self._sock.recv(65536)
             if not chunk:
                 raise PgProtocolError("server closed connection")
+            # pio: lint-ok[attr-no-lock] conn is pool-confined: one
+            # checkout owns it at a time (PgPool hands it to one thread)
             self._buf += chunk
         out, self._buf = self._buf[:n], self._buf[n:]
         return out

@@ -48,6 +48,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pio_tpu.utils.jaxcompat import ensure_jax_compat
+
+ensure_jax_compat()  # jax<0.5: install the jax.shard_map forwarding wrapper
+
 from pio_tpu.ops.bucketing import pow2_bucket
 from pio_tpu.parallel.mesh import DATA_AXIS
 

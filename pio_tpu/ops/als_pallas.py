@@ -63,6 +63,23 @@ def _pad_lanes(x, lane: int):
         [x, jnp.zeros((*x.shape[:-1], lane - k), x.dtype)], axis=-1)
 
 
+def _memory_space(pltpu):
+    """pltpu.MemorySpace on modern jax; on jax<0.5 the members live on
+    TPUMemorySpace and HBM is spelled ANY (compiler-placed, lands in
+    HBM for buffers this size)."""
+    ms = getattr(pltpu, "MemorySpace", None)
+    if ms is not None:
+        return ms
+
+    class _Compat:
+        SMEM = pltpu.TPUMemorySpace.SMEM
+        VMEM = pltpu.TPUMemorySpace.VMEM
+        ANY = pltpu.TPUMemorySpace.ANY
+        HBM = pltpu.TPUMemorySpace.ANY
+
+    return _Compat
+
+
 def _segment_kernel(*refs, chunk: int, slot_fn):
     """Shared segment-flush kernel body. refs =
     (rows_ref (1,1,chunk) SMEM, *data_refs, a_init, b_init,   <- inputs
@@ -170,8 +187,8 @@ def _run_segment_group(rows_g, data, data_specs, a_buf, b_buf, *,
     from jax.experimental.pallas import tpu as pltpu
 
     n_steps = rows_g.shape[0] // chunk
-    smem = pltpu.MemorySpace.SMEM
-    hbm = pltpu.MemorySpace.HBM
+    smem = _memory_space(pltpu).SMEM
+    hbm = _memory_space(pltpu).HBM
     n_in = 1 + len(data) + 2
     return pl.pallas_call(
         functools.partial(_segment_kernel, chunk=chunk, slot_fn=slot_fn),
@@ -526,7 +543,7 @@ def gather_rows_pallas(table, idx, rows_per_step: int = 1024,
             # (1,1,R) SMEM: 1-d s32 operands tile T(1024) vs Mosaic's
             # T(128) (round-3 portability rule)
             pl.BlockSpec((1, 1, rows_per_step), lambda i: (i, 0, 0),
-                         memory_space=pltpu.MemorySpace.SMEM),
+                         memory_space=_memory_space(pltpu).SMEM),
             # whole table, constant index map -> fetched once, resident
             pl.BlockSpec((n, lane), lambda i: (0, 0)),
         ),

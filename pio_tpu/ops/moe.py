@@ -34,6 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pio_tpu.utils.jaxcompat import ensure_jax_compat
+
+ensure_jax_compat()  # jax<0.5: install the jax.shard_map forwarding wrapper
+
 
 @dataclass(frozen=True)
 class MoEConfig:

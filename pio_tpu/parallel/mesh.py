@@ -21,6 +21,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pio_tpu.utils.jaxcompat import ensure_jax_compat
+
+ensure_jax_compat()  # jax<0.5: install the jax.shard_map forwarding wrapper
+
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"

@@ -114,6 +114,8 @@ class HttpApp:
         compiled = re.compile("^" + pattern + "$")
 
         def deco(fn: Handler) -> Handler:
+            # pio: lint-ok[attr-no-lock] route table is built while the
+            # app is constructed, before any server thread serves from it
             self.routes.append((method.upper(), compiled, fn))
             return fn
 

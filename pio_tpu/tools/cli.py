@@ -684,6 +684,32 @@ def cmd_upgrade(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Static trace-safety & concurrency analysis (pio_tpu/analysis/):
+    the compile-time net the reference gets from Scala's type system.
+    Exits 0 when no error/warning findings survive suppressions (INFO
+    findings are advisory). See docs/lint.md for the rule catalogue."""
+    from pio_tpu.analysis import run_lint
+
+    select = {s for s in (args.select or "").split(",") if s}
+    ignore = {s for s in (args.ignore or "").split(",") if s}
+    report = run_lint(args.paths, select=select or None,
+                      ignore=ignore or None)
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in report.findings],
+            "suppressed": len(report.suppressed),
+            "files": report.n_files,
+        }, indent=2))
+        return report.exit_code
+    shown = [f for f in report.findings
+             if args.show_info or f.severity.label() != "info"]
+    for f in shown:
+        print(f.format())
+    print(report.summary())
+    return report.exit_code
+
+
 def cmd_template(args) -> int:
     """Scaffold a new engine directory from the template gallery
     (reference console/Template.scala). The built-in gallery is the local
@@ -994,6 +1020,21 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--no-metadata", action="store_true")
     x.set_defaults(fn=cmd_upgrade)
 
+    x = sub.add_parser(
+        "lint",
+        help="static trace-safety/concurrency analysis (docs/lint.md)")
+    x.add_argument("paths", nargs="*", default=["."],
+                   help="files or directories to lint (default: .)")
+    x.add_argument("--format", choices=["text", "json"], default="text")
+    x.add_argument("--select", default="",
+                   help="comma-separated rule-id prefixes to run "
+                        "(e.g. trace,bench)")
+    x.add_argument("--ignore", default="",
+                   help="comma-separated rule-id prefixes to skip")
+    x.add_argument("--show-info", action="store_true",
+                   help="print INFO-level (advisory) findings too")
+    x.set_defaults(fn=cmd_lint)
+
     x = sub.add_parser("template")
     xs = x.add_subparsers(dest="subcommand", required=True)
     t = xs.add_parser("new")
@@ -1027,8 +1068,10 @@ def main(argv: list[str] | None = None) -> int:
         if platform:
             jax.config.update("jax_platforms", platform)
         if n_cpu:
+            from pio_tpu.utils.jaxcompat import set_cpu_device_count
+
             try:
-                jax.config.update("jax_num_cpu_devices", int(n_cpu))
+                set_cpu_device_count(int(n_cpu))
             except ValueError:
                 return _fail(f"PIO_TPU_CPU_DEVICES={n_cpu!r} is not an int")
     # engine dirs put engine.py on the path (factory "engine.MyEngine")

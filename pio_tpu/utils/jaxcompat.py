@@ -1,0 +1,57 @@
+"""Compatibility shims for older jax releases (the CI image pins 0.4.x).
+
+The kernels target the modern public API (`jax.shard_map` with
+`check_vma`); on a jax that predates it, `ensure_jax_compat()` installs
+a forwarding wrapper over `jax.experimental.shard_map` (whose
+`check_rep` kwarg is the old spelling of `check_vma`). Call it after
+`import jax` in any module that uses `jax.shard_map` — it is idempotent
+and never imports anything heavier than jax itself (so bench.py's
+no-jax-in-the-parent rule is unaffected: the caller already imported
+jax).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Force `n` virtual CPU devices, portably across jax versions.
+
+    Modern jax has the `jax_num_cpu_devices` config option; 0.4.x only
+    honors the XLA flag, which must land before the (lazy) backend
+    initializes — call this right after forcing `jax_platforms=cpu`,
+    before any device use."""
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(n)}"
+        )
+
+
+def ensure_jax_compat() -> None:
+    import jax
+
+    if not hasattr(jax.lax, "axis_size"):
+        import jax.core as _core
+
+        def _axis_size(axis_name):
+            # 0.4.x: axis_frame(name) resolves to the (static) axis size
+            return int(_core.axis_frame(axis_name))
+
+        jax.lax.axis_size = _axis_size
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        kw.setdefault("check_rep", check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map

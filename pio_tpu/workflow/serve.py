@@ -511,32 +511,36 @@ def _depth_for_rtt(rtt_s: float) -> int:
 
 
 _auto_depth_cache: int | None = None
+_auto_depth_lock = threading.Lock()
 
 
 def _auto_pipeline_depth() -> int:
     """Resolve ServingConfig.batch_pipeline=0: measure the device dispatch
     round-trip once per process (cached — re-deploys and multi-engine
-    processes skip the probe) and map it via _depth_for_rtt."""
+    processes skip the probe) and map it via _depth_for_rtt. Probe and
+    cache write run under a lock: two engines deploying concurrently must
+    not both pay the probe (found by `pio lint`, global-no-lock)."""
     global _auto_depth_cache
-    if _auto_depth_cache is not None:
-        return _auto_depth_cache
-    try:
-        import jax
-        import jax.numpy as jnp
+    with _auto_depth_lock:
+        if _auto_depth_cache is not None:
+            return _auto_depth_cache
+        try:
+            import jax
+            import jax.numpy as jnp
 
-        one = jnp.ones(())
-        add = jax.jit(lambda x: x + 1)
-        jax.block_until_ready(add(one))  # compile outside the measurement
-        samples = []
-        for _ in range(5):
-            t0 = time.monotonic()
-            jax.block_until_ready(add(one))
-            samples.append(time.monotonic() - t0)
-        depth = _depth_for_rtt(sorted(samples)[len(samples) // 2])
-    except Exception:  # noqa: BLE001 - sizing heuristic must never fail boot
-        depth = 2
-    _auto_depth_cache = depth
-    return depth
+            one = jnp.ones(())
+            add = jax.jit(lambda x: x + 1)
+            jax.block_until_ready(add(one))  # compile, not measurement
+            samples = []
+            for _ in range(5):
+                t0 = time.monotonic()
+                jax.block_until_ready(add(one))
+                samples.append(time.monotonic() - t0)
+            depth = _depth_for_rtt(sorted(samples)[len(samples) // 2])
+        except Exception:  # noqa: BLE001 - sizing must never fail boot
+            depth = 2
+        _auto_depth_cache = depth
+        return depth
 
 
 class QueryBatcher:

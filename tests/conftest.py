@@ -14,8 +14,14 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
+from pio_tpu.utils.jaxcompat import set_cpu_device_count  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+set_cpu_device_count(8)  # version-portable (jax<0.5 lacks the config)
+
+from pio_tpu.utils.jaxcompat import ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()  # jax<0.5: tests call jax.shard_map directly
 
 import pytest  # noqa: E402
 

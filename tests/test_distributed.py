@@ -69,10 +69,11 @@ os.environ["PIO_TPU_COORDINATOR"] = "127.0.0.1:" + port
 os.environ["PIO_TPU_NUM_PROCESSES"] = "2"
 os.environ["PIO_TPU_PROCESS_ID"] = str(pid)
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
 sys.path.insert(0, "{repo}")
 sys.path.insert(0, "{repo}/tests")
+from pio_tpu.utils.jaxcompat import set_cpu_device_count
+jax.config.update("jax_platforms", "cpu")
+set_cpu_device_count(2)
 from pio_tpu.parallel.distributed import initialize_distributed, runtime_info
 assert initialize_distributed() is True
 info = runtime_info()
@@ -215,8 +216,9 @@ os.environ["PIO_TPU_COORDINATOR"] = "127.0.0.1:{port}"
 os.environ["PIO_TPU_NUM_PROCESSES"] = "1"
 os.environ["PIO_TPU_PROCESS_ID"] = "0"
 import jax
+from pio_tpu.utils.jaxcompat import set_cpu_device_count
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+set_cpu_device_count(4)
 from pio_tpu.parallel.distributed import initialize_distributed, runtime_info
 assert initialize_distributed() is True
 info = runtime_info()

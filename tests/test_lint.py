@@ -1,0 +1,551 @@
+"""`pio lint` (pio_tpu/analysis/): per-family positive/negative fixtures,
+suppression-comment handling, CLI wiring, and a repo-wide smoke test.
+
+Every rule family gets at least one known-bad snippet that must fire and
+one known-good snippet that must stay silent — the analyzer's own
+contract (ISSUE 1 acceptance criteria).
+"""
+
+import textwrap
+
+from pio_tpu.analysis import ProjectInfo, Severity, lint_text, run_lint
+
+
+def lint(src: str, select=None, project=None):
+    return lint_text(textwrap.dedent(src), select=select, project=project)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- trace purity -----------------------------------------------------------
+
+def test_trace_item_and_print_fire():
+    fs = lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("step", x)
+            return x.item()
+    """)
+    assert "trace-print" in rules_of(fs)
+    assert "trace-host-sync" in rules_of(fs)
+
+
+def test_trace_clock_rng_global_fire():
+    fs = lint("""
+        import time
+        import jax
+        import numpy as np
+
+        COUNT = 0
+
+        @jax.jit
+        def step(x):
+            global COUNT
+            COUNT = COUNT + 1
+            t = time.time()
+            np.random.seed(0)
+            return x * t
+    """)
+    assert {"trace-clock", "trace-rng", "trace-global"} <= rules_of(fs)
+
+
+def test_trace_partial_jit_and_wrapped_fn_detected():
+    fs = lint("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def decorated(x, n):
+            return float(x)
+
+        def wrapped(x):
+            return x.item()
+
+        wrapped_jit = jax.jit(wrapped)
+    """)
+    assert len([f for f in fs if f.rule == "trace-host-sync"]) == 2
+
+
+def test_trace_shard_map_detected():
+    fs = lint("""
+        from functools import partial
+        import jax
+
+        @partial(jax.shard_map, mesh=None, in_specs=(), out_specs=())
+        def run(x):
+            return x.item()
+    """)
+    assert "trace-host-sync" in rules_of(fs)
+
+
+def test_trace_clean_function_silent():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            y = jnp.sum(x * 2)
+            return jnp.sqrt(y)
+
+        def host_side(x):
+            # host code may read back freely: not traced
+            return float(jnp.sum(x)), x.item()
+    """)
+    assert fs == []
+
+
+# -- shard spec -------------------------------------------------------------
+
+def test_shard_axis_typo_fires():
+    fs = lint("""
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("bath", None)
+    """)
+    assert rules_of(fs) == {"shard-axis"}
+    assert "'bath'" in fs[0].message
+
+
+def test_shard_known_axes_and_unresolvable_silent():
+    fs = lint("""
+        from jax.sharding import PartitionSpec as P
+
+        def make(axis_name):
+            return P("data", ("seq", "model"), None, axis_name)
+    """)
+    assert fs == []
+
+
+def test_collective_axis_fires_and_mesh_constants_pass():
+    fs = lint("""
+        import jax
+        from pio_tpu.parallel.mesh import DATA_AXIS
+
+        def f(x):
+            good = jax.lax.psum(x, DATA_AXIS)
+            also = jax.lax.all_gather(x, "data", tiled=True)
+            bad = jax.lax.psum(x, "dp")
+            return good + also + bad
+    """)
+    assert [f.rule for f in fs] == ["collective-axis"]
+    assert "'dp'" in fs[0].message
+
+
+def test_custom_mesh_vocabulary_respected():
+    project = ProjectInfo(mesh_axes=frozenset({"x", "y"}))
+    fs = lint("""
+        from jax.sharding import PartitionSpec as P
+
+        a = P("x")
+        b = P("data")
+    """, project=project)
+    assert [f.rule for f in fs] == ["shard-axis"]
+    assert "'data'" in fs[0].message
+
+
+def test_donate_hint_info():
+    fs = lint("""
+        import jax
+
+        @jax.jit
+        def update(table, idx, val):
+            table = table.at[idx].set(val)
+            return table
+    """)
+    hints = [f for f in fs if f.rule == "donate-hint"]
+    assert len(hints) == 1
+    assert hints[0].severity == Severity.INFO
+
+
+def test_donate_hint_silent_when_donated():
+    fs = lint("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(table, idx, val):
+            table = table.at[idx].set(val)
+            return table
+    """)
+    assert [f for f in fs if f.rule == "donate-hint"] == []
+
+
+# -- concurrency ------------------------------------------------------------
+
+def test_unlocked_counter_fires():
+    fs = lint("""
+        import threading
+
+        class Handler:
+            def __init__(self):
+                self.count = 0
+                self.rows = []
+
+            def handle(self, req):
+                self.count += 1
+                self.rows.append(req)
+    """)
+    assert [f.rule for f in fs] == ["attr-no-lock", "attr-no-lock"]
+
+
+def test_locked_counter_silent():
+    fs = lint("""
+        import threading
+
+        class Handler:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def handle(self, req):
+                with self._lock:
+                    self.count += 1
+    """)
+    assert fs == []
+
+
+def test_init_and_async_and_no_threading_exempt():
+    fs = lint("""
+        import asyncio
+
+        class Conn:
+            def __init__(self):
+                self.tasks = set()
+                self.n = 0
+                self.n += 1          # __init__ is single-threaded
+
+            async def handle(self, task):
+                self.tasks.add(task)  # event-loop-confined
+    """)
+    assert fs == []
+    fs2 = lint("""
+        class Script:
+            def bump(self):
+                self.n += 1  # no threading import: not a shared object
+    """)
+    assert fs2 == []
+
+
+def test_module_global_write_fires():
+    fs = lint("""
+        import threading
+
+        _cache = None
+
+        def get():
+            global _cache
+            _cache = compute()
+            return _cache
+    """)
+    assert [f.rule for f in fs] == ["global-no-lock"]
+
+
+def test_module_mutable_append_fires_and_locked_silent():
+    fs = lint("""
+        import threading
+
+        REGISTRY = []
+        _lock = threading.Lock()
+
+        def register(x):
+            REGISTRY.append(x)
+
+        def register_safe(x):
+            with _lock:
+                REGISTRY.append(x)
+    """)
+    assert [f.rule for f in fs] == ["global-no-lock"]
+    assert fs[0].line == 8  # the unlocked append, not the locked one
+
+
+def test_blocking_call_in_async_fires():
+    fs = lint("""
+        import time
+        import urllib.request
+
+        async def handler(req):
+            time.sleep(0.1)
+            urllib.request.urlopen("http://x")
+    """)
+    assert [f.rule for f in fs] == ["async-blocking", "async-blocking"]
+
+
+def test_async_with_executor_silent():
+    fs = lint("""
+        import asyncio
+
+        async def handler(pool, req):
+            return await asyncio.get_running_loop().run_in_executor(
+                pool, work, req)
+    """)
+    assert fs == []
+
+
+# -- bench hygiene ----------------------------------------------------------
+
+def test_time_time_fires():
+    fs = lint("""
+        import time
+
+        def measure():
+            t0 = time.time()
+            work()
+            return time.time() - t0
+    """)
+    assert rules_of(fs) == {"bench-clock"}
+
+
+def test_unsynced_jax_timing_fires():
+    fs = lint("""
+        import time
+        import jax.numpy as jnp
+
+        def measure(x):
+            t0 = time.perf_counter()
+            y = jnp.dot(x, x)
+            return time.perf_counter() - t0
+    """)
+    assert "bench-no-sync" in rules_of(fs)
+
+
+def test_synced_jax_timing_silent():
+    fs = lint("""
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def measure(x):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jnp.dot(x, x))
+            return time.perf_counter() - t0
+
+        def measure_via_readback(x):
+            t0 = time.perf_counter()
+            v = float(jnp.sum(jnp.dot(x, x)))
+            return time.perf_counter() - t0
+    """)
+    assert fs == []
+
+
+def test_sync_through_local_helper_recognized():
+    fs = lint("""
+        import time
+        import jax.numpy as jnp
+
+        def measure(x):
+            def go():
+                return float(jnp.sum(jnp.dot(x, x)))
+
+            go()  # compile
+            t0 = time.perf_counter()
+            go()
+            return time.perf_counter() - t0
+    """)
+    assert fs == []
+
+
+def test_non_jax_timing_silent():
+    fs = lint("""
+        import time
+
+        def measure():
+            t0 = time.perf_counter()
+            rows = fetch_http()
+            return time.perf_counter() - t0
+    """)
+    assert fs == []
+
+
+# -- workflow contracts -----------------------------------------------------
+
+def test_missing_dase_methods_fire():
+    fs = lint("""
+        from pio_tpu.controller.base import PAlgorithm, Serving
+
+        class MyAlgo(PAlgorithm):
+            def train(self, ctx, pd):
+                return pd
+            # predict missing
+
+        class MyServing(Serving):
+            pass
+    """)
+    assert [f.rule for f in fs] == ["dase-contract", "dase-contract"]
+    assert "'predict'" in fs[0].message
+    assert "'serve'" in fs[1].message
+
+
+def test_complete_dase_class_silent():
+    fs = lint("""
+        from pio_tpu.controller.base import DataSource, LAlgorithm
+
+        class MySource(DataSource):
+            def read_training(self, ctx):
+                return []
+
+        class MyAlgo(LAlgorithm):
+            def train(self, ctx, pd):
+                return pd
+
+            def predict(self, model, query):
+                return {}
+    """)
+    assert fs == []
+
+
+def test_abstract_intermediate_exempt_but_leaf_checked():
+    fs = lint("""
+        import abc
+        from pio_tpu.controller.base import Algorithm
+
+        class SharedBase(Algorithm):
+            def train(self, ctx, pd):
+                return pd
+
+        class Leaf(SharedBase):
+            pass
+    """)
+    # SharedBase contains "Base" -> exempt; Leaf still owes predict
+    assert [f.rule for f in fs] == ["dase-contract"]
+    assert fs[0].message.startswith("class 'Leaf'")
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_suppression_same_line_and_block_above():
+    fs = lint("""
+        import threading
+
+        class H:
+            def inc(self):
+                self.n += 1  # pio: lint-ok[attr-no-lock] metrics-only
+
+            def dec(self):
+                # pio: lint-ok[attr-no-lock] single writer thread,
+                # documented in the ops runbook
+                self.n -= 1
+
+            def raw(self):
+                self.n += 1
+    """)
+    assert len(fs) == 1
+    assert fs[0].line == 14
+
+
+def test_suppression_wrong_rule_does_not_apply():
+    fs = lint("""
+        import threading
+
+        class H:
+            def inc(self):
+                self.n += 1  # pio: lint-ok[bench-clock] wrong id
+    """)
+    assert [f.rule for f in fs] == ["attr-no-lock"]
+
+
+def test_star_suppression():
+    fs = lint("""
+        import time
+
+        def f():
+            t = time.time()  # pio: lint-ok[*]
+            return t
+    """)
+    assert fs == []
+
+
+# -- engine / CLI / repo smoke ---------------------------------------------
+
+def test_select_filters_families():
+    src = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+
+        def measure():
+            t0 = time.time()
+            return time.time() - t0
+    """
+    assert rules_of(lint(src, select={"trace"})) == {"trace-host-sync"}
+    assert rules_of(lint(src, select={"bench"})) == {"bench-clock"}
+
+
+def test_select_and_ignore_by_concrete_finding_id(tmp_path):
+    src = (
+        "import jax\n"
+        "from functools import partial\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    print(x)\n"
+        "    return x.item()\n\n"
+        "@jax.jit\n"
+        "def g(table, i, v):\n"
+        "    table = table.at[i].set(v)\n"
+        "    return table\n"
+    )
+    (tmp_path / "m.py").write_text(src)
+    # selecting a concrete id narrows to exactly that finding
+    r = run_lint([str(tmp_path)], select={"trace-host-sync"})
+    assert [f.rule for f in r.findings] == ["trace-host-sync"]
+    # ignoring one id must not silence its family-mates
+    r = run_lint([str(tmp_path)], ignore={"donate-hint"})
+    rules = [f.rule for f in r.findings]
+    assert "donate-hint" not in rules
+    assert "trace-print" in rules and "trace-host-sync" in rules
+    # family ignore still drops the whole family
+    r = run_lint([str(tmp_path)], ignore={"trace"})
+    assert [f.rule for f in r.findings] == ["donate-hint"]
+
+
+def test_run_lint_on_files(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    report = run_lint([str(tmp_path)])
+    assert report.n_files == 2
+    assert report.exit_code == 1
+    assert [f.rule for f in report.findings] == ["trace-host-sync"]
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def f(:\n")
+    report = run_lint([str(tmp_path)])
+    assert [x.rule for x in report.findings] == ["parse-error"]
+    assert report.exit_code == 1
+
+
+def test_cli_lint_verb(tmp_path, capsys):
+    from pio_tpu.tools.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nt0 = time.time()\n")
+    assert main(["lint", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "bench-clock" in out
+    (tmp_path / "bad.py").write_text("x = 1\n")
+    assert main(["lint", str(tmp_path)]) == 0
+
+
+def test_repo_lints_clean():
+    """The analyzer's own acceptance bar: zero unsuppressed findings on
+    the tree it ships in (ISSUE 1)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(root, "pio_tpu"),
+             os.path.join(root, "tests"),
+             os.path.join(root, "bench.py")]
+    report = run_lint(paths)
+    assert report.failing == [], "\n".join(
+        f.format() for f in report.failing)

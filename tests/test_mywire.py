@@ -354,6 +354,7 @@ def test_dialect_upsert_and_quoting():
         def execute(self, sql, params=()):
             from pio_tpu.data.backends.mywire import MyResult, interpolate
 
+            # pio: lint-ok[attr-no-lock] test fake, single-threaded use
             self.seen.append(interpolate(sql, params) if params else sql)
             return MyResult([], [], 1, 5)
 

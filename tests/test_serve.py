@@ -145,10 +145,10 @@ def test_bad_queries(deployed):
 def test_feedback_records_predict_event(deployed):
     http, qs, storage, *_ = deployed
     call(http.port, "POST", "/queries.json", body={"user": "u2", "num": 2})
-    deadline = time.time() + 5
+    deadline = time.monotonic() + 5
     found = []
     app_id = storage.get_metadata_apps().get_by_name("mlapp").id
-    while time.time() < deadline and not found:
+    while time.monotonic() < deadline and not found:
         found = list(storage.get_events().find(
             app_id, entity_type="pio_pr", limit=-1))
         time.sleep(0.05)
