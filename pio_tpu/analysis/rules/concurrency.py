@@ -15,10 +15,19 @@ pools) and an asyncio event loop side by side, so the hazards are:
   * `async-blocking` — time.sleep / sync HTTP / subprocess inside an
     `async def` stalls the whole event loop (every connection, not just
     the offender's).
+  * `bare-retry`     — a hand-rolled `while`/`for` retry loop around I/O
+    (an except-transport-error handler plus a sleep in the same loop)
+    that bypasses `pio_tpu.resilience.RetryPolicy`: ad-hoc loops skip
+    jitter, deadline caps, and breaker fail-fast, and every one is a
+    place the chaos tests cannot reach. Loops driven by a RetryPolicy
+    schedule (referencing `RetryPolicy`, a `*.delays(...)` /
+    `*.attempts(...)` call, or a name like `delays`) are exempt — the
+    async transports must drive their own `await asyncio.sleep`.
 
 Scope gate: modules that import threading/asyncio/concurrent.futures/
 multiprocessing — shared-state writes in single-threaded scripts are not
-hazards.
+hazards. (`async-blocking` and `bare-retry` apply regardless: blocking
+an event loop and hand-rolling retries are hazards in any module.)
 """
 
 from __future__ import annotations
@@ -54,12 +63,31 @@ _BLOCKING_CALLS = frozenset({
 })
 
 
+# exception names whose handlers mark a loop as "retrying transport
+# failures" (bare-retry): stdlib transport errors plus this repo's
+# wrapper types
+_TRANSPORT_EXC_NAMES = frozenset({
+    "OSError", "IOError", "ConnectionError", "ConnectionResetError",
+    "ConnectionRefusedError", "TimeoutError", "BrokenPipeError",
+    "HttpClientError", "StorageError", "URLError", "HTTPError",
+    "socket.error", "socket.timeout", "urllib.error.URLError",
+    "urllib.error.HTTPError", "Exception",
+})
+_SLEEP_CALLS = frozenset({"time.sleep", "asyncio.sleep"})
+# a loop is "policy-driven" when it references one of these NAMES (exact
+# identifiers, not substrings: `max_attempts` must not exempt) or calls
+# a `.delays()` / `.attempts()` schedule method
+_POLICY_NAMES = frozenset({"RetryPolicy", "retry_policy", "delays"})
+_POLICY_METHODS = frozenset({"delays", "attempts"})
+
+
 class ConcurrencyRule:
     id = "concurrency"
-    ids = ("attr-no-lock", "global-no-lock", "async-blocking")
+    ids = ("attr-no-lock", "global-no-lock", "async-blocking", "bare-retry")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         yield from self._async_blocking(ctx)
+        yield from self._bare_retry(ctx)
         if not ctx.imports_any("threading", "asyncio", "multiprocessing",
                                "concurrent"):
             return
@@ -169,6 +197,73 @@ class ConcurrencyRule:
         while isinstance(node, (ast.Subscript, ast.Attribute)):
             node = node.value
         return node.id if isinstance(node, ast.Name) else None
+
+    # -- hand-rolled retry loops ---------------------------------------------
+    def _bare_retry(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """Flag `while`/`for` loops that (a) catch a transport-class
+        exception and (b) sleep — the hand-rolled retry-with-backoff
+        shape — unless the loop is driven by a resilience.RetryPolicy
+        schedule. Only the INNERMOST qualifying loop is reported: an
+        outer loop wrapping a qualifying inner one is usually iteration,
+        not retry."""
+        qualifying: list[ast.AST] = [
+            node for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor))
+            and self._is_bare_retry(ctx, node)
+        ]
+        inner = [
+            node for node in qualifying
+            if not any(other is not node and self._contains(node, other)
+                       for other in qualifying)
+        ]
+        for node in inner:
+            yield self._f(
+                "bare-retry", ctx, node,
+                "hand-rolled retry loop around I/O (except-transport + "
+                "sleep): use resilience.RetryPolicy.call (or drive "
+                "policy.delays() for async sleeps) so backoff gets "
+                "jitter, deadline caps, and breaker fail-fast")
+
+    @staticmethod
+    def _contains(outer: ast.AST, inner: ast.AST) -> bool:
+        return any(n is inner for n in ast.walk(outer))
+
+    def _is_bare_retry(self, ctx: ModuleContext, loop: ast.AST) -> bool:
+        catches_transport = False
+        sleeps = False
+        for node in ast.walk(loop):
+            if isinstance(node, ast.ExceptHandler):
+                types = []
+                t = node.type
+                if isinstance(t, ast.Tuple):
+                    types = list(t.elts)
+                elif t is not None:
+                    types = [t]
+                for e in types:
+                    name = ctx.imports.canonical(e) or ast.unparse(e)
+                    if (name in _TRANSPORT_EXC_NAMES
+                            or name.rpartition(".")[2]
+                            in _TRANSPORT_EXC_NAMES):
+                        catches_transport = True
+            elif isinstance(node, ast.Call):
+                if ctx.imports.canonical(node.func) in _SLEEP_CALLS:
+                    sleeps = True
+        if not (catches_transport and sleeps):
+            return False
+        # RetryPolicy-driven loops are the sanctioned shape: an exact
+        # identifier reference (RetryPolicy / retry_policy / a `delays`
+        # schedule variable) or a .delays()/.attempts() call
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Name) and node.id in _POLICY_NAMES:
+                return False
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in _POLICY_NAMES):
+                return False
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _POLICY_METHODS):
+                return False
+        return True
 
     # -- blocking calls on the event loop ------------------------------------
     def _async_blocking(self, ctx: ModuleContext) -> Iterator[Finding]:

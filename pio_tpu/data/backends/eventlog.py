@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+from collections import deque
 from datetime import datetime
 from typing import Iterator, Sequence
 
@@ -102,6 +103,10 @@ class _EventLogEvents(d.EventsDAO):
         self.root = root
         self._ns_cache: dict[tuple[int, int | None], _Namespace] = {}
         self._lock = threading.RLock()
+        # per-namespace recent supplied-id window (see insert): FIFO of
+        # ids + membership set, both bounded by RECENT_ID_WINDOW
+        self._recent_ids: dict[
+            tuple[int, int | None], tuple[deque, set]] = {}
 
     def _dir(self, app_id: int, channel_id: int | None) -> str:
         name = f"app_{app_id}" if channel_id is None else f"app_{app_id}_ch_{channel_id}"
@@ -131,6 +136,8 @@ class _EventLogEvents(d.EventsDAO):
     def remove(self, app_id, channel_id=None):
         with self._lock:
             ns = self._ns_cache.pop((app_id, channel_id), None)
+            # removed data's ids may legitimately reappear (re-import)
+            self._recent_ids.pop((app_id, channel_id), None)
             if ns is not None:
                 ns.close()
             path = self._dir(app_id, channel_id)
@@ -146,10 +153,34 @@ class _EventLogEvents(d.EventsDAO):
             self._ns_cache.clear()
 
     # -- CRUD ----------------------------------------------------------------
+    # supplied-id dedupe window size (per namespace). Phantom retries —
+    # resilience.RetryPolicy re-inserting after a failure whose original
+    # actually committed, or a spill-drain racing its original — land
+    # within the retry budget (~seconds), so a bounded recent-id window
+    # catches them all at O(1) per insert and bounded memory. A full
+    # get() scan per insert would be O(log size) under the append lock
+    # (ingest collapse as the log grows); an unbounded id set would be
+    # O(total events) RAM.
+    RECENT_ID_WINDOW = 4096
+
     def insert(self, event: Event, app_id, channel_id=None):
+        # id-idempotent on a CALLER-supplied id within the recent window:
+        # the log is append-only, so a retried insert would otherwise
+        # append a second record that find()/columnarize() count twice.
+        # Check and append under ONE lock hold — a get-then-append would
+        # let two concurrent retries of the same id both pass the check.
         with self._lock:
             ns = self._ns(app_id, channel_id)
             eid = event.event_id or new_event_id()
+            if event.event_id is not None:
+                order, seen = self._recent_ids.setdefault(
+                    (app_id, channel_id), (deque(), set()))
+                if eid in seen:
+                    return eid
+                order.append(eid)
+                seen.add(eid)
+                if len(order) > self.RECENT_ID_WINDOW:
+                    seen.discard(order.popleft())
             ns.log.append(event.with_id(eid))
             return eid
 

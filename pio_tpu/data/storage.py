@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from pio_tpu.data import dao as daomod
+from pio_tpu.resilience import CircuitBreaker, ResilientDAO
 
 
 class StorageError(RuntimeError):
@@ -194,11 +195,24 @@ class Storage:
     ``get_storage``); construct directly with an env dict for tests.
     """
 
-    def __init__(self, env: dict[str, str] | None = None, test: bool = False):
+    def __init__(self, env: dict[str, str] | None = None, test: bool = False,
+                 resilience: bool | None = None):
         self.sources, self.repositories = parse_env(env)
         self.test = test
         self._clients: dict[str, Backend] = {}
         self._lock = threading.Lock()
+        # resilience wrapping (retry + circuit breaker + deadline + chaos
+        # point per DAO call). Default ON; PIO_TPU_RESILIENCE=off (or the
+        # explicit arg) disables for raw-backend benchmarking.
+        if resilience is None:
+            resilience = os.environ.get(
+                "PIO_TPU_RESILIENCE", "on").lower() not in (
+                    "off", "0", "false", "no")
+        self.resilience_enabled = resilience
+        # one breaker per storage SOURCE (not per DAO): every repository
+        # bound to a source shares its failure history, mirroring how a
+        # dead backend takes out all of its DAOs at once
+        self.breakers: dict[str, CircuitBreaker] = {}
 
     def _client(self, source_name: str) -> Backend:
         with self._lock:
@@ -215,41 +229,65 @@ class Storage:
                 )
             return self._clients[source_name]
 
-    def _repo_client(self, repo: str) -> Backend:
+    def _repo_source(self, repo: str) -> str:
         src = self.repositories.get(repo)
         if src is None:
             raise StorageError(
                 f"Repository {repo} is not configured "
                 f"(set PIO_STORAGE_REPOSITORIES_{repo}_SOURCE)"
             )
-        return self._client(src)
+        return src
+
+    def _repo_client(self, repo: str) -> Backend:
+        return self._client(self._repo_source(repo))
+
+    def breaker_for(self, source_name: str) -> CircuitBreaker:
+        """The circuit breaker fronting one storage source (created on
+        first use; `pio doctor` and /readyz read `self.breakers`)."""
+        with self._lock:
+            br = self.breakers.get(source_name)
+            if br is None:
+                br = CircuitBreaker(f"storage.{source_name}")
+                self.breakers[source_name] = br
+            return br
+
+    def _dao(self, repo: str, getter: Callable[[Backend], Any]):
+        """Resolve a DAO and, unless resilience is disabled, front it
+        with retry + the source's breaker + deadline/chaos hooks."""
+        src = self._repo_source(repo)
+        dao = getter(self._client(src))
+        if not self.resilience_enabled:
+            return dao
+        return ResilientDAO(
+            dao, breaker=self.breaker_for(src), point=f"storage.{src}"
+        )
 
     # -- reference Storage.scala:360-391 ------------------------------------
     def get_metadata_apps(self) -> daomod.AppsDAO:
-        return self._repo_client("METADATA").apps()
+        return self._dao("METADATA", lambda b: b.apps())
 
     def get_metadata_access_keys(self) -> daomod.AccessKeysDAO:
-        return self._repo_client("METADATA").access_keys()
+        return self._dao("METADATA", lambda b: b.access_keys())
 
     def get_metadata_channels(self) -> daomod.ChannelsDAO:
-        return self._repo_client("METADATA").channels()
+        return self._dao("METADATA", lambda b: b.channels())
 
     def get_metadata_engine_instances(self) -> daomod.EngineInstancesDAO:
-        return self._repo_client("METADATA").engine_instances()
+        return self._dao("METADATA", lambda b: b.engine_instances())
 
     def get_metadata_engine_manifests(self) -> daomod.EngineManifestsDAO:
-        return self._repo_client("METADATA").engine_manifests()
+        return self._dao("METADATA", lambda b: b.engine_manifests())
 
     def get_metadata_evaluation_instances(self) -> daomod.EvaluationInstancesDAO:
-        return self._repo_client("METADATA").evaluation_instances()
+        return self._dao("METADATA", lambda b: b.evaluation_instances())
 
     def get_model_data_models(self) -> daomod.ModelsDAO:
-        return self._repo_client("MODELDATA").models()
+        return self._dao("MODELDATA", lambda b: b.models())
 
     def get_events(self) -> daomod.EventsDAO:
         """The L/PEvents DAO (one API — columnarization for training lives in
         pio_tpu.data.eventstore)."""
-        return self._repo_client("EVENTDATA").events()
+        return self._dao("EVENTDATA", lambda b: b.events())
 
     def verify_all(self) -> list[str]:
         """Touch every repository DAO; returns a list of error strings

@@ -1042,13 +1042,19 @@ def als_train_sharded(
 
     key = jax.random.PRNGKey(params.seed)
     ku, ki = jax.random.split(key)
-    user0 = np.array(init_factors(ub * n_dev, params.rank, ku))
-    item0 = np.array(init_factors(ib * n_dev, params.rank, ki))
-    # zero the phantom rows beyond n_users/n_items: they receive no ratings
-    # (and solve to ~0 anyway), but a non-zero init would contaminate the
-    # shared Y^T Y term of the implicit-ALS first sweep
-    user0[n_users:] = 0.0
-    item0[n_items:] = 0.0
+    # draw the init at the UNPADDED shape — the exact same draw
+    # als_train makes — then zero-pad the phantom rows. Drawing at the
+    # padded shape and truncating is only prefix-stable under
+    # partitionable threefry (jax >= 0.5 default); on 0.4.x it yields a
+    # completely different init than the single-device path, and the two
+    # trainers then converge to different factor gauges (the sharded-vs-
+    # single drift failures on jax 0.4.37). Zero phantom rows are also
+    # required regardless: a non-zero init would contaminate the shared
+    # Y^T Y term of the implicit-ALS first sweep.
+    user0 = np.zeros((ub * n_dev, params.rank), np.float32)
+    item0 = np.zeros((ib * n_dev, params.rank), np.float32)
+    user0[:n_users] = np.array(init_factors(n_users, params.rank, ku))
+    item0[:n_items] = np.array(init_factors(n_items, params.rank, ki))
     user0 = user0.reshape(n_dev, ub, params.rank)
     item0 = item0.reshape(n_dev, ib, params.rank)
 

@@ -20,13 +20,21 @@ from __future__ import annotations
 
 import base64
 import json
+import threading
 from dataclasses import dataclass
 from typing import Any
 
+from pio_tpu.data.backends.common import new_event_id
 from pio_tpu.data.dao import AccessKey, Channel
 from pio_tpu.data.event import Event, EventValidationError, validate_event
 from pio_tpu.data.storage import Storage, get_storage
-from pio_tpu.server.http import AsyncHttpServer, HttpApp, HttpServer, Request
+from pio_tpu.resilience import SpillQueue, is_transient
+from pio_tpu.resilience.health import (
+    breaker_checks, install_health_routes, shedder_check,
+)
+from pio_tpu.server.http import (
+    AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
+)
 from pio_tpu.server.plugins import PluginContext, PluginRejection
 from pio_tpu.server.stats import Stats
 from pio_tpu.server.webhooks import ConnectorException, default_connectors
@@ -49,6 +57,12 @@ class EventServerConfig:
     certfile: str | None = None   # TLS cert (PEM); with keyfile -> HTTPS
     keyfile: str | None = None
     backend: str = "async"        # "async" (event loop) | "threaded"
+    # degraded-mode ingestion: when the event store is down (breaker
+    # open / transport failures), up to this many events park in a
+    # bounded in-memory queue and drain in the background once the store
+    # recovers — the server keeps answering 201 through short outages.
+    # 0 disables (transient failures then answer 503 + Retry-After).
+    spill_capacity: int = 10000
 
 
 class AuthError(Exception):
@@ -74,6 +88,39 @@ def build_event_app(
 
     app = HttpApp("eventserver")
     app.stats = stats  # exposed for tests/ops
+    # degraded-mode buffer: events that could not reach the store park
+    # here and drain in the background (resilience/spill.py)
+    spill = (SpillQueue(events_dao.insert, config.spill_capacity)
+             if config.spill_capacity > 0 else None)
+    app.spill = spill  # exposed for tests/ops (and readiness below)
+
+    # stale-while-down access-key cache: auth rides the same storage
+    # source as the event store, so a tripped breaker would otherwise
+    # take ingestion down at the AUTH step and make the spill queue
+    # unreachable. Successful lookups are cached; the cache is consulted
+    # ONLY when the live lookup fails transiently (not a TTL — a healthy
+    # store is always authoritative, so revocation lag is bounded by the
+    # outage length).
+    ak_cache: dict[str, AccessKey] = {}
+    ak_cache_lock = threading.Lock()
+
+    def lookup_access_key(key: str) -> AccessKey | None:
+        try:
+            ak = access_keys.get(key)
+        except Exception as e:  # noqa: BLE001 - classified below
+            if not is_transient(e):
+                raise
+            with ak_cache_lock:
+                cached = ak_cache.get(key)
+            if cached is None:
+                raise
+            return cached
+        with ak_cache_lock:
+            if ak is not None:
+                ak_cache[key] = ak
+            else:
+                ak_cache.pop(key, None)
+        return ak
 
     # -- auth (reference withAccessKey, EventServer.scala:90-128) -----------
     def authenticate(req: Request) -> tuple[AccessKey, int | None]:
@@ -90,7 +137,7 @@ def build_event_app(
                     raise AuthError(401, "Invalid accessKey.")
         if not key:
             raise AuthError(401, "Missing accessKey.")
-        ak = access_keys.get(key)
+        ak = lookup_access_key(key)
         if ak is None:
             raise AuthError(401, "Invalid accessKey.")
         channel_name = req.params.get("channel")
@@ -108,7 +155,13 @@ def build_event_app(
                 403, f"{event_name} events are not allowed"
             )
 
-    def insert_one(ak: AccessKey, channel_id: int | None, d: dict) -> str:
+    def insert_one(ak: AccessKey, channel_id: int | None, d: dict,
+                   ) -> tuple[str, bool]:
+        """-> (event_id, spilled). Validation/auth/plugin failures raise;
+        a TRANSIENT store failure (breaker open, transport error after
+        retries) degrades to the spill queue instead of failing the
+        request — the id is assigned up front so the client's receipt is
+        the id the drain later persists."""
         event = Event.from_api_dict(d)
         validate_event(event)
         check_event_allowed(ak, event.event)
@@ -119,10 +172,25 @@ def build_event_app(
                 sniffer.process(d, {"appId": ak.appid, "channelId": channel_id})
             except Exception:  # noqa: BLE001 - sniffers cannot fail requests
                 pass
-        event_id = events_dao.insert(event, ak.appid, channel_id)
+        # mint the id at the edge, BEFORE the store sees the event: the
+        # resilient DAO may retry a transiently-failed insert that
+        # actually committed (a phantom failure), and only an insert
+        # carrying its id is idempotent across every backend (memory/
+        # sql upsert by id; eventlog dedupes a supplied id)
+        if event.event_id is None:
+            event = event.with_id(new_event_id())
+        spilled = False
+        try:
+            event_id = events_dao.insert(event, ak.appid, channel_id)
+        except Exception as e:  # noqa: BLE001 - classified below
+            if spill is None or not is_transient(e):
+                raise
+            if not spill.offer(event, ak.appid, channel_id):
+                raise  # queue full: shed (503 via the authed wrapper)
+            event_id, spilled = event.event_id, True
         if config.stats:  # gated like reference EventServer.scala:284-285
             stats.update(ak.appid, 201, event.event, event.entity_type)
-        return event_id
+        return event_id, spilled
 
     # -- routes -------------------------------------------------------------
     def authed(fn):
@@ -144,6 +212,17 @@ def build_event_app(
                 ValueError,
             ) as e:
                 return 400, {"message": str(e)}
+            except Exception as e:  # noqa: BLE001 - classified below
+                if not is_transient(e):
+                    raise  # real bug: dispatch_safe's 500 applies
+                # event store down and spill unavailable/full: shed with
+                # an honest 503 + Retry-After instead of a 500 (clients
+                # and balancers treat 503 as retryable; reference spray
+                # returns 503 on ask-timeout the same way)
+                return 503, json_response(
+                    {"message": f"event store unavailable: {e}"},
+                    {"Retry-After": "1"},
+                )
 
         wrapper.__name__ = fn.__name__
         return wrapper
@@ -190,10 +269,17 @@ def build_event_app(
                 return _one_native(fast, req, ak, channel_id)
             except ValueError:
                 pass  # malformed body: Python path produces the message
+            except Exception as e:  # noqa: BLE001 - transient -> spill path
+                if not is_transient(e):
+                    raise
+                # store down mid-fast-path: fall through to the Python
+                # path, whose insert_one degrades into the spill queue
         body = req.json()
         if not isinstance(body, dict):
             return 400, {"message": "request body must be a JSON object"}
-        event_id = insert_one(ak, channel_id, body)
+        event_id, spilled = insert_one(ak, channel_id, body)
+        if spilled:
+            return 201, {"eventId": event_id, "spilled": True}
         return 201, {"eventId": event_id}
 
     @app.route("GET", r"/events/([^/]+)\.json")
@@ -267,6 +353,10 @@ def build_event_app(
                 }
             except ValueError:
                 results = None  # malformed body: Python path for messages
+            except Exception as e:  # noqa: BLE001 - transient -> spill path
+                if not is_transient(e):
+                    raise
+                results = None  # store down: Python path spills per event
             if results is not None:
                 out = []
                 for status, payload, event_name, entity_type in results:
@@ -293,8 +383,11 @@ def build_event_app(
             try:
                 if not isinstance(d, dict):
                     raise EventValidationError("event must be a JSON object")
-                event_id = insert_one(ak, channel_id, d)
-                results.append({"status": 201, "eventId": event_id})
+                event_id, spilled = insert_one(ak, channel_id, d)
+                r = {"status": 201, "eventId": event_id}
+                if spilled:
+                    r["spilled"] = True
+                results.append(r)
             except (EventValidationError, ValueError) as e:
                 results.append({"status": 400, "message": str(e)})
             except AuthError as e:
@@ -302,7 +395,10 @@ def build_event_app(
             except PluginRejection as e:
                 results.append({"status": 403, "message": str(e)})
             except Exception as e:  # noqa: BLE001 - per-event isolation
-                results.append({"status": 500, "message": str(e)})
+                results.append({
+                    "status": 503 if is_transient(e) else 500,
+                    "message": str(e),
+                })
         return 200, results
 
     @app.route("GET", r"/stats\.json")
@@ -357,7 +453,9 @@ def build_event_app(
         if not isinstance(data, dict):
             return 400, {"message": "webhook body must be a JSON object"}
         event_json = connector.to_event_json(data)
-        event_id = insert_one(ak, channel_id, event_json)
+        event_id, spilled = insert_one(ak, channel_id, event_json)
+        if spilled:
+            return 201, {"eventId": event_id, "spilled": True}
         return 201, {"eventId": event_id}
 
     @app.route("GET", r"/webhooks/([^/]+)\.json")
@@ -376,7 +474,9 @@ def build_event_app(
         if connector is None:
             return 404, {"message": f"webhook {name} not supported"}
         event_json = connector.to_event_json(req.form())
-        event_id = insert_one(ak, channel_id, event_json)
+        event_id, spilled = insert_one(ak, channel_id, event_json)
+        if spilled:
+            return 201, {"eventId": event_id, "spilled": True}
         return 201, {"eventId": event_id}
 
     @app.route("GET", r"/webhooks/([^/.]+)")
@@ -386,6 +486,20 @@ def build_event_app(
         if name in form_connectors:
             return 200, {"message": f"Ok. Will interpret form in {name} format"}
         return 404, {"message": f"webhook {name} not supported"}
+
+    def readiness() -> dict:
+        """storage breakers not open + spill queue not full + async
+        transport queue under its shed watermark."""
+        checks = breaker_checks(storage)
+        if spill is not None:
+            s = spill.snapshot()
+            checks["spill"] = {
+                "ok": s["size"] < s["capacity"], **s,
+            }
+        checks.update(shedder_check(getattr(app, "transport", None)))
+        return checks
+
+    install_health_routes(app, readiness)
 
     return app
 

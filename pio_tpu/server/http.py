@@ -27,6 +27,8 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
+from pio_tpu.resilience.policies import LoadShedder, RetryPolicy
+
 log = logging.getLogger("pio_tpu.http")
 
 # fixed-port binds retry briefly before giving up (reference
@@ -37,28 +39,29 @@ BIND_ATTEMPTS = 3
 BIND_RETRY_DELAY_S = 1.0
 
 
-def _bind_retry_continues(port: int, err: OSError, attempt: int) -> bool:
-    """Shared retry policy for both transports: True = log + retry,
-    False = out of attempts (caller re-raises). One place so the sync
-    and async servers cannot drift."""
-    attempts = BIND_ATTEMPTS if port else 1
-    if attempt >= attempts - 1:
-        return False
-    log.warning("bind to port %d failed (%s); retry %d/%d in %.0fs",
-                port, err, attempt + 1, attempts - 1, BIND_RETRY_DELAY_S)
-    return True
+def bind_retry_policy(port: int) -> RetryPolicy:
+    """Shared bind-retry schedule for both transports (fixed delay, no
+    jitter — redeploys race a TIME_WAIT socket, not a thundering herd).
+    One place so the sync and async servers cannot drift."""
+    return RetryPolicy(
+        attempts=BIND_ATTEMPTS if port else 1,
+        base_delay_s=BIND_RETRY_DELAY_S, multiplier=1.0,
+        jitter=0.0, retry_on=(OSError,),
+    )
+
+
+def _log_bind_retry(port: int):
+    def on_retry(attempt: int, err: BaseException, delay: float):
+        log.warning("bind to port %d failed (%s); retry %d/%d in %.0fs",
+                    port, err, attempt + 1, BIND_ATTEMPTS - 1, delay)
+    return on_retry
 
 
 def bind_with_retry(make, port: int):
     """Call make() (which binds a socket), retrying OSError up to
-    BIND_ATTEMPTS times for fixed ports."""
-    for attempt in range(BIND_ATTEMPTS):
-        try:
-            return make()
-        except OSError as e:
-            if not _bind_retry_continues(port, e, attempt):
-                raise
-            time.sleep(BIND_RETRY_DELAY_S)
+    BIND_ATTEMPTS times for fixed ports (resilience.RetryPolicy)."""
+    return bind_retry_policy(port).call(
+        make, on_retry=_log_bind_retry(port))
 
 
 def _reject_nonfinite(token: str):
@@ -151,25 +154,37 @@ def dispatch_safe(app: HttpApp, req: Request) -> tuple[int, Any]:
 class RawResponse:
     """Handler payload with an explicit content type (plain str/bytes
     default to text/html — wrong for e.g. Prometheus exposition, whose
-    strict scrapers reject unknown content types)."""
+    strict scrapers reject unknown content types) and optional extra
+    response headers (e.g. Retry-After on a 503)."""
 
     body: bytes | str
     content_type: str = "text/plain; charset=utf-8"
+    headers: dict[str, str] | None = None
 
 
-def encode_payload(payload: Any) -> tuple[bytes, str]:
-    """-> (body bytes, content-type). str/bytes pass through as HTML;
-    RawResponse carries its own content type."""
+def json_response(payload: Any, headers: dict[str, str]) -> RawResponse:
+    """JSON payload that carries extra response headers (the shape
+    degraded-mode 503s use for Retry-After)."""
+    return RawResponse(
+        json.dumps(payload).encode("utf-8"),
+        "application/json; charset=utf-8", headers,
+    )
+
+
+def encode_payload(payload: Any) -> tuple[bytes, str, dict[str, str]]:
+    """-> (body bytes, content-type, extra headers). str/bytes pass
+    through as HTML; RawResponse carries its own content type/headers."""
     if isinstance(payload, RawResponse):
         body = (payload.body.encode()
                 if isinstance(payload.body, str) else payload.body)
-        return body, payload.content_type
+        return body, payload.content_type, payload.headers or {}
     if isinstance(payload, (bytes, str)):
         data = payload.encode() if isinstance(payload, str) else payload
-        return data, "text/html; charset=utf-8"
+        return data, "text/html; charset=utf-8", {}
     return (
         json.dumps(payload).encode("utf-8"),
         "application/json; charset=utf-8",
+        {},
     )
 
 
@@ -211,10 +226,12 @@ class HttpServer:
                     body=body,
                 )
                 status, payload = dispatch_safe(outer.app, req)
-                data, ctype = encode_payload(payload)
+                data, ctype, extra = encode_payload(payload)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -222,6 +239,9 @@ class HttpServer:
 
         self._server = bind_with_retry(
             lambda: ThreadingHTTPServer((host, port), _Handler), port)
+        # readiness probes (resilience/health.py) reach the transport —
+        # and its load shedder, when it has one — through the app
+        app.transport = self
         if ssl_context is not None:
             self._server.socket = ssl_context.wrap_socket(
                 self._server.socket, server_side=True
@@ -262,6 +282,11 @@ _STATUS_TEXT = {
 _MAX_HEADER = 64 * 1024
 _MAX_BODY = 64 * 1024 * 1024
 
+# the liveness/readiness probe paths (handlers installed by
+# resilience/health.py, which imports this constant): the async
+# transport special-cases them — no shedding, no worker pool
+HEALTH_PATHS = ("/healthz", "/readyz")
+
 
 class AsyncHttpServer:
     """asyncio HTTP/1.1 server over the same HttpApp (keep-alive, bounded
@@ -275,7 +300,8 @@ class AsyncHttpServer:
     (EventServer.scala:219)."""
 
     def __init__(self, app: HttpApp, host: str = "127.0.0.1", port: int = 0,
-                 ssl_context=None, workers: int = 16):
+                 ssl_context=None, workers: int = 16,
+                 shed_watermark: int = 0, shed_retry_after_s: float = 1.0):
         self.app = app
         self.host = host
         self.port = port          # rebound to the real port once listening
@@ -284,6 +310,16 @@ class AsyncHttpServer:
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"{app.name}-worker"
         )
+        # load shedding: once this many requests are admitted (running on
+        # the pool + queued behind it), new work is answered 503 with
+        # Retry-After instead of deepening an unservable queue. Default
+        # watermark = 8x the worker pool — past that, queue wait alone
+        # exceeds any sane client timeout. /healthz + /readyz are exempt
+        # (probes must answer precisely when the server is saturated).
+        self.shedder = LoadShedder(
+            shed_watermark or workers * 8, shed_retry_after_s
+        )
+        app.transport = self  # readiness probes read shedder depth
         self._loop: asyncio.AbstractEventLoop | None = None
         self._server: asyncio.Server | None = None
         self._thread: threading.Thread | None = None
@@ -353,12 +389,43 @@ class AsyncHttpServer:
                     headers=headers,
                     body=body,
                 )
-                status, payload = await asyncio.get_running_loop() \
-                    .run_in_executor(self._pool, dispatch_safe, self.app, req)
                 close = (
                     headers.get("connection", "").lower() == "close"
                     or version == "HTTP/1.0"
                 )
+                # health probes bypass the shedder AND the worker pool
+                # (dispatched inline on the loop): a saturated pool is
+                # precisely when a balancer most needs /readyz to answer,
+                # and the probe handlers are lock-snapshot cheap
+                if parsed.path in HEALTH_PATHS:
+                    status, payload = dispatch_safe(self.app, req)
+                    await self._respond(writer, status, payload, close)
+                    if close:
+                        return
+                    continue
+                # load shedding: bounded-queue backpressure. Above the
+                # watermark new work answers 503 + Retry-After — how a
+                # balancer learns to STOP sending the traffic being shed.
+                shed = not self.shedder.try_acquire()
+                if shed:
+                    await self._respond(
+                        writer, 503,
+                        json_response(
+                            {"message": "server overloaded, retry later"},
+                            {"Retry-After":
+                             f"{self.shedder.retry_after_s:.0f}"},
+                        ),
+                        close,
+                    )
+                    if close:
+                        return
+                    continue
+                try:
+                    status, payload = await asyncio.get_running_loop() \
+                        .run_in_executor(
+                            self._pool, dispatch_safe, self.app, req)
+                finally:
+                    self.shedder.release()
                 await self._respond(writer, status, payload, close)
                 if close:
                     return
@@ -372,11 +439,13 @@ class AsyncHttpServer:
                 pass
 
     async def _respond(self, writer, status: int, payload: Any, close: bool):
-        data, ctype = encode_payload(payload)
+        data, ctype, extra = encode_payload(payload)
+        extra_lines = "".join(f"{k}: {v}\r\n" for k, v in extra.items())
         writer.write(
             f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Status')}\r\n"
             f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(data)}\r\n"
+            f"{extra_lines}"
             f"Connection: {'close' if close else 'keep-alive'}\r\n"
             "\r\n".encode("latin-1") + data
         )
@@ -385,7 +454,12 @@ class AsyncHttpServer:
     # -- lifecycle -----------------------------------------------------------
     async def _amain(self):
         self._main_task = asyncio.current_task()
-        for attempt in range(BIND_ATTEMPTS):
+        # same bind-retry schedule as the sync transport, driven manually
+        # because the sleep must be awaited (RetryPolicy.delays yields
+        # the schedule; RetryPolicy.call would block the loop)
+        log_retry = _log_bind_retry(self.port)
+        delays = list(bind_retry_policy(self.port).delays())
+        for attempt in range(len(delays) + 1):
             try:
                 self._server = await asyncio.start_server(
                     self._handle_conn, self.host, self.port, ssl=self._ssl,
@@ -393,9 +467,10 @@ class AsyncHttpServer:
                 )
                 break
             except OSError as e:
-                if not _bind_retry_continues(self.port, e, attempt):
+                if attempt >= len(delays):
                     raise
-                await asyncio.sleep(BIND_RETRY_DELAY_S)
+                log_retry(attempt, e, delays[attempt])
+                await asyncio.sleep(delays[attempt])
         self.port = self._server.sockets[0].getsockname()[1]
         self._ready.set()
         async with self._server:

@@ -256,6 +256,14 @@ def build_storage_app(
         return status, {"status": "ok" if not errors else "degraded",
                         "errors": errors}
 
+    # /healthz (liveness) + /readyz (backing-store breakers closed) —
+    # the shared health contract (resilience/health.py). /health above
+    # stays: it actively touches every DAO, which is a deeper (and more
+    # expensive) check than readiness polling should pay.
+    from pio_tpu.resilience.health import breaker_checks, install_health_routes
+
+    install_health_routes(app, lambda: breaker_checks(storage))
+
     @app.route("GET", r"/metrics")
     def metrics(req: Request):
         """Prometheus text exposition of per-RPC latency summaries —

@@ -12,8 +12,11 @@ from pio_tpu.tools import appops
 
 
 def build_admin_app(storage: Storage | None = None) -> HttpApp:
+    from pio_tpu.resilience.health import breaker_checks, install_health_routes
+
     storage = storage or get_storage()
     app = HttpApp("admin")
+    install_health_routes(app, lambda: breaker_checks(storage))
 
     @app.route("GET", r"/")
     def root(req: Request):

@@ -165,6 +165,78 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """Resilience doctor: poll every server surface's /healthz (liveness)
+    + /readyz (readiness) and print the per-check detail — storage
+    circuit-breaker states, load-shedder queue depth, eventserver spill
+    backlog, the serving model's instance. The aggregate view `pio
+    status` cannot give: status inspects THIS process's storage config;
+    doctor inspects the RUNNING stack's health surfaces."""
+    from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+    surfaces = {
+        "eventserver": args.eventserver_port,
+        "serving": args.serving_port,
+        "adminserver": args.adminserver_port,
+        "storageserver": args.storageserver_port,
+        "dashboard": args.dashboard_port,
+    }
+    report: dict[str, dict] = {}
+    exit_code = 0
+    for name, port in surfaces.items():
+        url = f"http://{args.ip}:{port}"
+        client = JsonHttpClient(url, timeout=args.timeout)
+        entry: dict = {"url": url}
+        try:
+            client.request("GET", "/healthz")
+            entry["live"] = True
+        except HttpClientError as e:
+            entry["live"] = False
+            entry["error"] = e.message
+            report[name] = entry
+            continue  # down surfaces are reported, not failed: doctor
+            # judges the health of what IS running
+        try:
+            ready = client.request("GET", "/readyz")
+        except HttpClientError as e:
+            # 503 carries the readiness payload in its message body;
+            # surface the raw state either way
+            entry["ready"] = False
+            entry["detail"] = e.message
+            exit_code = 1
+            report[name] = entry
+            continue
+        entry["ready"] = bool(ready.get("ready"))
+        entry["checks"] = ready.get("checks", {})
+        if not entry["ready"]:
+            exit_code = 1
+        report[name] = entry
+
+    chaos_spec = os.environ.get("PIO_TPU_CHAOS", "")
+    if args.json:
+        out = {"surfaces": report}
+        if chaos_spec:
+            out["chaos"] = chaos_spec
+        print(json.dumps(out, indent=2))
+        return exit_code
+
+    if chaos_spec:
+        print(f"[WARN] chaos injection active: PIO_TPU_CHAOS={chaos_spec}")
+    for name, entry in report.items():
+        if not entry["live"]:
+            print(f"{name:14s} DOWN    {entry['url']}  ({entry['error']})")
+            continue
+        state = "ready" if entry.get("ready") else "NOT READY"
+        print(f"{name:14s} up      {entry['url']}  {state}")
+        for check, detail in sorted(entry.get("checks", {}).items()):
+            ok = "ok " if detail.get("ok") else "FAIL"
+            rest = {k: v for k, v in detail.items() if k != "ok"}
+            print(f"  [{ok}] {check}: {rest}")
+        if not entry.get("ready") and "detail" in entry:
+            print(f"  detail: {entry['detail']}")
+    return exit_code
+
+
 def cmd_run(args) -> int:
     """Run a user script in the workflow environment (reference
     Console.scala `run` verb: arbitrary main class on the configured
@@ -809,6 +881,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="where start-all wrote pidfiles (default "
                         "$PIO_TPU_PID_DIR or ~/.pio_tpu/run)")
     x.set_defaults(fn=cmd_status)
+
+    x = sub.add_parser(
+        "doctor",
+        help="poll every server surface's /healthz + /readyz: breaker "
+             "states, shed queue depth, spill backlog, serving model",
+    )
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--eventserver-port", type=int, default=7070)
+    x.add_argument("--serving-port", type=int, default=8000)
+    x.add_argument("--adminserver-port", type=int, default=7071)
+    x.add_argument("--storageserver-port", type=int, default=7072)
+    x.add_argument("--dashboard-port", type=int, default=9000)
+    x.add_argument("--timeout", type=float, default=3.0)
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_doctor)
 
     x = sub.add_parser("run")
     x.add_argument("script")

@@ -34,7 +34,9 @@ class Service:
     name: str
     argv: list[str]          # cli args after `python -m pio_tpu.tools.cli`
     port: int
-    health_path: str = "/"
+    # /healthz: the uniform liveness endpoint every surface now serves
+    # (resilience/health.py) — pure process-up, no storage round trips
+    health_path: str = "/healthz"
 
 
 def stack_services(args) -> list[Service]:
@@ -47,7 +49,7 @@ def stack_services(args) -> list[Service]:
             # keyless: the RPC surface includes access keys + model blobs)
             argv += ["--server-key", args.server_key]
         services.append(Service(
-            "storageserver", argv, args.storageserver_port, "/health",
+            "storageserver", argv, args.storageserver_port,
         ))
     services.append(Service(
         "eventserver",
@@ -102,6 +104,9 @@ def _healthy(service: Service, ip: str, timeout_s: float = 20.0,
     host = "127.0.0.1" if ip in ("0.0.0.0", "") else ip
     url = f"http://{host}:{service.port}{service.health_path}"
     deadline = time.monotonic() + timeout_s
+    # pio: lint-ok[bare-retry] deadline-paced startup-readiness poll at a
+    # fixed cadence, not an I/O retry — backoff/jitter would only delay
+    # the "up" verdict
     while time.monotonic() < deadline:
         if child is not None and child.poll() is not None:
             return False  # died at startup: fail now, not after the timeout

@@ -15,8 +15,11 @@ from pio_tpu.utils.time import format_time
 
 
 def build_dashboard_app(storage: Storage | None = None) -> HttpApp:
+    from pio_tpu.resilience.health import breaker_checks, install_health_routes
+
     storage = storage or get_storage()
     app = HttpApp("dashboard")
+    install_health_routes(app, lambda: breaker_checks(storage))
 
     @app.route("GET", r"/")
     def index(req: Request):

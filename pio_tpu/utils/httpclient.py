@@ -17,6 +17,8 @@ import urllib.parse
 import urllib.request
 from typing import Any
 
+from pio_tpu.resilience.chaos import maybe_inject
+
 
 class HttpClientError(Exception):
     def __init__(self, status: int, message: str):
@@ -40,6 +42,9 @@ class JsonHttpClient:
     def request(self, method: str, path: str, body: Any = None,
                 params: dict | None = None) -> Any:
         """-> parsed JSON body (None when empty). Raises HttpClientError."""
+        # chaos point: injected ConnectionError/reset/stall surfaces to
+        # callers exactly like a real transport failure (normalized to
+        # HttpClientError(status=0) below)
         url = self.base + path
         if params:
             qs = {k: v for k, v in params.items() if v is not None}
@@ -55,6 +60,7 @@ class JsonHttpClient:
             headers={"Content-Type": "application/json"},
         )
         try:
+            maybe_inject(f"http.{method} {path}")
             with urllib.request.urlopen(
                 req, timeout=self.timeout, context=self._ctx
             ) as resp:

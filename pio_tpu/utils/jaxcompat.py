@@ -33,6 +33,39 @@ def set_cpu_device_count(n: int) -> None:
         )
 
 
+def multiprocess_cpu_supported() -> bool:
+    """Capability probe: can this jax/jaxlib run MULTIPROCESS
+    computations on the CPU backend? Requires a cross-process CPU
+    collectives implementation (gloo TCP) compiled into jaxlib — without
+    it XLA raises "Multiprocess computations aren't implemented on the
+    CPU backend" at dispatch time. Cheap (no backend init, no
+    subprocess); tests/test_distributed.py uses it to skip-with-reason
+    instead of failing on builds that lack gloo."""
+    try:
+        from jax._src.lib import xla_client
+    except Exception:  # noqa: BLE001 - internals moved: treat as absent
+        return False
+    return hasattr(xla_client._xla, "make_gloo_tcp_collectives")
+
+
+def enable_cpu_collectives() -> bool:
+    """Select the gloo CPU collectives implementation, so multiprocess
+    jobs work on the CPU backend (jax's default is 'none', which fails
+    at dispatch). Must run BEFORE the backend initializes — call it from
+    initialize_distributed, next to the platform forcing. True when the
+    knob was set (or gloo is simply unavailable -> False, caller may
+    proceed and let jax produce its own error)."""
+    if not multiprocess_cpu_supported():
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:  # noqa: BLE001 - knob renamed/absent on this jax
+        return False
+
+
 def ensure_jax_compat() -> None:
     import jax
 

@@ -38,7 +38,13 @@ from pio_tpu.controller.engine import Engine, EngineParams
 from pio_tpu.data.dao import AccessKey
 from pio_tpu.data.event import Event
 from pio_tpu.data.storage import Storage
-from pio_tpu.server.http import AsyncHttpServer, HttpApp, HttpServer, Request
+from pio_tpu.resilience import CircuitOpenError, Deadline, DeadlineExceeded
+from pio_tpu.resilience.health import (
+    breaker_checks, install_health_routes, shedder_check,
+)
+from pio_tpu.server.http import (
+    AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
+)
 from pio_tpu.server.plugins import PluginContext
 from pio_tpu.utils.time import format_time, utcnow
 from pio_tpu.utils.tracing import Tracer
@@ -93,6 +99,17 @@ class ServingConfig:
     # (record=False skips the histograms), so compiles never skew the
     # median the hedge timeout derives from.
     hedge_after: float = 3.0
+    # per-request time budget (seconds) opened around each /queries.json
+    # dispatch and propagated (resilience.Deadline contextvar) into the
+    # storage DAO calls made on the REQUEST THREAD: retries stop
+    # sleeping and I/O stops starting once the budget is spent, and the
+    # request answers 503 instead of holding a connection past its
+    # usefulness. Work executed on other pools (micro-batched execution,
+    # hedged/multi-algo predict dispatch, background feedback) does not
+    # inherit the contextvar — the batcher instead enforces the budget
+    # at its result wait, and predict stages are bounded by their own
+    # hedging. 0 = off.
+    request_budget_s: float = 0.0
 
 
 class QueryServer:
@@ -132,6 +149,14 @@ class QueryServer:
             max_workers=8, thread_name_prefix="hedge"
         )
         self.hedged_dispatches = 0
+        self.last_reload_error: str | None = None
+        # serializes whole reloads (resolve + restore + swap) end to end
+        # WITHOUT blocking queries: queries snapshot state under
+        # self._lock, which a reload only takes for the final swap.
+        # Without this, two concurrent /reloads could resolve different
+        # "latest" instances and swap in restore-completion order,
+        # leaving the older one serving.
+        self._load_lock = threading.Lock()
         self._load(instance_id)
         self.batcher = (
             QueryBatcher(self, config.batch_window_ms / 1e3, config.batch_max,
@@ -145,6 +170,19 @@ class QueryServer:
 
     # -- model lifecycle ----------------------------------------------------
     def _load(self, instance_id: str | None = None) -> None:
+        """Restore an instance's models and swap them in ATOMICALLY: every
+        failable step (metadata lookup, model restore, doer construction)
+        runs before the swap, so a failed load leaves the previous
+        instance/models/algorithms fully intact — the last-good model
+        keeps serving through a broken /reload (reference MasterActor
+        keeps its old ServerActor when ReloadServer fails). Whole loads
+        (resolve + restore + swap) are serialized by _load_lock so
+        concurrent reloads cannot swap in restore-completion order;
+        queries are NOT blocked — they contend only on the final swap."""
+        with self._load_lock:
+            self._load_locked(instance_id)
+
+    def _load_locked(self, instance_id: str | None) -> None:
         c = self.config
         instances = self.storage.get_metadata_engine_instances()
         if instance_id is None:
@@ -161,12 +199,14 @@ class QueryServer:
             instance = instances.get(instance_id)
             if instance is None:
                 raise ValueError(f"Engine instance {instance_id} not found")
+        # restore OUTSIDE the lock: queries keep serving the old model
+        # while the new one loads (restore can take seconds on big models)
+        models = load_models(
+            self.storage, self.engine, self.engine_params, instance.id,
+            ctx=self.ctx,
+        )
+        _, _, algorithms, serving = self.engine._doers(self.engine_params)
         with self._lock:
-            self.instance = instance
-            self.models = load_models(
-                self.storage, self.engine, self.engine_params, instance.id,
-                ctx=self.ctx,
-            )
             # hot-swap: retire the outgoing doers' resources (e.g. an
             # external engine's child process) — but on a delay: queries
             # that snapshotted the old algorithms may still be mid-predict,
@@ -181,14 +221,22 @@ class QueryServer:
                 )
                 t.daemon = True
                 t.start()
-            _, _, self.algorithms, self.serving = self.engine._doers(
-                self.engine_params
-            )
+            self.instance = instance
+            self.models = models
+            self.algorithms = algorithms
+            self.serving = serving
         log.info("deployed engine instance %s", instance.id)
 
     def reload(self) -> str:
-        """Hot-swap to the latest completed instance; returns its id."""
-        self._load(None)
+        """Hot-swap to the latest completed instance; returns its id. On
+        failure the exception propagates and the last-good model keeps
+        serving (the /reload route maps it to 503 + the serving id)."""
+        try:
+            self._load(None)
+        except Exception as e:
+            self.last_reload_error = f"{type(e).__name__}: {e}"
+            raise
+        self.last_reload_error = None
         return self.instance.id
 
     def close(self) -> None:
@@ -590,7 +638,16 @@ class QueryBatcher:
     def query(self, q: dict) -> Any:
         fut: Future = Future()
         self._q.put((q, fut))
-        return fut.result()
+        # batch execution runs on the batcher pool, which does not
+        # inherit the caller's Deadline contextvar — enforce the budget
+        # here, at the wait (the batch result lands harmlessly later)
+        timeout = Deadline.remaining()
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeoutError:
+            raise DeadlineExceeded(
+                "request budget exhausted waiting for batch execution"
+            ) from None
 
     def _run(self):
         while not self._closed:
@@ -669,6 +726,29 @@ def build_serving_app(server: QueryServer) -> HttpApp:
     def root(req: Request):
         return 200, server.status()
 
+    def _budgeted(fn):
+        """Run one query dispatch under the per-request Deadline budget
+        (ServingConfig.request_budget_s); exhausted budgets and tripped
+        storage breakers surface as 503 + Retry-After instead of a 500
+        or a connection held past its usefulness."""
+        try:
+            if config.request_budget_s > 0:
+                with Deadline.budget(config.request_budget_s):
+                    return 200, fn()
+            return 200, fn()
+        except KeyError as e:
+            return 400, {"message": f"query missing field {e}"}
+        except DeadlineExceeded as e:
+            return 503, json_response(
+                {"message": f"request budget exhausted: {e}"},
+                {"Retry-After": "1"},
+            )
+        except CircuitOpenError as e:
+            return 503, json_response(
+                {"message": str(e)},
+                {"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
+
     @app.route("POST", r"/queries\.json")
     def queries(req: Request):
         try:
@@ -677,14 +757,9 @@ def build_serving_app(server: QueryServer) -> HttpApp:
             return 400, {"message": f"Invalid query: {e}"}
         if not isinstance(q, dict):
             return 400, {"message": "query must be a JSON object"}
-        try:
-            if server.batcher is not None:
-                prediction = server.batcher.query(q)
-            else:
-                prediction = server.query(q)
-        except KeyError as e:
-            return 400, {"message": f"query missing field {e}"}
-        return 200, prediction
+        if server.batcher is not None:
+            return _budgeted(lambda: server.batcher.query(q))
+        return _budgeted(lambda: server.query(q))
 
     @app.route("POST", r"/batch/queries\.json")
     def batch_queries(req: Request):
@@ -699,16 +774,26 @@ def build_serving_app(server: QueryServer) -> HttpApp:
             return 400, {"message": "body must be a JSON array of objects"}
         if not qs:
             return 200, []
-        try:
-            return 200, server.query_batch(qs)
-        except KeyError as e:
-            return 400, {"message": f"query missing field {e}"}
+        return _budgeted(lambda: server.query_batch(qs))
 
     @app.route("GET", r"/reload")
     def reload(req: Request):
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
-        instance_id = server.reload()
+        try:
+            instance_id = server.reload()
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            # last-good serving: the failed load left the previous
+            # instance fully in place (see QueryServer._load), so report
+            # the failure AND what is still serving
+            with server._lock:
+                still = server.instance.id
+            return 503, json_response(
+                {"message": f"Reload failed ({type(e).__name__}: {e}); "
+                            "still serving last-good model",
+                 "engineInstanceId": still},
+                {"Retry-After": "1"},
+            )
         return 200, {"message": "Reloaded", "engineInstanceId": instance_id}
 
     @app.route("POST", r"/stop")
@@ -762,6 +847,22 @@ def build_serving_app(server: QueryServer) -> HttpApp:
         if logdir is None:
             return 409, {"message": "no profile running"}
         return 200, {"message": "profile written", "logdir": logdir}
+
+    def readiness() -> dict:
+        """model loaded + storage breakers not open + async-transport
+        queue under its shed watermark (resilience/health.py contract)."""
+        checks = breaker_checks(server.storage)
+        with server._lock:
+            inst = getattr(server, "instance", None)
+        checks["model"] = {
+            "ok": inst is not None,
+            "engineInstanceId": inst.id if inst is not None else None,
+            "lastReloadError": server.last_reload_error,
+        }
+        checks.update(shedder_check(getattr(app, "transport", None)))
+        return checks
+
+    install_health_routes(app, readiness)
 
     @app.route("GET", r"/plugins\.json")
     def plugins_list(req: Request):

@@ -480,7 +480,12 @@ def test_import_batches_and_isolates_bad_batch(cli, memory_storage,
         calls["batch"] += 1
         raise RuntimeError("bulk path down")
 
-    monkeypatch.setattr(type(ev), "insert_batch", bad_batch)
+    # patch the BACKING DAO class: `ev` is normally a ResilientDAO proxy,
+    # whose type() is the proxy class (isinstance sees through via
+    # __class__, type() does not); fresh proxies pick the patched method
+    # up. getattr fallback keeps this valid under PIO_TPU_RESILIENCE=off.
+    monkeypatch.setattr(type(getattr(ev, "_dao", ev)),
+                        "insert_batch", bad_batch)
     cli("app", "new", "fallbackimp")
     app2 = memory_storage.get_metadata_apps().get_by_name("fallbackimp").id
     code, out = cli("import", "--appid", str(app2), "--input", str(f))
@@ -502,13 +507,16 @@ def test_import_partial_batch_failure_no_duplicates(cli, memory_storage,
     cli("app", "new", "partialimp")
     app_id = memory_storage.get_metadata_apps().get_by_name("partialimp").id
     ev = memory_storage.get_events()
-    real_batch = type(ev).insert_batch
+    # patch the backing DAO class, not the ResilientDAO proxy (see
+    # test_import_batches_and_isolates_bad_batch)
+    backing_cls = type(getattr(ev, "_dao", ev))
+    real_batch = backing_cls.insert_batch
 
     def half_then_die(self, events, app_id_, channel_id=None):
         real_batch(self, events[: len(events) // 2], app_id_, channel_id)
         raise RuntimeError("died mid-batch")
 
-    monkeypatch.setattr(type(ev), "insert_batch", half_then_die)
+    monkeypatch.setattr(backing_cls, "insert_batch", half_then_die)
     f = tmp_path / "in.jsonl"
     f.write_text("".join(
         _json.dumps({"event": "rate", "entityType": "user",

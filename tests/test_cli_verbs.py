@@ -259,6 +259,8 @@ def test_deploy_and_undeploy_subprocess(tmp_path):
     try:
         deadline = time.monotonic() + 120
         body = None
+        # pio: lint-ok[bare-retry] test poll waiting for the deployed
+        # subprocess to come up — fixed cadence, not an I/O retry
         while time.monotonic() < deadline:
             try:
                 req = urllib.request.Request(

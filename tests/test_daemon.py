@@ -72,6 +72,8 @@ def test_start_all_stop_all_roundtrip(tmp_path):
     # ports released
     deadline = time.monotonic() + 15
     for name, port in ports.items():
+        # pio: lint-ok[bare-retry] test poll for port release after
+        # stop-all — fixed cadence, not an I/O retry
         while time.monotonic() < deadline:
             try:
                 with urllib.request.urlopen(

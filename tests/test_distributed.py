@@ -16,6 +16,17 @@ from pio_tpu.parallel.distributed import (
     is_primary,
     runtime_info,
 )
+from pio_tpu.utils.jaxcompat import multiprocess_cpu_supported
+
+# the 2-process tests dispatch real cross-process collectives on the CPU
+# backend, which needs gloo TCP collectives in jaxlib (selected by
+# initialize_distributed); without it XLA fails with "Multiprocess
+# computations aren't implemented on the CPU backend"
+needs_multiprocess_cpu = pytest.mark.skipif(
+    not multiprocess_cpu_supported(),
+    reason="this jaxlib lacks gloo CPU collectives (multiprocess CPU "
+           "computations unsupported)",
+)
 
 
 def test_single_host_is_noop(monkeypatch):
@@ -94,6 +105,7 @@ print("CHILD_OK", pid, flush=True)
 """
 
 
+@needs_multiprocess_cpu
 def test_two_process_collectives_match_single_process(tmp_path):
     """Two real OS processes join one distributed runtime (2 procs x 2 local
     CPU devices = 4 global) and run sharded ALS + dp x tp two-tower steps
@@ -139,6 +151,7 @@ def test_two_process_collectives_match_single_process(tmp_path):
         assert f"CHILD_OK {pid}" in out, f"process {pid} failed:\n{err}"
 
 
+@needs_multiprocess_cpu
 def test_two_process_training_from_shared_storage_server(tmp_path):
     """The full multi-host data plane, ours end to end: a storage server
     owns the events; TWO OS processes join one jax.distributed runtime,

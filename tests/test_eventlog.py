@@ -352,3 +352,29 @@ class TestTimePrecision:
         assert got.event_time == e.event_time
         assert got.event_time.utcoffset() == timedelta(hours=5, minutes=30)
         b.close()
+
+
+class TestSuppliedIdIdempotency:
+    def test_retried_insert_with_same_id_appends_once(self, tmp_path):
+        """Phantom-retry contract (resilience.RetryPolicy / spill drain):
+        re-inserting a caller-supplied id within the recent window must
+        not append a second record — the log is append-only, so a dup
+        would be counted twice by find()/columnarize()."""
+        b = EventLogBackend(
+            StorageClientConfig(properties={"PATH": str(tmp_path / "el")})
+        )
+        dao = b.events()
+        dao.init(1)
+        e = mk(0)  # mk assigns event_id "ev0"
+        assert dao.insert(e, 1) == e.event_id
+        assert dao.insert(e, 1) == e.event_id      # retry: deduped
+        assert len(list(dao.find(1, limit=-1))) == 1
+        # fresh events without ids are unaffected
+        from pio_tpu.data.event import Event
+
+        fresh = Event(event="rate", entity_type="user", entity_id="u9")
+        id_a = dao.insert(fresh, 1)
+        id_b = dao.insert(fresh, 1)                # no id: two inserts
+        assert id_a != id_b
+        assert len(list(dao.find(1, limit=-1))) == 3
+        b.close()

@@ -286,6 +286,84 @@ def test_async_with_executor_silent():
     assert fs == []
 
 
+def test_bare_retry_loop_fires():
+    fs = lint("""
+        import time
+        import urllib.request
+
+        def fetch(url):
+            for attempt in range(3):
+                try:
+                    return urllib.request.urlopen(url)
+                except OSError:
+                    time.sleep(1.0)
+    """)
+    assert [f.rule for f in fs] == ["bare-retry"]
+    assert "RetryPolicy" in fs[0].message
+
+
+def test_bare_retry_innermost_loop_only():
+    fs = lint("""
+        import time
+
+        def sweep(urls):
+            for url in urls:
+                while True:
+                    try:
+                        return fetch(url)
+                    except ConnectionError:
+                        time.sleep(0.5)
+    """)
+    assert [f.rule for f in fs] == ["bare-retry"]
+    assert fs[0].line == 6  # the while (retry), not the for (iteration)
+
+
+def test_bare_retry_not_exempted_by_lookalike_names():
+    # exact-identifier exemption: `max_attempts` must NOT read as a
+    # RetryPolicy schedule (regression: substring matching exempted it)
+    fs = lint("""
+        import time
+        import urllib.request
+
+        def fetch(url, max_attempts=3):
+            for attempt in range(max_attempts):
+                try:
+                    return urllib.request.urlopen(url)
+                except OSError:
+                    time.sleep(1.0)
+    """, select=["bare-retry"])
+    assert [f.rule for f in fs] == ["bare-retry"]
+
+
+def test_policy_driven_retry_loop_silent():
+    fs = lint("""
+        import asyncio
+        from pio_tpu.resilience import RetryPolicy
+
+        async def bind(make):
+            delays = list(RetryPolicy(attempts=3).delays())
+            for attempt in range(len(delays) + 1):
+                try:
+                    return make()
+                except OSError:
+                    await asyncio.sleep(delays[attempt])
+    """, select=["bare-retry"])
+    assert fs == []
+
+
+def test_sleep_without_transport_handler_silent():
+    fs = lint("""
+        import time
+
+        def poll(q):
+            while True:
+                item = q.get_nowait()
+                if item is None:
+                    time.sleep(0.1)
+    """, select=["bare-retry"])
+    assert fs == []
+
+
 # -- bench hygiene ----------------------------------------------------------
 
 def test_time_time_fires():
