@@ -23,16 +23,25 @@ pools) and an asyncio event loop side by side, so the hazards are:
     schedule (referencing `RetryPolicy`, a `*.delays(...)` /
     `*.attempts(...)` call, or a name like `delays`) are exempt — the
     async transports must drive their own `await asyncio.sleep`.
+  * `durable-write`  — a direct `open(path, "wb")` write of a model/
+    checkpoint artifact (the path expression mentions model/ckpt/
+    checkpoint) that bypasses `pio_tpu.utils.durable.durable_write`:
+    a crash mid-write leaves a truncated artifact with no checksum, the
+    exact torn-blob bug the durability layer exists to end. Same shape
+    as `bare-retry`: the sanctioned helper gives atomic rename + fsync
+    + CRC32C for free.
 
 Scope gate: modules that import threading/asyncio/concurrent.futures/
 multiprocessing — shared-state writes in single-threaded scripts are not
-hazards. (`async-blocking` and `bare-retry` apply regardless: blocking
-an event loop and hand-rolling retries are hazards in any module.)
+hazards. (`async-blocking`, `bare-retry`, and `durable-write` apply
+regardless: blocking an event loop, hand-rolling retries, and tearable
+artifact writes are hazards in any module.)
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator
 
 from pio_tpu.analysis.astutil import (
@@ -79,15 +88,20 @@ _SLEEP_CALLS = frozenset({"time.sleep", "asyncio.sleep"})
 # a `.delays()` / `.attempts()` schedule method
 _POLICY_NAMES = frozenset({"RetryPolicy", "retry_policy", "delays"})
 _POLICY_METHODS = frozenset({"delays", "attempts"})
+# path expressions naming artifact families whose torn writes corrupt
+# serving/resume (durable-write)
+_ARTIFACT_RE = re.compile(r"model|ckpt|checkpoint", re.IGNORECASE)
 
 
 class ConcurrencyRule:
     id = "concurrency"
-    ids = ("attr-no-lock", "global-no-lock", "async-blocking", "bare-retry")
+    ids = ("attr-no-lock", "global-no-lock", "async-blocking", "bare-retry",
+           "durable-write")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         yield from self._async_blocking(ctx)
         yield from self._bare_retry(ctx)
+        yield from self._durable_write(ctx)
         if not ctx.imports_any("threading", "asyncio", "multiprocessing",
                                "concurrent"):
             return
@@ -264,6 +278,41 @@ class ConcurrencyRule:
                     and node.func.attr in _POLICY_METHODS):
                 return False
         return True
+
+    # -- torn artifact writes -------------------------------------------------
+    def _durable_write(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """Flag `open(<model/checkpoint path>, "wb")` writes that bypass
+        utils.durable.durable_write. Heuristic: the mode is a binary
+        write ("w"/"a"/"x" + "b") and the path expression's source text
+        mentions model/ckpt/checkpoint — the artifact families whose
+        torn writes corrupt serving and resume."""
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.imports.canonical(node.func) == "open"
+                    and node.args):
+                continue
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            else:
+                mode = next((kw.value for kw in node.keywords
+                             if kw.arg == "mode"), None)
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)):
+                continue
+            m = mode.value
+            if "b" not in m or not any(c in m for c in "wax"):
+                continue
+            path_src = ast.unparse(node.args[0])
+            if not _ARTIFACT_RE.search(path_src):
+                continue
+            yield self._f(
+                "durable-write", ctx, node,
+                f"direct binary write of artifact path `{path_src}`: a "
+                "crash mid-write leaves a truncated, checksum-less blob "
+                "that readers misparse; use "
+                "pio_tpu.utils.durable.durable_write (tmp + fsync + "
+                "atomic rename + CRC32C)")
 
     # -- blocking calls on the event loop ------------------------------------
     def _async_blocking(self, ctx: ModuleContext) -> Iterator[Finding]:

@@ -4,6 +4,13 @@ Plays the role of reference data/.../storage/localfs/LocalFSModels.scala (and
 hdfs/HDFSModels.scala): MODELDATA repository storing model blobs as files.
 Checkpoint directories from orbax also live under the same root; this DAO
 covers the opaque-blob path used by pickled local models.
+
+Durability: ``insert`` goes through ``utils.durable.durable_write`` (tmp
+file + fsync + atomic rename + CRC32C header) — the reference's bare
+FileOutputStream left a truncated ``pio_model_*.bin`` behind any crash
+mid-write, and ``get`` happily returned it. ``get`` now verifies the
+frame and raises ``ModelIntegrityError`` on a torn or bit-rotted file;
+pre-durability files (no frame header) pass through unverified.
 """
 
 from __future__ import annotations
@@ -12,6 +19,9 @@ import os
 
 from pio_tpu.data import dao as d
 from pio_tpu.data.storage import Backend
+from pio_tpu.utils.durable import ModelIntegrityError, durable_read, durable_write
+
+__all__ = ["LocalFSBackend", "ModelIntegrityError"]
 
 
 class LocalFSBackend(Backend):
@@ -33,15 +43,13 @@ class _FSModels(d.ModelsDAO):
         return os.path.join(self.root, f"pio_model_{safe}.bin")
 
     def insert(self, m: d.Model):
-        with open(self._path(m.id), "wb") as f:
-            f.write(m.models)
+        durable_write(self._path(m.id), m.models)
 
     def get(self, model_id):
         p = self._path(model_id)
         if not os.path.exists(p):
             return None
-        with open(p, "rb") as f:
-            return d.Model(model_id, f.read())
+        return d.Model(model_id, durable_read(p))
 
     def delete(self, model_id):
         p = self._path(model_id)

@@ -51,7 +51,7 @@ CREATE TABLE IF NOT EXISTS engine_instances (
   engine_id TEXT, engine_version TEXT, engine_variant TEXT,
   engine_factory TEXT, batch TEXT, env TEXT, spark_conf TEXT,
   datasource_params TEXT, preparator_params TEXT, algorithms_params TEXT,
-  serving_params TEXT);
+  serving_params TEXT, progress TEXT);
 CREATE TABLE IF NOT EXISTS engine_manifests (
   id VARCHAR(191), version VARCHAR(191), name TEXT, description TEXT,
   files TEXT, engine_factory TEXT, PRIMARY KEY (id, version));
@@ -158,6 +158,18 @@ class MySQLBackend(Backend):
                 f"cannot reach MySQL at {url!r}: {e}"
             ) from e
         self._db = _MyDb(self._pool)
+        self._migrate_add_progress()
+
+    def _migrate_add_progress(self):
+        """Pre-lifecycle schemas lack engine_instances.progress; MySQL has
+        no ADD COLUMN IF NOT EXISTS, so probe information_schema."""
+        rows = self._db.query(
+            "SELECT COUNT(*) FROM information_schema.columns "
+            "WHERE table_schema = DATABASE() "
+            "AND table_name = 'engine_instances' AND column_name = 'progress'"
+        )
+        if rows and rows[0][0] == 0:
+            self._db.exec("ALTER TABLE engine_instances ADD COLUMN progress TEXT")
 
     def close(self):
         self._pool.close()

@@ -43,7 +43,8 @@ CREATE TABLE IF NOT EXISTS engine_instances (
   engine_id TEXT, engine_version TEXT, engine_variant TEXT,
   engine_factory TEXT, batch TEXT, env TEXT, spark_conf TEXT,
   datasource_params TEXT, preparator_params TEXT, algorithms_params TEXT,
-  serving_params TEXT);
+  serving_params TEXT, progress TEXT);
+ALTER TABLE engine_instances ADD COLUMN IF NOT EXISTS progress TEXT;
 CREATE TABLE IF NOT EXISTS engine_manifests (
   id TEXT, version TEXT, name TEXT, description TEXT, files TEXT,
   engine_factory TEXT, PRIMARY KEY (id, version));

@@ -197,7 +197,7 @@ class SqlEngineInstances(d.EngineInstancesDAO):
     COLS = (
         "id,status,start_time,end_time,engine_id,engine_version,engine_variant,"
         "engine_factory,batch,env,spark_conf,datasource_params,"
-        "preparator_params,algorithms_params,serving_params"
+        "preparator_params,algorithms_params,serving_params,progress"
     )
 
     def __init__(self, db: SqlDb):
@@ -209,7 +209,7 @@ class SqlEngineInstances(d.EngineInstancesDAO):
             i.engine_id, i.engine_version, i.engine_variant, i.engine_factory,
             i.batch, json.dumps(i.env), json.dumps(i.spark_conf),
             i.datasource_params, i.preparator_params, i.algorithms_params,
-            i.serving_params,
+            i.serving_params, json.dumps(i.progress),
         )
 
     def _from_row(self, r) -> d.EngineInstance:
@@ -219,7 +219,7 @@ class SqlEngineInstances(d.EngineInstancesDAO):
             engine_factory=r[7], batch=r[8], env=json.loads(r[9] or "{}"),
             spark_conf=json.loads(r[10] or "{}"), datasource_params=r[11],
             preparator_params=r[12], algorithms_params=r[13],
-            serving_params=r[14],
+            serving_params=r[14], progress=json.loads(r[15] or "{}"),
         )
 
     def insert(self, i: d.EngineInstance):
@@ -227,7 +227,7 @@ class SqlEngineInstances(d.EngineInstancesDAO):
         i = replace(i, id=iid)
         self.db.exec(
             f"INSERT INTO engine_instances ({self.COLS}) VALUES "
-            f"({','.join('?' * 15)})",
+            f"({','.join('?' * 16)})",
             self._to_row(i),
         )
         return iid
@@ -248,8 +248,8 @@ class SqlEngineInstances(d.EngineInstancesDAO):
             "UPDATE engine_instances SET status=?, start_time=?, end_time=?, "
             "engine_id=?, engine_version=?, engine_variant=?, engine_factory=?, "
             "batch=?, env=?, spark_conf=?, datasource_params=?, "
-            "preparator_params=?, algorithms_params=?, serving_params=? "
-            "WHERE id=?",
+            "preparator_params=?, algorithms_params=?, serving_params=?, "
+            "progress=? WHERE id=?",
             self._to_row(i)[1:] + (i.id,),
         )
 

@@ -35,7 +35,7 @@ CREATE TABLE IF NOT EXISTS engine_instances (
   engine_id TEXT, engine_version TEXT, engine_variant TEXT,
   engine_factory TEXT, batch TEXT, env TEXT, spark_conf TEXT,
   datasource_params TEXT, preparator_params TEXT, algorithms_params TEXT,
-  serving_params TEXT);
+  serving_params TEXT, progress TEXT);
 CREATE TABLE IF NOT EXISTS engine_manifests (
   id TEXT, version TEXT, name TEXT, description TEXT, files TEXT,
   engine_factory TEXT, PRIMARY KEY (id, version));
@@ -130,7 +130,20 @@ class SqliteBackend(Backend):
         with self._lock:
             self._migrate_events_pk()
             self._conn.executescript(_SCHEMA)
+            self._migrate_add_progress()
             self._conn.commit()
+
+    def _migrate_add_progress(self):
+        """Pre-lifecycle databases lack engine_instances.progress (the
+        training heartbeat column); CREATE TABLE IF NOT EXISTS does not
+        extend an existing table, so add it in place."""
+        cols = {
+            r[1] for r in self._conn.execute(
+                "PRAGMA table_info(engine_instances)")
+        }
+        if "progress" not in cols:
+            self._conn.execute(
+                "ALTER TABLE engine_instances ADD COLUMN progress TEXT")
 
     def _migrate_events_pk(self):
         """Rebuild pre-round-2 events tables whose PK was the global event id.

@@ -66,6 +66,7 @@ def engine_instance_to_wire(i: d.EngineInstance) -> dict:
         "preparatorParams": i.preparator_params,
         "algorithmsParams": i.algorithms_params,
         "servingParams": i.serving_params,
+        "progress": dict(i.progress),
     }
 
 
@@ -81,6 +82,7 @@ def engine_instance_from_wire(w: dict) -> d.EngineInstance:
         preparator_params=w.get("preparatorParams", ""),
         algorithms_params=w.get("algorithmsParams", ""),
         serving_params=w.get("servingParams", ""),
+        progress=dict(w.get("progress", {})),
     )
 
 

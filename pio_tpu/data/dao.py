@@ -55,10 +55,14 @@ class Channel:
 
 @dataclass(frozen=True)
 class EngineInstance:
-    """One train run (reference EngineInstances.scala)."""
+    """One train run (reference EngineInstances.scala).
+
+    Status lifecycle: INIT -> TRAINING -> COMPLETED | FAILED |
+    INTERRUPTED (preempted with a checkpoint; resumable, like FAILED).
+    """
 
     id: str
-    status: str  # INIT | TRAINING | COMPLETED | FAILED
+    status: str  # INIT | TRAINING | COMPLETED | FAILED | INTERRUPTED
     start_time: datetime
     end_time: datetime
     engine_id: str
@@ -72,6 +76,10 @@ class EngineInstance:
     preparator_params: str = ""
     algorithms_params: str = ""
     serving_params: str = ""
+    # training heartbeat/progress (workflow/lifecycle.py): {step,
+    # total_steps, heartbeat, pid, host, checkpoint_dir, ...}. Stale
+    # heartbeats are how the zombie sweep detects crashed runs.
+    progress: dict = field(default_factory=dict)
 
 
 @dataclass(frozen=True)

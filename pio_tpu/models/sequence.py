@@ -272,12 +272,14 @@ def make_encoder(n_items: int, p: SequenceParams) -> SeqEncoder:
 
 def train_sequence_model(
     data: SequenceData, p: SequenceParams, mesh: Mesh | None = None,
-    checkpoint=None,
+    checkpoint=None, lifecycle=None,
 ):
     """SPMD train loop: dp x sp shard_map step (see module docstring).
 
     `checkpoint` is a StepCheckpointer (or None): saves every save_every
     steps, resumes from the latest step with an identical batch stream.
+    `lifecycle` is a workflow.lifecycle.TrainLifecycle (or None):
+    heartbeats at span boundaries; preemption force-saves then raises.
     Returns (params, encoder, final loss)."""
     encoder = make_encoder(len(data.items), p)
     optimizer = optax.adam(p.learning_rate)
@@ -475,13 +477,19 @@ def train_sequence_model(
     # (2 arrays x cap x size x seq_len x 4B <= ~64 MB)
     seq_len = inp_all.shape[1]
     cap = max(1, min(512, (64 << 20) // max(1, 2 * size * seq_len * 4)))
+    from pio_tpu.workflow.spans import after_span, step_chaos_active
+
+    step_chaos = step_chaos_active()
+    if step_chaos:
+        cap = 1
     loss = None
     for lo, hi, save_after in span_bounds(start_step, p.steps, every,
                                           cap=cap):
         inps, tgts = batches_for(lo, hi)
         params, opt_state, loss = span(params, opt_state, inps, tgts)
-        if save_after:
-            checkpoint.maybe_save(hi - 1, params, opt_state)
+        after_span(hi, p.steps, params, opt_state, checkpoint=checkpoint,
+                   lifecycle=lifecycle, save_after=save_after,
+                   step_chaos=step_chaos)
     if loss is None:
         # resumed a run whose final step is already checkpointed (or
         # steps == 0): report the loss AT the restored params on the last
@@ -568,20 +576,26 @@ class SequenceAlgorithm(PAlgorithm):
             if ctx and ctx.mesh is not None and ctx.mesh.devices.size > 1
             else None
         )
+        lifecycle = getattr(ctx, "lifecycle", None)
+        # explicit params win; otherwise run_train's per-instance dir
+        ckpt_dir = self.params.checkpoint_dir or (
+            lifecycle.checkpoint_dir if lifecycle is not None else ""
+        )
         ckpt = None
-        if self.params.checkpoint_dir:
+        if ckpt_dir:
             from pio_tpu.workflow.orbax_ckpt import (
                 StepCheckpointConfig,
                 StepCheckpointer,
             )
 
             ckpt = StepCheckpointer(StepCheckpointConfig(
-                self.params.checkpoint_dir,
+                ckpt_dir,
                 save_every=self.params.checkpoint_every,
             ))
         try:
             params, _, _ = train_sequence_model(
-                data, self.params, mesh, checkpoint=ckpt
+                data, self.params, mesh, checkpoint=ckpt,
+                lifecycle=lifecycle,
             )
         finally:
             if ckpt is not None:

@@ -144,12 +144,16 @@ def train_two_tower(
     p: TwoTowerParams,
     mesh: Mesh | None = None,
     checkpoint=None,
+    lifecycle=None,
 ) -> tuple[dict, jax.Array, Any]:
     """-> (params, item_embeddings matrix, towers). Sharded over the mesh
     when given; single-device jit otherwise. `checkpoint` is a
     StepCheckpointer (or None): training saves every save_every steps and
     resumes from the latest saved step with an identical batch stream
-    (sampling is keyed by (seed, step))."""
+    (sampling is keyed by (seed, step)). `lifecycle` is a
+    workflow.lifecycle.TrainLifecycle (or None): heartbeats every span
+    boundary, and a requested preemption force-saves the current step
+    then raises TrainingPreempted."""
     optimizer = optax.adam(p.learning_rate)
     train_step, towers = make_train_step(
         inter.n_users, inter.n_items, p, optimizer
@@ -222,13 +226,16 @@ def train_two_tower(
         max(1, checkpoint.config.save_every) if checkpoint is not None
         else None
     )
-    for lo, hi, save_after in span_bounds(start_step, p.steps, every):
+    from pio_tpu.workflow.spans import after_span, step_chaos_active
+
+    step_chaos = step_chaos_active()
+    for lo, hi, save_after in span_bounds(
+            start_step, p.steps, every, cap=1 if step_chaos else 512):
         uu, ii = batches_for(lo, hi)
         params, opt_state = span(params, opt_state, uu, ii)
-        if save_after:
-            # only save-eligible steps: maybe_save device_gets the full
-            # state, which a declined save would waste
-            checkpoint.maybe_save(hi - 1, params, opt_state)
+        after_span(hi, p.steps, params, opt_state, checkpoint=checkpoint,
+                   lifecycle=lifecycle, save_after=save_after,
+                   step_chaos=step_chaos)
 
     # materialize all item embeddings for serving
     item_ids = jnp.arange(inter.n_items, dtype=jnp.int32)
@@ -317,20 +324,27 @@ class TwoTowerAlgorithm(PAlgorithm):
     def train(self, ctx, inter: Interactions) -> TwoTowerModel:
         inter.sanity_check()
         mesh = ctx.mesh if ctx and ctx.mesh and ctx.mesh.devices.size > 1 else None
+        lifecycle = getattr(ctx, "lifecycle", None)
+        # explicit params win; otherwise run_train's per-instance dir
+        # (lifecycle.checkpoint_dir) makes every supervised run resumable
+        ckpt_dir = self.params.checkpoint_dir or (
+            lifecycle.checkpoint_dir if lifecycle is not None else ""
+        )
         ckpt = None
-        if self.params.checkpoint_dir:
+        if ckpt_dir:
             from pio_tpu.workflow.orbax_ckpt import (
                 StepCheckpointConfig,
                 StepCheckpointer,
             )
 
             ckpt = StepCheckpointer(StepCheckpointConfig(
-                self.params.checkpoint_dir,
+                ckpt_dir,
                 save_every=self.params.checkpoint_every,
             ))
         try:
             params, item_emb, _ = train_two_tower(
-                inter, self.params, mesh, checkpoint=ckpt
+                inter, self.params, mesh, checkpoint=ckpt,
+                lifecycle=lifecycle,
             )
         finally:
             if ckpt is not None:

@@ -120,6 +120,40 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def any_process(flag: bool) -> bool:
+    """OR-reduce a per-process boolean across all hosts (identity on a
+    single host). Used for the preemption flag: the scheduler may
+    SIGTERM only one host's VM, and a host that force-saved while its
+    peers kept training would deadlock the save barrier — every host
+    must agree to stop before any of them does. Collective: all
+    processes must call it at the same point (the trainers do, at span
+    boundaries)."""
+    if jax.process_count() <= 1:
+        return flag
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return bool(
+        multihost_utils.process_allgather(np.asarray([flag])).any()
+    )
+
+
+def barrier(name: str) -> None:
+    """Block until every process reaches this point (no-op single-host).
+
+    Used at checkpoint-save boundaries: every host contributes its
+    addressable shards to an orbax save, and process 0 must not record
+    the step as durable (metadata write, COMPLETED transition) until all
+    hosts have finished theirs — otherwise a preemption between hosts
+    leaves a checkpoint that restores on some meshes and not others.
+    """
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
 def runtime_info() -> dict:
     """Topology snapshot for `pio status` / logs."""
     return {

@@ -1,7 +1,9 @@
 """Deterministic fault injection (chaos) harness.
 
 Instrumented I/O boundaries call ``maybe_inject("storage.MEM.insert")``
-(and similar points: ``http.request``, ``serve.reload`` …); when a chaos
+(and similar points: ``http.request``, ``serve.reload``, and the
+training-lifecycle family ``train.step.<n>`` / ``train.checkpoint`` /
+``train.persist`` — see docs/training-fault-tolerance.md); when a chaos
 monkey is active and a spec matches the point, the call fails with a
 connection-reset-flavored error, stalls for a configured latency, or
 passes through — decided by a SEEDED RNG so a failing run replays
@@ -41,7 +43,7 @@ from dataclasses import dataclass, field
 
 __all__ = [
     "ChaosError", "ChaosMonkey", "ChaosReset", "ChaosSpec", "active",
-    "inject", "install", "maybe_inject", "uninstall",
+    "inject", "install", "maybe_inject", "uninstall", "watches",
 ]
 
 ENV_VAR = "PIO_TPU_CHAOS"
@@ -214,3 +216,22 @@ def maybe_inject(point: str) -> None:
     monkey = active()
     if monkey is not None:
         monkey.maybe(point)
+
+
+def watches(point: str) -> bool:
+    """True when an active spec could fire at `point` or any point under
+    it — i.e. the spec's target prefix-overlaps `point` in either
+    direction (a spec targeting ``train.step.42`` watches the
+    ``train.step`` family; so does a spec targeting ``train``). The
+    trainers use this to degrade their multi-step device spans to
+    per-step spans so a ``train.step.<n>`` fault lands at EXACTLY step n
+    — deterministic kill-at-step for the resume tests."""
+    monkey = active()
+    if monkey is None:
+        return False
+    return any(
+        spec.target == "*"
+        or spec.target.startswith(point)
+        or point.startswith(spec.target)
+        for spec in monkey.specs
+    )

@@ -212,9 +212,38 @@ def cmd_doctor(args) -> int:
             exit_code = 1
         report[name] = entry
 
+    # training-lifecycle sweep: kill -9'd runs leave INIT/TRAINING
+    # instances whose heartbeat went stale; report them (and, with
+    # --sweep-zombies, transition them to FAILED so they become
+    # explicitly resumable and can never starve deploy's
+    # get_latest_completed contract)
+    zombies: list[dict] = []
+    sweep_error = ""
+    try:
+        from pio_tpu.workflow.lifecycle import stale_instances, sweep_zombies
+
+        storage = get_storage()
+        stale_s = getattr(args, "zombie_stale_s", 600.0)
+        if getattr(args, "sweep_zombies", False):
+            found = sweep_zombies(storage, stale_after_s=stale_s)
+            action = "swept"
+        else:
+            found = stale_instances(storage, stale_after_s=stale_s)
+            action = "stale"
+        zombies = [
+            {"id": i.id, "status": i.status, "action": action,
+             "lastStep": (i.progress or {}).get("step"),
+             "heartbeat": (i.progress or {}).get("heartbeat")}
+            for i in found
+        ]
+    except Exception as e:  # noqa: BLE001 - doctor reports, never dies
+        sweep_error = f"{type(e).__name__}: {e}"
+
     chaos_spec = os.environ.get("PIO_TPU_CHAOS", "")
     if args.json:
-        out = {"surfaces": report}
+        out = {"surfaces": report, "zombies": zombies}
+        if sweep_error:
+            out["zombieSweepError"] = sweep_error
         if chaos_spec:
             out["chaos"] = chaos_spec
         print(json.dumps(out, indent=2))
@@ -234,6 +263,13 @@ def cmd_doctor(args) -> int:
             print(f"  [{ok}] {check}: {rest}")
         if not entry.get("ready") and "detail" in entry:
             print(f"  detail: {entry['detail']}")
+    if sweep_error:
+        print(f"[WARN] zombie check failed: {sweep_error}")
+    for z in zombies:
+        verb = ("swept to FAILED (resumable)" if z["action"] == "swept"
+                else "stale (run doctor --sweep-zombies to mark FAILED)")
+        print(f"zombie instance {z['id']} [{z['status']}] last step "
+              f"{z['lastStep']} heartbeat {z['heartbeat']}: {verb}")
     return exit_code
 
 
@@ -452,8 +488,11 @@ def cmd_build(args) -> int:
 
 def cmd_train(args) -> int:
     from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.lifecycle import EXIT_PREEMPTED, TrainingPreempted
     from pio_tpu.workflow.train import run_train
 
+    if args.resume and args.auto_resume:
+        return _fail("--resume and --auto-resume are mutually exclusive")
     variant = _load_variant(args.engine_dir)
     engine, ep = _engine_from_variant(variant, args.engine_dir)
     engine_id, engine_version, engine_variant = _engine_ids(
@@ -473,7 +512,17 @@ def cmd_train(args) -> int:
             ctx=ctx,
             stop_after_read=args.stop_after_read,
             stop_after_prepare=args.stop_after_prepare,
+            resume_instance_id=args.resume or None,
+            auto_resume=args.auto_resume,
+            checkpoint_root=args.checkpoint_root or None,
         )
+    except TrainingPreempted as e:
+        # preemption honored: checkpoint on disk, instance INTERRUPTED.
+        # EXIT_PREEMPTED (75, EX_TEMPFAIL) tells supervisors this run
+        # wants `pio train --resume` (or --auto-resume), not a bug report.
+        print(f"Training preempted ({e}); resume with: "
+              "pio train --auto-resume")
+        return EXIT_PREEMPTED
     except TrainingInterruption as e:
         # controlled debug stop (reference --stop-after-read/-prepare)
         print(f"Training interrupted: {e}")
@@ -895,6 +944,13 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--dashboard-port", type=int, default=9000)
     x.add_argument("--timeout", type=float, default=3.0)
     x.add_argument("--json", action="store_true")
+    x.add_argument("--sweep-zombies", action="store_true",
+                   help="transition INIT/TRAINING instances with stale "
+                        "heartbeats to FAILED (resumable) instead of "
+                        "just reporting them")
+    x.add_argument("--zombie-stale-s", type=float, default=600.0,
+                   help="heartbeat age (seconds) after which an "
+                        "in-flight instance counts as a zombie")
     x.set_defaults(fn=cmd_doctor)
 
     x = sub.add_parser("run")
@@ -973,6 +1029,17 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--no-mesh", action="store_true")
     x.add_argument("--stop-after-read", action="store_true")
     x.add_argument("--stop-after-prepare", action="store_true")
+    x.add_argument("--resume", default="", metavar="INSTANCE_ID",
+                   help="resume an INTERRUPTED/FAILED engine instance "
+                        "from its step checkpoints")
+    x.add_argument("--auto-resume", action="store_true",
+                   help="resume the most recent resumable instance of "
+                        "this engine (fresh run when none has "
+                        "checkpoints)")
+    x.add_argument("--checkpoint-root", default="",
+                   help="root for per-instance step-checkpoint dirs "
+                        "(default $PIO_TPU_CKPT_ROOT or "
+                        "$PIO_TPU_HOME/checkpoints)")
     x.set_defaults(fn=cmd_train)
 
     x = sub.add_parser("eval")

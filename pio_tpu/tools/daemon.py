@@ -120,9 +120,23 @@ def _healthy(service: Service, ip: str, timeout_s: float = 20.0,
     return False
 
 
-def _terminate(pid: int, grace_s: float = 10.0) -> None:
+def _terminate(pid: int, grace_s: float | None = None) -> None:
     """SIGTERM the process group (children lead their own sessions), wait,
-    escalate to SIGKILL."""
+    escalate to SIGKILL.
+
+    SIGTERM is a *graceful preemption* for training children: the
+    lifecycle handler (workflow/lifecycle.py) force-saves a checkpoint
+    at the next step boundary and exits resumable, so the grace window
+    must cover a checkpoint write — tune with PIO_TPU_STOP_GRACE_S
+    (default 10s) for large models or slow blob stores. Only after the
+    grace expires does SIGKILL make the run a zombie (still resumable:
+    the sweep marks it FAILED and its last cadence checkpoint survives).
+    """
+    if grace_s is None:
+        try:
+            grace_s = float(os.environ.get("PIO_TPU_STOP_GRACE_S", "10"))
+        except ValueError:
+            grace_s = 10.0
     try:
         os.killpg(pid, signal.SIGTERM)
     except (ProcessLookupError, PermissionError, OSError):

@@ -1,0 +1,188 @@
+"""Crash-consistent artifact persistence: CRC32C framing + atomic writes.
+
+The reference's model persistence inherits durability from its backends
+(HBase WAL, Postgres fsync); the localfs path (LocalFSModels.scala) has
+none — a crash mid-write leaves a truncated blob that deserialization
+happily misreads. This module is the shared durability floor for every
+file-shaped artifact this framework writes (model blobs, exported
+state):
+
+  * ``frame``/``unframe`` — a self-describing envelope
+    ``MAGIC | crc32c(payload) | len(payload) | payload`` so ANY storage
+    backend (file, SQL BLOB, wire) can detect truncation and bit-rot at
+    read time. Legacy (unframed) blobs pass through unverified, so
+    pre-existing stores keep working.
+  * ``durable_write`` — tmp file in the same directory + flush + fsync
+    + atomic ``os.replace`` + directory fsync: a reader sees either the
+    old complete file or the new complete file, never a prefix.
+  * ``durable_read`` — read + unframe; raises ``ModelIntegrityError``
+    with the offending path on any mismatch.
+
+CRC32C (Castagnoli) is computed by a table-based pure-Python routine —
+no external dependency, and the polynomial matches what GCS/HDFS record
+alongside objects, so checksums stay comparable if blobs ever move to
+such stores. The ``pio lint`` ``durable-write`` rule flags model/
+checkpoint artifact writers that bypass this module.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+
+class ModelIntegrityError(RuntimeError):
+    """A persisted artifact failed checksum/length verification.
+
+    Deliberately NOT a ConnectionError subclass: integrity failures are
+    permanent for that blob, so resilience retry predicates
+    (``is_transient``) must not retry them — callers fall back (serve
+    picks the previous COMPLETED instance) or fail loudly.
+    """
+
+
+# -- CRC32C (Castagnoli, polynomial 0x1EDC6F41, reflected 0x82F63B78) --------
+
+def _make_table() -> tuple[int, ...]:
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return tuple(table)
+
+
+_TABLE = _make_table()
+
+try:  # C-speed CRC32C when the wheel is present (GB/s vs the pure-
+    # Python table's ~MB/s — the fallback is correctness-equivalent but
+    # large model blobs want the accelerated path)
+    import google_crc32c as _gcrc32c
+except ImportError:  # pragma: no cover - depends on the image
+    _gcrc32c = None
+
+
+def crc32c(data: bytes, value: int = 0) -> int:
+    """CRC32C of ``data`` (optionally continuing from a prior value)."""
+    if _gcrc32c is not None:
+        return _gcrc32c.extend(value, data)
+    crc = value ^ 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# -- framing -----------------------------------------------------------------
+
+MAGIC = b"PIOD\x01"       # content frame (models_to_bytes & friends)
+WRAP_MAGIC = b"PIOW\x01"  # file wrapper durable_write adds to raw payloads
+_HEADER = struct.Struct(">5sIQ")  # magic, crc32c, payload length
+
+
+def frame(payload: bytes, magic: bytes = MAGIC) -> bytes:
+    """Envelope ``payload`` with magic + CRC32C + length."""
+    return _HEADER.pack(magic, crc32c(payload), len(payload)) + payload
+
+
+def is_framed(blob: bytes, magic: bytes = MAGIC) -> bool:
+    return blob[:len(magic)] == magic
+
+
+def unframe(blob: bytes, source: str = "", magic: bytes = MAGIC) -> bytes:
+    """Verify and strip a ``frame`` envelope; unframed (legacy) blobs
+    pass through untouched. Raises ModelIntegrityError on a framed blob
+    whose length or checksum does not match — a truncated or bit-rotted
+    artifact must never reach the deserializer."""
+    if not is_framed(blob, magic):
+        return blob
+    where = f" in {source}" if source else ""
+    if len(blob) < _HEADER.size:
+        raise ModelIntegrityError(
+            f"framed blob{where} truncated inside its header "
+            f"({len(blob)} bytes)"
+        )
+    _, want_crc, want_len = _HEADER.unpack_from(blob)
+    payload = blob[_HEADER.size:]
+    if len(payload) != want_len:
+        raise ModelIntegrityError(
+            f"framed blob{where} truncated: header promises {want_len} "
+            f"bytes, found {len(payload)}"
+        )
+    got = crc32c(payload)
+    if got != want_crc:
+        raise ModelIntegrityError(
+            f"framed blob{where} corrupt: crc32c {got:#010x} != recorded "
+            f"{want_crc:#010x}"
+        )
+    return payload
+
+
+# -- atomic file persistence -------------------------------------------------
+
+def durable_write(path: str, payload: bytes) -> None:
+    """Atomically persist ``payload`` at ``path`` with an integrity frame.
+
+    Write order: tmp file (same directory, so the rename cannot cross
+    filesystems) -> flush -> fsync -> ``os.replace`` -> fsync of the
+    directory entry. A crash at ANY point leaves either the previous
+    complete file or the new complete file; a torn write inside the tmp
+    file is additionally caught by the frame checksum at read time.
+
+    An already content-framed payload (``models_to_bytes`` output) is
+    written as-is — its own CRC protects the file, and re-framing would
+    double the checksum cost on multi-GB blobs. Raw payloads get the
+    ``WRAP_MAGIC`` wrapper, which ``durable_read`` strips so bytes
+    round-trip exactly in both cases.
+    """
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(
+        directory, f".{os.path.basename(path)}.tmp.{os.getpid()}"
+    )
+    data = payload if is_framed(payload) else frame(payload, WRAP_MAGIC)
+    try:
+        with open(tmp, "wb") as f:  # pio: lint-ok[durable-write] this IS
+            # durable_write: the tmp+fsync+rename implementation itself
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # never leave tmp litter behind a failed/interrupted write
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(directory)
+
+
+def durable_read(path: str) -> bytes:
+    """Read + verify a ``durable_write`` artifact, returning exactly the
+    bytes that were passed to ``durable_write``: the ``WRAP_MAGIC``
+    wrapper is verified and stripped; a content-framed (``MAGIC``) file
+    is verified and returned WITH its frame (the caller's deserializer
+    owns stripping it). Legacy unframed files pass through unverified
+    (back-compat with pre-durability stores)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if is_framed(data, WRAP_MAGIC):
+        return unframe(data, source=path, magic=WRAP_MAGIC)
+    if is_framed(data):
+        unframe(data, source=path)  # verify only; frame belongs to caller
+    return data
+
+
+def _fsync_dir(directory: str) -> None:
+    """fsync the directory so the rename itself is durable; best-effort
+    on platforms/filesystems that refuse O_RDONLY directory fds."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -21,6 +21,13 @@ from typing import Any
 import jax
 import numpy as np
 
+from pio_tpu.utils.durable import ModelIntegrityError, frame, unframe
+
+__all__ = [
+    "ModelIntegrityError", "host_copy", "models_from_bytes",
+    "models_to_bytes",
+]
+
 
 def _to_host(x: Any) -> Any:
     if isinstance(x, jax.Array):
@@ -34,10 +41,17 @@ def host_copy(model: Any) -> Any:
 
 
 def models_to_bytes(models: list[Any]) -> bytes:
+    """Pickle + CRC32C-frame (utils/durable.py): the checksum rides
+    INSIDE the blob, so every backend — file, SQL BLOB, wire — hands
+    `models_from_bytes` enough to detect truncation and bit-rot, not
+    just the localfs path with its own file-level durability."""
     buf = io.BytesIO()
     pickle.dump([host_copy(m) for m in models], buf, protocol=5)
-    return buf.getvalue()
+    return frame(buf.getvalue())
 
 
 def models_from_bytes(data: bytes) -> list[Any]:
-    return pickle.loads(data)
+    """Verify + unpickle. Raises ModelIntegrityError (NOT a pickle error
+    deep in a partial stream) when a framed blob fails its checksum;
+    legacy unframed blobs from pre-durability stores unpickle as before."""
+    return pickle.loads(unframe(data, source="model blob"))

@@ -25,6 +25,10 @@ class WorkflowContext:
     seed: int = 0
     batch: str = ""
     params: dict = field(default_factory=dict)  # runtime conf (sparkConf slot)
+    # training supervision handle (workflow/lifecycle.py TrainLifecycle):
+    # heartbeats, preemption checks, and the per-instance checkpoint dir.
+    # Set by run_train; None outside a supervised training run.
+    lifecycle: Any = None
 
     @property
     def event_store(self) -> EventStore:

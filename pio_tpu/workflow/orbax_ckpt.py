@@ -50,10 +50,52 @@ class StepCheckpointer:
     def maybe_save(self, step: int, params: Any, opt_state: Any) -> bool:
         """Save if the cadence says so (orbax enforces save_interval_steps).
         Arrays are pulled to host so the checkpoint is mesh-portable."""
+        return self._save(step, params, opt_state, force=False)
+
+    def save(self, step: int, params: Any, opt_state: Any) -> bool:
+        """Save unconditionally — the preemption path's final checkpoint
+        at the interrupted step, regardless of cadence."""
+        return self._save(step, params, opt_state, force=True)
+
+    def _save(self, step: int, params: Any, opt_state: Any,
+              force: bool) -> bool:
         import orbax.checkpoint as ocp
 
-        state = jax.device_get({"params": params, "opt_state": opt_state})
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+        from pio_tpu.resilience import chaos
+
+        save_error: Exception | None = None
+        saved = False
+        try:
+            # chaos point: a `train.checkpoint` spec simulates a
+            # checkpoint-write fault (full disk, flaky blobstore) —
+            # training must surface it, and a later resume must restore
+            # the PREVIOUS step
+            chaos.maybe_inject("train.checkpoint")
+            state = jax.device_get(
+                {"params": params, "opt_state": opt_state})
+            saved = self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=force
+            )
+            if saved and (force or jax.process_count() > 1):
+                # forced (preemption) saves are followed by process exit,
+                # and multi-host saves must not let any process run ahead
+                # of its peers' shard writes — both demand the save be
+                # durable NOW. Cadence saves on a single host stay async
+                # (orbax overlaps them with the next span; close() drains
+                # the tail).
+                self._mgr.wait_until_finished()
+        except Exception as e:  # noqa: BLE001 - re-raised after barrier
+            save_error = e
+        if (saved or save_error is not None) and (
+                force or jax.process_count() > 1):
+            # reached on success AND failure: a host whose save raised
+            # must not strand its peers in sync_global_devices
+            from pio_tpu.parallel.distributed import barrier
+
+            barrier(f"ckpt-save-{step}")
+        if save_error is not None:
+            raise save_error
+        return saved
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

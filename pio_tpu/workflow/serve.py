@@ -46,6 +46,7 @@ from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
 )
 from pio_tpu.server.plugins import PluginContext
+from pio_tpu.utils.durable import ModelIntegrityError
 from pio_tpu.utils.time import format_time, utcnow
 from pio_tpu.utils.tracing import Tracer
 from pio_tpu.workflow.context import WorkflowContext, create_workflow_context
@@ -186,10 +187,10 @@ class QueryServer:
         c = self.config
         instances = self.storage.get_metadata_engine_instances()
         if instance_id is None:
-            instance = instances.get_latest_completed(
+            candidates = instances.get_completed(
                 c.engine_id, c.engine_version, c.engine_variant
             )
-            if instance is None:
+            if not candidates:
                 raise ValueError(
                     f"No COMPLETED engine instance found for engine "
                     f"{c.engine_id} {c.engine_version} {c.engine_variant}. "
@@ -199,12 +200,32 @@ class QueryServer:
             instance = instances.get(instance_id)
             if instance is None:
                 raise ValueError(f"Engine instance {instance_id} not found")
+            candidates = [instance]
         # restore OUTSIDE the lock: queries keep serving the old model
-        # while the new one loads (restore can take seconds on big models)
-        models = load_models(
-            self.storage, self.engine, self.engine_params, instance.id,
-            ctx=self.ctx,
-        )
+        # while the new one loads (restore can take seconds on big models).
+        # A corrupt blob (CRC32C mismatch — torn write, bit rot) on the
+        # latest instance falls back to the previous COMPLETED one:
+        # integrity failures are permanent for that blob, and an older
+        # good model beats no model. Explicit instance_ids do not fall
+        # back — the operator asked for THAT instance.
+        models = instance = None
+        last_integrity_error: ModelIntegrityError | None = None
+        for candidate in candidates:
+            try:
+                models = load_models(
+                    self.storage, self.engine, self.engine_params,
+                    candidate.id, ctx=self.ctx,
+                )
+                instance = candidate
+                break
+            except ModelIntegrityError as e:
+                log.error(
+                    "model blob for instance %s is corrupt (%s); trying "
+                    "the previous COMPLETED instance", candidate.id, e,
+                )
+                last_integrity_error = e
+        if models is None:
+            raise last_integrity_error
         _, _, algorithms, serving = self.engine._doers(self.engine_params)
         with self._lock:
             # hot-swap: retire the outgoing doers' resources (e.g. an

@@ -42,3 +42,54 @@ def span_bounds(start: int, steps: int, save_every: int | None,
             save_every is not None and (e - 1) % save_every == 0
         )
         s = e
+
+
+def step_chaos_active() -> bool:
+    """True when a `train.step` chaos spec is live: trainers then degrade
+    their spans to single steps (cap=1) so a `train.step.<n>` fault fires
+    at EXACTLY step n — deterministic kill-at-step for the resume tests.
+    Zero-cost when chaos is off (one module-global read)."""
+    from pio_tpu.resilience import chaos
+
+    return chaos.watches("train.step")
+
+
+def after_span(
+    hi: int,
+    total_steps: int,
+    params,
+    opt_state,
+    *,
+    checkpoint,
+    lifecycle,
+    save_after: bool,
+    step_chaos: bool,
+) -> None:
+    """Shared span-boundary bookkeeping for the iterative trainers
+    (models/twotower.py, models/sequence.py) — one implementation so the
+    chaos/save/preemption ordering cannot drift between them:
+
+      1. `train.step.<hi-1>` chaos point (the kill-at-step hook);
+      2. cadence save (only save-eligible steps reach maybe_save — it
+         device_gets the full state, which a declined save would waste);
+      3. preemption: force-save the current step when it is off-cadence,
+         then raise TrainingPreempted (via lifecycle.check_preemption).
+         Multi-host, the flag is OR-reduced across processes FIRST — a
+         SIGTERM often lands on one host only, and a lone force-saver
+         would strand its peers at the save barrier;
+      4. heartbeat.
+    """
+    if step_chaos:
+        from pio_tpu.resilience import chaos
+
+        chaos.maybe_inject(f"train.step.{hi - 1}")
+    if save_after:
+        checkpoint.maybe_save(hi - 1, params, opt_state)
+    if lifecycle is not None:
+        from pio_tpu.parallel.distributed import any_process
+
+        if any_process(lifecycle.preempted()):
+            if checkpoint is not None and not save_after:
+                checkpoint.save(hi - 1, params, opt_state)
+            lifecycle.check_preemption(hi - 1, force=True)  # raises
+        lifecycle.heartbeat(hi - 1, total_steps)

@@ -364,6 +364,47 @@ def test_sleep_without_transport_handler_silent():
     assert fs == []
 
 
+def test_durable_write_model_artifact_fires():
+    fs = lint("""
+        def save(root, blob):
+            with open(root + "/pio_model_x.bin", "wb") as f:
+                f.write(blob)
+    """, select=["durable-write"])
+    assert [f.rule for f in fs] == ["durable-write"]
+
+
+def test_durable_write_checkpoint_mode_kw_fires():
+    fs = lint("""
+        def save(checkpoint_path, blob):
+            f = open(checkpoint_path, mode="ab")
+            f.write(blob)
+    """, select=["durable-write"])
+    assert [f.rule for f in fs] == ["durable-write"]
+
+
+def test_durable_write_non_artifact_and_text_silent():
+    fs = lint("""
+        def save(path, blob, model):
+            with open(path + "/notes.bin", "wb") as f:   # not an artifact
+                f.write(blob)
+            with open(path + "/model.json", "w") as f:   # text mode
+                f.write("{}")
+            with open(path + "/model.bin", "rb") as f:   # read
+                return f.read()
+    """, select=["durable-write"])
+    assert fs == []
+
+
+def test_durable_write_suppressible():
+    fs = lint("""
+        def save(path, blob):
+            # pio: lint-ok[durable-write] scratch checkpoint, torn ok
+            with open(path + "/ckpt.tmp", "wb") as f:
+                f.write(blob)
+    """, select=["durable-write"])
+    assert fs == []
+
+
 # -- bench hygiene ----------------------------------------------------------
 
 def test_time_time_fires():
