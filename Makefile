@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: lint test check
+.PHONY: lint test check bench-smoke
 
 lint:
 	$(PY) -m pio_tpu.tools.cli lint pio_tpu/ tests/ bench.py eval/ examples/
@@ -14,4 +14,10 @@ test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-check: lint test
+# CPU-stable perf gate: ingest events/s + serving p50 vs BASELINE.json
+# published.smoke, +-20% (PIO_SMOKE_TOL). Regressions exit 1.
+# Refresh the baseline with: python bench.py --smoke --update-baseline
+bench-smoke:
+	$(PY) bench.py --smoke
+
+check: lint test bench-smoke

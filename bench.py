@@ -201,10 +201,12 @@ def run_als(users, items, vals, iters: int,
             rank: int = None, chunk: int = None, repeats: int = 3,
             layouts=None) -> float | None:
     """-> best wall seconds for `iters` sweeps over `repeats` runs, compile
-    excluded (the warm-up runs the exact same program: iterations is a
-    static scan length), or None when repeats<=0 (warm-up/compile-only
-    mode — not a measurement). Best-of-N because the tunneled device shows
-    +-0.3s run-to-run noise that would otherwise swamp per-sweep deltas.
+    excluded (the pre-timing call runs the exact same program: iterations
+    is a static scan length), or None when repeats<=0 (compile-only mode —
+    not a measurement; programs are usually pre-compiled shape-abstract
+    via als_warm_compile instead). Best-of-N because the tunneled device
+    shows +-0.3s run-to-run noise that would otherwise swamp per-sweep
+    deltas.
     With `layouts` (ops/als.py ALSLayouts) the runs measure the RETRAIN
     path: slot layouts resident in HBM, no per-call rebuild."""
     from pio_tpu.ops.als import als_train
@@ -226,7 +228,7 @@ def run_als(users, items, vals, iters: int,
         return float(jnp.sum(model.user_factors))
 
     go()  # compile (identical program: same static iterations)
-    if repeats <= 0:      # warm-up/compile-only mode: not a measurement —
+    if repeats <= 0:      # compile-only mode: not a measurement —
         return None       # never let inf masquerade as a timing
     best = float("inf")
     for _ in range(repeats):
@@ -254,6 +256,13 @@ def phase_train() -> dict:
     # train-phase stall
     trail = StageWriter(os.environ.get("PIO_PROBE_PROGRESS"))
     trail.stage("train_start", pid=os.getpid())
+    # persistent XLA compile cache (utils/compilecache.py): the SECOND
+    # bench/train run deserializes the warm-up programs instead of
+    # re-running XLA — the probe records hit/miss so the warmup_compile_
+    # sec trajectory is legible (cold ~14.6s on the r05 CPU rig)
+    from pio_tpu.utils.compilecache import CacheProbe
+
+    cache_probe = CacheProbe()
     from pio_tpu.ops.als import ALSParams
 
     trail.stage("imports_done")
@@ -293,33 +302,30 @@ def phase_train() -> dict:
     float(jnp.sum(jax.device_put(np.ones(8))))  # backend up
     trail.stage("backend_up")
 
-    from pio_tpu.ops.als import als_build_layouts
+    from pio_tpu.ops.als import als_build_layouts, als_warm_compile
 
     # ---- cold-start overlap: warm-up compiles run WHILE the COO columns
     # are in flight. The compile of the layout+train programs (~20-40 s
     # through the tunnel, milliseconds of dispatch to start) completely
     # hides the ~4 s transfer, so a cold first train pays
-    # max(compile, transfer), not their sum. Warm-up runs on
-    # device-created zeros of the exact padded shapes (no host bytes).
+    # max(compile, transfer), not their sum. Warm-up is AOT
+    # (als_warm_compile: abstract shapes through .lower().compile()) —
+    # rounds 1-5 EXECUTED the programs on zero-filled arrays to reach the
+    # same compiles, burning ~the sweep cost in pointless device math;
+    # compile-only warm-up also makes warmup_compile_sec the clean number
+    # the persistent compile cache shrinks (a warm restart deserializes
+    # instead of re-running XLA; see extra.train.compile_cache).
     t_put = time.monotonic()
     dev = [jax.device_put(x) for x in host]          # async
-    nnz_pad0 = nnz + (-nnz % max(1, CHUNK))
-    zu = jnp.zeros((nnz_pad0,), jnp.int32)
-    zi = jnp.zeros((nnz_pad0,), jnp.int32)
-    zv = jnp.zeros((nnz_pad0,), jnp.float32)
-    p_w = bench_params(iters)
-    warm_lay = als_build_layouts(zu, zi, zv, n_users, n_items, p_w)
-    run_als(zu, zi, zv, iters, n_users=n_users, n_items=n_items,
-            layouts=warm_lay, repeats=0)
-    run_als(zu, zi, zv, 1, n_users=n_users, n_items=n_items,
-            layouts=warm_lay, repeats=0)
     # pre-warm the fence expression at the real columns' shapes/dtypes so
     # its own compile doesn't pollute the exposed-transfer measurement
     fz = [jnp.zeros(h.shape, h.dtype) for h in host]
     float(jnp.sum(fz[0]) + jnp.sum(fz[1])
           + jnp.sum(fz[2].astype(jnp.float32)))
+    als_warm_compile(nnz, n_users, n_items, bench_params(iters),
+                     sweep_lengths=(iters, 1))
     warm_s = time.monotonic() - t_put
-    del warm_lay, zu, zi, zv, fz
+    del fz
     # fence: scalar readback touching ALL THREE columns — device_put is
     # async and a fence on one array creates no dependency on the others
     float(jnp.sum(dev[0]) + jnp.sum(dev[1])
@@ -399,12 +405,17 @@ def phase_train() -> dict:
         if hbm_bound_sweep_s and split_ok else None
     return {
         "rate": rate,
+        "compile_cache": cache_probe.report(),
         "retrain_rate": round(retrain_rate, 1),
         "wall_sec": round(dt + transfer_s + layout_s, 3),
         "nnz": nnz,
         "sweeps": iters,
         "transfer_sec": round(transfer_s, 3),
         "exposed_transfer_after_overlap_sec": round(exposed_transfer_s, 3),
+        # COMPILE-only since round 6 (AOT warm-up): rounds 1-5 folded the
+        # zero-data warm executions in, so this number dropped ~2x by
+        # construction — compare compile_cache.status across runs for the
+        # persistent-cache effect
         "warmup_compile_sec": round(warm_s, 3),
         # DIRECTLY measured now (als_build_layouts, persisted across the
         # timed retrain runs) — rounds 1-3 inferred it from the
@@ -775,12 +786,116 @@ def _ingest_once(env: dict) -> dict:
         srv.stop()
 
 
+def phase_smoke() -> dict:
+    """CPU-stable micro-bench for the CI perf gate (`make bench-smoke`):
+    Python-pipeline ingest events/s + serving p50 with a tiny model.
+    Deliberately avoids the TPU probe, the native eventlog, and the
+    concurrent-tail machinery — only metrics that are stable on a loaded
+    CI box, compared against BASELINE.json published.smoke with a
+    tolerance band so perf regressions fail PRs instead of surfacing in
+    round reviews."""
+    import urllib.request
+
+    import numpy as np
+
+    out: dict = {}
+    # best-of-3 reps throughout: a scheduler stall or GC pause on a
+    # loaded CI box halves a single rep; the best rep is the stable
+    # capability number a 2x-class regression gate needs
+    ingest_reps = [
+        _ingest_once({
+            "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        })
+        for _ in range(3)
+    ]
+    out["ingest_events_per_sec"] = max(
+        r["events_per_sec"] for r in ingest_reps)
+    out["ingest_events_per_sec_sequential"] = max(
+        r["events_per_sec_sequential"] for r in ingest_reps)
+
+    from pio_tpu.controller import EngineParams
+    from pio_tpu.data import DataMap, Event
+    from pio_tpu.data.dao import App
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+    from pio_tpu.workflow.train import run_train
+
+    n_users, n_items, n_events = 200, 60, 2_000
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    app_id = storage.get_metadata_apps().insert(App(0, "smokeapp"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    uu = rng.integers(0, n_users, n_events)
+    ii = rng.integers(0, n_items, n_events)
+    ev.insert_batch([
+        Event(event="rate", entity_type="user", entity_id=f"u{uu[m]}",
+              target_entity_type="item", target_entity_id=f"i{ii[m]}",
+              properties=DataMap({"rating": int(rng.integers(1, 6))}))
+        for m in range(n_events)
+    ], app_id)
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="smokeapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=16, num_iterations=3, lambda_=0.05, chunk=2048))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    run_train(engine, ep, storage, engine_id="smoke", ctx=ctx)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="smoke",
+                      backend="async",
+                      warm_query={"user": "u0", "num": 10}),
+        ctx=ctx,
+    )
+    http.start()
+    try:
+        def one_rep() -> float:
+            lat = []
+            for r in range(120):
+                q = json.dumps(
+                    {"user": f"u{r % n_users}", "num": 10}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http.port}/queries.json", data=q,
+                    method="POST")
+                t0 = time.monotonic()
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                if r >= 20:
+                    lat.append(time.monotonic() - t0)
+            lat.sort()
+            return lat[len(lat) // 2] * 1e3
+
+        # best-of-3 p50: a scheduler stall on a loaded CI box can double
+        # a single rep's median; the BEST rep is the stable capability
+        # number a regression gate needs
+        out["serving_p50_ms"] = round(min(one_rep() for _ in range(3)), 3)
+    finally:
+        http.stop()
+        qs.close()
+    return out
+
+
 PHASES = {
     "probe": phase_probe,
     "train": phase_train,
     "cpu": phase_cpu,
     "serving": phase_serving,
     "ingest": phase_ingest,
+    "smoke": phase_smoke,
 }
 
 
@@ -970,6 +1085,70 @@ def snapshot_main() -> int:
     return 0
 
 
+def smoke_main() -> int:
+    """`python bench.py --smoke` — the CI perf gate. Runs phase_smoke in
+    a CPU subprocess and compares the CPU-stable metrics against
+    BASELINE.json's published.smoke block with a +-PIO_SMOKE_TOL band
+    (default 0.20): ingest must not be > tol slower, serving p50 not
+    > tol higher. rc 1 on regression so the gate fails PRs.
+    --update-baseline rewrites the block from this run."""
+    tol = float(os.environ.get("PIO_SMOKE_TOL", "0.20"))
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BASELINE.json")
+    res, err = run_phase("smoke", 900, CPU_ENV)
+    if res is None:
+        print(json.dumps({"smoke": "error", "error": err}))
+        return 1
+    with open(baseline_path) as f:
+        baseline_doc = json.load(f)
+    if "--update-baseline" in sys.argv:
+        # MERGE into the block: extra keys (the committed floors carry a
+        # methodology note explaining they are deliberate conservative
+        # floors, not point measurements) must survive a refresh
+        block = baseline_doc.setdefault("published", {}).setdefault(
+            "smoke", {})
+        block.update(
+            ingest_events_per_sec=res["ingest_events_per_sec"],
+            serving_p50_ms=res["serving_p50_ms"],
+        )
+        with open(baseline_path, "w") as f:
+            json.dump(baseline_doc, f, indent=2)
+            f.write("\n")
+        print(json.dumps({
+            "smoke": "baseline-updated", "measured": res,
+            "warning": "values are now THIS rig's point measurements — "
+                       "see the block's note about conservative floors "
+                       "before committing them"}))
+        return 0
+    base = (baseline_doc.get("published") or {}).get("smoke")
+    if not base:
+        print(json.dumps({
+            "smoke": "no-baseline", "measured": res,
+            "hint": "run `python bench.py --smoke --update-baseline`"}))
+        return 1
+    checks = {
+        "ingest_events_per_sec": (
+            res["ingest_events_per_sec"],
+            base["ingest_events_per_sec"],
+            res["ingest_events_per_sec"]
+            >= base["ingest_events_per_sec"] * (1 - tol)),
+        "serving_p50_ms": (
+            res["serving_p50_ms"], base["serving_p50_ms"],
+            res["serving_p50_ms"] <= base["serving_p50_ms"] * (1 + tol)),
+    }
+    ok = all(passed for _, _, passed in checks.values())
+    print(json.dumps({
+        "smoke": "pass" if ok else "FAIL",
+        "tolerance": tol,
+        "checks": {
+            k: {"measured": m, "baseline": b, "ok": passed}
+            for k, (m, b, passed) in checks.items()
+        },
+        "extra": res,
+    }))
+    return 0 if ok else 1
+
+
 def main() -> int:
     errors: dict[str, str] = {}
     extra: dict = {"errors": errors, "small": SMALL}
@@ -1002,7 +1181,7 @@ def main() -> int:
                 k: train[k] for k in
                 ("retrain_rate", "wall_sec", "nnz", "sweeps",
                  "transfer_sec", "exposed_transfer_after_overlap_sec",
-                 "warmup_compile_sec", "fixed_layout_sec",
+                 "warmup_compile_sec", "compile_cache", "fixed_layout_sec",
                  "retrain_residual_sec",
                  "per_sweep_sec", "per_sweep_rate", "flops_per_sweep",
                  "flops_per_sec", "mfu_vs_bf16_peak",
@@ -1069,4 +1248,6 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--snapshot" in sys.argv:
         sys.exit(snapshot_main())
+    if "--smoke" in sys.argv:
+        sys.exit(smoke_main())
     sys.exit(main())

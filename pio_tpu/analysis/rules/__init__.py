@@ -8,7 +8,9 @@ Each rule object exposes:
 
 from __future__ import annotations
 
-from pio_tpu.analysis.rules.bench_hygiene import BenchHygieneRule
+from pio_tpu.analysis.rules.bench_hygiene import (
+    BenchHygieneRule, HotLoopAllocRule,
+)
 from pio_tpu.analysis.rules.concurrency import ConcurrencyRule
 from pio_tpu.analysis.rules.shard_spec import ShardSpecRule
 from pio_tpu.analysis.rules.trace_purity import TracePurityRule
@@ -19,6 +21,7 @@ ALL_RULES = [
     ShardSpecRule(),
     ConcurrencyRule(),
     BenchHygieneRule(),
+    HotLoopAllocRule(),
     WorkflowContractRule(),
 ]
 

@@ -1,4 +1,5 @@
-"""Benchmark-hygiene rules: timing that measures the wrong thing.
+"""Benchmark-hygiene rules: timing that measures the wrong thing, and
+per-event allocation in data-plane hot loops.
 
   * `bench-clock` — `time.time()` for duration measurement: the wall
     clock is not monotonic (NTP slews it mid-measurement) and has coarse
@@ -11,6 +12,14 @@
     so the "measurement" is the dispatch overhead — exactly the bug this
     repo's own BENCH history records (bench.py round-1/2 postmortem:
     timings that were silently dispatch times).
+  * `hot-loop-alloc` — per-event `json.loads`/`Event(...)`/
+    `Event.from_api_dict`/`DataMap.from_json` construction inside a
+    `for`/`while` loop in the data plane (`pio_tpu/data/`,
+    `pio_tpu/server/`): the row-at-a-time deserialization the columnar
+    path (data/columnar.py) exists to eliminate — BENCH_r05 measured it
+    at 2.7x the ingest cost of the native path. Use the columnar
+    batch/decode APIs, or justify the row fallback with
+    `# pio: lint-ok[hot-loop-alloc] <why>`.
 
 Timed regions are matched structurally: `t = <clock>()` ... any later
 statement in the same suite containing `<clock>() - t`. Helper calls are
@@ -166,3 +175,57 @@ class BenchHygieneRule:
             return True
         return (isinstance(node.func, ast.Attribute)
                 and node.func.attr in _SYNC_ATTRS)
+
+
+# per-event constructors the data plane must not run row-at-a-time
+_HOT_ALLOC_CALLS = frozenset({
+    "json.loads",
+    "pio_tpu.data.event.Event",
+    "pio_tpu.data.event.Event.from_api_dict",
+    "pio_tpu.data.event.Event.from_json",
+    "pio_tpu.data.datamap.DataMap.from_json",
+    "pio_tpu.data.backends.wire.event_from_wire",
+})
+# data-plane path fragments the rule applies to (normalized separators)
+_HOT_PATHS = ("pio_tpu/data/", "pio_tpu/server/")
+
+
+class HotLoopAllocRule:
+    """`hot-loop-alloc`: flag per-event decode/construction inside
+    explicit `for`/`while` loops in the data plane. Scoped by path so
+    engine templates, tests, and tools keep their readable row loops;
+    inside `pio_tpu/data/` and `pio_tpu/server/` every row loop is
+    either the documented fallback (suppress with a justification) or a
+    regression against the columnar path."""
+
+    id = "bench"
+    ids = ("hot-loop-alloc",)
+
+    def check(self, ctx: ModuleContext):
+        path = ctx.path.replace("\\", "/")
+        if not any(p in path for p in _HOT_PATHS):
+            return
+        seen: set[tuple[int, int]] = set()  # nested loops: flag once
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (node.lineno, node.col_offset) in seen:
+                    continue
+                name = ctx.imports.canonical(node.func)
+                if name not in _HOT_ALLOC_CALLS:
+                    continue
+                seen.add((node.lineno, node.col_offset))
+                short = name.rsplit(".", 2)[-1] if name != "json.loads" \
+                    else "json.loads"
+                yield Finding(
+                    "hot-loop-alloc", Severity.WARNING, ctx.path,
+                    node.lineno, node.col_offset,
+                    f"per-event {short}() inside a data-plane loop: "
+                    "row-at-a-time deserialization is the ingest/training "
+                    "bottleneck the columnar path removes — use "
+                    "data/columnar.py (decode_api_batch / find_columnar "
+                    "/ insert_batch), or justify the row fallback with "
+                    "# pio: lint-ok[hot-loop-alloc]")

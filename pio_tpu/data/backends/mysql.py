@@ -111,6 +111,12 @@ class _MyDb:
                 return None
             raise
 
+    def exec_many(self, sql: str, params_seq: list[tuple]) -> None:
+        # text protocol: statements run one by one; batching still saves
+        # the per-event DAO/resilience round trips at the caller
+        for params in params_seq:
+            self._pool.execute(sql, params)
+
     def try_exec(self, sql: str, params: tuple = ()) -> bool:
         try:
             self.exec(sql, params)

@@ -104,6 +104,14 @@ class _PgDb:
                 return None
             raise
 
+    def exec_many(self, sql: str, params_seq: list[tuple]) -> None:
+        # the extended-protocol client has no batch bind; the win over the
+        # default per-event DAO loop is one statement + one connection
+        # checkout for the batch (and one resilience guard at the caller)
+        dollars = qmark_to_dollar(sql)
+        for params in params_seq:
+            self._pool.execute(dollars, params)
+
     def try_exec(self, sql: str, params: tuple = ()) -> bool:
         try:
             self.exec(sql, params)

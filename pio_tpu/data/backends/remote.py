@@ -403,6 +403,9 @@ class _RemoteEvents(_Remote, d.EventsDAO):
                         query=q(FIND_PAGE, boundary_t, sorted(boundary_ids)),
                     )
                     for r in rows:
+                        # pio: lint-ok[hot-loop-alloc] find()'s contract
+                        # IS Event objects — the columnar training path
+                        # is the columnarize RPC, which never pages here
                         e = w.event_from_wire(r)
                         if (e.event_time == boundary_t
                                 and e.event_id in boundary_ids):

@@ -68,6 +68,12 @@ class SqlDb(Protocol):
         explicit inserts; sqlite rowid allocation does — no-op there)."""
         ...
 
+    def exec_many(self, sql: str, params_seq: list[tuple]) -> None:
+        """Run one write statement over a parameter batch (sqlite:
+        executemany + ONE commit instead of a commit per row; wire
+        dialects: one connection checkout for the loop)."""
+        ...
+
 
 def _dt(s: str | None) -> datetime | None:
     return parse_time(s) if s else None
@@ -456,16 +462,107 @@ class SqlEvents(d.EventsDAO):
                  "pr_id", "creation_time"),
                 self._events_conflict,
             ),
-            (
-                eid, app_id, channel_id, event.event, event.entity_type,
-                event.entity_id, event.target_entity_type,
-                event.target_entity_id, event.properties.to_json(),
-                format_time(event.event_time), millis(event.event_time),
-                json.dumps(list(event.tags)), event.pr_id,
-                format_time(event.creation_time),
-            ),
+            self._insert_row(event, eid, app_id, channel_id),
         )
         return eid
+
+    def _insert_row(self, event: Event, eid: str, app_id, channel_id) -> tuple:
+        return (
+            eid, app_id, channel_id, event.event, event.entity_type,
+            event.entity_id, event.target_entity_type,
+            event.target_entity_id, event.properties.to_json(),
+            format_time(event.event_time), millis(event.event_time),
+            json.dumps(list(event.tags)), event.pr_id,
+            format_time(event.creation_time),
+        )
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        """Bulk upsert: one namespace check + one exec_many for the whole
+        batch (the default loop pays a namespace probe and a COMMIT per
+        event — the dominant cost of sqlite ingest)."""
+        self._check_ns(app_id, channel_id)
+        ids = [e.event_id or new_event_id() for e in events]
+        sql = self.db.upsert_sql(
+            "events",
+            ("id", "app_id", "channel_id", "event", "entity_type",
+             "entity_id", "target_entity_type", "target_entity_id",
+             "properties", "event_time", "event_time_ms", "tags",
+             "pr_id", "creation_time"),
+            self._events_conflict,
+        )
+        self.db.exec_many(sql, [
+            self._insert_row(e, eid, app_id, channel_id)
+            for e, eid in zip(events, ids)
+        ])
+        return ids
+
+    def _where_filters(
+        self, app_id, channel_id, start_time, until_time, entity_type,
+        entity_id, event_names, target_entity_type, target_entity_id,
+    ) -> tuple[str, list]:
+        """The events WHERE clause both read paths share. ONE builder by
+        design: find_columnar's parity guarantee ('row order matches
+        find(limit=-1)') is structural only while the filters cannot
+        drift."""
+        ns = self.db.nullsafe
+        sql = f" WHERE app_id=? AND channel_id {ns} ?"
+        params: list = [app_id, channel_id]
+        if start_time is not None:
+            sql += " AND event_time_ms >= ?"
+            params.append(millis(start_time))
+        if until_time is not None:
+            sql += " AND event_time_ms < ?"
+            params.append(millis(until_time))
+        if entity_type is not None:
+            sql += " AND entity_type = ?"
+            params.append(entity_type)
+        if entity_id is not None:
+            sql += " AND entity_id = ?"
+            params.append(entity_id)
+        if event_names is not None:
+            sql += f" AND event IN ({','.join('?' * len(event_names))})"
+            params.extend(event_names)
+        if target_entity_type is not ...:
+            if target_entity_type is None:
+                sql += " AND target_entity_type IS NULL"
+            else:
+                sql += " AND target_entity_type = ?"
+                params.append(target_entity_type)
+        if target_entity_id is not ...:
+            if target_entity_id is None:
+                sql += " AND target_entity_id IS NULL"
+            else:
+                sql += " AND target_entity_id = ?"
+                params.append(target_entity_id)
+        return sql, params
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+    ):
+        """Columnar bulk read straight from SQL rows: only the four
+        columns the training folds touch are decoded (one fixed-layout
+        ISO timestamp parse per row; property JSON rides as a lazy raw
+        sidecar) — no Event/DataMap objects, no tags/prId/creationTime
+        parsing. Same WHERE builder and ordering as find(limit=-1), so
+        fold tie-breaking is identical to the row path on this backend."""
+        from pio_tpu.data.columnar import ColumnarEvents
+
+        self._check_ns(app_id, channel_id)
+        where, params = self._where_filters(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+        sql = ("SELECT event, entity_id, target_entity_id, event_time, "
+               f"properties FROM events{where} ORDER BY event_time_ms ASC")
+        return ColumnarEvents.from_rows(self.db.query(sql, tuple(params)))
 
     def _from_row(self, r) -> Event:
         return Event(
@@ -511,39 +608,10 @@ class SqlEvents(d.EventsDAO):
         reversed: bool = False,
     ) -> Iterator[Event]:
         self._check_ns(app_id, channel_id)
-        ns = self.db.nullsafe
-        sql = (
-            f"SELECT {EVENT_COLS} FROM events "
-            f"WHERE app_id=? AND channel_id {ns} ?"
-        )
-        params: list = [app_id, channel_id]
-        if start_time is not None:
-            sql += " AND event_time_ms >= ?"
-            params.append(millis(start_time))
-        if until_time is not None:
-            sql += " AND event_time_ms < ?"
-            params.append(millis(until_time))
-        if entity_type is not None:
-            sql += " AND entity_type = ?"
-            params.append(entity_type)
-        if entity_id is not None:
-            sql += " AND entity_id = ?"
-            params.append(entity_id)
-        if event_names is not None:
-            sql += f" AND event IN ({','.join('?' * len(event_names))})"
-            params.extend(event_names)
-        if target_entity_type is not ...:
-            if target_entity_type is None:
-                sql += " AND target_entity_type IS NULL"
-            else:
-                sql += " AND target_entity_type = ?"
-                params.append(target_entity_type)
-        if target_entity_id is not ...:
-            if target_entity_id is None:
-                sql += " AND target_entity_id IS NULL"
-            else:
-                sql += " AND target_entity_id = ?"
-                params.append(target_entity_id)
+        where, params = self._where_filters(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+        sql = f"SELECT {EVENT_COLS} FROM events{where}"
         # push ordering + paging into SQL so the serve path stays O(limit)
         sql += f" ORDER BY event_time_ms {'DESC' if reversed else 'ASC'}"
         if limit is None:

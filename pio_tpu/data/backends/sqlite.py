@@ -95,6 +95,14 @@ class _SqliteDb:
         except sqlite3.IntegrityError:
             return None
 
+    def exec_many(self, sql: str, params_seq: list[tuple]) -> None:
+        # one executemany + ONE commit: per-row commits are the dominant
+        # cost of sqlite ingest (each is an fsync in non-WAL journals and
+        # a WAL frame flush here)
+        with self._lock:
+            self._conn.executemany(sql, params_seq)
+            self._conn.commit()
+
     def try_exec(self, sql: str, params: tuple = ()) -> bool:
         try:
             self.exec(sql, params)

@@ -341,7 +341,73 @@ class EventsDAO(abc.ABC):
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: int | None = None
     ) -> list[str]:
+        """Insert a batch, returning ids in input order. Default = per-event
+        loop; backends override with bulk appends (one lock hold / one
+        transaction / one RPC) — the ingest hot path calls THIS, so the
+        override is what turns N guarded inserts into one."""
         return [self.insert(e, app_id, channel_id) for e in events]
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+    ):
+        """Bulk read as struct-of-arrays columns (data/columnar.py) — the
+        training-path alternative to ``find``'s per-event objects.
+        Default adapts ``find``; backends whose storage is already
+        row/columnar (SQL) override to decode straight from rows."""
+        from pio_tpu.data.columnar import ColumnarEvents
+
+        return ColumnarEvents.from_events(self.find(
+            app_id=app_id, channel_id=channel_id,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=-1,
+        ))
+
+    def columnarize(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        value_key: str | None = "rating",
+        default_value: float = 1.0,
+        dedup: str = "last",
+        value_event: str | None = None,
+    ):
+        """Training read -> COO interaction columns (native.eventlog
+        ``Columns``). Default: ``find_columnar`` + the vectorized fold —
+        bit-identical to the find+fold row path but without per-event
+        Python objects.  The eventlog backend overrides with its one-sweep
+        C++ columnarizer, remote/sharded with the server-side RPC; this
+        default is what extends the columnar path to every LOCAL backend
+        (memory/SQL) and the storage server's generic case."""
+        from pio_tpu.data.columnar import columnar_interactions
+
+        cols = self.find_columnar(
+            app_id=app_id, channel_id=channel_id,
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, event_names=event_names,
+            target_entity_type=target_entity_type,
+        )
+        return columnar_interactions(
+            cols, value_key=value_key, default_value=default_value,
+            dedup=dedup, value_event=value_event,
+        )
 
     def aggregate_properties(
         self,
@@ -353,19 +419,21 @@ class EventsDAO(abc.ABC):
         required: Iterable[str] | None = None,
     ) -> dict[str, PropertyMap]:
         """Reference LEvents.futureAggregateProperties: replay special events
-        of one entityType into a PropertyMap per entity."""
-        from pio_tpu.data.aggregator import aggregate_properties, required_filter
+        of one entityType into a PropertyMap per entity.  Runs on the
+        columnar read (one stable numpy sort, property JSON decoded only
+        for the special events the fold touches) — same contract as the
+        row fold in data/aggregator.py, which remains the parity oracle."""
+        from pio_tpu.data.columnar import columnar_aggregate
 
-        events = self.find(
+        cols = self.find_columnar(
             app_id=app_id,
             channel_id=channel_id,
             start_time=start_time,
             until_time=until_time,
             entity_type=entity_type,
             event_names=["$set", "$unset", "$delete"],
-            limit=-1,
         )
-        return required_filter(aggregate_properties(events), required)
+        return columnar_aggregate(cols, required)
 
     def find_single_entity(
         self,

@@ -85,7 +85,12 @@ class Event:
         return json.dumps(self.to_api_dict(), sort_keys=True)
 
     @staticmethod
-    def from_api_dict(d: dict[str, Any]) -> "Event":
+    def from_api_dict(d: dict[str, Any], now: datetime | None = None) -> "Event":
+        """Decode one API dict. ``now`` is the receive timestamp used when
+        eventTime/creationTime are absent — batch decoders pass one shared
+        value so a 50-event batch costs one utcnow(), not 100. THE single
+        implementation of the wire-decode rules (the columnar batch path
+        wraps this; keep it that way so the two cannot drift)."""
         try:
             event = d["event"]
             entity_type = d["entityType"]
@@ -104,30 +109,41 @@ class Event:
             raise EventValidationError("properties must be a JSON object")
         ev_time = d.get("eventTime")
         try:
-            event_time = parse_time(ev_time) if ev_time else utcnow()
+            event_time = parse_time(ev_time) if ev_time else (now or utcnow())
         except (ValueError, TypeError, AttributeError) as e:
             raise EventValidationError(f"invalid eventTime: {ev_time}") from e
         creation = d.get("creationTime")
         try:
-            creation_time = parse_time(creation) if creation else utcnow()
+            if creation:
+                creation_time = parse_time(creation)
+            elif now is not None:
+                creation_time = now
+            elif not ev_time:
+                creation_time = event_time  # share the one utcnow() above
+            else:
+                creation_time = utcnow()
         except (ValueError, TypeError, AttributeError) as e:
             raise EventValidationError(f"invalid creationTime: {creation}") from e
         tags = d.get("tags", []) or []
         if not isinstance(tags, list) or not all(isinstance(t, str) for t in tags):
             raise EventValidationError("tags must be a list of strings")
-        return Event(
-            event=event,
-            entity_type=entity_type,
-            entity_id=entity_id,
-            target_entity_type=d.get("targetEntityType"),
-            target_entity_id=d.get("targetEntityId"),
-            properties=DataMap(dict(props)),
-            event_time=event_time,
-            tags=tuple(tags),
-            pr_id=d.get("prId"),
-            event_id=d.get("eventId"),
-            creation_time=creation_time,
-        )
+        # fast construction: every field above is already coerced (aware
+        # datetimes from parse_time/utcnow, DataMap, tuple), so re-running
+        # __post_init__'s checks would only tax the ingest hot loop
+        e = object.__new__(Event)
+        s = object.__setattr__
+        s(e, "event", event)
+        s(e, "entity_type", entity_type)
+        s(e, "entity_id", entity_id)
+        s(e, "target_entity_type", d.get("targetEntityType"))
+        s(e, "target_entity_id", d.get("targetEntityId"))
+        s(e, "properties", DataMap(dict(props)))
+        s(e, "event_time", event_time)
+        s(e, "tags", tuple(tags))
+        s(e, "pr_id", d.get("prId"))
+        s(e, "event_id", d.get("eventId"))
+        s(e, "creation_time", creation_time)
+        return e
 
     @staticmethod
     def from_json(s: str) -> "Event":

@@ -110,12 +110,17 @@ class EventStore:
     ) -> Interactions:
         """Training read straight to COO interactions.
 
-        When the events DAO is the native log backend this is one C++ sweep
-        (filter + dict-encode + value extract + dedup, no per-event Python);
-        otherwise it falls back to find + to_interactions. `value_key` reads
-        a numeric property (None = always default_value); `value_event`
-        restricts that read to one event name (others take default_value) —
-        the reference recommendation template's rate-vs-buy rule.
+        Every EventsDAO carries a `columnarize` now (dao.py): one C++
+        sweep on the native log backend, the server-side RPC on
+        remote/sharded, and the vectorized columnar fold
+        (data/columnar.py) on the local memory/SQL backends — per-event
+        Python objects never materialize on this path. The find +
+        to_interactions row fold below remains only for duck-typed
+        third-party DAOs (and as the parity oracle in tests).
+        `value_key` reads a numeric property (None = always
+        default_value); `value_event` restricts that read to one event
+        name (others take default_value) — the reference recommendation
+        template's rate-vs-buy rule.
         """
         app_id, channel_id = self._resolve(app_name, channel_name)
         dao = self._dao()

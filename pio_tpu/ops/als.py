@@ -38,6 +38,7 @@ normal-equation blocks scatter-add into the same row system.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import math
 from dataclasses import dataclass
@@ -780,6 +781,49 @@ def _train_from_layouts_jit(bu_rows, bu_idx, bu_val, bu_lens,
     sweep_with = _sweep_factory(by_user, by_item, n_users, n_items, cs,
                                 params)
     return _run_schedule(sweep_with, params, cg_u, cg_i, (user0, item0))
+
+
+def als_warm_compile(
+    nnz: int, n_users: int, n_items: int, params: ALSParams,
+    sweep_lengths: tuple[int, ...] = (),
+) -> int:
+    """AOT-compile the layout-build and layouts-train programs for this
+    COO shape WITHOUT executing anything: abstract ShapeDtypeStruct
+    inputs through ``.lower().compile()``. With the persistent compile
+    cache (utils/compilecache.py) each ``.compile()`` on a warm restart
+    is a deserialize, so a train process front-loads — or entirely skips
+    — its XLA work while e.g. the host->HBM transfer is in flight,
+    instead of the old warm-up idiom of EXECUTING the programs on
+    zero-filled arrays (whose pointless math burned device time and
+    polluted measurements). Shape/static derivation mirrors
+    ``_prep_coo``/``als_build_layouts`` exactly, so the later real
+    dispatch compiles byte-identical HLO and hits the cache.
+    Returns the number of programs compiled."""
+    nnz_pad = nnz + (-nnz % max(1, params.chunk))
+    u = jax.ShapeDtypeStruct((nnz_pad,), jnp.int32)
+    v = jax.ShapeDtypeStruct((nnz_pad,), jnp.float32)
+    _layouts_jit.lower(
+        u, u, v, n_users=n_users, n_items=n_items, params=params
+    ).compile()
+    n = 1
+    if not sweep_lengths:
+        return n
+    by_user, by_item = jax.eval_shape(
+        lambda a, b, c: _layouts_jit(
+            a, b, c, n_users=n_users, n_items=n_items, params=params),
+        u, u, v,
+    )
+    cs = min(params.chunk_slots, _slots_for(nnz_pad, 0, params.width, 1))
+    user0, item0 = jax.eval_shape(
+        lambda: _init_or(None, n_users, n_items, params))
+    for length in sweep_lengths:
+        p = dataclasses.replace(params, iterations=length)
+        _train_from_layouts_jit.lower(
+            *by_user, *by_item, n_users=n_users, n_items=n_items,
+            cs=cs, params=p, user0=user0, item0=item0,
+        ).compile()
+        n += 1
+    return n
 
 
 def als_train(

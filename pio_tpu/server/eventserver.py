@@ -192,6 +192,110 @@ def build_event_app(
             stats.update(ak.appid, 201, event.event, event.entity_type)
         return event_id, spilled
 
+    def insert_many(ak: AccessKey, channel_id: int | None,
+                    body: list) -> list[dict]:
+        """The Python batch-ingest pipeline, columnarized: ONE decode pass
+        over the JSON batch (columnar.decode_api_batch — shared receive
+        timestamp, fast Event construction), ids minted in bulk (one
+        entropy syscall), and ONE insert_batch DAO call instead of a
+        guarded per-event insert.  Per-event isolation is preserved: a
+        slot's validation/auth/plugin failure becomes its own 400/403
+        while the rest of the batch proceeds, and a store failure falls
+        back to the per-event insert/spill path so degraded-mode
+        semantics match the single-event route exactly."""
+        from pio_tpu.data.backends.common import new_event_ids
+        from pio_tpu.data.columnar import decode_api_batch
+
+        decoded = decode_api_batch(body)
+        results: list[dict | None] = [None] * len(body)
+        ctx = {"appId": ak.appid, "channelId": channel_id}
+        to_insert: list[tuple[int, Event]] = []
+        for i, item in enumerate(decoded):
+            if isinstance(item, EventValidationError):
+                results[i] = {"status": 400, "message": str(item)}
+                continue
+            event = item
+            try:
+                check_event_allowed(ak, event.event)
+                for blocker in plugins.input_blockers:
+                    blocker.process(body[i], ctx)
+            except AuthError as e:
+                results[i] = {"status": e.status, "message": e.message}
+                continue
+            except PluginRejection as e:
+                results[i] = {"status": 403, "message": str(e)}
+                continue
+            except ValueError as e:
+                # client-error class (the single-event route's authed
+                # wrapper maps it to 400 the same way)
+                results[i] = {"status": 400, "message": str(e)}
+                continue
+            except Exception as e:  # noqa: BLE001 - per-event isolation:
+                # a misbehaving blocker (or any unexpected per-event
+                # failure) fails ITS slot, never its batch-mates — the
+                # same net the old per-event loop cast
+                results[i] = {
+                    "status": 503 if is_transient(e) else 500,
+                    "message": str(e),
+                }
+                continue
+            for sniffer in plugins.input_sniffers:
+                try:
+                    sniffer.process(body[i], ctx)
+                except Exception:  # noqa: BLE001 - sniffers cannot fail
+                    pass
+            to_insert.append((i, event))
+        # mint ids at the edge in bulk (same idempotency contract as
+        # insert_one: a retried/spilled insert always carries its id)
+        fresh = new_event_ids(
+            sum(1 for _, e in to_insert if e.event_id is None))
+        it = iter(fresh)
+        to_insert = [
+            (i, e if e.event_id is not None else e.with_id(next(it)))
+            for i, e in to_insert
+        ]
+
+        def ok(i: int, event: Event, spilled: bool) -> None:
+            r: dict = {"status": 201, "eventId": event.event_id}
+            if spilled:
+                r["spilled"] = True
+            results[i] = r
+            if config.stats:
+                stats.update(ak.appid, 201, event.event, event.entity_type)
+
+        def insert_fallback(i: int, event: Event) -> None:
+            """Single-event degraded path: insert, spill on transient
+            failure, per-event 503/500 otherwise (the old loop's net)."""
+            try:
+                events_dao.insert(event, ak.appid, channel_id)
+                ok(i, event, False)
+            except ValueError as e:
+                # 400 like the old loop (and the single-event route):
+                # a ValueError out of the store is a client-error class,
+                # not a server fault
+                results[i] = {"status": 400, "message": str(e)}
+            except Exception as e:  # noqa: BLE001 - per-event isolation
+                if spill is not None and is_transient(e) and spill.offer(
+                        event, ak.appid, channel_id):
+                    ok(i, event, True)
+                    return
+                results[i] = {
+                    "status": 503 if is_transient(e) else 500,
+                    "message": str(e),
+                }
+
+        if to_insert:
+            try:
+                events_dao.insert_batch(
+                    [e for _, e in to_insert], ak.appid, channel_id)
+            except Exception:  # noqa: BLE001 - degrade per event
+                for i, event in to_insert:
+                    insert_fallback(i, event)
+            else:
+                for i, event in to_insert:
+                    ok(i, event, False)
+        return results  # type: ignore[return-value]
+
     # -- routes -------------------------------------------------------------
     def authed(fn):
         """Wrap a handler with authentication + the AuthError/403/400 status
@@ -378,28 +482,7 @@ def build_event_app(
                 "message": "Batch request must have less than or equal to "
                 f"{MAX_EVENTS_PER_BATCH} events"
             }
-        results = []
-        for d in body:
-            try:
-                if not isinstance(d, dict):
-                    raise EventValidationError("event must be a JSON object")
-                event_id, spilled = insert_one(ak, channel_id, d)
-                r = {"status": 201, "eventId": event_id}
-                if spilled:
-                    r["spilled"] = True
-                results.append(r)
-            except (EventValidationError, ValueError) as e:
-                results.append({"status": 400, "message": str(e)})
-            except AuthError as e:
-                results.append({"status": e.status, "message": e.message})
-            except PluginRejection as e:
-                results.append({"status": 403, "message": str(e)})
-            except Exception as e:  # noqa: BLE001 - per-event isolation
-                results.append({
-                    "status": 503 if is_transient(e) else 500,
-                    "message": str(e),
-                })
-        return 200, results
+        return 200, insert_many(ak, channel_id, body)
 
     @app.route("GET", r"/stats\.json")
     @authed

@@ -644,6 +644,41 @@ def cmd_undeploy(args) -> int:
         return _fail(f"undeploy failed: {e}")
 
 
+def cmd_compilecache(args) -> int:
+    """Inspect or clear the persistent XLA compile cache (the thing that
+    makes the SECOND `pio train`/`pio deploy` skip cold-start XLA; see
+    docs/performance.md). Shows the serving bucket registries too."""
+    from pio_tpu.utils.compilecache import (
+        cache_disabled, cache_stats, clear_cache, default_cache_dir,
+    )
+
+    d = args.dir or default_cache_dir()
+    if args.clear:
+        n = clear_cache(d)
+        print(f"removed {n} file(s) from {d}")
+        return 0
+    stats = cache_stats(d)
+    registries = sorted(
+        f for f in (os.listdir(d) if os.path.isdir(d) else [])
+        if f.startswith("buckets__") and f.endswith(".json")
+    )
+    if args.json:
+        print(json.dumps({**stats, "disabled": cache_disabled(),
+                          "bucket_registries": registries}))
+        return 0
+    state = "DISABLED (PIO_TPU_COMPILE_CACHE=off)" if cache_disabled() \
+        else "enabled"
+    print(f"compile cache: {state}")
+    print(f"  dir:     {stats['dir']}")
+    print(f"  entries: {stats['entries']}"
+          f" ({stats['bytes'] / 1e6:.1f} MB)")
+    for r in registries:
+        with open(os.path.join(d, r), encoding="utf-8") as f:
+            buckets = json.load(f).get("buckets", [])
+        print(f"  buckets: {r[len('buckets__'):-len('.json')]} -> {buckets}")
+    return 0
+
+
 def cmd_start_all(args) -> int:
     from pio_tpu.tools.daemon import default_pid_dir, start_all
 
@@ -1173,6 +1208,19 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--appid", type=int)
     x.add_argument("--no-metadata", action="store_true")
     x.set_defaults(fn=cmd_upgrade)
+
+    x = sub.add_parser(
+        "compilecache",
+        help="persistent XLA compile cache: show size/location, prune, "
+             "or clear (docs/performance.md)")
+    x.add_argument("--dir", default=None,
+                   help="cache directory (default $PIO_TPU_COMPILE_CACHE "
+                        "or $PIO_TPU_HOME/compile_cache)")
+    x.add_argument("--clear", action="store_true",
+                   help="delete every cached executable and bucket "
+                        "registry (next train/deploy recompiles)")
+    x.add_argument("--json", action="store_true")
+    x.set_defaults(fn=cmd_compilecache)
 
     x = sub.add_parser(
         "lint",

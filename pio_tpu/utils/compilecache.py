@@ -1,0 +1,234 @@
+"""Persistent XLA compilation cache + serving bucket-shape registry.
+
+Every fresh ``pio train`` process pays the full XLA compile of the
+training programs before the first useful step (BENCH_r05:
+``warmup_compile_sec`` 14.6 s on the CPU rig, 20-40 s through a tunneled
+TPU), and a fresh ``pio deploy`` pays one compile per micro-batch bucket.
+Both are pure recomputation: the programs are byte-identical across runs.
+This module kills that cold start twice over:
+
+ * :func:`enable_compile_cache` points jax's persistent compilation cache
+   (``jax_compilation_cache_dir``) at a durable directory, so the SECOND
+   process deserializes executables instead of re-running XLA.  Keyed by
+   HLO + compile options + jax/XLA version, so upgrades invalidate
+   naturally — stale entries are never *wrong*, only unused; ``clear``
+   reclaims the space.
+ * :class:`BucketRegistry` records which serving batch buckets a
+   deployment actually compiled, persisted alongside the cache keyed by
+   the engine triple — the next ``pio deploy`` pre-warms exactly that
+   bucket set (each warm now a cache hit) instead of guessing a
+   power-of-two sweep.
+
+Kill switch: ``PIO_TPU_COMPILE_CACHE=off`` (or ``0``/``false``/``no``).
+``PIO_TPU_COMPILE_CACHE=<path>`` overrides the directory (default
+``$PIO_TPU_HOME/compile_cache``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+log = logging.getLogger("pio_tpu.compilecache")
+
+_OFF_VALUES = ("off", "0", "false", "no")
+_lock = threading.Lock()
+_enabled_dir: str | None = None
+
+
+def default_cache_dir() -> str:
+    env = os.environ.get("PIO_TPU_COMPILE_CACHE", "")
+    if env and env.lower() not in _OFF_VALUES:
+        return env
+    home = os.environ.get(
+        "PIO_TPU_HOME", os.path.join(os.path.expanduser("~"), ".pio_tpu")
+    )
+    return os.path.join(home, "compile_cache")
+
+
+def cache_disabled() -> bool:
+    return os.environ.get(
+        "PIO_TPU_COMPILE_CACHE", "").lower() in _OFF_VALUES
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at ``cache_dir`` (default
+    resolution above). Returns the directory, or None when disabled.
+    Idempotent and thread-safe; safe to call after backend init (the
+    cache config is read per compile). The min-compile-time/entry-size
+    floors are dropped to zero so even fast CPU-fallback compiles
+    persist — a training session compiles dozens of small programs whose
+    sum, not max, is the 14.6 s warmup."""
+    global _enabled_dir
+    if cache_disabled():
+        return None
+    with _lock:
+        if _enabled_dir is not None and cache_dir in (None, _enabled_dir):
+            return _enabled_dir
+        d = cache_dir or default_cache_dir()
+        try:
+            os.makedirs(d, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", d)
+            for opt, val in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ):
+                try:
+                    jax.config.update(opt, val)
+                except (AttributeError, ValueError):
+                    pass  # older/newer jax: floor stays at its default
+        except Exception as e:  # noqa: BLE001 - cache is an optimization
+            log.warning("persistent compile cache unavailable: %s", e)
+            return None
+        _enabled_dir = d
+        log.info("persistent XLA compile cache at %s", d)
+        return d
+
+
+def cache_stats(cache_dir: str | None = None) -> dict:
+    """{dir, entries, bytes} for the cache directory (entries = compiled
+    executables, not atime sidecars)."""
+    d = cache_dir or _enabled_dir or default_cache_dir()
+    entries = 0
+    size = 0
+    try:
+        for name in os.listdir(d):
+            p = os.path.join(d, name)
+            if not os.path.isfile(p):
+                continue
+            if name.endswith("-atime"):
+                continue
+            entries += 1
+            try:
+                size += os.path.getsize(p)
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return {"dir": d, "entries": entries, "bytes": size}
+
+
+def clear_cache(cache_dir: str | None = None) -> int:
+    """Delete every cache entry (and bucket registries); returns the
+    number of files removed."""
+    d = cache_dir or _enabled_dir or default_cache_dir()
+    removed = 0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    for name in names:
+        p = os.path.join(d, name)
+        if os.path.isfile(p):
+            try:
+                os.remove(p)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+class CacheProbe:
+    """Before/after watermark answering "did this session's compiles hit
+    the persistent cache?" — ``status`` is ``hit`` when the session added
+    nothing to a non-empty cache, ``miss`` when it wrote new entries,
+    ``cold`` when the cache started empty, ``disabled`` when off."""
+
+    def __init__(self, cache_dir: str | None = None):
+        self.dir = enable_compile_cache(cache_dir)
+        self.before = cache_stats(self.dir)["entries"] if self.dir else 0
+
+    def report(self) -> dict:
+        if self.dir is None:
+            return {"enabled": False, "status": "disabled"}
+        after = cache_stats(self.dir)["entries"]
+        if self.before == 0:
+            status = "cold"
+        elif after > self.before:
+            status = "miss"
+        else:
+            status = "hit"
+        return {
+            "enabled": True, "dir": self.dir, "status": status,
+            "entries_before": self.before, "entries_after": after,
+        }
+
+
+# ---------------------------------------------------------------------------
+# serving bucket-shape registry
+# ---------------------------------------------------------------------------
+
+class BucketRegistry:
+    """Persisted set of micro-batch bucket sizes one engine's deployment
+    actually served.  ``pio deploy`` pre-compiles exactly this set (plus
+    bucket 1 for the single-query path) so a restart never pays a
+    bucket-miss compile mid-traffic, and never wastes warm time on
+    buckets the workload does not reach."""
+
+    def __init__(self, engine_id: str, engine_version: str = "1",
+                 engine_variant: str = "default",
+                 cache_dir: str | None = None):
+        d = cache_dir or default_cache_dir()
+        safe = "__".join(
+            s.replace("/", "_").replace("\\", "_") or "_"
+            for s in (engine_id, engine_version, engine_variant)
+        )
+        self.path = os.path.join(d, f"buckets__{safe}.json")
+        self._lock = threading.Lock()
+        self._buckets: set[int] = set()
+        self._dirty = False
+        self._flush_timer: threading.Timer | None = None
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+            self._buckets = {
+                int(b) for b in data.get("buckets", []) if int(b) > 0
+            }
+        except (OSError, ValueError):
+            pass
+
+    def buckets(self) -> list[int]:
+        with self._lock:
+            return sorted(self._buckets)
+
+    def record(self, bucket: int) -> None:
+        """Note a served bucket size. The disk write is DEBOUNCED onto a
+        background timer: record() sits on the serving hot path, and a
+        synchronous write on first sighting measurably bends request
+        p99 on small hosts. Durability is best-effort by design — the
+        registry only tunes the NEXT deploy's warm sweep."""
+        if bucket <= 0:
+            return
+        with self._lock:
+            if bucket in self._buckets:
+                return
+            self._buckets.add(bucket)
+            self._dirty = True
+            if self._flush_timer is None:
+                self._flush_timer = threading.Timer(1.0, self._flush_bg)
+                self._flush_timer.daemon = True
+                self._flush_timer.start()
+
+    def _flush_bg(self) -> None:
+        with self._lock:
+            self._flush_timer = None
+        self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._dirty:
+                return
+            payload = {"buckets": sorted(self._buckets)}
+            self._dirty = False
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            log.warning("bucket registry write failed: %s", e)

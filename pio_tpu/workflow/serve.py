@@ -165,8 +165,32 @@ class QueryServer:
                          or _auto_pipeline_depth())
             if config.batch_window_ms != 0 else None
         )
+        # persistent XLA compile cache: a re-deploy deserializes the
+        # predict/bucket executables the last deployment compiled instead
+        # of re-running XLA (utils/compilecache.py); the bucket registry
+        # remembers WHICH buckets that deployment actually served so the
+        # warm sweep compiles exactly that set
+        from pio_tpu.utils.compilecache import BucketRegistry, enable_compile_cache
+
+        cache_dir = enable_compile_cache()
+        self.bucket_registry = (
+            BucketRegistry(config.engine_id, config.engine_version,
+                           config.engine_variant, cache_dir=cache_dir)
+            if cache_dir is not None else None
+        )
         self._buckets_warmed = False
         self._warm_once = threading.Lock()
+        # /readyz gate (resilience/health.py "buckets" check): starts
+        # NOT-ready only when a warm sweep is owed at startup (batching on
+        # + a warm query to run it with); set once the sweep completes so
+        # a load balancer never routes traffic into a bucket-miss compile.
+        # Without a warm query the first real request triggers the
+        # background sweep — gating then would deadlock readiness on the
+        # traffic it gates, so the server reports ready and the gate only
+        # drops while that background warm is in flight.
+        self._buckets_ready = threading.Event()
+        if self.batcher is None or config.warm_query is None:
+            self._buckets_ready.set()
         self._warm()
 
     # -- model lifecycle ----------------------------------------------------
@@ -266,12 +290,31 @@ class QueryServer:
         HTTP transport's stop() does not know about them."""
         if self.batcher is not None:
             self.batcher.close()
+        if self.bucket_registry is not None:
+            self.bucket_registry.flush()
         self._predict_pool.shutdown(wait=False)
         self._hedge_pool.shutdown(wait=False)
         for algo in getattr(self, "algorithms", []):
             close = getattr(algo, "close", None)
             if callable(close):
                 close()
+
+    def _warm_bucket_set(self) -> list[int]:
+        """The bucket sizes the warm sweep compiles: exactly what the
+        LAST deployment of this engine served (bucket registry) when
+        known, else the full power-of-two ladder up to batch_max."""
+        recorded = (
+            self.bucket_registry.buckets() if self.bucket_registry else []
+        )
+        recorded = [b for b in recorded if b <= self.config.batch_max]
+        if recorded:
+            return sorted(set(recorded) | {1})
+        out = []
+        b = 1
+        while b <= self.config.batch_max:
+            out.append(b)
+            b *= 2
+        return out
 
     def _warm(self) -> None:
         if self.config.warm_query is None:
@@ -285,17 +328,21 @@ class QueryServer:
         if self.batcher is None:
             return
         try:
-            # compile every power-of-two batch bucket up front so the
-            # micro-batcher's varying batch sizes never pay jit in traffic
-            b = 1
-            while b <= self.config.batch_max:
+            # compile the registry's bucket set (or the power-of-two
+            # ladder) up front so the micro-batcher's varying batch sizes
+            # never pay jit in traffic; with the persistent compile cache
+            # each of these is a deserialize, not an XLA run
+            for b in self._warm_bucket_set():
                 self.query_batch(
                     [dict(self.config.warm_query)] * b, record=False
                 )
-                b *= 2
             self._buckets_warmed = True
         except Exception:  # noqa: BLE001 - warmup is best-effort
             log.warning("warm batch failed", exc_info=True)
+        finally:
+            # ready either way: a failed warm means traffic pays the
+            # compile, which beats a permanently not-ready instance
+            self._buckets_ready.set()
 
     # -- query path (reference CreateServer.scala:492-615) ------------------
     def _auto_warm_buckets(self, sample: dict) -> None:
@@ -312,15 +359,17 @@ class QueryServer:
             if self._buckets_warmed:
                 return
             self._buckets_warmed = True
+        # pio: lint-ok[attr-no-lock] threading.Event is internally locked
+        self._buckets_ready.clear()  # /readyz drops while the sweep runs
 
         def go():
             try:
-                b = 1
-                while b <= self.config.batch_max:
+                for b in self._warm_bucket_set():
                     self.query_batch([dict(sample)] * b, record=False)
-                    b *= 2
             except Exception:  # noqa: BLE001 - warmup is best-effort
                 log.warning("background bucket warm failed", exc_info=True)
+            finally:
+                self._buckets_ready.set()
 
         threading.Thread(
             target=go, name="bucket-warm", daemon=True
@@ -456,6 +505,13 @@ class QueryServer:
             # (query() is bypassed), so auto-warm must hook here too; the
             # warm calls themselves pass record=False and cannot recurse
             self._auto_warm_buckets(queries[0])
+            if self.bucket_registry is not None:
+                # remember the pow2 bucket this batch landed in so the
+                # NEXT deployment's warm sweep compiles exactly the set
+                # this one served
+                self.bucket_registry.record(
+                    min(1 << (len(queries) - 1).bit_length(),
+                        self.config.batch_max))
         with span("serve"):
             predictions = [
                 self.serving.serve(q, [algo_out[i] for algo_out in per_algo])
@@ -880,6 +936,19 @@ def build_serving_app(server: QueryServer) -> HttpApp:
             "engineInstanceId": inst.id if inst is not None else None,
             "lastReloadError": server.last_reload_error,
         }
+        # bucket-warm gate: NOT ready while a micro-batch warm sweep is
+        # owed or in flight — a balancer that routes on /readyz never
+        # lands traffic in a bucket-miss XLA compile (BENCH_r05's 187 ms
+        # async_batched cold-start p99). Always-true when batching is off
+        # or no warm query is configured (the sweep then rides the first
+        # real request, which readiness must not deadlock on).
+        if server.batcher is not None:
+            checks["buckets"] = {
+                "ok": server._buckets_ready.is_set(),
+                "warmed": server._buckets_warmed,
+                "registry": (server.bucket_registry.buckets()
+                             if server.bucket_registry else None),
+            }
         checks.update(shedder_check(getattr(app, "transport", None)))
         return checks
 

@@ -164,6 +164,13 @@ def run_train(
     (INTERRUPTED/FAILED) instance; ``auto_resume`` picks the most recent
     one with checkpoints on disk.
     """
+    # persistent XLA compile cache BEFORE any engine compile: the second
+    # consecutive train of the same engine deserializes its executables
+    # instead of re-running XLA (utils/compilecache.py; PIO_TPU_COMPILE_
+    # CACHE=off disables)
+    from pio_tpu.utils.compilecache import enable_compile_cache
+
+    enable_compile_cache()
     ctx = ctx or create_workflow_context(storage)
     instances = storage.get_metadata_engine_instances()
     from pio_tpu.parallel.distributed import barrier, is_primary

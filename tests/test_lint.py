@@ -468,6 +468,61 @@ def test_sync_through_local_helper_recognized():
     assert fs == []
 
 
+def test_hot_loop_alloc_fires_in_data_plane():
+    src = """
+        import json
+        from pio_tpu.data.event import Event
+
+        def decode_rows(rows):
+            out = []
+            for r in rows:
+                out.append(Event.from_api_dict(json.loads(r)))
+            return out
+    """
+    fs = lint_text(textwrap.dedent(src), path="pio_tpu/data/backends/x.py")
+    assert {f.rule for f in fs} == {"hot-loop-alloc"}
+    # json.loads AND the Event decode each flagged, but once per call
+    # site (nested loops must not double-report)
+    assert len(fs) == 2
+
+
+def test_hot_loop_alloc_scoped_to_data_plane_paths():
+    src = """
+        import json
+
+        def parse_all(rows):
+            out = []
+            for r in rows:
+                out.append(json.loads(r))
+            return out
+    """
+    # engine templates / tools / tests keep their row loops
+    assert lint_text(textwrap.dedent(src), path="pio_tpu/models/x.py") == []
+    assert lint_text(textwrap.dedent(src), path="tests/test_x.py") == []
+    assert lint_text(textwrap.dedent(src), path="pio_tpu/server/x.py") != []
+
+
+def test_hot_loop_alloc_silent_outside_loops_and_suppressible():
+    ok = """
+        import json
+        from pio_tpu.data.event import Event
+
+        def decode_one(raw):
+            return Event.from_api_dict(json.loads(raw))
+    """
+    assert lint_text(textwrap.dedent(ok), path="pio_tpu/data/x.py") == []
+    suppressed = """
+        import json
+
+        def fallback(rows):
+            for r in rows:
+                # pio: lint-ok[hot-loop-alloc] documented row fallback
+                yield json.loads(r)
+    """
+    assert lint_text(
+        textwrap.dedent(suppressed), path="pio_tpu/data/x.py") == []
+
+
 def test_non_jax_timing_silent():
     fs = lint("""
         import time
