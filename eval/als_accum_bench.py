@@ -64,6 +64,19 @@ CELLS = [
     # production accum. ALSParams.gather "auto" flips on a win here.
     {"accum": "hybrid", "chunk_slots": 32768, "gather": "pallas-copy"},
     {"accum": "hybrid", "chunk_slots": 32768, "gather": "pallas-take"},
+    # round-6 streaming A/B (eval/ALS_ROOFLINE.md round-6 plan; CPU-
+    # validated in interpret mode, these cells convert it to measured
+    # numbers at the next tunnel window): overlapped segment flush
+    # alone (vs the hybrid cell above isolates the 65 ms in-kernel
+    # flush waits), + the double-buffered streaming gather (vs the
+    # gather emitter's 119 ms), + lane-packed A end-to-end (the 6.1x
+    # isolated packed-matvec win composing with no relayout). A win
+    # flips ALSParams "auto" accum/gather; packed_a stays opt-in until
+    # the composed cell wins.
+    {"accum": "stream", "chunk_slots": 32768},
+    {"accum": "stream", "chunk_slots": 32768, "gather": "stream"},
+    {"accum": "stream", "chunk_slots": 32768, "gather": "stream",
+     "packed_a": True},
 ]
 
 
@@ -81,7 +94,7 @@ def main() -> None:
     results = []
     cells = [
         c for c in CELLS
-        if not (c["accum"] in ("pallas", "hybrid")
+        if not (c["accum"] in ("pallas", "hybrid", "stream")
                 and dev.platform == "cpu")
         # pallas on CPU runs in interpret mode — a correctness tool
         # (tests/test_als_pallas.py), meaningless to time

@@ -11,24 +11,32 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/6 snapshot (probe + train only -> eval/TPU_BENCH_r05.json) =="
-python bench.py --snapshot
+echo "== 1/7 snapshot (probe + train only -> eval/TPU_BENCH_r06.json) =="
+# --out: the r06 snapshot must land BESIDE the committed r05 artifact
+# (the baseline the round-6 A/B compares against), never over it
+python bench.py --snapshot --out eval/TPU_BENCH_r06.json
 
-echo "== 2/6 accumulation + GATHER A/B (flips ALSParams.gather auto on a win) =="
+echo "== 2/7 accumulation + GATHER A/B incl. the round-6 STREAM cells =="
+echo "==     (accum=stream / gather=stream / packed_a: a win here flips =="
+echo "==      the ALSParams auto policy — see eval/ALS_ROOFLINE.md) =="
 python eval/als_accum_bench.py --out eval/ALS_ACCUM_BENCH.json || true
 
-echo "== 3/6 per-phase profile (feeds the roofline accounting) =="
+echo "== 3/7 kernel lab: streaming-gather + pallas packed-matvec cells =="
+python eval/als_kernel_lab.py --out eval/ALS_KERNEL_LAB.json || true
+
+echo "== 4/7 per-phase profile (feeds the roofline accounting) =="
 python eval/als_phase_profile.py || true
 
-echo "== 4/6 serving decomposition on-device (tunnel RTT vs dispatch) =="
+echo "== 5/7 serving decomposition on-device (tunnel RTT vs dispatch) =="
 python eval/serving_decomposition.py || true
 
-echo "== 5/6 full headline bench (all phases, probe ladder) =="
-python bench.py | tee eval/TPU_BENCH_full_r05.json || true
+echo "== 6/7 full headline bench (all phases, probe ladder) =="
+python bench.py | tee eval/TPU_BENCH_full_r06.json || true
 
-echo "== 6/6 full-shape quality artifact on TPU (longest; best-sweep curve) =="
+echo "== 7/7 full-shape quality artifact on TPU (longest; best-sweep curve) =="
 python eval/rmse_parity.py --scale full || true
 
-echo "== done; commit eval/TPU_BENCH_r05.json, eval/TPU_BENCH_full_r05.json"
-echo "== and every regenerated artifact =="
-echo "== if the gather A/B showed a win, flip ALSParams.gather auto =="
+echo "== done; commit eval/TPU_BENCH_r06.json, eval/TPU_BENCH_full_r06.json,"
+echo "== eval/ALS_KERNEL_LAB.json and every regenerated artifact =="
+echo "== if a stream cell won its A/B, flip the matching ALSParams auto"
+echo "== (accum and/or gather) and record the numbers in ALS_ROOFLINE.md =="

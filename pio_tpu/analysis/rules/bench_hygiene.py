@@ -189,21 +189,53 @@ _HOT_ALLOC_CALLS = frozenset({
 # data-plane path fragments the rule applies to (normalized separators)
 _HOT_PATHS = ("pio_tpu/data/", "pio_tpu/server/")
 
+# ops scope: array materialization inside a PYTHON loop. Every
+# iteration of an un-jitted host loop re-traces and re-materializes a
+# device buffer (and inside a jitted function an unrolled python loop
+# emits one buffer PER ITERATION into the HLO — compile-time and
+# live-range bloat the als group chaining is carefully structured to
+# avoid); hot-path loops over groups/chunks must hoist the allocation
+# or vectorize it. The kernel-adjacent helpers that intentionally
+# allocate per group carry `# pio: lint-ok[hot-loop-alloc]`
+# justifications.
+_TRACE_ALLOC_CALLS = frozenset({
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+    "jax.numpy.eye", "jax.numpy.arange", "jax.numpy.linspace",
+    "jax.numpy.concatenate", "jax.numpy.stack", "jax.numpy.asarray",
+    "jax.numpy.array",
+    "numpy.zeros", "numpy.ones", "numpy.full", "numpy.concatenate",
+    "jax.device_put",
+})
+_OPS_PATHS = ("pio_tpu/ops/",)
+
 
 class HotLoopAllocRule:
-    """`hot-loop-alloc`: flag per-event decode/construction inside
-    explicit `for`/`while` loops in the data plane. Scoped by path so
-    engine templates, tests, and tools keep their readable row loops;
-    inside `pio_tpu/data/` and `pio_tpu/server/` every row loop is
-    either the documented fallback (suppress with a justification) or a
-    regression against the columnar path."""
+    """`hot-loop-alloc`: flag per-iteration allocation inside explicit
+    `for`/`while` loops on hot paths. Two scopes, one id:
+
+      * data plane (`pio_tpu/data/`, `pio_tpu/server/`): per-event
+        decode/construction (`json.loads`, `Event(...)`, ...) — the
+        row-at-a-time cost the columnar path removes;
+      * ops layer (`pio_tpu/ops/`): array materialization
+        (`jnp.zeros`, `jnp.concatenate`, `device_put`, ...) — each
+        python-loop iteration re-traces an allocation XLA materializes
+        per call (kernel group loops must thread aliased buffers, not
+        allocate fresh ones).
+
+    Scoped by path so engine templates, tests, and tools keep their
+    readable loops; in scope every finding is either fixed or carries a
+    `# pio: lint-ok[hot-loop-alloc] <why>` justification."""
 
     id = "bench"
     ids = ("hot-loop-alloc",)
 
     def check(self, ctx: ModuleContext):
         path = ctx.path.replace("\\", "/")
-        if not any(p in path for p in _HOT_PATHS):
+        if any(p in path for p in _HOT_PATHS):
+            calls, msg = _HOT_ALLOC_CALLS, self._data_msg
+        elif any(p in path for p in _OPS_PATHS):
+            calls, msg = _TRACE_ALLOC_CALLS, self._ops_msg
+        else:
             return
         seen: set[tuple[int, int]] = set()  # nested loops: flag once
         for loop in ast.walk(ctx.tree):
@@ -215,17 +247,30 @@ class HotLoopAllocRule:
                 if (node.lineno, node.col_offset) in seen:
                     continue
                 name = ctx.imports.canonical(node.func)
-                if name not in _HOT_ALLOC_CALLS:
+                if name not in calls:
                     continue
                 seen.add((node.lineno, node.col_offset))
-                short = name.rsplit(".", 2)[-1] if name != "json.loads" \
-                    else "json.loads"
                 yield Finding(
                     "hot-loop-alloc", Severity.WARNING, ctx.path,
-                    node.lineno, node.col_offset,
-                    f"per-event {short}() inside a data-plane loop: "
-                    "row-at-a-time deserialization is the ingest/training "
-                    "bottleneck the columnar path removes — use "
-                    "data/columnar.py (decode_api_batch / find_columnar "
-                    "/ insert_batch), or justify the row fallback with "
-                    "# pio: lint-ok[hot-loop-alloc]")
+                    node.lineno, node.col_offset, msg(name))
+
+    @staticmethod
+    def _data_msg(name: str) -> str:
+        short = name.rsplit(".", 2)[-1] if name != "json.loads" \
+            else "json.loads"
+        return (
+            f"per-event {short}() inside a data-plane loop: "
+            "row-at-a-time deserialization is the ingest/training "
+            "bottleneck the columnar path removes — use "
+            "data/columnar.py (decode_api_batch / find_columnar "
+            "/ insert_batch), or justify the row fallback with "
+            "# pio: lint-ok[hot-loop-alloc]")
+
+    @staticmethod
+    def _ops_msg(name: str) -> str:
+        return (
+            f"{name.rsplit('.', 1)[-1]}() materializes an array inside "
+            "a Python loop in the ops layer: each iteration re-traces "
+            "an allocation (unrolled into the HLO under jit) — hoist "
+            "it out of the loop, vectorize, or justify with "
+            "# pio: lint-ok[hot-loop-alloc]")

@@ -119,8 +119,25 @@ class ALSParams:
     #   "hybrid":  XLA batched-MXU blocks + Pallas segment-flush scatter
     #              (ops/als_pallas.py normal_equations_hybrid) — keeps
     #              the fast einsum, replaces only the scatter emitter;
+    #   "stream":  hybrid with the OVERLAPPED flush kernel
+    #              (_segment_kernel_stream): each A-row DMA starts at
+    #              its flush point and is awaited at the next flush
+    #              that reuses the staging slot, hiding the
+    #              65 ms/sweep of exposed flush latency the round-5
+    #              profile charged the hybrid kernel's in-kernel waits;
     #   "auto":    per-backend (see resolved_accum)
     accum: str = "auto"
+    # store A lane-packed (n, k²) end-to-end: the streaming flush
+    # kernel writes packed rows (k² is a 128-multiple — no lane
+    # padding, a 2x byte cut on A at rank 64) and the CG solve consumes
+    # them through the Pallas packed batched matvec, so the 6.1x
+    # isolated packed-matvec win (eval/als_kernel_lab.py) composes with
+    # no XLA relayout at the scatter/solve boundary
+    # (eval/ALS_ROOFLINE.md "Lane-packed A" verdict). Requires the
+    # streaming flush: accum="hybrid" is promoted to "stream", the XLA
+    # accumulation paths ignore the flag (resolved_packed() reports
+    # what actually ran). Exact-Cholesky sides unpack once per solve.
+    packed_a: bool = False
     # stacked mode: max slots whose (k,k) blocks are materialized at once;
     # temp bytes = group_slots * k * k * 4 (73k slots @ k=64 = 1.2 GB)
     group_slots: int = 73728
@@ -131,12 +148,18 @@ class ALSParams:
     #       ~10x off HBM peak for VMEM-sized tables and the decision is
     #       out of reach from JAX (eval/ALS_ROOFLINE.md); applied only
     #       when the table fits GATHER_VMEM_TABLE_BUDGET, XLA otherwise;
+    #   "stream":      double-buffered HBM->VMEM streaming gather
+    #       (ops/als_pallas.py gather_rows_stream): per-row async
+    #       copies with mini-group prefetch, ANY table size — the
+    #       custom gather eval/ALS_ROOFLINE.md calls for on both sweep
+    #       halves (the users-half table is 4x over the VMEM budget);
     #   "auto":        currently "xla" — the Pallas variants are
     #       interpret-mode-validated; flips only when the on-hardware
     #       A/B (eval/als_accum_bench.py gather cells) shows a win
     gather: str = "auto"
 
-    _GATHER_MODES = ("auto", "xla", "pallas-copy", "pallas-take")
+    _GATHER_MODES = ("auto", "xla", "pallas-copy", "pallas-take", "stream")
+    _ACCUM_MODES = ("auto", "carry", "stacked", "pallas", "hybrid", "stream")
 
     def __post_init__(self):
         # validate here, not in the kernel: "pallas" alone would pass a
@@ -146,6 +169,13 @@ class ALSParams:
             raise ValueError(
                 f"ALSParams.gather={self.gather!r}; "
                 f"expected one of {self._GATHER_MODES}")
+        # same rationale for accum: the dispatch chain and the packed_a
+        # promotion key on exact strings, so a typo ("strem") would
+        # silently run the stacked path unpacked
+        if self.accum not in self._ACCUM_MODES:
+            raise ValueError(
+                f"ALSParams.accum={self.accum!r}; "
+                f"expected one of {self._ACCUM_MODES}")
 
     def resolved_cg_iters(self, n_self: int | None = None) -> int:
         """-1 (default) = auto, decided per factor side by its row count:
@@ -177,25 +207,37 @@ class ALSParams:
         """The accumulation strategy that actually runs ("auto" resolves
         here, next to resolved_cg_iters, so callers — bench artifacts
         included — can report the real mode, not the knob). Rank-aware:
-        _normal_equations falls back hybrid->stacked above k=256 (the
-        segment-flush kernel's VMEM blocks exceed the 16 MB scoped
+        _normal_equations falls back hybrid/stream->stacked above k=256
+        (the segment-flush kernel's VMEM blocks exceed the 16 MB scoped
         budget), and this mirror applies the same rule so artifacts
-        never report a mode that did not run.
+        never report a mode that did not run. packed_a promotes hybrid
+        to stream (packed rows need the streaming flush kernel).
 
         auto is per-backend: on TPU "hybrid" (XLA batched-MXU blocks +
         Pallas segment-flush scatter) measured 0.439 s/sweep at the
         ML-20M shape vs stacked 0.485 / carry 0.499 — the XLA
         scatter-add emitter runs at ~13% of streaming peak and the
         kernel writes each A row exactly once instead
-        (eval/ALS_ROOFLINE.md, eval/als_accum_bench.py). On CPU the
-        Pallas kernel only exists in interpret mode, and carry measured
-        fastest of the XLA paths, so carry stays."""
+        (eval/ALS_ROOFLINE.md, eval/als_accum_bench.py). auto stays on
+        hybrid — NOT stream — until the on-chip A/B
+        (eval/als_accum_bench.py stream cells) shows the overlapped
+        flush winning on hardware. On CPU the Pallas kernel only exists
+        in interpret mode, and carry measured fastest of the XLA paths,
+        so carry stays."""
         mode = self.accum
         if mode == "auto":
             mode = "hybrid" if _accelerator_backend() else "carry"
-        if mode == "hybrid" and self.rank > 256:
+        if self.packed_a and mode == "hybrid":
+            mode = "stream"    # packed rows require the streaming flush
+        if mode in ("hybrid", "stream") and self.rank > 256:
             mode = "stacked"   # keep in sync with _normal_equations
         return mode
+
+    def resolved_packed(self) -> bool:
+        """True when A actually flows lane-packed: packed_a requested
+        AND the resolved accumulation is the streaming flush kernel
+        (the XLA paths and the k>256 fallback produce (n,k,k))."""
+        return self.packed_a and self.resolved_accum() == "stream"
 
 
 @dataclass(frozen=True)
@@ -333,7 +375,22 @@ def _chunk_blocks(src, i_c, v_c, l_c, implicit: bool, alpha: float,
     mask = (
         jnp.arange(W, dtype=jnp.int32)[None, :] < l_c[:, None]
     ).astype(jnp.float32)
-    if gather.startswith("pallas"):
+    if gather == "stream":
+        from pio_tpu.ops.als_pallas import gather_rows_stream
+
+        # double-buffered HBM->VMEM streaming gather: no table-size
+        # precondition, and the output block is written sequentially in
+        # exactly the (C*W, k) layout this reshape consumes — no XLA
+        # copy between the gather and the blocks einsum (the 38 ms
+        # y-copy in the round-5 profile)
+        n, k = src.shape
+        C = i_c.shape[0]
+        flat = i_c.reshape(-1)
+        y = gather_rows_stream(
+            src, flat,
+            rows_per_step=_gather_pow2_rows(flat.shape[0], cap=512),
+        ).reshape(C, W, k).astype(jnp.float32)
+    elif gather.startswith("pallas"):
         from pio_tpu.ops.als_pallas import (
             GATHER_VMEM_TABLE_BUDGET, gather_rows_pallas, gather_table_bytes,
         )
@@ -378,7 +435,8 @@ def _chunk_blocks(src, i_c, v_c, l_c, implicit: bool, alpha: float,
 def _normal_equations(layout, other_factors, n_self, implicit: bool,
                       alpha: float, chunk_slots: int,
                       bf16_gather: bool = False, accum: str = "auto",
-                      group_slots: int = 73728, gather: str = "auto"):
+                      group_slots: int = 73728, gather: str = "auto",
+                      packed: bool = False):
     """Accumulate per-row normal equations A (n_self,k,k), b (n_self,k).
 
     Slots sharing a row (rows wider than `width`) scatter-add into the same
@@ -393,7 +451,12 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
     accum="stacked" emits per-slot blocks as scan OUTPUTS and folds each
     group of `group_slots` slots into A with ONE sorted scatter-add — the
     accumulator is written, not carried, at the price of a bounded
-    (group_slots,k,k) temp."""
+    (group_slots,k,k) temp.
+
+    packed=True requests lane-packed A (n_self, k²); only the streaming
+    flush kernel can produce it, so accum="hybrid" is promoted to
+    "stream" and the XLA paths return (n,k,k) regardless (callers
+    detect the form by A.ndim — see _solve_factors)."""
     rows, idx, val, lens = layout
     k = other_factors.shape[1]
     S, W = idx.shape
@@ -421,22 +484,28 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
             bf16_gather=bf16_gather,
         )
 
-    if accum == "hybrid" and k > 256:
+    if packed and accum == "hybrid":
+        accum = "stream"   # packed rows require the streaming flush
+
+    if accum in ("hybrid", "stream") and k > 256:
         # the kernel's VMEM blocks block is >=8 slots x k^2 x 4 B double-
         # buffered; beyond k=256 that exceeds the 16 MB scoped VMEM no
         # matter the chunk, so high ranks take the XLA scatter path
         accum = "stacked"
 
-    if accum == "hybrid":
+    if accum in ("hybrid", "stream"):
         from pio_tpu.ops.als_pallas import normal_equations_hybrid
 
         # XLA batched-MXU blocks + Pallas segment-flush in place of the
         # XLA scatter-add (the 118 ms/sweep, ~13%-of-peak emitter —
-        # eval/ALS_ROOFLINE.md)
+        # eval/ALS_ROOFLINE.md); "stream" overlaps the flush DMAs and
+        # optionally writes A lane-packed
         return normal_equations_hybrid(
             layout, other_factors, n_self, implicit, alpha,
             chunk_slots=chunk_slots, group_slots=group_slots,
             bf16_gather=bf16_gather, gather=gather,
+            overlap=(accum == "stream"),
+            packed=packed,  # packed implies accum=="stream" (promoted)
         )
 
     if accum == "carry":
@@ -507,22 +576,9 @@ def _normal_equations(layout, other_factors, n_self, implicit: bool,
     return A, b
 
 
-def _cg_solve(A, b, x0, n_iter: int):
-    """Batched Jacobi-preconditioned conjugate gradient for SPD systems.
-
-    ALS is block coordinate descent, so the inexact inner solve (relative
-    residual ~1e-4 at 24 iters on k=64) does not change the fixed point it
-    converges to; warm-starting from the previous sweep's factors keeps
-    later sweeps cheap.
-    """
-    dinv = 1.0 / jnp.diagonal(A, axis1=1, axis2=2)
-
-    def mv(x):
-        return jnp.einsum(
-            "bij,bj->bi", A, x, preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGH,
-        )
-
+def _cg_body(mv, dinv, b, x0, n_iter: int):
+    """The Jacobi-CG iteration shared by the lane-padded and packed
+    matvec forms: only `mv` (the batched A@x) and `dinv` differ."""
     x = x0
     r = b - mv(x)
     z = r * dinv
@@ -545,30 +601,114 @@ def _cg_solve(A, b, x0, n_iter: int):
     return x
 
 
+def _cg_solve(A, b, x0, n_iter: int):
+    """Batched Jacobi-preconditioned conjugate gradient for SPD systems.
+
+    ALS is block coordinate descent, so the inexact inner solve (relative
+    residual ~1e-4 at 24 iters on k=64) does not change the fixed point it
+    converges to; warm-starting from the previous sweep's factors keeps
+    later sweeps cheap.
+    """
+    dinv = 1.0 / jnp.diagonal(A, axis1=1, axis2=2)
+
+    def mv(x):
+        return jnp.einsum(
+            "bij,bj->bi", A, x, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGH,
+        )
+
+    return _cg_body(mv, dinv, b, x0, n_iter)
+
+
+def _cg_solve_packed(Ap, b, x0, n_iter: int, block_rows: int):
+    """_cg_solve on LANE-PACKED A (n, k²): the matvec is the Pallas
+    packed batched matvec (ops/als_pallas.py packed_block_matvec), so
+    no (n,k²)->(n,k,k) relayout appears inside the CG loop — the
+    structural property tests/test_als_pallas.py pins on the optimized
+    HLO. The Jacobi diagonal is a k-element strided take per solve
+    (outside the loop)."""
+    from pio_tpu.ops.als_pallas import packed_block_matvec
+
+    k = b.shape[1]
+    diag = Ap[:, jnp.arange(k, dtype=jnp.int32) * (k + 1)]
+    dinv = 1.0 / diag
+
+    def mv(x):
+        return packed_block_matvec(Ap, x, block_rows=block_rows)
+
+    return _cg_body(mv, dinv, b, x0, n_iter)
+
+
+def _shared_yty(other_factors, yty):
+    """Shared Y^T Y term (confidence-1 part handled in accumulation).
+    The sharded trainer passes a psum-reduced `yty` built from the
+    LOCAL opposing block: recomputing it from the gathered matrix
+    would be O(n_dev) redundant FLOPs on every device (measured as
+    the dominant super-linear term in eval/WEAK_SCALING.json)."""
+    if yty is not None:
+        return yty
+    return jnp.matmul(
+        other_factors.T, other_factors,
+        precision=jax.lax.Precision.HIGH,
+    )
+
+
+def _solve_packed(A, b, reg, implicit, alpha, other_factors, yty, x0,
+                  cg_iters: int):
+    """The solve on LANE-PACKED A (n, k²) from the streaming flush
+    kernel: the reg/yty terms are elementwise adds in packed space, and
+    CG runs on the Pallas packed matvec — the packed form survives from
+    the flush to the last CG iteration with no relayout. The one pad to
+    the matvec's row-block multiple happens HERE, once per solve,
+    outside the CG loop (identity rows keep the padded diagonal
+    invertible; padded b/x0 are zero, and CG's per-row arithmetic never
+    mixes rows, so the pad is exact). Exact-Cholesky sides (cg_iters=0:
+    small row batches, bit-exactness escapes) unpack once — also
+    outside any loop."""
+    from pio_tpu.ops.als_pallas import _matvec_block_rows
+
+    n_self, k2 = A.shape
+    k = b.shape[1]
+    eye_flat = jnp.eye(k, dtype=jnp.float32).reshape(k2)
+    if implicit:
+        A = A + _shared_yty(other_factors, yty).reshape(k2)[None, :]
+    A = A + reg * eye_flat[None, :]
+    if cg_iters <= 0:
+        A3 = A.reshape(n_self, k, k)
+        chol = jax.scipy.linalg.cho_factor(A3)
+        return jax.scipy.linalg.cho_solve(chol, b)
+    block = _matvec_block_rows(k)
+    pad = -n_self % block
+    if pad:
+        A = jnp.concatenate(
+            [A, jnp.broadcast_to(eye_flat, (pad, k2))])
+        b = jnp.concatenate([b, jnp.zeros((pad, k), b.dtype)])
+        if x0 is not None:
+            x0 = jnp.concatenate([x0, jnp.zeros((pad, k), jnp.float32)])
+    if x0 is None:
+        x0 = jnp.zeros_like(b)
+    x = _cg_solve_packed(A, b, x0, cg_iters, block)
+    return x[:n_self]
+
+
 def _solve_factors(layout, other_factors, n_self, reg, implicit, alpha,
                    chunk_slots, x0=None, cg_iters: int = 0,
                    bf16_gather: bool = False, accum: str = "auto",
                    group_slots: int = 73728, yty=None,
-                   gather: str = "auto"):
+                   gather: str = "auto", packed: bool = False):
     A, b = _normal_equations(
         layout, other_factors, n_self, implicit, alpha, chunk_slots,
         bf16_gather=bf16_gather, accum=accum, group_slots=group_slots,
-        gather=gather,
+        gather=gather, packed=packed,
     )
+    if A.ndim == 2:
+        # the streaming flush produced lane-packed (n, k²) rows
+        return _solve_packed(A, b, reg, implicit, alpha, other_factors,
+                             yty, x0, cg_iters)
     k = other_factors.shape[1]
     eye = jnp.eye(k, dtype=jnp.float32)
     if implicit:
-        # shared Y^T Y term (confidence-1 part handled in accumulation).
-        # The sharded trainer passes a psum-reduced `yty` built from the
-        # LOCAL opposing block: recomputing it from the gathered matrix
-        # would be O(n_dev) redundant FLOPs on every device (measured as
-        # the dominant super-linear term in eval/WEAK_SCALING.json)
-        if yty is None:
-            yty = jnp.matmul(
-                other_factors.T, other_factors,
-                precision=jax.lax.Precision.HIGH,
-            )
-        A = A + yty[None, :, :]
+        A = A + _shared_yty(other_factors, yty)[None, :, :]
     A = A + reg * eye[None, :, :]
     if cg_iters > 0:
         if x0 is None:
@@ -630,14 +770,14 @@ def _sweep_factory(by_user, by_item, n_users: int, n_items: int, cs: int,
                 params.reg, params.implicit, params.alpha, cs,
                 x0=users, cg_iters=cg_u_n, bf16_gather=params.bf16_gather,
                 accum=params.accum, group_slots=params.group_slots,
-                gather=params.gather,
+                gather=params.gather, packed=params.packed_a,
             )
             items = _solve_factors(
                 by_item, users, n_items,
                 params.reg, params.implicit, params.alpha, cs,
                 x0=items, cg_iters=cg_i_n, bf16_gather=params.bf16_gather,
                 accum=params.accum, group_slots=params.group_slots,
-                gather=params.gather,
+                gather=params.gather, packed=params.packed_a,
             )
             return (users, items), None
         return sweep
@@ -1005,6 +1145,7 @@ def _sharded_train_fn(mesh: Mesh, ub: int, ib: int, su: int, si: int,
                     bf16_gather=params.bf16_gather,
                     accum=params.accum, group_slots=params.group_slots,
                     yty=yty_i, gather=params.gather,
+                    packed=params.packed_a,
                 )
                 yty_u = gram_psum(users) if params.implicit else None
                 all_users = jax.lax.all_gather(
@@ -1017,6 +1158,7 @@ def _sharded_train_fn(mesh: Mesh, ub: int, ib: int, su: int, si: int,
                     bf16_gather=params.bf16_gather,
                     accum=params.accum, group_slots=params.group_slots,
                     yty=yty_u, gather=params.gather,
+                    packed=params.packed_a,
                 )
                 return (users, items), None
             return sweep

@@ -277,6 +277,250 @@ def test_gather_budget_helper():
     assert gather_table_bytes(138_493, 64, True) > GATHER_VMEM_TABLE_BUDGET
 
 
+# ---------------------------------------------------------------------------
+# round-6 streaming kernels: double-buffered gather, overlapped flush,
+# lane-packed A (all interpret mode — the kernel-parity CI job)
+# ---------------------------------------------------------------------------
+
+def _relerr(got, ref):
+    got, ref = np.asarray(got, np.float64), np.asarray(ref, np.float64)
+    scale = np.abs(ref).max()
+    return float(np.abs(got - ref).max() / (scale if scale else 1.0))
+
+
+@pytest.mark.parametrize("k", [64, 128])
+@pytest.mark.parametrize("bf16", [False, True])
+def test_gather_stream_parity(k, bf16):
+    """Streaming gather vs the plain table[idx] oracle at both lane
+    regimes (k=64 pads to 128 lanes, k=128 is lane-exact), bf16 and
+    f32, with an ODD index count (the internal sentinel padding and
+    the partial trailing mini-group both execute). Exact: a gather
+    moves bytes."""
+    from pio_tpu.ops.als_pallas import gather_rows_stream
+
+    rng = np.random.default_rng(0)
+    n, m = 37, 421   # m % rows_per_step != 0 and m % group != 0
+    table = rng.normal(size=(n, k)).astype(np.float32)
+    tbl = jnp.asarray(table, jnp.bfloat16) if bf16 else jnp.asarray(table)
+    idx = jnp.asarray(rng.integers(0, n, m), jnp.int32)
+    got = gather_rows_stream(tbl, idx, rows_per_step=64, group=16)
+    ref = np.asarray(tbl, np.float32)[np.asarray(idx)]
+    np.testing.assert_array_equal(np.asarray(got, np.float32), ref)
+
+
+def test_gather_stream_single_group_and_tiny():
+    """rows_per_step >= m (one grid step, one mini-group: the prefetch
+    branch never fires) and group clamped to a rows_per_step divisor."""
+    from pio_tpu.ops.als_pallas import gather_rows_stream
+
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.normal(size=(9, 8)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, 9, 5), jnp.int32)
+    got = gather_rows_stream(table, idx, rows_per_step=512, group=48)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(table)[np.asarray(idx)])
+
+
+def test_accum_stream_matches_hybrid_exactly_and_oracle():
+    """accum="stream" (overlapped flush) must be BIT-EXACT vs the
+    hardware-validated plain hybrid kernel — identical adds in an
+    identical order, only the DMA schedule moves — and within 1e-6
+    relerr of the XLA carry oracle, including rows whose slot runs
+    cross kernel-chunk AND group boundaries (cross-group trails)."""
+    from pio_tpu.ops.als import _normal_equations
+    from pio_tpu.ops.als_pallas import normal_equations_hybrid
+
+    rng = np.random.default_rng(7)
+    NU, NI, NNZ, K, W, CS = 70, 30, 4000, 16, 8, 64
+    u = (rng.zipf(1.2, NNZ) % NU).astype(np.int32)
+    i = (rng.zipf(1.2, NNZ) % NI).astype(np.int32)
+    v = rng.integers(1, 6, NNZ).astype(np.float32)
+    su = _slots_for(NNZ, NU, W, CS)
+    lay = _device_slot_layout(
+        jnp.asarray(u), jnp.asarray(i), jnp.asarray(v), NU, W, su)
+    fac = jnp.asarray(rng.normal(size=(NI, K)).astype(np.float32)) * 0.3
+    # group_slots=128 -> several groups; zipf-heavy rows span them
+    kw = dict(chunk_slots=CS, group_slots=128, bf16_gather=False,
+              interpret=True)
+    A_h, b_h = normal_equations_hybrid(lay, fac, NU, True, 5.0, **kw)
+    A_s, b_s = normal_equations_hybrid(lay, fac, NU, True, 5.0,
+                                       overlap=True, **kw)
+    np.testing.assert_array_equal(np.asarray(A_s), np.asarray(A_h))
+    np.testing.assert_array_equal(np.asarray(b_s), np.asarray(b_h))
+    A_ref, b_ref = _normal_equations(
+        lay, fac, NU, True, 5.0, CS, accum="carry", bf16_gather=False)
+    assert _relerr(A_s, A_ref) < 1e-6
+    assert _relerr(b_s, b_ref) < 1e-6
+
+
+@pytest.mark.parametrize("k", [64, 128])
+def test_accum_stream_odd_last_chunk_and_k_lane_regimes(k):
+    """k=64 (lane-padded acc) and k=128 (lane-exact) through the
+    streaming flush, with a slot count that is NOT a multiple of the
+    kernel chunk so the sentinel quantum-padding branch runs (the
+    'odd last chunk')."""
+    from pio_tpu.ops.als import _normal_equations
+
+    rng = np.random.default_rng(11)
+    NU, NI, NNZ, W, CS = 9, 12, 300, 4, 24
+    u = rng.integers(0, NU, NNZ).astype(np.int32)
+    i = rng.integers(0, NI, NNZ).astype(np.int32)
+    v = (rng.random(NNZ) * 2 + 0.5).astype(np.float32)
+    su = _slots_for(NNZ, NU, W, CS)   # multiple of 24, not of 8/16
+    lay = _device_slot_layout(
+        jnp.asarray(u), jnp.asarray(i), jnp.asarray(v), NU, W, su)
+    fac = jnp.asarray(rng.normal(size=(NI, k)).astype(np.float32)) * 0.2
+    A_ref, b_ref = _normal_equations(
+        lay, fac, NU, False, 1.0, CS, accum="carry", bf16_gather=False)
+    A_s, b_s = _normal_equations(
+        lay, fac, NU, False, 1.0, CS, accum="stream", bf16_gather=False)
+    assert _relerr(A_s, A_ref) < 1e-6
+    assert _relerr(b_s, b_ref) < 1e-6
+
+
+def test_packed_a_matches_unpacked_bitwise():
+    """The packed flush writes the SAME f32 sums the unpacked flush
+    writes, just lane-packed: bit-exact vs accum="stream" reshaped,
+    empty rows all-zero (the zeros contract survives packing)."""
+    from pio_tpu.ops.als import _normal_equations
+
+    layout, factors, u = _layout_and_factors(
+        n_self=37, chunk_slots=16, k=8)
+    A_s, b_s = _normal_equations(
+        layout, factors, 37, True, 2.5, 16, accum="stream",
+        bf16_gather=False)
+    A_p, b_p = _normal_equations(
+        layout, factors, 37, True, 2.5, 16, accum="stream",
+        bf16_gather=False, packed=True)
+    assert A_p.shape == (37, 64)
+    np.testing.assert_array_equal(
+        np.asarray(A_p), np.asarray(A_s).reshape(37, 64))
+    np.testing.assert_array_equal(np.asarray(b_p), np.asarray(b_s))
+    for empty in (5, 6):
+        assert empty not in set(u.tolist())
+        assert np.all(np.asarray(A_p)[empty] == 0)
+
+
+@pytest.mark.parametrize("k", [8, 64, 128])
+def test_packed_block_matvec_matches_einsum(k):
+    from pio_tpu.ops.als_pallas import packed_block_matvec
+
+    rng = np.random.default_rng(2)
+    n = 24
+    A = rng.normal(size=(n, k, k)).astype(np.float32)
+    A = A + np.swapaxes(A, 1, 2)      # symmetric, like a normal equation
+    x = rng.normal(size=(n, k)).astype(np.float32)
+    got = packed_block_matvec(
+        jnp.asarray(A.reshape(n, k * k)), jnp.asarray(x), block_rows=8)
+    ref = np.einsum("bij,bj->bi", A.astype(np.float64), x)
+    assert _relerr(got, ref) < 1e-6
+
+
+def test_packed_train_end_to_end_and_x0_padding():
+    """als_train with packed_a=True (stream accum + packed CG) reaches
+    the carry path's solution quality; n_self deliberately NOT a
+    multiple of the matvec row block, so the identity-row pad in
+    _solve_packed runs with a warm x0."""
+    from pio_tpu.ops.als import ALSParams, als_train, rmse
+
+    rng = np.random.default_rng(3)
+    nu, ni, nnz = 53, 31, 900
+    u = rng.integers(0, nu, nnz).astype(np.int64)
+    i = rng.integers(0, ni, nnz).astype(np.int64)
+    v = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    kw = dict(rank=8, iterations=5, reg=0.1, chunk=256, width=8,
+              chunk_slots=64, cg_iters=10, bf16_gather=False)
+    m_p = als_train(u, i, v, nu, ni,
+                    ALSParams(**kw, accum="stream", packed_a=True))
+    m_c = als_train(u, i, v, nu, ni, ALSParams(**kw, accum="carry"))
+    assert abs(rmse(m_p, u, i, v) - rmse(m_c, u, i, v)) < 1e-3
+
+
+def test_stream_gather_composes_in_training():
+    """gather="stream" through the full hybrid/stream accumulation:
+    identical math, only the gather implementation moves — factors
+    must match the XLA-gather run bit-for-bit (both gathers produce
+    the same bytes and the downstream program is identical)."""
+    import dataclasses
+
+    from pio_tpu.ops.als import ALSParams, als_train
+
+    rng = np.random.default_rng(4)
+    nu, ni, nnz = 40, 25, 800
+    u = rng.integers(0, nu, nnz).astype(np.int64)
+    i = rng.integers(0, ni, nnz).astype(np.int64)
+    v = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    base = ALSParams(rank=8, iterations=3, reg=0.05, chunk=256, width=8,
+                     chunk_slots=64, cg_iters=8, accum="stream",
+                     bf16_gather=False)
+    ref = als_train(u, i, v, nu, ni, base)
+    got = als_train(u, i, v, nu, ni,
+                    dataclasses.replace(base, gather="stream"))
+    np.testing.assert_array_equal(
+        np.asarray(got.user_factors), np.asarray(ref.user_factors))
+
+
+def test_stream_modes_compose_with_shard_map():
+    """The full round-6 configuration — accum="stream",
+    gather="stream", packed_a=True — inside als_train_sharded's
+    shard_map (8 virtual devices) vs the single-device carry ground
+    truth: the production multi-chip composition of every new kernel
+    at once."""
+    from pio_tpu.ops.als import ALSParams, als_train, als_train_sharded, rmse
+    from pio_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    rng = np.random.default_rng(0)
+    nu, ni, nnz = 60, 40, 900
+    u = rng.integers(0, nu, nnz)
+    i = rng.integers(0, ni, nnz)
+    v = (rng.random(nnz) * 4 + 1).astype(np.float32)
+    mesh = create_mesh(MeshConfig(data=8))
+    kw = dict(rank=8, iterations=5, reg=0.1, chunk=256, width=8,
+              chunk_slots=64, cg_iters=8)
+    m = als_train_sharded(
+        u, i, v, nu, ni,
+        ALSParams(**kw, accum="stream", gather="stream", packed_a=True),
+        mesh)
+    m1 = als_train(u, i, v, nu, ni, ALSParams(**kw, accum="carry"))
+    assert abs(rmse(m, u, i, v) - rmse(m1, u, i, v)) < 5e-3
+
+
+def test_packed_train_step_hlo_has_no_relayout():
+    """The structural property the packed path exists to guarantee,
+    checkable WITHOUT a chip: the optimized HLO of the packed-A
+    training step contains NO (n,k,k)-shaped full-A tensor — no
+    (n,k²)<->(n,k,k) reshape/relayout anywhere, in particular not
+    inside the CG while loop. cg_iters is explicit so BOTH sides take
+    the CG path (the exact-Cholesky escape legitimately unpacks).
+    Absence of the 3-d shape module-wide is strictly stronger than
+    absence inside the loop. The packed shape must be present (the
+    check would pass vacuously if the packed path silently fell back)."""
+    from pio_tpu.ops.als import ALSParams, _init_or, _prep_coo, _train_jit
+
+    rng = np.random.default_rng(9)
+    nu, ni, nnz, k = 57, 41, 600, 8
+    params = ALSParams(rank=k, iterations=2, reg=0.05, chunk=0, width=8,
+                       chunk_slots=64, accum="stream", packed_a=True,
+                       cg_iters=6, bf16_gather=False)
+    u, i, v = _prep_coo(
+        rng.integers(0, nu, nnz).astype(np.int64),
+        rng.integers(0, ni, nnz).astype(np.int64),
+        (rng.random(nnz) * 4 + 1).astype(np.float32), nu, ni, params)
+    user0, item0 = _init_or(None, nu, ni, params)
+    txt = _train_jit.lower(
+        jnp.asarray(u), jnp.asarray(i), jnp.asarray(v),
+        n_users=nu, n_items=ni, params=params,
+        user0=user0, item0=item0,
+    ).compile().as_text()
+    assert f"f32[{nu},{k},{k}]" not in txt, (
+        "full-A (n,k,k) tensor appears in the packed-A program — a "
+        "relayout leaked into the solve")
+    assert f"f32[{ni},{k},{k}]" not in txt
+    assert (f"f32[{nu},{k * k}]" in txt
+            or f"f32[{nu + 1},{k * k}]" in txt), (
+        "packed (n,k²) A absent — the packed path did not run")
+
+
 def test_als_train_with_pallas_gather_matches_xla():
     """End-to-end ALS with gather='pallas-*' must match gather='xla'
     (identical math, only the gather implementation moves)."""

@@ -523,6 +523,58 @@ def test_hot_loop_alloc_silent_outside_loops_and_suppressible():
         textwrap.dedent(suppressed), path="pio_tpu/data/x.py") == []
 
 
+def test_hot_loop_alloc_ops_scope_flags_array_materialization():
+    src = """
+        import jax.numpy as jnp
+
+        def fold_groups(groups, k):
+            acc = None
+            for g in groups:
+                buf = jnp.zeros((g, k, k))
+                acc = buf if acc is None else acc + buf
+            return acc
+    """
+    fs = lint_text(textwrap.dedent(src), path="pio_tpu/ops/x.py")
+    assert {f.rule for f in fs} == {"hot-loop-alloc"}
+    assert "materializes an array" in fs[0].message
+    # models/, eval/, tests keep their readable loops
+    assert lint_text(textwrap.dedent(src), path="pio_tpu/models/x.py") == []
+    # and the data-plane call set does NOT apply in ops (json decode in
+    # an ops tool loop is not a columnar-path regression)
+    ops_json = """
+        import json
+
+        def parse(rows):
+            return [json.loads(r) for r in rows]
+    """
+    assert lint_text(textwrap.dedent(ops_json), path="pio_tpu/ops/x.py") == []
+
+
+def test_hot_loop_alloc_ops_scope_hoisted_and_suppressed_ok():
+    hoisted = """
+        import jax.numpy as jnp
+
+        def fold_groups(groups, k):
+            acc = jnp.zeros((128, k, k))
+            for g in groups:
+                acc = acc + g
+            return acc
+    """
+    assert lint_text(textwrap.dedent(hoisted), path="pio_tpu/ops/x.py") == []
+    suppressed = """
+        import jax.numpy as jnp
+
+        def trails(parts):
+            out = []
+            for p in parts:
+                # pio: lint-ok[hot-loop-alloc] one tiny trail per group
+                out.append(jnp.asarray(p))
+            return out
+    """
+    assert lint_text(
+        textwrap.dedent(suppressed), path="pio_tpu/ops/x.py") == []
+
+
 def test_non_jax_timing_silent():
     fs = lint("""
         import time
