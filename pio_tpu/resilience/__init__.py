@@ -31,7 +31,7 @@ from pio_tpu.resilience.policies import (
     RetryPolicy,
     is_transient,
 )
-from pio_tpu.resilience.spill import SpillQueue
+from pio_tpu.resilience.spill import SpillQueue, SpillSaturated
 
 __all__ = [
     "STORAGE_RETRY",
@@ -43,5 +43,6 @@ __all__ = [
     "ResilientDAO",
     "RetryPolicy",
     "SpillQueue",
+    "SpillSaturated",
     "is_transient",
 ]

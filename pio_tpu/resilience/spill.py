@@ -32,18 +32,45 @@ from pio_tpu.resilience.policies import is_transient
 log = logging.getLogger("pio_tpu.resilience.spill")
 
 
+class SpillSaturated(Exception):
+    """The spill queue crossed its high-water mark: the caller should
+    answer 429 + Retry-After (an explicit, retryable backpressure
+    signal) instead of parking yet more memory behind a dead store.
+    Raised by callers, not the queue — ``should_shed()`` is the
+    query."""
+
+
 class SpillQueue:
     """Bounded FIFO of (event, app_id, channel_id) awaiting re-insert.
 
     `insert_fn(event, app_id, channel_id)` is the (already resilient)
     DAO insert. The drain thread starts lazily on first spill and runs
     for the queue's lifetime; `close()` stops it.
+
+    Backpressure hysteresis: once depth reaches ``high_water`` the queue
+    reports ``should_shed()`` — the event server then answers 429 +
+    Retry-After instead of 201-spilling — and keeps shedding until the
+    drain brings depth back to ``low_water``, so a store outage long
+    enough to fill the buffer produces ONE clean flip to shedding and
+    ONE flip back, not a 201/429 flutter at the boundary.
+    ``high_water <= 0`` (0 is the default) disables backpressure
+    entirely — exactly the pre-hysteresis behavior: offers are accepted
+    until the queue is literally full, and a full queue refuses the
+    offer (the caller's 503 path). An explicit mark is clamped to
+    ``capacity`` so a misconfigured mark above it cannot silently
+    disable the feature.
     """
 
     def __init__(self, insert_fn: Callable[..., Any], capacity: int = 10000,
-                 base_interval_s: float = 0.2, max_interval_s: float = 5.0):
+                 base_interval_s: float = 0.2, max_interval_s: float = 5.0,
+                 high_water: int = 0, low_water: int = 0):
         self._insert = insert_fn
         self.capacity = int(capacity)
+        self.high_water = (min(int(high_water), self.capacity)
+                           if int(high_water) > 0 else 0)
+        self.low_water = (max(0, min(int(low_water) or self.high_water // 2,
+                                     self.high_water - 1))
+                          if self.high_water else 0)
         self._base_interval_s = base_interval_s
         self._max_interval_s = max_interval_s
         self._q: deque[tuple[Any, int, int | None]] = deque()
@@ -51,9 +78,11 @@ class SpillQueue:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._closed = False
+        self._saturated = False
         self.spilled_total = 0
         self.drained_total = 0
         self.dropped_total = 0   # offers refused because the queue was full
+        self.shed_total = 0      # callers turned away above high water
 
     # -- producer side ------------------------------------------------------
     def offer(self, event: Any, app_id: int,
@@ -66,6 +95,8 @@ class SpillQueue:
                 return False
             self._q.append((event, app_id, channel_id))
             self.spilled_total += 1
+            if self.high_water and len(self._q) >= self.high_water:
+                self._saturated = True
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._drain_loop, name="event-spill-drain",
@@ -75,6 +106,20 @@ class SpillQueue:
         self._wake.set()
         return True
 
+    def should_shed(self) -> bool:
+        """True while depth has crossed high_water and has not yet
+        drained back to low_water (hysteresis — see class docstring).
+        Callers that turn a request away on this MUST call
+        ``record_shed()`` so the counter stays honest."""
+        with self._lock:
+            if self._saturated and len(self._q) <= self.low_water:
+                self._saturated = False
+            return self._saturated
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed_total += 1
+
     @property
     def size(self) -> int:
         with self._lock:
@@ -82,10 +127,14 @@ class SpillQueue:
 
     def snapshot(self) -> dict:
         with self._lock:
+            if self._saturated and len(self._q) <= self.low_water:
+                self._saturated = False
             return {
                 "size": len(self._q), "capacity": self.capacity,
+                "highWater": self.high_water, "lowWater": self.low_water,
+                "saturated": self._saturated,
                 "spilled": self.spilled_total, "drained": self.drained_total,
-                "dropped": self.dropped_total,
+                "dropped": self.dropped_total, "shed": self.shed_total,
             }
 
     # -- drain side ---------------------------------------------------------

@@ -28,7 +28,7 @@ from pio_tpu.data.backends.common import new_event_id
 from pio_tpu.data.dao import AccessKey, Channel
 from pio_tpu.data.event import Event, EventValidationError, validate_event
 from pio_tpu.data.storage import Storage, get_storage
-from pio_tpu.resilience import SpillQueue, is_transient
+from pio_tpu.resilience import SpillQueue, SpillSaturated, is_transient
 from pio_tpu.resilience.health import (
     breaker_checks, install_health_routes, shedder_check,
 )
@@ -63,6 +63,16 @@ class EventServerConfig:
     # recovers — the server keeps answering 201 through short outages.
     # 0 disables (transient failures then answer 503 + Retry-After).
     spill_capacity: int = 10000
+    # end-to-end backpressure: past `spill_high_water` queued events the
+    # server answers 429 + Retry-After (an explicit retryable signal)
+    # instead of 201-spilling without bound, and resumes spilling once
+    # the background drain brings the queue back to `spill_low_water`
+    # (hysteresis — no 201/429 flutter at the boundary). high_water 0
+    # (the default) disables the 429 path — the pre-existing behavior:
+    # spill until the queue is literally full, then 503. An explicit
+    # mark is clamped to capacity; low_water defaults to high_water/2.
+    spill_high_water: int = 0
+    spill_low_water: int = 0
 
 
 class AuthError(Exception):
@@ -90,9 +100,26 @@ def build_event_app(
     app.stats = stats  # exposed for tests/ops
     # degraded-mode buffer: events that could not reach the store park
     # here and drain in the background (resilience/spill.py)
-    spill = (SpillQueue(events_dao.insert, config.spill_capacity)
+    spill = (SpillQueue(events_dao.insert, config.spill_capacity,
+                        high_water=config.spill_high_water,
+                        low_water=config.spill_low_water)
              if config.spill_capacity > 0 else None)
     app.spill = spill  # exposed for tests/ops (and readiness below)
+
+    def offer_or_shed(event: Event, app_id: int,
+                      channel_id: int | None) -> bool:
+        """Park an event in the spill queue, honoring the high-water
+        backpressure mark: past it, raise SpillSaturated (mapped to 429
+        + Retry-After) instead of growing the backlog; a literally full
+        queue returns False (the caller re-raises the store error ->
+        503). Hysteresis lives in SpillQueue.should_shed()."""
+        if spill.should_shed():
+            spill.record_shed()
+            raise SpillSaturated(
+                f"event spill queue past its high-water mark "
+                f"({spill.size}/{spill.high_water}); retry later"
+            )
+        return spill.offer(event, app_id, channel_id)
 
     # stale-while-down access-key cache: auth rides the same storage
     # source as the event store, so a tripped breaker would otherwise
@@ -185,7 +212,7 @@ def build_event_app(
         except Exception as e:  # noqa: BLE001 - classified below
             if spill is None or not is_transient(e):
                 raise
-            if not spill.offer(event, ak.appid, channel_id):
+            if not offer_or_shed(event, ak.appid, channel_id):
                 raise  # queue full: shed (503 via the authed wrapper)
             event_id, spilled = event.event_id, True
         if config.stats:  # gated like reference EventServer.scala:284-285
@@ -275,10 +302,16 @@ def build_event_app(
                 # not a server fault
                 results[i] = {"status": 400, "message": str(e)}
             except Exception as e:  # noqa: BLE001 - per-event isolation
-                if spill is not None and is_transient(e) and spill.offer(
-                        event, ak.appid, channel_id):
-                    ok(i, event, True)
-                    return
+                if spill is not None and is_transient(e):
+                    try:
+                        if offer_or_shed(event, ak.appid, channel_id):
+                            ok(i, event, True)
+                            return
+                    except SpillSaturated as sat:
+                        # per-slot 429: same backpressure signal the
+                        # single-event route answers past high water
+                        results[i] = {"status": 429, "message": str(sat)}
+                        return
                 results[i] = {
                     "status": 503 if is_transient(e) else 500,
                     "message": str(e),
@@ -316,6 +349,14 @@ def build_event_app(
                 ValueError,
             ) as e:
                 return 400, {"message": str(e)}
+            except SpillSaturated as e:
+                # end-to-end backpressure: the spill queue crossed its
+                # high-water mark — 429 tells well-behaved clients to
+                # back off while the drain catches up (resumes at the
+                # low-water mark; see resilience/spill.py hysteresis)
+                return 429, json_response(
+                    {"message": str(e)}, {"Retry-After": "1"},
+                )
             except Exception as e:  # noqa: BLE001 - classified below
                 if not is_transient(e):
                     raise  # real bug: dispatch_safe's 500 applies
@@ -571,13 +612,16 @@ def build_event_app(
         return 404, {"message": f"webhook {name} not supported"}
 
     def readiness() -> dict:
-        """storage breakers not open + spill queue not full + async
-        transport queue under its shed watermark."""
+        """storage breakers not open + spill queue under its high-water
+        mark (the snapshot exports depth/watermarks/saturation so
+        balancers and `pio doctor` see backpressure building before the
+        429s start) + async transport queue under its shed watermark."""
         checks = breaker_checks(storage)
         if spill is not None:
             s = spill.snapshot()
             checks["spill"] = {
-                "ok": s["size"] < s["capacity"], **s,
+                "ok": not s["saturated"] and s["size"] < s["capacity"],
+                **s,
             }
         checks.update(shedder_check(getattr(app, "transport", None)))
         return checks

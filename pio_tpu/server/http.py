@@ -171,6 +171,16 @@ def json_response(payload: Any, headers: dict[str, str]) -> RawResponse:
     )
 
 
+def server_key_ok(req: "Request", server_key: str) -> bool:
+    """The operator-endpoint accessKey guard (/reload, /stop) shared by
+    the single-host server, the fleet router, and the shard servers —
+    one place to harden (e.g. constant-time compare) for all three. An
+    empty configured key disables the check."""
+    if not server_key:
+        return True
+    return req.params.get("accessKey", "") == server_key
+
+
 def encode_payload(payload: Any) -> tuple[bytes, str, dict[str, str]]:
     """-> (body bytes, content-type, extra headers). str/bytes pass
     through as HTML; RawResponse carries its own content type/headers."""
@@ -277,7 +287,8 @@ class HttpServer:
 _STATUS_TEXT = {
     200: "OK", 400: "Bad Request", 401: "Unauthorized", 404: "Not Found",
     405: "Method Not Allowed", 409: "Conflict", 413: "Payload Too Large",
-    500: "Internal Server Error", 503: "Service Unavailable",
+    429: "Too Many Requests", 500: "Internal Server Error",
+    503: "Service Unavailable",
 }
 _MAX_HEADER = 64 * 1024
 _MAX_BODY = 64 * 1024 * 1024

@@ -1,0 +1,51 @@
+"""Sharded, replicated serving fleet (ROADMAP item 1).
+
+The single-host ``QueryServer`` (workflow/serve.py) keeps one full model
+copy per process — a ceiling on both model size and availability. This
+package splits the serving tier into three roles:
+
+  * **shard plan** (``plan.py``) — a deterministic crc32c partition of
+    the user/item factor tables by entity id, computed at deploy time
+    from the persisted model and recorded alongside the EngineInstance
+    (a plan blob + one CRC32C-framed partition blob per shard in the
+    MODELDATA repository).
+  * **shard servers** (``shard.py``) — each loads ONLY its partition
+    (enforced by an optional memory budget) and answers row-fetch /
+    partial-top-k / pair-score RPCs. Reload keeps last-good semantics:
+    a corrupt partition blob falls back to the previous COMPLETED
+    instance's partition, per shard.
+  * **router** (``router.py``) — the query front-end: fetches the user
+    row from its owner shard, fans partial-score RPCs to every shard,
+    and merges top-k bit-identically to the single-host path. Every
+    shard call runs under the resilience stack (per-replica
+    CircuitBreaker, Deadline checked before every attempt) with
+    single-attempt replica failover in preference order; with a
+    whole shard group down it serves a flagged degraded response
+    (popularity fallback blend) instead of a 5xx.
+
+``fleet.py`` boots the whole thing (``pio deploy --shards N
+--replicas R``); ``python -m pio_tpu.serving_fleet shard ...`` runs one
+shard server as its own process. See docs/serving.md "Sharded fleet".
+"""
+
+from pio_tpu.serving_fleet.plan import (
+    ShardPlan,
+    build_plan,
+    partition_model,
+    persist_fleet_artifacts,
+    shard_of,
+)
+from pio_tpu.serving_fleet.router import FleetRouter, RouterConfig
+from pio_tpu.serving_fleet.shard import ShardConfig, ShardServer
+
+__all__ = [
+    "FleetRouter",
+    "RouterConfig",
+    "ShardConfig",
+    "ShardPlan",
+    "ShardServer",
+    "build_plan",
+    "partition_model",
+    "persist_fleet_artifacts",
+    "shard_of",
+]

@@ -1,0 +1,304 @@
+"""Shard plan: deterministic partition of factor tables by entity id.
+
+The plan is a pure function of (model entity ids, n_shards): entity e
+lives on shard ``crc32c(e) % n_shards`` (utils/durable.py's CRC32C — the
+stdlib ``hash()`` is salted per process and MUST NOT be used here; the
+router and every shard have to agree across processes and restarts).
+
+At deploy time ``persist_fleet_artifacts`` computes the plan from the
+persisted model blob and records, in the MODELDATA repository alongside
+the EngineInstance's own blob:
+
+  * ``<instance>:shardplan``  — the plan JSON (counts per shard, the
+    popularity fallback list the router serves when a whole shard group
+    is down, and a plan hash), CRC32C-framed;
+  * ``<instance>:shard<i>``   — shard i's partition: its user rows, its
+    item rows + their GLOBAL dense indices (the merge key that keeps
+    fleet top-k bit-identical to the single-host oracle), pickled and
+    CRC32C-framed so every backend detects truncation/bit-rot at load.
+
+Partitions carry entity ids in dense-index order, so per-shard local
+order preserves global order and ``lax.top_k``'s lowest-index-first tie
+break survives the merge.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import pickle
+from dataclasses import asdict, dataclass
+from typing import Any
+
+import numpy as np
+
+from pio_tpu.utils.durable import ModelIntegrityError, crc32c, frame, unframe
+
+log = logging.getLogger("pio_tpu.fleet")
+
+PLAN_STRATEGY = "crc32c"
+PLAN_VERSION = 1
+FALLBACK_ITEMS = 50  # popularity list length recorded in the plan
+
+
+def shard_of(entity_id: str, n_shards: int) -> int:
+    """Owning shard for an entity id — stable across processes/hosts."""
+    return crc32c(str(entity_id).encode("utf-8")) % n_shards
+
+
+def plan_model_id(instance_id: str) -> str:
+    return f"{instance_id}:shardplan"
+
+
+def shard_model_id(instance_id: str, shard_index: int) -> str:
+    return f"{instance_id}:shard{shard_index}"
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """The deploy-time partition record (see module docstring)."""
+
+    instance_id: str
+    n_shards: int
+    n_replicas: int
+    strategy: str
+    version: int
+    user_counts: tuple[int, ...]   # users per shard
+    item_counts: tuple[int, ...]   # items per shard
+    fallback: tuple[dict, ...]     # [{"item": id, "score": s}, ...]
+    plan_hash: str                 # crc32c of the partition content
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "ShardPlan":
+        d = json.loads(text)
+        return ShardPlan(
+            instance_id=d["instance_id"], n_shards=int(d["n_shards"]),
+            n_replicas=int(d["n_replicas"]), strategy=d["strategy"],
+            version=int(d["version"]),
+            user_counts=tuple(d["user_counts"]),
+            item_counts=tuple(d["item_counts"]),
+            fallback=tuple(d["fallback"]),
+            plan_hash=d["plan_hash"],
+        )
+
+
+@dataclass
+class ShardPartition:
+    """One shard's slice of the factor tables.
+
+    ``item_gidx`` holds each local item's index in the FULL item table:
+    the router merges per-shard top-k by ``(-score, global_index)``,
+    which reproduces ``lax.top_k``'s descending-score, lowest-index tie
+    order exactly.
+    """
+
+    shard_index: int
+    n_shards: int
+    instance_id: str
+    user_ids: list[str]
+    user_rows: np.ndarray      # (n_local_users, k) float32
+    item_ids: list[str]
+    item_gidx: np.ndarray      # (n_local_items,) int32 global dense index
+    item_rows: np.ndarray      # (n_local_items, k) float32
+
+    def nbytes(self) -> int:
+        return int(self.user_rows.nbytes + self.item_rows.nbytes)
+
+
+def _factor_tables(model: Any) -> tuple[np.ndarray, np.ndarray, Any, Any]:
+    """Extract (user_factors, item_factors, users_index, items_index)
+    from a factor-table model (the RecommendationModel shape: ``factors``
+    with ``user_factors``/``item_factors`` jax/numpy arrays plus
+    ``users``/``items`` EntityIdIndex). Raises for model families the
+    fleet cannot partition yet."""
+    factors = getattr(model, "factors", None)
+    users = getattr(model, "users", None)
+    items = getattr(model, "items", None)
+    uf = getattr(factors, "user_factors", None)
+    itf = getattr(factors, "item_factors", None)
+    if uf is None or itf is None or users is None or items is None:
+        raise ValueError(
+            f"fleet serving needs a factor-table model (factors.user_factors"
+            f"/factors.item_factors + users/items indexes); got "
+            f"{type(model).__name__}"
+        )
+    return np.asarray(uf), np.asarray(itf), users, items
+
+
+def model_nbytes(model: Any) -> int:
+    """Total factor-table bytes of a model — what ONE host would have to
+    hold to serve it unsharded (the memory-budget comparisons in tests
+    and ``pio doctor --fleet`` use this)."""
+    uf, itf, _, _ = _factor_tables(model)
+    return int(uf.nbytes + itf.nbytes)
+
+
+def _assignments(ids: list[str], n_shards: int) -> np.ndarray:
+    return np.fromiter(
+        (shard_of(i, n_shards) for i in ids), dtype=np.int32, count=len(ids)
+    )
+
+
+def partition_model(model: Any, instance_id: str,
+                    n_shards: int) -> list[ShardPartition]:
+    """Split a factor-table model into ``n_shards`` partitions, each
+    holding only its users' and items' rows (in dense-index order)."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    uf, itf, users, items = _factor_tables(model)
+    user_ids = users.ids()
+    item_ids = items.ids()
+    ua = _assignments(user_ids, n_shards)
+    ia = _assignments(item_ids, n_shards)
+    out = []
+    for s in range(n_shards):
+        usel = np.flatnonzero(ua == s)
+        isel = np.flatnonzero(ia == s)
+        out.append(ShardPartition(
+            shard_index=s,
+            n_shards=n_shards,
+            instance_id=instance_id,
+            user_ids=[user_ids[i] for i in usel],
+            user_rows=np.ascontiguousarray(uf[usel]),
+            item_ids=[item_ids[i] for i in isel],
+            item_gidx=isel.astype(np.int32),
+            item_rows=np.ascontiguousarray(itf[isel]),
+        ))
+    return out
+
+
+def _popularity_fallback(model: Any, k: int = FALLBACK_ITEMS) -> list[dict]:
+    """The degraded-mode item list: score every item against the MEAN
+    user factor — a cheap global-popularity proxy that needs nothing but
+    the model. Served flagged (``"degraded": true``) when a whole shard
+    group is unreachable, so availability never depends on the fleet."""
+    uf, itf, _, items = _factor_tables(model)
+    if uf.shape[0] == 0 or itf.shape[0] == 0:
+        return []
+    mean_user = uf.mean(axis=0, dtype=np.float64).astype(np.float32)
+    scores = itf @ mean_user
+    order = np.argsort(-scores, kind="stable")[:k]
+    ids = items.ids()
+    return [
+        {"item": ids[i], "score": float(scores[i])} for i in order
+    ]
+
+
+def build_plan(model: Any, instance_id: str, n_shards: int,
+               n_replicas: int) -> ShardPlan:
+    """Compute the plan WITHOUT persisting anything (the determinism
+    tests and doctor use this). Same model -> same plan (plan_hash
+    covers the full per-entity assignment, not just the counts)."""
+    parts = partition_model(model, instance_id, n_shards)
+    return _plan_from_partitions(model, parts, instance_id, n_shards,
+                                 n_replicas)
+
+
+def _plan_from_partitions(model: Any, parts: list[ShardPartition],
+                          instance_id: str, n_shards: int,
+                          n_replicas: int) -> ShardPlan:
+    h = 0
+    for p in parts:
+        h = crc32c("\x00".join(p.user_ids).encode("utf-8"), h)
+        h = crc32c("\x00".join(p.item_ids).encode("utf-8"), h)
+    return ShardPlan(
+        instance_id=instance_id,
+        n_shards=n_shards,
+        n_replicas=n_replicas,
+        strategy=PLAN_STRATEGY,
+        version=PLAN_VERSION,
+        user_counts=tuple(len(p.user_ids) for p in parts),
+        item_counts=tuple(len(p.item_ids) for p in parts),
+        fallback=tuple(_popularity_fallback(model)),
+        plan_hash=f"{h:#010x}",
+    )
+
+
+# -- persistence (MODELDATA repository, CRC32C-framed) -----------------------
+
+def partition_to_bytes(part: ShardPartition) -> bytes:
+    buf = io.BytesIO()
+    pickle.dump(part, buf, protocol=5)
+    return frame(buf.getvalue())
+
+
+def partition_from_bytes(blob: bytes, source: str = "") -> ShardPartition:
+    """Verify + unpickle a partition blob. Raises ModelIntegrityError on
+    a framed blob whose checksum fails — the shard server's last-good
+    fallback catches it and tries the previous COMPLETED instance."""
+    part = pickle.loads(unframe(blob, source=source or "shard partition"))
+    if not isinstance(part, ShardPartition):
+        raise ModelIntegrityError(
+            f"blob {source or '?'} is not a shard partition "
+            f"(got {type(part).__name__})"
+        )
+    return part
+
+
+def persist_fleet_artifacts(storage, instance_id: str, model: Any,
+                            n_shards: int, n_replicas: int) -> ShardPlan:
+    """Partition `model` and write plan + per-shard blobs next to the
+    instance's own model blob. Idempotent: re-running overwrites with
+    identical content (the plan is deterministic)."""
+    from pio_tpu.data.dao import Model
+
+    parts = partition_model(model, instance_id, n_shards)
+    plan = _plan_from_partitions(model, parts, instance_id, n_shards,
+                                 n_replicas)
+    models = storage.get_model_data_models()
+    for p in parts:
+        models.insert(Model(shard_model_id(instance_id, p.shard_index),
+                            partition_to_bytes(p)))
+    models.insert(Model(plan_model_id(instance_id),
+                        frame(plan.to_json().encode("utf-8"))))
+    log.info("fleet artifacts persisted for %s: %d shards x %d replicas "
+             "(users %s, items %s)", instance_id, n_shards, n_replicas,
+             plan.user_counts, plan.item_counts)
+    return plan
+
+
+def load_plan(storage, instance_id: str) -> ShardPlan | None:
+    """The recorded plan for an instance, or None when it was never
+    partitioned. Raises ModelIntegrityError on a corrupt plan blob."""
+    rec = storage.get_model_data_models().get(plan_model_id(instance_id))
+    if rec is None:
+        return None
+    return ShardPlan.from_json(
+        unframe(rec.models, source=plan_model_id(instance_id))
+        .decode("utf-8"))
+
+
+def load_partition(storage, instance_id: str,
+                   shard_index: int) -> ShardPartition | None:
+    """One shard's partition blob, or None when absent. Raises
+    ModelIntegrityError on corruption (callers fall back last-good)."""
+    mid = shard_model_id(instance_id, shard_index)
+    rec = storage.get_model_data_models().get(mid)
+    if rec is None:
+        return None
+    return partition_from_bytes(rec.models, source=mid)
+
+
+def partitioned_instances(storage, engine_id: str, engine_version: str,
+                          engine_variant: str,
+                          n_shards: int) -> list:
+    """COMPLETED instances of the engine that were partitioned with this
+    topology, most recent first — the shard/router resolution order (the
+    fleet analogue of deploy's get_latest_completed contract)."""
+    instances = storage.get_metadata_engine_instances()
+    out = []
+    for inst in instances.get_completed(engine_id, engine_version,
+                                        engine_variant):
+        try:
+            plan = load_plan(storage, inst.id)
+        except ModelIntegrityError as e:
+            log.error("shard plan for instance %s is corrupt (%s); "
+                      "skipping", inst.id, e)
+            continue
+        if plan is not None and plan.n_shards == n_shards:
+            out.append(inst)
+    return out

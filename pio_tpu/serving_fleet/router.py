@@ -1,0 +1,624 @@
+"""Fleet router: query front-end over the shard servers.
+
+Query path (POST /queries.json):
+
+  1. owner = plan.shard_of(user) — fetch the user's factor row from the
+     owning shard group (row-fetch RPC, replica failover);
+  2. fan a partial-top-k RPC to EVERY shard group concurrently (each
+     scores the row against its item slice with the single-host kernel);
+  3. merge by ``(-score, global_index)`` — exactly ``lax.top_k``'s
+     descending-score, lowest-index-first order — then apply black/white
+     list semantics IDENTICALLY to ALSAlgorithm.predict, so the fleet's
+     answer is bit-identical to the single-host oracle.
+
+Every shard call runs under the resilience stack: a per-replica
+``CircuitBreaker`` (an open breaker skips the replica without a network
+attempt), single-attempt failover across replicas in preference order
+(no backoff — a replica either answers within the RPC timeout or the
+next one is tried), the ambient ``Deadline`` checked before every
+replica attempt, and a ``chaos.maybe_inject`` point per shard
+(``fleet.shard<i>.<op>``) so drills can kill exactly one shard. With a
+whole shard group down the router DEGRADES instead of 5xx-ing: partial
+results from the live shards are blended with the plan's popularity
+fallback list and the response is flagged ``"degraded": true``.
+
+A background prober keeps per-replica /readyz freshness for replica
+ordering, ``/fleet.json`` (what ``pio doctor --fleet`` reads), and the
+router's own ``/readyz`` (ready while every shard group has a live
+replica).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from pio_tpu.resilience import (
+    CircuitBreaker, CircuitOpenError, Deadline, DeadlineExceeded,
+)
+from pio_tpu.resilience import chaos
+from pio_tpu.resilience.health import install_health_routes, shedder_check
+from pio_tpu.server.http import (
+    AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
+    server_key_ok,
+)
+from pio_tpu.serving_fleet.plan import ShardPlan, shard_of
+from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+from pio_tpu.utils.time import format_time, utcnow
+from pio_tpu.utils.tracing import Tracer
+
+log = logging.getLogger("pio_tpu.fleet.router")
+
+
+class ShardUnavailable(ConnectionError):
+    """Every replica of a shard group refused or failed transiently.
+
+    ConnectionError subclass so the ambient resilience classification
+    (is_transient) treats it like any other transport outage; the router
+    catches it itself and degrades instead of letting it 5xx.
+    """
+
+    def __init__(self, shard_index: int, last_error: Exception | None):
+        super().__init__(
+            f"shard {shard_index} unavailable"
+            + (f" (last error: {last_error})" if last_error else "")
+        )
+        self.shard_index = shard_index
+
+
+@dataclass
+class RouterConfig:
+    ip: str = "127.0.0.1"
+    port: int = 0
+    engine_id: str = ""
+    engine_version: str = "1"
+    engine_variant: str = "default"
+    server_key: str = ""            # guards /reload and /stop
+    # per replica-attempt HTTP timeout; the ambient Deadline is checked
+    # before EVERY attempt, so a spent budget stops the failover scan,
+    # but an in-flight attempt runs to this timeout
+    rpc_timeout_s: float = 5.0
+    request_budget_s: float = 0.0   # per-request Deadline budget; 0 = off
+    probe_interval_s: float = 1.0   # replica /readyz prober; 0 = off
+    backend: str = "async"
+    # per-replica breaker sizing: small window + short open so a dead
+    # replica stops eating connection attempts after a handful of
+    # failures and is re-probed quickly once it rejoins
+    breaker_min_calls: int = 4
+    breaker_failure_rate: float = 0.5
+    breaker_open_s: float = 2.0
+    breaker_window_s: float = 30.0
+
+
+@dataclass
+class _Replica:
+    url: str
+    client: JsonHttpClient
+    breaker: CircuitBreaker
+    healthy: bool = True        # last prober verdict (optimistic start)
+    last_probe: float = 0.0
+    info: dict = field(default_factory=dict)   # last /shard/info payload
+
+
+class FleetRouter:
+    """Shard-plan-aware query front-end (see module docstring)."""
+
+    def __init__(self, storage, config: RouterConfig, plan: ShardPlan,
+                 endpoints: list[list[str]]):
+        if len(endpoints) != plan.n_shards:
+            raise ValueError(
+                f"endpoints cover {len(endpoints)} shards but the plan "
+                f"has {plan.n_shards}"
+            )
+        self.storage = storage
+        self.config = config
+        self.plan = plan
+        self.start_time = utcnow()
+        self.tracer = Tracer()
+        self._lock = threading.RLock()
+        self._stop_requested = threading.Event()
+        self.degraded_count = 0
+        self.rerouted_count = 0
+        self.replicas: list[list[_Replica]] = [
+            [
+                _Replica(
+                    url=url,
+                    client=JsonHttpClient(url, timeout=config.rpc_timeout_s),
+                    breaker=CircuitBreaker(
+                        f"shard{s}/replica{r}",
+                        min_calls=config.breaker_min_calls,
+                        failure_rate=config.breaker_failure_rate,
+                        open_s=config.breaker_open_s,
+                        window_s=config.breaker_window_s,
+                    ),
+                )
+                for r, url in enumerate(urls)
+            ]
+            for s, urls in enumerate(endpoints)
+        ]
+        self._preferred = [0] * plan.n_shards
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * plan.n_shards),
+            thread_name_prefix="fleet-fan",
+        )
+        self._prober: threading.Thread | None = None
+        if config.probe_interval_s > 0:
+            self._prober = threading.Thread(
+                target=self._probe_loop, name="fleet-prober", daemon=True
+            )
+            self._prober.start()
+
+    # -- shard RPC with failover --------------------------------------------
+    def _replica_order(self, shard: int) -> list[int]:
+        """Preferred (last-good) replica first, then prober-healthy ones,
+        then the rest — a dead replica is tried LAST, not skipped, so a
+        stale health verdict can never strand a reachable shard."""
+        group = self.replicas[shard]
+        with self._lock:
+            pref = self._preferred[shard]
+        order = sorted(
+            range(len(group)),
+            key=lambda r: (r != pref, not group[r].healthy, r),
+        )
+        return order
+
+    def _call(self, shard: int, op: str, path: str, body) -> dict:
+        """One shard-group RPC: replicas in preference order, per-replica
+        breaker guard, transient failures roll to the next replica.
+        Raises ShardUnavailable when the whole group is down."""
+        Deadline.check(f"shard {shard} {op}")
+        try:
+            # drill point: a spec targeting fleet.shard<i> takes that
+            # whole shard group down FROM THE ROUTER'S VIEW — the injected
+            # ConnectionError classifies as the group being unreachable,
+            # so the drill exercises the same degrade path a real outage
+            # does
+            chaos.maybe_inject(f"fleet.shard{shard}.{op}")
+        except ConnectionError as e:
+            raise ShardUnavailable(shard, e) from e
+        group = self.replicas[shard]
+        last_error: Exception | None = None
+        for r in self._replica_order(shard):
+            Deadline.check(f"shard {shard} {op} replica {r}")
+            rep = group[r]
+            try:
+                with rep.breaker.guard():
+                    out = rep.client.request("POST", path, body)
+            except CircuitOpenError as e:
+                last_error = e
+                continue
+            except HttpClientError as e:
+                if e.status and e.status not in (408, 429, 502, 503, 504):
+                    raise  # application error: the shard DID answer
+                last_error = e
+                log.warning("shard %d replica %d (%s) failed %s: %s",
+                            shard, r, rep.url, op, e)
+                continue
+            with self._lock:
+                if self._preferred[shard] != r:
+                    self.rerouted_count += 1
+                    self._preferred[shard] = r
+            return out
+        raise ShardUnavailable(shard, last_error)
+
+    # -- query path ---------------------------------------------------------
+    def query(self, q: dict) -> dict:
+        """Single-host-oracle-equivalent prediction, or a flagged
+        degraded response when part of the fleet is unreachable."""
+        t0 = time.monotonic()
+        user = q["user"]
+        num = int(q.get("num", 10))
+        black = set(q.get("blackList") or ())
+        white = q.get("whiteList")
+        # RAW id value, no str() coercion: the single-host oracle treats
+        # a non-string id as unknown (dict-keyed id index), and the
+        # fleet must agree; shard_of str-coerces only for hashing
+        out = self._query_inner(user, num, black, white)
+        if out.get("degraded"):
+            with self._lock:
+                self.degraded_count += 1
+        self.tracer.record("query", time.monotonic() - t0)
+        return out
+
+    def _query_inner(self, user, num: int, black: set,
+                     white) -> dict:
+        owner = shard_of(user, self.plan.n_shards)
+        with self.tracer.span("user_row"):
+            try:
+                row_resp = self._call(owner, "user_row", "/shard/user_row",
+                                      {"user": user})
+            except ShardUnavailable as e:
+                return self._fallback(num, black, str(e))
+        if not row_resp.get("found"):
+            return {"itemScores": []}  # unknown user: same as single-host
+        row = row_resp["row"]
+        if white:
+            return self._white_query(row, num, black, white)
+        return self._topk_query(row, num, black)
+
+    def _fan(self, op: str, path: str, body,
+             shards=None) -> tuple[dict[int, dict], list[int]]:
+        """Concurrent RPC to `shards` (default: every shard group) ->
+        ({shard: result}, [down shards]). Each task runs in a COPY of
+        the caller's context so the ambient Deadline follows the work
+        onto the pool (a spent budget surfaces as DeadlineExceeded ->
+        the edge's 503, never a silent over-budget fan-out)."""
+        import contextvars
+
+        futs = {
+            s: self._pool.submit(
+                contextvars.copy_context().run,
+                self._call, s, op, path, body)
+            for s in (range(self.plan.n_shards) if shards is None
+                      else shards)
+        }
+        results: dict[int, dict] = {}
+        down: list[int] = []
+        for s, f in futs.items():
+            try:
+                results[s] = f.result()
+            except ShardUnavailable as e:
+                log.warning("degrading: %s", e)
+                down.append(s)
+        return results, down
+
+    def _topk_query(self, row: list[float], num: int, black: set) -> dict:
+        # over-fetch exactly like ALSAlgorithm.predict: k = num + |black|
+        # capped at the (global) item count, so blacklist filtering can
+        # never starve the result below the single-host answer
+        n_items = sum(self.plan.item_counts)
+        k = min(num + len(black), n_items)
+        with self.tracer.span("score"):
+            results, down = self._fan("topk", "/shard/topk",
+                                      {"row": row, "k": k})
+        merged: list[tuple[float, int, str]] = []
+        for res in results.values():
+            merged.extend(zip(res["scores"], res["indices"], res["items"]))
+        # descending score, ties to the LOWEST global index — the exact
+        # lax.top_k order the single-host oracle produces
+        merged.sort(key=lambda t: (-t[0], t[1]))
+        out = []
+        for score, _, item in merged:
+            if item in black:
+                continue
+            out.append({"item": item, "score": float(score)})
+            if len(out) >= num:
+                break
+        if not down:
+            return {"itemScores": out}
+        return self._blend(out, num, black,
+                           f"shard group(s) {sorted(down)} unavailable")
+
+    def _white_query(self, row: list[float], num: int, black: set,
+                     white: list) -> dict:
+        # row-fetch the candidates' factor rows from their owning shards
+        # ONLY (a non-owner group being down is irrelevant to this
+        # query and must not flag it degraded), then score HERE in one
+        # einsum with the exact operand shapes the single-host oracle
+        # uses (n candidates at once) — shard-side per-subset scoring
+        # drifts by an ULP because XLA's einsum lowering is
+        # shape-sensitive
+        owners = sorted({shard_of(w, self.plan.n_shards) for w in white})
+        with self.tracer.span("score"):
+            results, down = self._fan(
+                "item_rows", "/shard/item_rows",
+                {"items": list(white)}, shards=owners)
+        rows: dict[str, list[float]] = {}
+        for res in results.values():
+            rows.update(res["rows"])
+        # candidate order matches the oracle: whiteList order, filtered
+        # to known items not blacklisted; then the same argsort ranking.
+        # Membership is RAW (JSON object keys are strings, and so are
+        # all owned ids) — a non-string candidate is unknown, exactly
+        # like the oracle's id-index membership
+        cand = [w for w in white if w in rows and w not in black]
+        if not cand and not down:
+            return {"itemScores": []}
+        ranked = (self._score_candidates(row, cand, rows, num)
+                  if cand else {"itemScores": []})
+        if not down:
+            return ranked
+        ranked["degraded"] = True
+        ranked["degradedReason"] = (
+            f"shard group(s) {sorted(down)} unavailable; whiteList "
+            "candidates on those shards were not scored")
+        return ranked
+
+    @staticmethod
+    def _score_candidates(row: list[float], cand: list,
+                          rows: dict[str, list[float]], num: int) -> dict:
+        """ALSAlgorithm.predict's whiteList ranking, reassembled from
+        fetched rows: same predict_pairs einsum over the same (n, k)
+        operand values, same _rank_candidates argsort — bit-identical."""
+        import numpy as np
+
+        from pio_tpu.models.recommendation import _rank_candidates
+        from pio_tpu.ops import als
+
+        n = len(cand)
+        model = als.ALSModel(
+            np.asarray([row], dtype=np.float32),
+            np.asarray([rows[c] for c in cand], dtype=np.float32),
+        )
+        scores = np.asarray(als.predict_pairs(
+            model, np.zeros(n, dtype=np.int32),
+            np.arange(n, dtype=np.int32)))
+        return _rank_candidates(cand, scores, num)
+
+    def _blend(self, partial: list[dict], num: int, black: set,
+               reason: str) -> dict:
+        """Partial real results + popularity fallback fill, flagged."""
+        have = {s["item"] for s in partial}
+        out = list(partial)
+        for fb in self.plan.fallback:
+            if len(out) >= num:
+                break
+            if fb["item"] in have or fb["item"] in black:
+                continue
+            out.append({"item": fb["item"], "score": fb["score"],
+                        "fallback": True})
+        return {"itemScores": out, "degraded": True,
+                "degradedReason": reason}
+
+    def _fallback(self, num: int, black: set, reason: str) -> dict:
+        return self._blend([], num, black, reason)
+
+    def query_batch(self, queries: list[dict]) -> list[dict]:
+        # sequential on purpose: each query already fans across shards
+        # on the router pool; nesting batch-level fan-out on the same
+        # pool could deadlock it against its own children
+        return [self.query(q) for q in queries]
+
+    # -- health / status ----------------------------------------------------
+    def _probe_loop(self) -> None:
+        interval = self.config.probe_interval_s
+        while not self._stop_requested.wait(timeout=interval):
+            for s, group in enumerate(self.replicas):
+                for rep in group:
+                    try:
+                        rep.client.request("GET", "/readyz")
+                        info = rep.client.request("GET", "/shard/info")
+                        ok = True
+                    except HttpClientError:
+                        ok, info = False, rep.info
+                    with self._lock:
+                        rep.healthy = ok
+                        rep.last_probe = time.monotonic()
+                        rep.info = info or {}
+
+    def shard_health(self) -> dict:
+        """Per shard group: replica breaker/health detail + whether at
+        least one replica is routable (breaker not open)."""
+        shards = {}
+        for s, group in enumerate(self.replicas):
+            reps = []
+            routable = 0
+            for r, rep in enumerate(group):
+                snap = rep.breaker.snapshot()
+                if snap.state != "open":
+                    routable += 1
+                with self._lock:
+                    healthy, info = rep.healthy, dict(rep.info)
+                reps.append({
+                    "replica": r, "url": rep.url,
+                    "breaker": snap.state,
+                    "failureRate": round(snap.failure_rate, 3),
+                    "opened": snap.opened_count,
+                    "healthy": healthy,
+                    "engineInstanceId": info.get("engineInstanceId"),
+                })
+            shards[str(s)] = {
+                "ok": routable > 0,
+                "routable": routable,
+                "replicas": reps,
+            }
+        return shards
+
+    def fleet_status(self) -> dict:
+        shards = self.shard_health()
+        instances = {
+            rep.get("engineInstanceId")
+            for g in shards.values() for rep in g["replicas"]
+            if rep.get("engineInstanceId")
+        }
+        with self._lock:
+            degraded, rerouted = self.degraded_count, self.rerouted_count
+        return {
+            "plan": {
+                "instanceId": self.plan.instance_id,
+                "nShards": self.plan.n_shards,
+                "nReplicas": self.plan.n_replicas,
+                "strategy": self.plan.strategy,
+                "planHash": self.plan.plan_hash,
+                "userCounts": list(self.plan.user_counts),
+                "itemCounts": list(self.plan.item_counts),
+            },
+            "shards": shards,
+            "instanceSkew": len(instances) > 1,
+            "degradedResponses": degraded,
+            "reroutedCalls": rerouted,
+            "startTime": format_time(self.start_time),
+        }
+
+    def reload(self) -> dict:
+        """Fan /reload to every replica, then re-resolve the newest plan
+        for this topology (shards that hit a corrupt blob keep serving
+        their last-good partition — the fleet survives, possibly with
+        instance skew, which /fleet.json surfaces)."""
+        from pio_tpu.serving_fleet.plan import (
+            load_plan, partitioned_instances,
+        )
+
+        results: dict[str, dict] = {}
+        key = self.config.server_key
+        for s, group in enumerate(self.replicas):
+            for r, rep in enumerate(group):
+                try:
+                    out = rep.client.request(
+                        "GET", "/reload",
+                        params={"accessKey": key} if key else None)
+                    results[f"shard{s}/replica{r}"] = {
+                        "ok": True,
+                        "engineInstanceId": out.get("engineInstanceId"),
+                    }
+                except HttpClientError as e:
+                    results[f"shard{s}/replica{r}"] = {
+                        "ok": False, "error": e.message,
+                    }
+        if self.storage is not None:
+            c = self.config
+            insts = partitioned_instances(
+                self.storage, c.engine_id, c.engine_version,
+                c.engine_variant, self.plan.n_shards)
+            if insts:
+                plan = load_plan(self.storage, insts[0].id)
+                if plan is not None:
+                    with self._lock:
+                        self.plan = plan
+        return {"replicas": results, "planInstanceId": self.plan.instance_id}
+
+    def close(self) -> None:
+        self._stop_requested.set()
+        self._pool.shutdown(wait=False)
+        if self._prober is not None:
+            self._prober.join(timeout=2)
+
+
+def build_router_app(router: FleetRouter) -> HttpApp:
+    app = HttpApp("fleet-router")
+    config = router.config
+
+    def check_server_key(req: Request) -> bool:
+        return server_key_ok(req, config.server_key)
+
+    def _budgeted(fn):
+        """Same request-edge policy as the single-host server: per-
+        request Deadline budget, breaker/deadline failures -> 503 +
+        Retry-After (degradation below this layer answers 200)."""
+        try:
+            if config.request_budget_s > 0:
+                with Deadline.budget(config.request_budget_s):
+                    return 200, fn()
+            return 200, fn()
+        except KeyError as e:
+            return 400, {"message": f"query missing field {e}"}
+        except DeadlineExceeded as e:
+            return 503, json_response(
+                {"message": f"request budget exhausted: {e}"},
+                {"Retry-After": "1"},
+            )
+        except CircuitOpenError as e:
+            return 503, json_response(
+                {"message": str(e)},
+                {"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
+
+    @app.route("GET", r"/")
+    def root(req: Request):
+        h = router.tracer.histogram("query")
+        return 200, {
+            "status": "alive",
+            "role": "fleet-router",
+            "engineInstanceId": router.plan.instance_id,
+            "nShards": router.plan.n_shards,
+            "nReplicas": router.plan.n_replicas,
+            "requestCount": h.count,
+            "avgServingSec": round(h.total / h.count, 6) if h.count else 0.0,
+            "startTime": format_time(router.start_time),
+        }
+
+    @app.route("POST", r"/queries\.json")
+    def queries(req: Request):
+        try:
+            q = req.json()
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"Invalid query: {e}"}
+        if not isinstance(q, dict):
+            return 400, {"message": "query must be a JSON object"}
+        return _budgeted(lambda: router.query(q))
+
+    @app.route("POST", r"/batch/queries\.json")
+    def batch_queries(req: Request):
+        try:
+            qs = req.json()
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"Invalid query batch: {e}"}
+        if not isinstance(qs, list) or not all(isinstance(q, dict)
+                                               for q in qs):
+            return 400, {"message": "body must be a JSON array of objects"}
+        if not qs:
+            return 200, []
+        return _budgeted(lambda: router.query_batch(qs))
+
+    @app.route("GET", r"/fleet\.json")
+    def fleet(req: Request):
+        return 200, router.fleet_status()
+
+    @app.route("GET", r"/metrics\.json")
+    def metrics(req: Request):
+        with router._lock:
+            degraded, rerouted = router.degraded_count, router.rerouted_count
+        return 200, {
+            "startTime": format_time(router.start_time),
+            "spans": router.tracer.snapshot(),
+            "degradedResponses": degraded,
+            "reroutedCalls": rerouted,
+        }
+
+    @app.route("GET", r"/reload")
+    def reload(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        return 200, router.reload()
+
+    @app.route("POST", r"/stop")
+    def stop(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        router._stop_requested.set()
+        return 200, {"message": "Shutting down."}
+
+    def readiness() -> dict:
+        """Ready while EVERY shard group has >= 1 routable replica
+        (breaker not open). Instance skew across shards is surfaced but
+        does not fail readiness — a skewed fleet still serves."""
+        checks: dict[str, dict] = {}
+        status = router.shard_health()
+        for s, g in status.items():
+            checks[f"shard:{s}"] = {
+                "ok": g["ok"], "routable": g["routable"],
+                "replicas": len(g["replicas"]),
+            }
+        instances = {
+            rep.get("engineInstanceId")
+            for g in status.values() for rep in g["replicas"]
+            if rep.get("engineInstanceId")
+        }
+        checks["plan"] = {
+            "ok": True,
+            "instanceId": router.plan.instance_id,
+            "planHash": router.plan.plan_hash,
+            "instanceSkew": len(instances) > 1,
+        }
+        checks.update(shedder_check(getattr(app, "transport", None)))
+        return checks
+
+    install_health_routes(app, readiness)
+    return app
+
+
+def create_fleet_router(storage, config: RouterConfig, plan: ShardPlan,
+                        endpoints: list[list[str]]):
+    """-> (http transport, FleetRouter)."""
+    router = FleetRouter(storage, config, plan, endpoints)
+    server_cls = AsyncHttpServer if config.backend == "async" else HttpServer
+    try:
+        http = server_cls(build_router_app(router), host=config.ip,
+                          port=config.port)
+    except BaseException:
+        router.close()   # bind failed: stop the prober/pool we started
+        raise
+    return http, router

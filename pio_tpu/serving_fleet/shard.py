@@ -1,0 +1,384 @@
+"""Shard server: serves ONE partition of the factor tables over RPC.
+
+Each shard process loads only its partition blob (CRC32C-framed, see
+plan.py) — never the full model — and answers three RPCs the router
+composes into a query:
+
+  POST /shard/user_row  {"user": id}            -> {"found", "row"}
+  POST /shard/topk      {"row": [...], "k": n}  -> {"items", "indices",
+                                                    "scores"}
+  POST /shard/item_rows {"items": [ids]}        -> {"rows": {id: row}}
+
+(the whiteList path fetches candidate ROWS and scores router-side — see
+``item_rows`` below for why shard-side pair scoring would break
+bit-parity).
+
+Scoring reuses the exact single-host kernels (``als.recommend_topk`` /
+``als.predict_pairs``) on the local slice, so per-item scores are
+bit-identical to the full-table path and the router's
+``(-score, global_index)`` merge reproduces the single-host top-k
+exactly (``item_gidx`` carries the global dense index).
+
+Model lifecycle mirrors workflow/serve.py: ``/reload`` resolves the
+latest COMPLETED instance partitioned with this topology and swaps
+atomically; a corrupt partition blob (ModelIntegrityError) falls back to
+the previous COMPLETED instance's partition — one bad blob on one shard
+must never take down the fleet. An optional ``memory_budget_bytes``
+makes "loads only its partition" an enforced invariant, not a habit.
+
+Run standalone (its own host/process) via
+``python -m pio_tpu.serving_fleet shard --shard-index I --n-shards N``
+with the storage configured by the usual PIO_STORAGE_* environment.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from pio_tpu.resilience.health import (
+    breaker_checks, install_health_routes, shedder_check,
+)
+from pio_tpu.server.http import (
+    AsyncHttpServer, HttpApp, HttpServer, Request, server_key_ok,
+)
+from pio_tpu.serving_fleet.plan import (
+    ShardPartition, load_partition, partitioned_instances,
+)
+from pio_tpu.utils.durable import ModelIntegrityError
+from pio_tpu.utils.time import format_time, utcnow
+
+log = logging.getLogger("pio_tpu.fleet.shard")
+
+
+class ShardMemoryBudgetExceeded(RuntimeError):
+    """The partition does not fit this shard's configured memory budget
+    — the deployment needs more shards, not a bigger lie."""
+
+
+@dataclass
+class ShardConfig:
+    ip: str = "127.0.0.1"
+    port: int = 0
+    shard_index: int = 0
+    n_shards: int = 1
+    engine_id: str = ""
+    engine_version: str = "1"
+    engine_variant: str = "default"
+    instance_id: str = ""         # pin an instance; "" = latest partitioned
+    server_key: str = ""          # guards /reload and /stop
+    # hard cap on partition bytes this shard may hold; 0 = unlimited.
+    # Loading enforces it BEFORE swap, so an oversized partition can
+    # never evict a serving one.
+    memory_budget_bytes: int = 0
+    backend: str = "threaded"     # many shards ride one test process
+
+
+class ShardServer:
+    """Partition holder + scorer (the fleet's per-host serving runtime)."""
+
+    def __init__(self, storage, config: ShardConfig):
+        self.storage = storage
+        self.config = config
+        self.start_time = utcnow()
+        self._lock = threading.RLock()
+        self._load_lock = threading.Lock()
+        self._stop_requested = threading.Event()
+        self.last_reload_error: str | None = None
+        self.partition: ShardPartition | None = None
+        self._item_factors_dev = None   # device copy of the item rows
+        self._user_row_of: dict[str, int] = {}
+        self._item_local_of: dict[str, int] = {}
+        self._load(config.instance_id or None)
+
+    # -- partition lifecycle ------------------------------------------------
+    def _candidates(self, instance_id: str | None) -> list[str]:
+        if instance_id is not None:
+            return [instance_id]
+        c = self.config
+        insts = partitioned_instances(
+            self.storage, c.engine_id, c.engine_version, c.engine_variant,
+            c.n_shards,
+        )
+        if not insts:
+            raise ValueError(
+                f"no COMPLETED instance of engine {c.engine_id} "
+                f"{c.engine_version} {c.engine_variant} has been "
+                f"partitioned for {c.n_shards} shards — run "
+                "`pio deploy --shards N` (it partitions at deploy time)"
+            )
+        return [i.id for i in insts]
+
+    def _load(self, instance_id: str | None = None) -> None:
+        """Resolve + restore + swap, with last-good fallback: a corrupt
+        partition blob on the latest instance falls back to the previous
+        COMPLETED partitioned instance (explicitly pinned instances do
+        not fall back — the operator asked for THAT one). The swap is
+        atomic under self._lock; a failed load leaves the serving
+        partition untouched."""
+        with self._load_lock:
+            part = None
+            last_error: Exception | None = None
+            for cid in self._candidates(instance_id):
+                try:
+                    part = load_partition(
+                        self.storage, cid, self.config.shard_index)
+                except ModelIntegrityError as e:
+                    log.error(
+                        "shard %d partition of instance %s is corrupt "
+                        "(%s); trying the previous COMPLETED instance",
+                        self.config.shard_index, cid, e,
+                    )
+                    last_error = e
+                    continue
+                if part is None:
+                    last_error = ValueError(
+                        f"instance {cid} has no partition blob for shard "
+                        f"{self.config.shard_index}"
+                    )
+                    continue
+                break
+            if part is None:
+                raise last_error or ValueError("no partition found")
+            budget = self.config.memory_budget_bytes
+            if budget and part.nbytes() > budget:
+                raise ShardMemoryBudgetExceeded(
+                    f"shard {self.config.shard_index} partition of "
+                    f"instance {part.instance_id} needs {part.nbytes()} "
+                    f"bytes but the shard's budget is {budget} — deploy "
+                    "with more shards"
+                )
+            import jax
+
+            item_dev = jax.device_put(part.item_rows)
+            user_row_of = {u: i for i, u in enumerate(part.user_ids)}
+            item_local_of = {it: i for i, it in enumerate(part.item_ids)}
+            with self._lock:
+                self.partition = part
+                self._item_factors_dev = item_dev
+                self._user_row_of = user_row_of
+                self._item_local_of = item_local_of
+            log.info("shard %d serving instance %s (%d users, %d items, "
+                     "%d bytes)", self.config.shard_index, part.instance_id,
+                     len(part.user_ids), len(part.item_ids), part.nbytes())
+
+    def reload(self) -> str:
+        try:
+            self._load(None)
+        except Exception as e:
+            self.last_reload_error = f"{type(e).__name__}: {e}"
+            raise
+        self.last_reload_error = None
+        with self._lock:
+            return self.partition.instance_id
+
+    # -- RPC bodies ---------------------------------------------------------
+    def user_row(self, user) -> list[float] | None:
+        with self._lock:
+            part = self.partition
+            row = self._user_row_of.get(user)
+        if row is None:
+            return None
+        return [float(x) for x in part.user_rows[row]]
+
+    def topk(self, row: list[float], k: int) -> dict:
+        """Partial top-k of the query user's row against this shard's
+        item slice — same kernel as the single-host path, so the per-item
+        scores are bit-identical and the router's merge is exact."""
+        from pio_tpu.ops import als
+
+        with self._lock:
+            part = self.partition
+            item_dev = self._item_factors_dev
+        n_local = len(part.item_ids)
+        if n_local == 0:
+            return {"items": [], "indices": [], "scores": []}
+        u = np.asarray(row, dtype=np.float32)[None, :]
+        local = als.ALSModel(u, item_dev)
+        scores, idx = als.recommend_topk(local, np.array([0]), int(k))
+        scores = np.asarray(scores)[0]
+        idx = np.asarray(idx)[0]
+        return {
+            "items": [part.item_ids[i] for i in idx],
+            "indices": [int(part.item_gidx[i]) for i in idx],
+            "scores": [float(s) for s in scores],
+        }
+
+    def item_rows(self, items: list) -> dict:
+        """Factor ROWS for the subset of `items` this shard owns (the
+        whiteList path's row-fetch) — keyed by item id; unowned ids are
+        simply absent, which is how the router learns ownership. The
+        ROUTER scores candidates, in one einsum with the exact operand
+        shapes the single-host oracle uses: per-pair scores computed
+        shard-side in smaller batches drift by an ULP (XLA's einsum
+        lowering is shape-sensitive), which would break bit-parity."""
+        with self._lock:
+            part = self.partition
+            owned = [(it, self._item_local_of[it]) for it in items
+                     if it in self._item_local_of]
+        return {"rows": {
+            it: [float(x) for x in part.item_rows[i]] for it, i in owned
+        }}
+
+    def info(self) -> dict:
+        with self._lock:
+            part = self.partition
+        return {
+            "shardIndex": self.config.shard_index,
+            "nShards": self.config.n_shards,
+            "engineInstanceId": part.instance_id if part else None,
+            "users": len(part.user_ids) if part else 0,
+            "items": len(part.item_ids) if part else 0,
+            "partitionBytes": part.nbytes() if part else 0,
+            "memoryBudgetBytes": self.config.memory_budget_bytes,
+            "startTime": format_time(self.start_time),
+            "lastReloadError": self.last_reload_error,
+        }
+
+
+def build_shard_app(server: ShardServer) -> HttpApp:
+    app = HttpApp(f"shard{server.config.shard_index}")
+    config = server.config
+
+    def check_server_key(req: Request) -> bool:
+        return server_key_ok(req, config.server_key)
+
+    @app.route("GET", r"/")
+    def root(req: Request):
+        return 200, server.info()
+
+    @app.route("GET", r"/shard/info")
+    def shard_info(req: Request):
+        return 200, server.info()
+
+    @app.route("POST", r"/shard/user_row")
+    def shard_user_row(req: Request):
+        body = req.json()
+        if not isinstance(body, dict) or "user" not in body:
+            return 400, {"message": "body must be {\"user\": id}"}
+        # RAW value lookup, no str() coercion: the single-host oracle
+        # treats a non-string id as unknown (not in the id index), and
+        # the fleet must agree
+        row = server.user_row(body["user"])
+        if row is None:
+            return 200, {"found": False}
+        return 200, {"found": True, "row": row}
+
+    @app.route("POST", r"/shard/topk")
+    def shard_topk(req: Request):
+        body = req.json()
+        if (not isinstance(body, dict) or "row" not in body
+                or "k" not in body):
+            return 400, {"message": "body must be {\"row\": [...], \"k\": n}"}
+        return 200, server.topk(body["row"], int(body["k"]))
+
+    @app.route("POST", r"/shard/item_rows")
+    def shard_item_rows(req: Request):
+        body = req.json()
+        if not isinstance(body, dict) or not isinstance(
+                body.get("items"), list):
+            return 400, {"message": "body must be {\"items\": [...]}"}
+        # raw values: see /shard/user_row — membership must match the
+        # single-host id-index semantics exactly
+        return 200, server.item_rows(list(body["items"]))
+
+    @app.route("GET", r"/reload")
+    def reload(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        try:
+            instance_id = server.reload()
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            with server._lock:
+                part = server.partition
+            return 503, {
+                "message": f"Reload failed ({type(e).__name__}: {e}); "
+                           "still serving last-good partition",
+                "engineInstanceId": part.instance_id if part else None,
+            }
+        return 200, {"message": "Reloaded", "engineInstanceId": instance_id}
+
+    @app.route("POST", r"/stop")
+    def stop(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        server._stop_requested.set()
+        return 200, {"message": "Shutting down."}
+
+    def readiness() -> dict:
+        checks = breaker_checks(server.storage)
+        with server._lock:
+            part = server.partition
+        checks["partition"] = {
+            "ok": part is not None,
+            "shardIndex": config.shard_index,
+            "engineInstanceId": part.instance_id if part else None,
+            "lastReloadError": server.last_reload_error,
+        }
+        checks.update(shedder_check(getattr(app, "transport", None)))
+        return checks
+
+    install_health_routes(app, readiness)
+    return app
+
+
+def create_shard_server(storage,
+                        config: ShardConfig) -> tuple[object, ShardServer]:
+    """-> (http transport, ShardServer); start() the transport yourself
+    (with port=0 the real port is only known after bind)."""
+    srv = ShardServer(storage, config)
+    server_cls = AsyncHttpServer if config.backend == "async" else HttpServer
+    http = server_cls(build_shard_app(srv), host=config.ip, port=config.port)
+    return http, srv
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone shard process (``python -m pio_tpu.serving_fleet shard``).
+    Storage comes from the PIO_STORAGE_* environment like every other
+    pio process; prints the bound port so supervisors can discover it."""
+    import argparse
+
+    from pio_tpu.data.storage import get_storage
+
+    p = argparse.ArgumentParser(prog="pio_tpu.serving_fleet shard")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--shard-index", type=int, required=True)
+    p.add_argument("--n-shards", type=int, required=True)
+    p.add_argument("--engine-id", required=True)
+    p.add_argument("--engine-version", default="1")
+    p.add_argument("--engine-variant", default="default")
+    p.add_argument("--instance-id", default="")
+    p.add_argument("--server-key", default="")
+    p.add_argument("--memory-budget-bytes", type=int, default=0)
+    p.add_argument("--server-backend", choices=["async", "threaded"],
+                   default="threaded")
+    args = p.parse_args(argv)
+    config = ShardConfig(
+        ip=args.ip, port=args.port, shard_index=args.shard_index,
+        n_shards=args.n_shards, engine_id=args.engine_id,
+        engine_version=args.engine_version,
+        engine_variant=args.engine_variant,
+        instance_id=args.instance_id, server_key=args.server_key,
+        memory_budget_bytes=args.memory_budget_bytes,
+        backend=args.server_backend,
+    )
+    http, srv = create_shard_server(get_storage(), config)
+    http.start()
+    print(f"shard {args.shard_index}/{args.n_shards} on "
+          f"http://{args.ip}:{http.port} (instance "
+          f"{srv.partition.instance_id})", flush=True)
+
+    def watch_stop():
+        srv._stop_requested.wait()
+        http.stop()
+
+    threading.Thread(target=watch_stop, daemon=True).start()
+    try:
+        http.wait()
+    except KeyboardInterrupt:
+        http.stop()
+    return 0

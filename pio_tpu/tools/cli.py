@@ -165,14 +165,105 @@ def cmd_status(args) -> int:
     return 0
 
 
+def _doctor_fleet(args) -> int:
+    """`pio doctor --fleet`: one table over the whole serving fleet —
+    shard plan, every shard/replica's /healthz + /readyz + serving
+    instance, replication status per shard group, and open breakers as
+    the router sees them. Endpoints come from the router's /fleet.json,
+    so the only address the operator needs is the router's."""
+    from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+    router_url = args.router_url or f"http://{args.ip}:{args.serving_port}"
+    client = JsonHttpClient(router_url, timeout=args.timeout)
+    try:
+        fleet = client.request("GET", "/fleet.json")
+    except HttpClientError as e:
+        return _fail(f"fleet router at {router_url} unreachable: "
+                     f"{e.message}")
+    plan = fleet.get("plan", {})
+    rows = []
+    exit_code = 0
+    for s, group in sorted(fleet.get("shards", {}).items(),
+                           key=lambda kv: int(kv[0])):
+        group_ready = 0
+        for rep in group["replicas"]:
+            probe = JsonHttpClient(rep["url"], timeout=args.timeout)
+            live = ready = False
+            instance = rep.get("engineInstanceId")
+            try:
+                probe.request("GET", "/healthz")
+                live = True
+                probe.request("GET", "/readyz")
+                ready = True
+                info = probe.request("GET", "/shard/info")
+                instance = info.get("engineInstanceId", instance)
+            except HttpClientError:
+                pass
+            group_ready += ready
+            rows.append({
+                "shard": int(s), "replica": rep["replica"],
+                "url": rep["url"], "live": live, "ready": ready,
+                "breaker": rep["breaker"], "instance": instance,
+            })
+        # fail on the router's breaker view OR the doctor's own probes:
+        # on an IDLE fleet breakers never trip (they only open on failed
+        # calls), so a dead group still reports routable until traffic
+        # starts failing — the direct /readyz probe catches it now
+        if not group["ok"] or group_ready == 0:
+            exit_code = 1
+    open_breakers = [f"shard{r['shard']}/replica{r['replica']}"
+                     for r in rows if r["breaker"] == "open"]
+    replication = {
+        s: f"{g['routable']}/{len(g['replicas'])}"
+        for s, g in sorted(fleet.get("shards", {}).items(),
+                           key=lambda kv: int(kv[0]))
+    }
+    if args.json:
+        print(json.dumps({
+            "router": router_url, "plan": plan, "replicas": rows,
+            "replication": replication, "openBreakers": open_breakers,
+            "instanceSkew": fleet.get("instanceSkew", False),
+            "degradedResponses": fleet.get("degradedResponses", 0),
+        }, indent=2))
+        return exit_code
+    print(f"fleet router {router_url}: instance {plan.get('instanceId')} "
+          f"plan {plan.get('planHash')} "
+          f"({plan.get('nShards')} shards x {plan.get('nReplicas')} "
+          "replicas)")
+    print(f"  users/shard: {plan.get('userCounts')}  "
+          f"items/shard: {plan.get('itemCounts')}")
+    print(f"{'shard':>5} {'rep':>3} {'live':<5} {'ready':<5} "
+          f"{'breaker':<9} {'instance':<12} url")
+    for r in rows:
+        print(f"{r['shard']:>5} {r['replica']:>3} "
+              f"{'up' if r['live'] else 'DOWN':<5} "
+              f"{'yes' if r['ready'] else 'NO':<5} "
+              f"{r['breaker']:<9} {str(r['instance']):<12} {r['url']}")
+    print("replication (routable/total): "
+          + ", ".join(f"shard {s}: {v}" for s, v in replication.items()))
+    if open_breakers:
+        print(f"[WARN] open breakers: {', '.join(open_breakers)}")
+    if fleet.get("instanceSkew"):
+        print("[WARN] instance skew: shards serve different engine "
+              "instances (a corrupt partition fell back last-good; "
+              "retrain or repartition to converge)")
+    if fleet.get("degradedResponses"):
+        print(f"degraded responses served: {fleet['degradedResponses']}")
+    return exit_code
+
+
 def cmd_doctor(args) -> int:
     """Resilience doctor: poll every server surface's /healthz (liveness)
     + /readyz (readiness) and print the per-check detail — storage
     circuit-breaker states, load-shedder queue depth, eventserver spill
     backlog, the serving model's instance. The aggregate view `pio
     status` cannot give: status inspects THIS process's storage config;
-    doctor inspects the RUNNING stack's health surfaces."""
+    doctor inspects the RUNNING stack's health surfaces. With --fleet,
+    inspects a sharded serving fleet through its router instead."""
     from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+    if getattr(args, "fleet", False):
+        return _doctor_fleet(args)
 
     surfaces = {
         "eventserver": args.eventserver_port,
@@ -557,6 +648,11 @@ def cmd_deploy(args) -> int:
         variant, args.engine_dir
     )
     storage = get_storage()
+    if args.shards > 0:
+        # fleet path: partition the persisted model at deploy time, boot
+        # N x R shard servers + the router front-end (serving_fleet/)
+        return _deploy_fleet_cmd(args, storage, engine_id, engine_version,
+                                 engine_variant)
     ctx = create_workflow_context(storage, use_mesh=not args.no_mesh)
     config = ServingConfig(
         ip=args.ip, port=args.port,
@@ -591,6 +687,69 @@ def cmd_deploy(args) -> int:
         http.stop()
     qs.close()
     print("Server stopped.")
+    return 0
+
+
+def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
+                      engine_variant: str) -> int:
+    """`pio deploy --shards N [--replicas R]`: sharded, replicated
+    serving (docs/serving.md "Sharded fleet"). The router binds
+    --ip/--port; shard servers take ephemeral ports (printed, and always
+    discoverable via the router's /fleet.json)."""
+    from pio_tpu.serving_fleet.fleet import deploy_fleet
+
+    # fail loudly on single-host-only options rather than silently
+    # ignoring them — --cert/--key especially: an operator asking for
+    # TLS must never get plaintext without an error
+    if args.cert or args.key:
+        return _fail("TLS termination is not supported in fleet mode yet "
+                     "(--shards with --cert/--key); front the router with "
+                     "a TLS-terminating proxy instead")
+    unsupported = [flag for flag, on in (
+        ("--feedback", args.feedback),
+        ("--warm-query", bool(args.warm_query)),
+        ("--batch-window-ms", args.batch_window_ms > 0),
+    ) if on]
+    if unsupported:
+        return _fail(f"{', '.join(unsupported)} not supported in fleet "
+                     "mode (--shards); they configure the single-host "
+                     "QueryServer")
+    if args.replicas < 1:
+        return _fail("--replicas must be >= 1")
+
+    # shard endpoints must be dialable by the router, so a wildcard bind
+    # resolves to loopback for the in-process fleet shape
+    ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+    handle = deploy_fleet(
+        storage,
+        engine_id=engine_id, engine_version=engine_version,
+        engine_variant=engine_variant,
+        n_shards=args.shards, n_replicas=args.replicas,
+        ip=ip,
+        router_port=args.port,
+        instance_id=args.engine_instance_id,
+        server_key=args.server_key or os.environ.get("PIO_SERVER_KEY", ""),
+        memory_budget_bytes=args.shard_memory_budget_mb * 1024 * 1024,
+        shard_backend=args.server_backend,
+    )
+    print(f"Fleet router for instance {handle.plan.instance_id} on "
+          f"http://{ip}:{handle.router_http.port} "
+          f"({args.shards} shards x {args.replicas} replicas)")
+    for s, urls in enumerate(handle.endpoints):
+        print(f"  shard {s}: {' '.join(urls)}")
+    import threading
+
+    def watch_stop():
+        handle.router._stop_requested.wait()
+        handle.router_http.stop()
+
+    threading.Thread(target=watch_stop, daemon=True).start()
+    try:
+        handle.wait()
+    except KeyboardInterrupt:
+        pass
+    handle.close()
+    print("Fleet stopped.")
     return 0
 
 
@@ -986,6 +1145,13 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--zombie-stale-s", type=float, default=600.0,
                    help="heartbeat age (seconds) after which an "
                         "in-flight instance counts as a zombie")
+    x.add_argument("--fleet", action="store_true",
+                   help="inspect a sharded serving fleet via its router: "
+                        "shard plan, per-replica health, replication "
+                        "status, open breakers in one table")
+    x.add_argument("--router-url", default="",
+                   help="fleet router base URL (default "
+                        "http://<ip>:<serving-port>)")
     x.set_defaults(fn=cmd_doctor)
 
     x = sub.add_parser("run")
@@ -1108,6 +1274,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "continuous batching (no added wait; batch = "
                         "whatever queued during the previous execution); "
                         "0 = off")
+    x.add_argument("--shards", type=int, default=0,
+                   help="> 0 deploys a SHARDED fleet: partition the "
+                        "model's factor tables across this many shard "
+                        "servers behind a top-k-merging router "
+                        "(docs/serving.md); 0 = single-host serve")
+    x.add_argument("--replicas", type=int, default=2,
+                   help="replicas per shard (fleet mode; >= 2 gives warm "
+                        "failover)")
+    x.add_argument("--shard-memory-budget-mb", type=int, default=0,
+                   help="hard cap (MB) each shard may hold; a partition "
+                        "over budget fails deploy instead of lying about "
+                        "capacity. 0 = unlimited")
     x.set_defaults(fn=cmd_deploy)
 
     x = sub.add_parser(

@@ -44,6 +44,7 @@ from pio_tpu.resilience.health import (
 )
 from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
+    server_key_ok,
 )
 from pio_tpu.server.plugins import PluginContext
 from pio_tpu.utils.durable import ModelIntegrityError
@@ -795,9 +796,7 @@ def build_serving_app(server: QueryServer) -> HttpApp:
     config = server.config
 
     def check_server_key(req: Request) -> bool:
-        if not config.server_key:
-            return True
-        return req.params.get("accessKey", "") == config.server_key
+        return server_key_ok(req, config.server_key)
 
     @app.route("GET", r"/")
     def root(req: Request):

@@ -105,6 +105,53 @@ print("CHILD_OK", pid, flush=True)
 """
 
 
+def _run_two_children(code, expected, extra=()):
+    """Spawn the 2-process distributed child pair on a freshly chosen
+    coordinator port; -> [(stdout, stderr)] per child."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(port), str(pid), str(expected),
+             *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd="/root/repo",
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for proc in procs:
+        try:
+            out, err = proc.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        outs.append((out, err))
+    return outs
+
+
+def _assert_children_ok(code, expected, extra=()):
+    """Run the child pair with ONE bounded retry on gloo's TCP-pair
+    handshake race: the bind(0)-close-reuse coordinator port can be
+    cross-connected by an unrelated ephemeral socket under full-suite
+    load, which surfaces as gloo::EnforceNotMet ('op.preamble.length <=
+    op.nbytes') inside a child — an infra race, not a product failure
+    (the tests pass in isolation). Only that signature retries; any
+    other failure, or a second gloo failure, still fails the test."""
+    for attempt in (0, 1):
+        outs = _run_two_children(code, expected, extra)
+        if all(f"CHILD_OK {pid}" in out
+               for pid, (out, _err) in enumerate(outs)):
+            return
+        gloo_race = any("gloo::EnforceNotMet" in err for _out, err in outs)
+        if not gloo_race or attempt:
+            break
+    for pid, (out, err) in enumerate(outs):
+        assert f"CHILD_OK {pid}" in out, f"process {pid} failed:\n{err}"
+
+
 @needs_multiprocess_cpu
 def test_two_process_collectives_match_single_process(tmp_path):
     """Two real OS processes join one distributed runtime (2 procs x 2 local
@@ -126,29 +173,8 @@ def test_two_process_collectives_match_single_process(tmp_path):
     expected = tmp_path / "expected.npz"
     np.savez(expected, uf=uf, itf=itf, losses=losses)
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     code = _CHILD.format(repo="/root/repo")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", code, str(port), str(pid), str(expected)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd="/root/repo",
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for pid, proc in enumerate(procs):
-        try:
-            out, err = proc.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            raise
-        outs.append((out, err))
-    for pid, (out, err) in enumerate(outs):
-        assert f"CHILD_OK {pid}" in out, f"process {pid} failed:\n{err}"
+    _assert_children_ok(code, expected)
 
 
 @needs_multiprocess_cpu
@@ -188,30 +214,8 @@ def test_two_process_training_from_shared_storage_server(tmp_path):
         expected = tmp_path / "expected_shared.npz"
         np.savez(expected, uf=uf, itf=itf, losses=losses)
 
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            coord_port = s.getsockname()[1]
         code = _CHILD.format(repo="/root/repo")
-        procs = [
-            subprocess.Popen(
-                [sys.executable, "-c", code, str(coord_port), str(pid),
-                 str(expected), str(server.port)],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                cwd="/root/repo",
-            )
-            for pid in range(2)
-        ]
-        outs = []
-        for pid, proc in enumerate(procs):
-            try:
-                out, err = proc.communicate(timeout=420)
-            except subprocess.TimeoutExpired:
-                for p in procs:
-                    p.kill()
-                raise
-            outs.append((out, err))
-        for pid, (out, err) in enumerate(outs):
-            assert f"CHILD_OK {pid}" in out, f"process {pid} failed:\n{err}"
+        _assert_children_ok(code, expected, extra=(str(server.port),))
     finally:
         server.stop()
         backing.close()

@@ -482,3 +482,69 @@ def test_batch_isolates_misbehaving_blocker(memory_storage):
     dao = memory_storage.get_events()
     assert dao.get(out[0]["eventId"], app_id) is not None
     assert dao.get(out[2]["eventId"], app_id) is not None
+
+
+def test_spill_high_water_backpressure_429_with_hysteresis(memory_storage):
+    """End-to-end backpressure (ROADMAP item 4's robustness half): past
+    the spill queue's high-water mark the server flips from 201-spill to
+    429 + Retry-After, and resumes 201s only once the drain brings the
+    queue back under the LOW-water mark — one clean flip each way, no
+    flutter at the boundary. Depth/watermarks/saturation are exported on
+    /readyz so backpressure is visible before the 429s start."""
+    import time
+
+    from pio_tpu.resilience import chaos
+    from pio_tpu.server.eventserver import build_event_app
+    from pio_tpu.server.http import Request, dispatch_safe
+
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "bpapp"))
+    memory_storage.get_metadata_access_keys().insert(
+        AccessKey("BP", app_id, ()))
+    memory_storage.get_events().init(app_id)
+    app = build_event_app(memory_storage, EventServerConfig(
+        spill_capacity=100, spill_high_water=4, spill_low_water=2))
+
+    def post(i):
+        status, body = dispatch_safe(app, Request(
+            method="POST", path="/events.json", params={"accessKey": "BP"},
+            headers={}, body=json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": f"u{i}", "targetEntityType": "item",
+                "targetEntityId": "i1"}).encode()))
+        return status, body
+
+    try:
+        with chaos.inject("storage.MEM.insert", error=1.0, seed=1):
+            results = [post(i) for i in range(10)]
+            codes = [s for s, _ in results]
+            # 201-spill until the high-water mark, then a clean flip to
+            # 429 (the drain may hold ONE item in flight, so the flip
+            # lands at high_water or high_water + 1)
+            first429 = codes.index(429)
+            assert 4 <= first429 <= 5, codes
+            assert set(codes[first429:]) == {429}, codes
+            body = results[first429][1]
+            # Retry-After rides the 429 (RawResponse headers)
+            assert body.headers.get("Retry-After") == "1"
+            # saturation is visible on readiness BEFORE clients see it
+            status, ready = dispatch_safe(
+                app, Request("GET", "/readyz", {}, {}))
+            assert status == 503
+            spill_check = ready["checks"]["spill"]
+            assert spill_check["saturated"] is True
+            assert spill_check["highWater"] == 4
+            assert spill_check["shed"] == codes.count(429)
+        # store back up: the drain empties the queue past low water and
+        # ingestion resumes with 201s
+        deadline = time.monotonic() + 15
+        while app.spill.size > 2 and time.monotonic() < deadline:
+            app.spill._wake.set()
+            time.sleep(0.02)
+        status, body = post(99)
+        assert status == 201 and "spilled" not in body
+        snap = app.spill.snapshot()
+        assert snap["saturated"] is False
+        status, _ = dispatch_safe(app, Request("GET", "/readyz", {}, {}))
+        assert status == 200
+    finally:
+        app.spill.close()

@@ -1,0 +1,590 @@
+"""Sharded serving fleet tests (pio_tpu/serving_fleet/):
+
+  * shard-plan determinism + partition completeness,
+  * serve-under-memory-cap with merged top-k BIT-IDENTICAL to the
+    single-host oracle (the ROADMAP item 1 acceptance),
+  * replica warm failover, kill-one-shard chaos drill (no 5xx, bounded
+    degraded responses, recovery on rejoin),
+  * corrupt-partition last-good fallback (one bad blob never takes the
+    fleet down),
+  * `pio doctor --fleet`,
+  * a slow-marked 2 shards x 2 replicas SUBPROCESS drill (the CI
+    fleet-chaos job's shape: real processes, SIGKILL, rejoin).
+"""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller import EngineParams
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.dao import App
+from pio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+from pio_tpu.resilience import chaos
+from pio_tpu.serving_fleet.fleet import deploy_fleet, resolve_fleet_model
+from pio_tpu.serving_fleet.plan import (
+    build_plan,
+    load_partition,
+    model_nbytes,
+    partition_model,
+    persist_fleet_artifacts,
+    shard_model_id,
+    shard_of,
+)
+from pio_tpu.serving_fleet.router import RouterConfig
+from pio_tpu.serving_fleet.shard import (
+    ShardConfig, ShardMemoryBudgetExceeded, create_shard_server,
+)
+from pio_tpu.workflow.context import create_workflow_context
+from pio_tpu.workflow.train import load_models, run_train
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def seed_and_train(storage, n_iter=4, engine_id="rec"):
+    app_id = storage.get_metadata_apps().insert(App(0, "mlapp"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    m = 0
+    for u in range(20):
+        for i in range(12):
+            match = (u % 2) == (i % 2)
+            if rng.random() < (0.8 if match else 0.1):
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5 if match else 1}),
+                    event_time=T0 + timedelta(minutes=m)), app_id)
+                m += 1
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="mlapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=4, num_iterations=n_iter, lambda_=0.05, chunk=1024))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    iid = run_train(engine, ep, storage, engine_id=engine_id, ctx=ctx)
+    return engine, ep, ctx, iid
+
+
+def call(port, method, path, body=None, **params):
+    import urllib.parse
+
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+@pytest.fixture()
+def trained(memory_storage):
+    engine, ep, ctx, iid = seed_and_train(memory_storage)
+    return memory_storage, engine, ep, ctx, iid
+
+
+# -- plan ---------------------------------------------------------------------
+
+def test_shard_plan_deterministic(trained):
+    storage, engine, ep, ctx, iid = trained
+    _, model = resolve_fleet_model(storage, "rec")
+    p1 = build_plan(model, iid, n_shards=3, n_replicas=2)
+    p2 = build_plan(model, iid, n_shards=3, n_replicas=2)
+    assert p1 == p2                       # same model -> same plan
+    assert p1.plan_hash == p2.plan_hash
+    # round-trips through its JSON record exactly
+    from pio_tpu.serving_fleet.plan import ShardPlan
+
+    assert ShardPlan.from_json(p1.to_json()) == p1
+    # the hash covers assignments, not just counts: a different shard
+    # count must change it
+    assert build_plan(model, iid, 2, 2).plan_hash != p1.plan_hash
+    # entity routing is a pure function usable from any process
+    for u in ("u0", "u7", "anyone"):
+        assert shard_of(u, 3) == shard_of(u, 3)
+        assert 0 <= shard_of(u, 3) < 3
+
+
+def test_partitions_cover_model_disjointly(trained):
+    storage, *_ , iid = trained
+    _, model = resolve_fleet_model(storage, "rec")
+    parts = partition_model(model, iid, 3)
+    users = [u for p in parts for u in p.user_ids]
+    items = [i for p in parts for i in p.item_ids]
+    assert sorted(users) == sorted(model.users.ids())
+    assert sorted(items) == sorted(model.items.ids())
+    assert len(set(users)) == len(users) and len(set(items)) == len(items)
+    for p in parts:
+        # every user/item landed on its crc32c-owned shard, rows match
+        # the full tables at the recorded global indices
+        assert all(shard_of(u, 3) == p.shard_index for u in p.user_ids)
+        assert all(shard_of(i, 3) == p.shard_index for i in p.item_ids)
+        np.testing.assert_array_equal(
+            p.item_rows, np.asarray(model.factors.item_factors)[p.item_gidx])
+
+
+def test_memory_budget_enforced(trained):
+    storage, *_ , iid = trained
+    _, model = resolve_fleet_model(storage, "rec")
+    persist_fleet_artifacts(storage, iid, model, 2, 1)
+    part = load_partition(storage, iid, 0)
+    with pytest.raises(ShardMemoryBudgetExceeded, match="more shards"):
+        create_shard_server(storage, ShardConfig(
+            shard_index=0, n_shards=2, engine_id="rec", instance_id=iid,
+            memory_budget_bytes=part.nbytes() - 1))
+
+
+# -- fleet vs single-host oracle ---------------------------------------------
+
+def test_fleet_bit_identical_to_oracle_under_memory_cap(trained):
+    """The acceptance scenario: a model whose factor tables exceed one
+    shard's enforced memory budget serves across 2 shards, and every
+    answer — plain, blackList over-fetch, whiteList, unknown user,
+    k > n_items — is BIT-identical to the single-host path."""
+    storage, engine, ep, ctx, iid = trained
+    _, model = resolve_fleet_model(storage, "rec")
+    total = model_nbytes(model)
+    budget = int(total * 0.75)   # one host (full model) would NOT fit...
+    assert total > budget
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1, memory_budget_bytes=budget)
+    try:
+        for _http, srv in handle.shards:
+            assert srv.partition.nbytes() <= budget  # ...each shard does
+        algo = engine._doers(ep)[2][0]
+        full = load_models(storage, engine, ep, iid, ctx=ctx)[0]
+        queries = [
+            {"user": "u0", "num": 4},
+            {"user": "u3", "num": 6, "blackList": ["i1", "i5"]},
+            {"user": "u5", "num": 3,
+             "whiteList": ["i2", "i7", "i9", "nope"]},
+            {"user": "u5", "num": 2, "whiteList": ["i2", "i7", "i9"],
+             "blackList": ["i7"]},
+            {"user": "ghost", "num": 4},
+            {"user": "u7", "num": 50},   # over-fetch past n_items
+        ]
+        for q in queries:
+            status, fleet_out = call(handle.router_http.port, "POST",
+                                     "/queries.json", body=dict(q))
+            assert status == 200, (q, fleet_out)
+            assert fleet_out == algo.predict(full, dict(q)), q
+        # the batch route matches too
+        status, batch = call(handle.router_http.port, "POST",
+                             "/batch/queries.json",
+                             body=[dict(q) for q in queries])
+        assert status == 200
+        assert batch == [algo.predict(full, dict(q)) for q in queries]
+    finally:
+        handle.close()
+
+
+# -- failover / degradation ---------------------------------------------------
+
+def _fleet(storage, n_shards=2, n_replicas=2, **kw):
+    return deploy_fleet(
+        storage, engine_id="rec", n_shards=n_shards, n_replicas=n_replicas,
+        router_config=RouterConfig(
+            breaker_min_calls=2, breaker_open_s=0.5, probe_interval_s=0.2),
+        **kw)
+
+
+def test_replica_failover_serves_through_replica_loss(trained):
+    storage, *_ = trained
+    handle = _fleet(storage)
+    try:
+        # shards list is [s0r0, s0r1, s1r0, s1r1]: kill shard0/replica0
+        handle.shards[0][0].stop()
+        out = [call(handle.router_http.port, "POST", "/queries.json",
+                    body={"user": f"u{u}", "num": 3}) for u in range(10)]
+        assert all(status == 200 for status, _ in out), out
+        # replica 1 answered: nothing degraded, results are real scores
+        assert not any(body.get("degraded") for _, body in out)
+        assert all(body["itemScores"] for _, body in out)
+        status, fs = call(handle.router_http.port, "GET", "/fleet.json")
+        assert fs["reroutedCalls"] >= 1
+        # the fleet stays READY: every shard group still has a replica
+        status, _ = call(handle.router_http.port, "GET", "/readyz")
+        assert status == 200
+    finally:
+        handle.close()
+
+
+def test_kill_one_shard_drill_degrades_then_recovers(trained):
+    """The chaos drill under concurrent load: kill BOTH replicas of one
+    shard mid-load -> every in-flight and subsequent request completes
+    (rerouted or explicitly degraded — never a 5xx burst), and the fleet
+    returns to full service when the shard rejoins."""
+    storage, *_ = trained
+    handle = _fleet(storage, n_shards=2, n_replicas=2)
+    port = handle.router_http.port
+    statuses: list[tuple[int, bool]] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(w):
+        while not stop.is_set():
+            s, body = call(port, "POST", "/queries.json",
+                           body={"user": f"u{w}", "num": 3})
+            with lock:
+                statuses.append((s, bool(body.get("degraded"))))
+
+    threads = [threading.Thread(target=hammer, args=(w,)) for w in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)                      # load flowing, fleet healthy
+        dead = [handle.shards[0], handle.shards[1]]  # all of shard 0
+        for http, _srv in dead:
+            http.stop()                      # the kill, mid-load
+        time.sleep(1.5)                      # breakers settle
+        with lock:
+            during = list(statuses)
+        # rejoin shard 0 on one of its old ports
+        old_port = int(handle.endpoints[0][0].rsplit(":", 1)[1])
+        http2, _srv2 = create_shard_server(storage, ShardConfig(
+            ip="127.0.0.1", port=old_port, shard_index=0, n_shards=2,
+            engine_id="rec"))
+        http2.start()
+        try:
+            deadline = time.monotonic() + 10
+            recovered = False
+            while time.monotonic() < deadline and not recovered:
+                s, body = call(port, "POST", "/queries.json",
+                               body={"user": "u2", "num": 3})
+                recovered = s == 200 and not body.get("degraded")
+                time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            # zero 5xx across the whole drill — outage answers are 200s
+            # flagged degraded, not errors
+            assert all(s < 500 for s, _ in statuses), \
+                [s for s, _ in statuses if s >= 500][:5]
+            assert any(d for _, d in during), "no degraded response seen"
+            assert recovered, "fleet never returned to full service"
+            # degraded responses are BOUNDED by the outage: the post-
+            # recovery tail serves real answers again
+            with lock:
+                tail = statuses[-3:]
+            assert not any(d for _, d in tail), tail
+        finally:
+            http2.stop()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        handle.close()
+
+
+def test_chaos_point_per_shard_drives_degrade_path(trained):
+    """`chaos.inject("fleet.shard<i>", ...)` takes exactly that shard
+    group down from the router's view — the seeded drill hook."""
+    storage, *_ = trained
+    handle = _fleet(storage, n_shards=2, n_replicas=1)
+    try:
+        port = handle.router_http.port
+        with chaos.inject("fleet.shard1", error=1.0, seed=7) as monkey:
+            s, body = call(port, "POST", "/queries.json",
+                           body={"user": "u2", "num": 3})
+            assert s == 200 and body["degraded"] is True
+            assert "shard group(s) [1]" in body["degradedReason"]
+            assert any(p.startswith("fleet.shard1.") for p in monkey.injected)
+        s, body = call(port, "POST", "/queries.json",
+                       body={"user": "u2", "num": 3})
+        assert s == 200 and not body.get("degraded")
+    finally:
+        handle.close()
+
+
+def test_whitelist_ignores_down_nonowner_shard(trained):
+    """A down shard that owns NEITHER the query user NOR any whiteList
+    candidate is irrelevant to the query: the router fans item_rows only
+    to owner shards, so the answer stays exact and un-degraded."""
+    storage, engine, ep, ctx, iid = trained
+    handle = _fleet(storage, n_shards=2, n_replicas=1)
+    try:
+        live, dead = 0, 1
+        users = [f"u{u}" for u in range(20) if shard_of(f"u{u}", 2) == live]
+        items = [f"i{i}" for i in range(12) if shard_of(f"i{i}", 2) == live]
+        assert users and len(items) >= 2
+        handle.shards[dead][0].stop()
+        algo = engine._doers(ep)[2][0]
+        from pio_tpu.workflow.train import load_models
+
+        full = load_models(storage, engine, ep, iid, ctx=ctx)[0]
+        q = {"user": users[0], "num": 2, "whiteList": items[:3]}
+        s, body = call(handle.router_http.port, "POST", "/queries.json",
+                       body=dict(q))
+        assert s == 200
+        assert "degraded" not in body, body
+        assert body == algo.predict(full, dict(q))
+    finally:
+        handle.close()
+
+
+def test_degraded_fallback_when_owner_shard_down(trained):
+    """User-row owner group down -> popularity fallback blend, flagged,
+    still 200 (the availability floor a dead shard cannot break)."""
+    storage, *_ = trained
+    handle = _fleet(storage, n_shards=2, n_replicas=1)
+    try:
+        owner = shard_of("u0", 2)
+        handle.shards[owner][0].stop()
+        s, body = call(handle.router_http.port, "POST", "/queries.json",
+                       body={"user": "u0", "num": 3})
+        assert s == 200 and body["degraded"] is True
+        assert body["itemScores"], "fallback blend must still fill top-k"
+        assert all(x.get("fallback") for x in body["itemScores"])
+        # router /readyz now fails: a shard group has no routable replica
+        # (after its breaker opens on the failed calls)
+        for _ in range(3):
+            call(handle.router_http.port, "POST", "/queries.json",
+                 body={"user": "u0", "num": 3})
+        status, ready = call(handle.router_http.port, "GET", "/readyz")
+        assert status == 503 and not ready["ready"]
+    finally:
+        handle.close()
+
+
+# -- last-good partition fallback --------------------------------------------
+
+def test_corrupt_partition_falls_back_to_previous_instance(trained):
+    """One corrupt partition blob (CRC32C mismatch) on the latest
+    instance must not take down the fleet: that shard falls back to the
+    previous COMPLETED instance's partition and keeps serving; the
+    router surfaces the instance skew."""
+    storage, engine, ep, ctx, iid1 = trained
+    from pio_tpu.data.dao import Model
+
+    _, model1 = resolve_fleet_model(storage, "rec", instance_id=iid1)
+    persist_fleet_artifacts(storage, iid1, model1, 2, 1)
+    iid2 = run_train(engine, ep, storage, engine_id="rec", ctx=ctx)
+    _, model2 = resolve_fleet_model(storage, "rec", instance_id=iid2)
+    persist_fleet_artifacts(storage, iid2, model2, 2, 1)
+    # corrupt instance 2's shard-0 blob: flip a payload byte so the
+    # CRC32C frame fails verification at load
+    models_dao = storage.get_model_data_models()
+    blob = bytearray(models_dao.get(shard_model_id(iid2, 0)).models)
+    blob[-1] ^= 0xFF
+    models_dao.insert(Model(shard_model_id(iid2, 0), bytes(blob)))
+
+    handle = _fleet(storage, n_shards=2, n_replicas=1, repartition=False)
+    try:
+        served = {srv.config.shard_index: srv.partition.instance_id
+                  for _http, srv in handle.shards}
+        assert served[0] == iid1      # fell back last-good
+        assert served[1] == iid2      # healthy shard serves the latest
+        s, body = call(handle.router_http.port, "POST", "/queries.json",
+                       body={"user": "u0", "num": 3})
+        assert s == 200 and body["itemScores"]
+        # the router's prober surfaces the skew once it has seen every
+        # replica's /shard/info (probe_interval_s=0.2 in _fleet)
+        deadline = time.monotonic() + 10
+        skew = False
+        while time.monotonic() < deadline and not skew:
+            s, fs = call(handle.router_http.port, "GET", "/fleet.json")
+            skew = fs["instanceSkew"]
+            time.sleep(0.1)
+        assert skew, fs
+    finally:
+        handle.close()
+
+
+def test_fleet_reload_moves_to_new_partitioned_instance(trained):
+    storage, engine, ep, ctx, iid1 = trained
+    handle = _fleet(storage, n_shards=2, n_replicas=1)
+    try:
+        iid2 = run_train(engine, ep, storage, engine_id="rec", ctx=ctx)
+        _, model2 = resolve_fleet_model(storage, "rec", instance_id=iid2)
+        persist_fleet_artifacts(storage, iid2, model2, 2, 1)
+        s, out = call(handle.router_http.port, "GET", "/reload")
+        assert s == 200
+        assert out["planInstanceId"] == iid2
+        assert all(r["ok"] and r["engineInstanceId"] == iid2
+                   for r in out["replicas"].values()), out
+        s, body = call(handle.router_http.port, "POST", "/queries.json",
+                       body={"user": "u0", "num": 3})
+        assert s == 200 and body["itemScores"]
+    finally:
+        handle.close()
+
+
+# -- doctor -------------------------------------------------------------------
+
+def test_doctor_fleet_table(trained, cli):
+    storage, *_ = trained
+    handle = _fleet(storage, n_shards=2, n_replicas=2)
+    try:
+        url = f"http://127.0.0.1:{handle.router_http.port}"
+        code, captured = cli("doctor", "--fleet", "--router-url", url)
+        assert code == 0
+        out = captured.out
+        assert "2 shards x 2 replicas" in out
+        assert "replication (routable/total)" in out
+        assert out.count("up") >= 4      # every replica live
+        code, captured = cli("doctor", "--fleet", "--router-url", url,
+                             "--json")
+        assert code == 0
+        report = json.loads(captured.out)
+        assert report["plan"]["nShards"] == 2
+        assert len(report["replicas"]) == 4
+        assert report["replication"] == {"0": "2/2", "1": "2/2"}
+        assert report["openBreakers"] == []
+    finally:
+        handle.close()
+
+
+# -- subprocess drill (the CI fleet-chaos job's shape) ------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_subprocess_fleet_chaos_drill(tmp_path):
+    """2 shards x 2 replicas as REAL processes over shared sqlite
+    storage: SIGKILL both replicas of shard 1 mid-load -> zero 5xx,
+    explicit degraded answers; restart one replica -> full service."""
+    import os
+
+    db = tmp_path / "fleet.db"
+    env_map = {
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(db),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    }
+    from pio_tpu.data.storage import Storage
+
+    storage = Storage(env=env_map)
+    try:
+        _engine, _ep, _ctx, iid = seed_and_train(storage)
+        _, model = resolve_fleet_model(storage, "rec")
+        plan = persist_fleet_artifacts(storage, iid, model, 2, 2)
+    finally:
+        storage.close()
+
+    proc_env = dict(os.environ, JAX_PLATFORMS="cpu", **env_map)
+
+    def spawn(shard_index: int, port: int) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "pio_tpu.serving_fleet", "shard",
+             "--shard-index", str(shard_index), "--n-shards", "2",
+             "--engine-id", "rec", "--instance-id", iid,
+             "--port", str(port)],
+            env=proc_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    ports = [[_free_port() for _ in range(2)] for _ in range(2)]
+    procs = {(s, r): spawn(s, ports[s][r])
+             for s in range(2) for r in range(2)}
+
+    def wait_ready(port: int, timeout=60):
+        deadline = time.monotonic() + timeout
+        # pio: lint-ok[bare-retry] test poll waiting for a freshly
+        # spawned shard subprocess to bind and report ready
+        while time.monotonic() < deadline:
+            try:
+                s, _ = call(port, "GET", "/readyz")
+                if s == 200:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.2)
+        raise AssertionError(f"shard on port {port} never became ready")
+
+    handle = None
+    storage = Storage(env=env_map)
+    try:
+        for s in range(2):
+            for r in range(2):
+                wait_ready(ports[s][r])
+        from pio_tpu.serving_fleet.router import create_fleet_router
+
+        router_http, router = create_fleet_router(
+            storage,
+            RouterConfig(engine_id="rec", breaker_min_calls=2,
+                         breaker_open_s=0.5, probe_interval_s=0.2),
+            plan,
+            [[f"http://127.0.0.1:{p}" for p in group] for group in ports],
+        )
+        router_http.start()
+        handle = (router_http, router)
+
+        statuses: list[tuple[int, bool]] = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def hammer(w):
+            while not stop.is_set():
+                st, body = call(router_http.port, "POST", "/queries.json",
+                                body={"user": f"u{w}", "num": 3})
+                with lock:
+                    statuses.append((st, bool(body.get("degraded"))))
+
+        threads = [threading.Thread(target=hammer, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        # the kill: SIGKILL both replicas of shard 1, mid-load
+        for r in range(2):
+            procs[(1, r)].kill()
+        time.sleep(2.0)
+        with lock:
+            during = list(statuses)
+        assert any(d for _, d in during), "no degraded response during kill"
+        # rejoin one replica of shard 1 on its old port
+        procs[(1, 0)] = spawn(1, ports[1][0])
+        wait_ready(ports[1][0])
+        deadline = time.monotonic() + 15
+        recovered = False
+        while time.monotonic() < deadline and not recovered:
+            st, body = call(router_http.port, "POST", "/queries.json",
+                            body={"user": "u2", "num": 3})
+            recovered = st == 200 and not body.get("degraded")
+            time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(st < 500 for st, _ in statuses), \
+            [st for st, _ in statuses if st >= 500][:5]
+        assert recovered, "fleet never recovered after the shard rejoined"
+        st, _ = call(router_http.port, "GET", "/readyz")
+        assert st == 200
+    finally:
+        if handle is not None:
+            handle[0].stop()
+            handle[1].close()
+        storage.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
