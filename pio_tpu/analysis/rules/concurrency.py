@@ -30,12 +30,23 @@ pools) and an asyncio event loop side by side, so the hazards are:
     exact torn-blob bug the durability layer exists to end. Same shape
     as `bare-retry`: the sanctioned helper gives atomic rename + fsync
     + CRC32C for free.
+  * `foldin-cursor`  — ANY direct file-write persistence inside
+    `pio_tpu/freshness/` (`open(..., "w"/"a"/"x"...)`,
+    `Path.write_text`/`write_bytes`, `json.dump`/`pickle.dump`/
+    `np.save` to a path): the fold-in cursor IS the subsystem's
+    exactly-once-effective resume point, so every byte it persists must
+    ride `utils/durable.py` (tmp + fsync + atomic rename + CRC32C). A
+    torn or silently-truncated cursor rewinds the folder to event 0 —
+    or worse, fast-forwards past unserved fold-ins and loses them.
+    Stricter than `durable-write` on purpose: in this package there is
+    no benign direct write, so the rule needs no artifact-name
+    heuristic.
 
 Scope gate: modules that import threading/asyncio/concurrent.futures/
 multiprocessing — shared-state writes in single-threaded scripts are not
-hazards. (`async-blocking`, `bare-retry`, and `durable-write` apply
-regardless: blocking an event loop, hand-rolling retries, and tearable
-artifact writes are hazards in any module.)
+hazards. (`async-blocking`, `bare-retry`, `durable-write`, and
+`foldin-cursor` apply regardless: blocking an event loop, hand-rolling
+retries, and tearable artifact/cursor writes are hazards in any module.)
 """
 
 from __future__ import annotations
@@ -92,16 +103,25 @@ _POLICY_METHODS = frozenset({"delays", "attempts"})
 # serving/resume (durable-write)
 _ARTIFACT_RE = re.compile(r"model|ckpt|checkpoint", re.IGNORECASE)
 
+# foldin-cursor scope: every module of the freshness subsystem
+_FRESHNESS_PATHS = ("pio_tpu/freshness/",)
+# direct-persistence calls beyond open(): the serializer-to-path and
+# Path-method shapes that also bypass utils/durable.py
+_PERSIST_CALLS = frozenset({"json.dump", "pickle.dump", "numpy.save",
+                            "np.save", "marshal.dump", "shelve.open"})
+_PERSIST_METHODS = frozenset({"write_text", "write_bytes"})
+
 
 class ConcurrencyRule:
     id = "concurrency"
     ids = ("attr-no-lock", "global-no-lock", "async-blocking", "bare-retry",
-           "durable-write")
+           "durable-write", "foldin-cursor")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         yield from self._async_blocking(ctx)
         yield from self._bare_retry(ctx)
         yield from self._durable_write(ctx)
+        yield from self._foldin_cursor(ctx)
         if not ctx.imports_any("threading", "asyncio", "multiprocessing",
                                "concurrent"):
             return
@@ -313,6 +333,45 @@ class ConcurrencyRule:
                 "that readers misparse; use "
                 "pio_tpu.utils.durable.durable_write (tmp + fsync + "
                 "atomic rename + CRC32C)")
+
+    # -- fold-in cursor persistence -------------------------------------------
+    def _foldin_cursor(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """Flag EVERY direct file-write in `pio_tpu/freshness/` (see
+        module docstring): cursor/offset persistence there must go
+        through utils/durable.py, and the package has no other
+        legitimate direct writes — anything that looks like one is
+        either cursor state on a side channel or belongs elsewhere."""
+        path = ctx.path.replace("\\", "/")
+        if not any(p in path for p in _FRESHNESS_PATHS):
+            return
+        msg = ("direct file write in pio_tpu/freshness/ ({what}): "
+               "cursor/offset persistence must ride "
+               "pio_tpu.utils.durable (durable_write/durable_read — "
+               "tmp + fsync + atomic rename + CRC32C); a torn cursor "
+               "either replays from event 0 or silently loses fold-ins")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.imports.canonical(node.func)
+            if name == "open" and node.args:
+                mode = (node.args[1] if len(node.args) >= 2 else
+                        next((kw.value for kw in node.keywords
+                              if kw.arg == "mode"), None))
+                if (isinstance(mode, ast.Constant)
+                        and isinstance(mode.value, str)
+                        and any(c in mode.value for c in "wax+")):
+                    yield self._f(
+                        "foldin-cursor", ctx, node,
+                        msg.format(what=f"`open(..., {mode.value!r})`"))
+            elif name in _PERSIST_CALLS:
+                yield self._f("foldin-cursor", ctx, node,
+                              msg.format(what=f"`{name}(...)`"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _PERSIST_METHODS):
+                yield self._f(
+                    "foldin-cursor", ctx, node,
+                    msg.format(
+                        what=f"`.{node.func.attr}(...)`"))
 
     # -- blocking calls on the event loop ------------------------------------
     def _async_blocking(self, ctx: ModuleContext) -> Iterator[Finding]:

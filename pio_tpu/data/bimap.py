@@ -128,3 +128,26 @@ class EntityIdIndex:
 
     def __contains__(self, entity_id: str) -> bool:
         return entity_id in self.bimap
+
+    def extended(self, new_ids: Iterable[str]) -> "EntityIdIndex":
+        """A NEW index with `new_ids` appended after the existing dense
+        range (ids already present keep their index and are skipped).
+        Copy-on-write for the serving fold-in path: queries holding the
+        old index are never mutated under, and existing indices never
+        move — factor rows stay aligned."""
+        fwd = dict(self.bimap._fwd)
+        appended = []
+        for nid in new_ids:
+            if nid not in fwd:
+                fwd[nid] = len(fwd)
+                appended.append(nid)
+        if not appended:
+            return self
+        bm = BiMap.__new__(BiMap)
+        bm._fwd = fwd
+        bm._rev = {v: k for k, v in fwd.items()}
+        out = EntityIdIndex.__new__(EntityIdIndex)
+        out.bimap = bm
+        out._id_array = np.concatenate(
+            [self._id_array, np.array(appended, dtype=object)])
+        return out

@@ -1269,6 +1269,107 @@ def als_train_sharded(
 
 
 # ---------------------------------------------------------------------------
+# streaming fold-in: refresh user rows against FIXED item factors
+# ---------------------------------------------------------------------------
+
+def _solve_rows_invariant(A, b):
+    """Exact per-row solve whose bits do NOT depend on the batch size:
+    `lax.map` compiles ONE unbatched (k,k) Cholesky program and runs it
+    per row, so row u's solution is identical whether u is solved alone
+    or among any batch mates — unlike the BATCHED cho_factor/cho_solve,
+    whose CPU lowering drifts by an ULP with batch size (measured; this
+    is what the fold-in oracle parity test would catch). Fold-in
+    batches are small (≤ a few thousand rows), so per-row is cheap."""
+    def solve_one(ab):
+        a_row, b_row = ab
+        return jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(a_row), b_row)
+
+    return jax.lax.map(solve_one, (A, b))
+
+
+@partial(jax.jit, static_argnames=("n_users", "params"))
+def _fold_in_jit(u, i, v, item_factors, n_users: int, params: ALSParams):
+    nnz = u.shape[0]
+    cs = min(params.chunk_slots, _slots_for(nnz, 0, params.width, 1))
+    su = _slots_for(nnz, n_users, params.width, cs)
+    by_user = _device_slot_layout(u, i, v, n_users, params.width, su)
+    A, b = _normal_equations(
+        by_user, item_factors, n_users, params.implicit, params.alpha, cs,
+        bf16_gather=params.bf16_gather, accum=params.accum,
+        group_slots=params.group_slots, gather=params.gather,
+        packed=params.packed_a,
+    )
+    k = item_factors.shape[1]
+    if params.implicit:
+        A = A + _shared_yty(item_factors, None)[None, :, :]
+    A = A + params.reg * jnp.eye(k, dtype=jnp.float32)[None, :, :]
+    return _solve_rows_invariant(A, b)
+
+
+def fold_in_params(params: ALSParams) -> ALSParams:
+    """The bit-conservative variant of `params` a fold-in solve runs
+    under: f32 gather and the plain XLA accumulation/gather paths, so a
+    refreshed row is a pure function of (events, item factors) — the
+    same answer on every backend, every batch composition, and every
+    restart. Iteration-schedule fields are irrelevant (fold-in is one
+    half-sweep); they are zeroed so they cannot fragment the jit cache."""
+    return dataclasses.replace(
+        params, bf16_gather=False, accum="carry", gather="xla",
+        packed_a=False, iterations=1, cg_warm_iters=-1, seed=0, chunk=0,
+    )
+
+
+def als_fold_in(
+    item_factors,
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    values: np.ndarray,
+    n_users: int,
+    params: ALSParams,
+) -> jax.Array:
+    """Solve one ridge system per user against FIXED item factors — the
+    online half of ALS (the MLlib lineage's fold-in): exactly ONE
+    user half-sweep of `_normal_equations` + the exact solve, nothing
+    else. Returns (n_users, k) f32 rows.
+
+    `user_idx` holds LOCAL dense ids in [0, n_users); `item_idx` indexes
+    `item_factors` rows. Users in [0, n_users) with no events get the
+    zero row (b = 0 under the exact solve), which callers treat as
+    "don't apply".
+
+    Batch-composition invariance (the freshness subsystem's oracle
+    contract, tests/test_freshness.py): with `fold_in_params`, user u's
+    row is BIT-identical whether u is folded alone or inside any batch —
+    per-slot normal-equation blocks are row-independent batched matmuls,
+    the sorted scatter sums u's slots in the same order regardless of
+    batch mates, and `_solve_rows_invariant` runs one UNBATCHED Cholesky
+    per row. Both the dense-id space (`n_users`) and the event count are
+    padded to powers of two here, so a steady fold-in stream compiles
+    O(log²) programs and then runs entirely out of the persistent
+    compile cache (PR 4)."""
+    nnz = len(values)
+    if nnz == 0 or n_users <= 0:
+        k = item_factors.shape[1]
+        return jnp.zeros((max(n_users, 0), k), jnp.float32)
+    u = np.ascontiguousarray(user_idx, dtype=np.int32)
+    i = np.ascontiguousarray(item_idx, dtype=np.int32)
+    v = np.ascontiguousarray(values, dtype=np.float32)
+    n_bucket = pow2_bucket(n_users)
+    pad = pow2_bucket(nnz) - nnz
+    if pad:
+        # padding rides the user-side sentinel (u = n_bucket): the slot
+        # layout drops those entries entirely, so item id 0 / value 0
+        # never reach a real row's system
+        u = np.concatenate([u, np.full(pad, n_bucket, np.int32)])
+        i = np.concatenate([i, np.zeros(pad, np.int32)])
+        v = np.concatenate([v, np.zeros(pad, np.float32)])
+    rows = _fold_in_jit(u, i, v, jnp.asarray(item_factors), n_bucket,
+                        fold_in_params(params))
+    return rows[:n_users]
+
+
+# ---------------------------------------------------------------------------
 # prediction / scoring
 # ---------------------------------------------------------------------------
 

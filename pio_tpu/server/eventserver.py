@@ -478,6 +478,55 @@ def build_event_app(
             return 404, {"message": "Not Found"}
         return 200, [e.to_api_dict() for e in out]
 
+    @app.route("GET", r"/tail/events\.json")
+    @authed
+    def tail_events(req: Request, ak, channel_id):
+        """Subscription tail over the columnar batch path (the
+        freshness subsystem's remote window read): events at or after
+        ``sinceUs`` (event-time µs; -1 = from the beginning) as a
+        columnar JSON batch — parallel arrays, no per-event objects —
+        plus ``nextUs``, the boundary to resume from (INCLUSIVE re-read;
+        consumers dedupe the boundary microsecond, see
+        pio_tpu/freshness/cursor.py). ``events`` is a comma-separated
+        event-name filter; ``entityType``/``targetEntityType`` filter
+        like GET /events.json."""
+        import numpy as np
+
+        from pio_tpu.data.columnar import _restore_time
+
+        p = req.params
+        since_us = int(p.get("sinceUs", -1))
+        limit = max(1, min(int(p.get("limit", 20000)), 100_000))
+        names = [s for s in (p.get("events") or "").split(",") if s]
+        cols = events_dao.find_columnar(
+            app_id=ak.appid,
+            channel_id=channel_id,
+            start_time=(_restore_time(since_us, 0)
+                        if since_us >= 0 else None),
+            entity_type=p.get("entityType"),
+            event_names=names or None,
+            target_entity_type=(p["targetEntityType"]
+                                if "targetEntityType" in p else ...),
+        )
+        t = np.asarray(cols.time_us)
+        order = np.argsort(t, kind="stable")[:limit]
+        ent = np.asarray(cols.entity_ids, dtype=object)
+        evn = np.asarray(cols.event_names, dtype=object)
+        tgt = np.asarray(cols.target_ids, dtype=object)
+        tcode = np.asarray(cols.target_code)[order]
+        out_t = t[order]
+        return 200, {
+            "count": int(order.shape[0]),
+            "sinceUs": since_us,
+            "nextUs": int(out_t.max()) if order.shape[0] else since_us,
+            "timesUs": out_t.tolist(),
+            "entityIds": ent[np.asarray(cols.entity_code)[order]].tolist(),
+            "events": evn[np.asarray(cols.event_code)[order]].tolist(),
+            "targetEntityIds": [
+                (tgt[c] if c >= 0 else None) for c in tcode
+            ],
+        }
+
     @app.route("POST", r"/batch/events\.json")
     @authed
     def batch_events(req: Request, ak, channel_id):

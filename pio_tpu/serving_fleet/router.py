@@ -366,6 +366,82 @@ class FleetRouter:
     def _fallback(self, num: int, black: set, reason: str) -> dict:
         return self._blend([], num, black, reason)
 
+    # -- streaming fold-in (pio_tpu/freshness/) ------------------------------
+    def upsert_users(self, rows: dict,
+                     staleness_s: float | None = None) -> dict:
+        """Fan refreshed user rows to EVERY replica of each row's
+        crc32c owner shard group — the same ``shard_of`` routing
+        queries use, so a fold-in lands exactly where the next
+        ``/shard/user_row`` will look. Unlike the query path this is a
+        fan-to-ALL, not a failover scan: every replica must hold the
+        row or it serves stale until the next fold or /reload. A group
+        where NO replica applied lands in ``failedGroups`` (callers —
+        ``RouterFleetApplier`` — keep those users pending and retry); a
+        partially-applied group stays ok, with the lagging replica
+        visible in per-replica results and in ``pio doctor --fleet``'s
+        fold-in lag column."""
+        groups: dict[int, dict] = {}
+        for uid, row in rows.items():
+            groups.setdefault(
+                shard_of(uid, self.plan.n_shards), {})[uid] = row
+        key = self.config.server_key
+        results: dict[str, dict] = {}
+        failed_groups: list[int] = []
+        for s, group_rows in sorted(groups.items()):
+            body: dict = {"users": group_rows}
+            if staleness_s is not None:
+                body["stalenessSeconds"] = staleness_s
+            try:
+                # same drill point family as the query path: a spec
+                # targeting fleet.shard<i> takes this group's applies
+                # down from the router's view
+                chaos.maybe_inject(f"fleet.shard{s}.upsert_users")
+            except ConnectionError as e:
+                failed_groups.append(s)
+                results[str(s)] = {"ok": False, "error": str(e)}
+                continue
+            reps: dict[str, dict] = {}
+            ok_replicas = 0
+            for r, rep in enumerate(self.replicas[s]):
+                Deadline.check(f"shard {s} upsert replica {r}")
+                try:
+                    # same per-replica breaker as the query path: a dead
+                    # replica stops eating a full HTTP timeout on every
+                    # apply once its breaker opens (half-open re-probes),
+                    # and its failures stay visible on /fleet.json and
+                    # `pio doctor --fleet`
+                    with rep.breaker.guard():
+                        out = rep.client.request(
+                            "POST", "/shard/upsert_users", body,
+                            params={"accessKey": key} if key else None)
+                except CircuitOpenError as e:
+                    reps[str(r)] = {"ok": False, "error": str(e)}
+                    continue
+                except HttpClientError as e:
+                    reps[str(r)] = {"ok": False, "error": e.message}
+                    continue
+                rejected = out.get("rejected") or []
+                # 200-with-rejections means the shard REFUSED rows (a
+                # plan mismatch, e.g. mid-rolling-redeploy): they are
+                # NOT servable there, so the replica cannot count
+                # toward the group being ok — group "ok" must keep
+                # implying "every row of this group landed", or the
+                # folder pops users whose rows never applied
+                reps[str(r)] = {"ok": not rejected,
+                                "applied": out.get("applied"),
+                                "rejected": rejected}
+                if not rejected:
+                    ok_replicas += 1
+            if ok_replicas == 0:
+                failed_groups.append(s)
+            results[str(s)] = {"ok": ok_replicas > 0,
+                               "fullyApplied":
+                                   ok_replicas == len(self.replicas[s]),
+                               "replicas": reps}
+        return {"ok": not failed_groups, "groups": results,
+                "failedGroups": failed_groups,
+                "engineInstanceId": self.plan.instance_id}
+
     def query_batch(self, queries: list[dict]) -> list[dict]:
         # sequential on purpose: each query already fans across shards
         # on the router pool; nesting batch-level fan-out on the same
@@ -552,6 +628,24 @@ def build_router_app(router: FleetRouter) -> HttpApp:
         if not qs:
             return 200, []
         return _budgeted(lambda: router.query_batch(qs))
+
+    @app.route("POST", r"/fleet/upsert_users")
+    def fleet_upsert_users(req: Request):
+        """Streaming fold-in apply surface (pio_tpu/freshness/):
+        ``{"users": {id: [row]}, "stalenessSeconds"?: s}`` routed to
+        every replica of each row's owner shard group. Guarded like
+        /reload — it mutates serving state."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        try:
+            body = req.json()
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"Invalid body: {e}"}
+        if not isinstance(body, dict) or not isinstance(
+                body.get("users"), dict):
+            return 400, {"message": "body must be {\"users\": {id: [row]}}"}
+        return 200, router.upsert_users(
+            body["users"], body.get("stalenessSeconds"))
 
     @app.route("GET", r"/fleet\.json")
     def fleet(req: Request):

@@ -92,6 +92,12 @@ class ShardServer:
         self._item_factors_dev = None   # device copy of the item rows
         self._user_row_of: dict[str, int] = {}
         self._item_local_of: dict[str, int] = {}
+        # streaming fold-in accounting (upsert_user_rows): surfaced on
+        # /shard/info so `pio doctor --fleet` can compare fold-in lag
+        # across shard groups
+        self.foldin_applied_users = 0
+        self.foldin_last_time = None
+        self.foldin_last_staleness_s: float | None = None
         self._load(config.instance_id or None)
 
     # -- partition lifecycle ------------------------------------------------
@@ -223,6 +229,90 @@ class ShardServer:
             it: [float(x) for x in part.item_rows[i]] for it, i in owned
         }}
 
+    def upsert_user_rows(self, rows: dict,
+                         staleness_s: float | None = None) -> dict:
+        """Streaming fold-in apply (pio_tpu/freshness/): replace or
+        append user factor rows in THIS shard's partition. Only rows
+        this shard OWNS under the crc32c plan are accepted — a
+        mis-routed row is rejected loudly (``rejected`` in the result)
+        instead of silently shadowing the owner shard's copy. Last-good
+        semantics: the updated partition is built copy-on-write and
+        swapped atomically; the memory budget is enforced BEFORE the
+        swap, exactly like /reload."""
+        import dataclasses
+
+        from pio_tpu.serving_fleet.plan import shard_of
+
+        with self._lock:
+            part = self.partition
+        if part is None:
+            raise ValueError("shard has no partition loaded")
+        k = int(part.user_rows.shape[1]) if part.user_rows.size else (
+            int(part.item_rows.shape[1]))
+        owned: list[tuple] = []
+        rejected: list = []
+        for uid, row in rows.items():
+            if shard_of(uid, self.config.n_shards) != self.config.shard_index:
+                rejected.append(uid)
+                continue
+            if len(row) != k:
+                raise ValueError(
+                    f"fold-in row for {uid!r} has {len(row)} dims, "
+                    f"partition rank is {k}")
+            owned.append((uid, row))
+        if owned:
+            user_rows = np.array(part.user_rows, dtype=np.float32,
+                                 copy=True)
+            user_ids = list(part.user_ids)
+            row_of = dict(self._user_row_of)
+            appended: list[np.ndarray] = []
+            for uid, row in owned:
+                at = row_of.get(uid)
+                vec = np.asarray(row, dtype=np.float32)
+                if at is not None:
+                    user_rows[at] = vec
+                else:
+                    row_of[uid] = len(user_ids)
+                    user_ids.append(uid)
+                    appended.append(vec)
+            if appended:
+                user_rows = np.concatenate(
+                    [user_rows.reshape(-1, k),
+                     np.stack(appended)]).astype(np.float32)
+            new_part = dataclasses.replace(
+                part, user_ids=user_ids, user_rows=user_rows)
+            budget = self.config.memory_budget_bytes
+            if budget and new_part.nbytes() > budget:
+                raise ShardMemoryBudgetExceeded(
+                    f"fold-in would grow shard {self.config.shard_index} "
+                    f"to {new_part.nbytes()} bytes over its "
+                    f"{budget}-byte budget — repartition with more shards"
+                )
+            with self._lock:
+                if self.partition is not part:
+                    # a /reload swapped instances mid-build: applying
+                    # rows solved against the OLD factors onto the new
+                    # partition would mix factor spaces
+                    raise ValueError(
+                        "partition changed during fold-in apply; retry")
+                self.partition = new_part
+                self._user_row_of = row_of
+                self.foldin_applied_users += len(owned)
+                self.foldin_last_time = utcnow()
+                if staleness_s is not None:
+                    self.foldin_last_staleness_s = float(staleness_s)
+        return {"applied": len(owned), "rejected": rejected,
+                "engineInstanceId": part.instance_id}
+
+    def foldin_status(self) -> dict:
+        with self._lock:
+            return {
+                "appliedUsers": self.foldin_applied_users,
+                "lastAppliedTime": (format_time(self.foldin_last_time)
+                                    if self.foldin_last_time else None),
+                "stalenessSeconds": self.foldin_last_staleness_s,
+            }
+
     def info(self) -> dict:
         with self._lock:
             part = self.partition
@@ -236,6 +326,7 @@ class ShardServer:
             "memoryBudgetBytes": self.config.memory_budget_bytes,
             "startTime": format_time(self.start_time),
             "lastReloadError": self.last_reload_error,
+            "foldin": self.foldin_status(),
         }
 
 
@@ -284,6 +375,25 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         # raw values: see /shard/user_row — membership must match the
         # single-host id-index semantics exactly
         return 200, server.item_rows(list(body["items"]))
+
+    @app.route("POST", r"/shard/upsert_users")
+    def shard_upsert_users(req: Request):
+        """Streaming fold-in apply (pio_tpu/freshness/). Guarded like
+        /reload — it mutates the serving partition."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        body = req.json()
+        if not isinstance(body, dict) or not isinstance(
+                body.get("users"), dict):
+            return 400, {"message": "body must be {\"users\": {id: [row]}}"}
+        try:
+            out = server.upsert_user_rows(
+                body["users"], body.get("stalenessSeconds"))
+        except ShardMemoryBudgetExceeded as e:
+            return 507, {"message": str(e)}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        return 200, out
 
     @app.route("GET", r"/reload")
     def reload(req: Request):

@@ -182,14 +182,18 @@ def _doctor_fleet(args) -> int:
                      f"{e.message}")
     plan = fleet.get("plan", {})
     rows = []
+    foldin_lag: dict[str, dict] = {}
     exit_code = 0
     for s, group in sorted(fleet.get("shards", {}).items(),
                            key=lambda kv: int(kv[0])):
         group_ready = 0
+        group_stale: list[float] = []
+        group_applied: list[int] = []
         for rep in group["replicas"]:
             probe = JsonHttpClient(rep["url"], timeout=args.timeout)
             live = ready = False
             instance = rep.get("engineInstanceId")
+            foldin = None
             try:
                 probe.request("GET", "/healthz")
                 live = True
@@ -197,14 +201,33 @@ def _doctor_fleet(args) -> int:
                 ready = True
                 info = probe.request("GET", "/shard/info")
                 instance = info.get("engineInstanceId", instance)
+                foldin = info.get("foldin")
             except HttpClientError:
                 pass
             group_ready += ready
+            if foldin:
+                group_applied.append(int(foldin.get("appliedUsers") or 0))
+                if foldin.get("stalenessSeconds") is not None:
+                    group_stale.append(float(foldin["stalenessSeconds"]))
             rows.append({
                 "shard": int(s), "replica": rep["replica"],
                 "url": rep["url"], "live": live, "ready": ready,
                 "breaker": rep["breaker"], "instance": instance,
+                "foldin": foldin,
             })
+        # per-group fold-in lag: MAX staleness any replica recorded at
+        # its last apply, plus replica skew (a replica that missed
+        # upserts — e.g. it was down during a fold — serves older rows
+        # than its group mates until the next fold or /reload)
+        foldin_lag[s] = {
+            "maxStalenessSeconds": max(group_stale) if group_stale
+            else None,
+            "appliedUsers": group_applied,
+            "replicaSkew": len(set(group_applied)) > 1,
+            "overBudget": bool(group_stale
+                               and max(group_stale)
+                               > args.staleness_budget),
+        }
         # fail on the router's breaker view OR the doctor's own probes:
         # on an IDLE fleet breakers never trip (they only open on failed
         # calls), so a dead group still reports routable until traffic
@@ -224,6 +247,8 @@ def _doctor_fleet(args) -> int:
             "replication": replication, "openBreakers": open_breakers,
             "instanceSkew": fleet.get("instanceSkew", False),
             "degradedResponses": fleet.get("degradedResponses", 0),
+            "foldinLag": foldin_lag,
+            "stalenessBudgetSeconds": args.staleness_budget,
         }, indent=2))
         return exit_code
     print(f"fleet router {router_url}: instance {plan.get('instanceId')} "
@@ -241,6 +266,22 @@ def _doctor_fleet(args) -> int:
               f"{r['breaker']:<9} {str(r['instance']):<12} {r['url']}")
     print("replication (routable/total): "
           + ", ".join(f"shard {s}: {v}" for s, v in replication.items()))
+    lag_cells = []
+    for s, lag in sorted(foldin_lag.items(), key=lambda kv: int(kv[0])):
+        ms = lag["maxStalenessSeconds"]
+        cell = f"shard {s}: {'-' if ms is None else f'{ms:.1f}s'}"
+        if lag["replicaSkew"]:
+            cell += " (replica skew)"
+        lag_cells.append(cell)
+    if lag_cells:
+        print("fold-in lag (max staleness at last apply): "
+              + ", ".join(lag_cells))
+    over = sorted((s for s, lag in foldin_lag.items()
+                   if lag["overBudget"]), key=int)
+    if over:
+        print(f"[WARN] fold-in staleness over the "
+              f"{args.staleness_budget:.0f}s budget in shard group(s): "
+              f"{', '.join(over)}")
     if open_breakers:
         print(f"[WARN] open breakers: {', '.join(open_breakers)}")
     if fleet.get("instanceSkew"):
@@ -271,6 +312,10 @@ def cmd_doctor(args) -> int:
         "adminserver": args.adminserver_port,
         "storageserver": args.storageserver_port,
         "dashboard": args.dashboard_port,
+        # the freshness row: the fold-in worker's /healthz carries
+        # staleness_seconds + queue depth, its /readyz flips past the
+        # staleness budget (docs/freshness.md)
+        "foldin": args.foldin_port,
     }
     report: dict[str, dict] = {}
     exit_code = 0
@@ -753,6 +798,104 @@ def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
     return 0
 
 
+def cmd_foldin(args) -> int:
+    """`pio foldin` — the streaming fold-in worker (docs/freshness.md):
+    tail the event stream, solve refreshed user rows against the
+    deployed model's item factors, and hot-swap them into serving
+    (single host or fleet router). Training-read semantics and solver
+    params come from the SAME engine.json train/deploy read, so they
+    cannot drift from the model being refreshed."""
+    import threading
+
+    from pio_tpu.freshness import (
+        FoldInConfig, FoldInWorker, RouterFleetApplier, ServingHttpApplier,
+        create_foldin_server,
+    )
+    from pio_tpu.freshness.tail import HttpEventSource
+    from pio_tpu.ops import als
+
+    variant = _load_variant(args.engine_dir)
+    engine, ep = _engine_from_variant(variant, args.engine_dir)
+    engine_id, engine_version, engine_variant = _engine_ids(
+        variant, args.engine_dir
+    )
+    _, ds = ep.datasource
+    _, ap = (ep.algorithms or [(None, None)])[0]
+    rank = getattr(ap, "rank", None)
+    if rank is None:
+        return _fail(
+            "fold-in needs a factor-model engine (algorithm params with "
+            f"rank/lambda_/alpha/implicit_prefs); got {type(ap).__name__}")
+    als_params = als.ALSParams(
+        rank=rank,
+        reg=getattr(ap, "lambda_", 0.1),
+        alpha=getattr(ap, "alpha", 1.0),
+        implicit=getattr(ap, "implicit_prefs", False),
+    )
+    app_name = getattr(ds, "app_name", "")
+    if not app_name:
+        return _fail("engine.json datasource params carry no appName")
+    state_path = args.state_path or os.path.join(
+        os.path.expanduser(os.environ.get("PIO_TPU_HOME", "~/.pio_tpu")),
+        "foldin", f"{engine_id}-{engine_variant}.cursor")
+    config = FoldInConfig(
+        app_name=app_name,
+        channel_name=getattr(ds, "channel_name", None),
+        engine_id=engine_id, engine_version=engine_version,
+        engine_variant=engine_variant,
+        event_names=tuple(getattr(ds, "event_names", ("rate", "buy"))),
+        value_event=getattr(ds, "rating_event", "rate"),
+        default_value=getattr(ds, "implicit_value", 4.0),
+        als_params=als_params,
+        state_path=state_path,
+        replay=args.replay,
+        poll_interval_s=args.interval,
+        max_batch_users=args.max_batch_users,
+        staleness_budget_s=args.staleness_budget,
+        ip=args.ip, port=args.port,
+    )
+    key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
+    if args.router_url:
+        applier = RouterFleetApplier(args.router_url, key)
+        target = args.router_url
+    else:
+        applier = ServingHttpApplier(args.serving_url, key)
+        target = args.serving_url
+    source = None
+    if args.event_server_url:
+        source = HttpEventSource(
+            args.event_server_url, args.access_key,
+            channel_name=config.channel_name,
+            event_names=config.event_names,
+        )
+    storage = get_storage()
+    worker = FoldInWorker(storage, config, applier, source=source)
+    if args.once:
+        try:
+            stats = worker.run_once()
+        except Exception as e:  # noqa: BLE001 - --once reports, not loops
+            print(json.dumps({"error": f"{type(e).__name__}: {e}",
+                              **worker.snapshot()}))
+            return 1
+        print(json.dumps({**stats, **worker.snapshot()}))
+        return 0
+    http = create_foldin_server(worker)
+    http.start()
+    worker.start()
+    print(f"fold-in worker for engine {engine_id} -> {target} "
+          f"(health on http://{args.ip}:{http.port}, cursor {state_path})")
+
+    stop = threading.Event()
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    worker.stop()
+    http.stop()
+    print("fold-in worker stopped.")
+    return 0
+
+
 def cmd_batchpredict(args) -> int:
     """Offline bulk scoring through the full serving composition
     (workflow/batchpredict.py); no HTTP server involved."""
@@ -1152,6 +1295,12 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--router-url", default="",
                    help="fleet router base URL (default "
                         "http://<ip>:<serving-port>)")
+    x.add_argument("--foldin-port", type=int, default=8100,
+                   help="fold-in worker health port (the freshness row; "
+                        "reported down when no folder is running)")
+    x.add_argument("--staleness-budget", type=float, default=60.0,
+                   help="fold-in staleness warn threshold (seconds) for "
+                        "--fleet's per-group lag column")
     x.set_defaults(fn=cmd_doctor)
 
     x = sub.add_parser("run")
@@ -1287,6 +1436,47 @@ def build_parser() -> argparse.ArgumentParser:
                         "over budget fails deploy instead of lying about "
                         "capacity. 0 = unlimited")
     x.set_defaults(fn=cmd_deploy)
+
+    x = sub.add_parser(
+        "foldin",
+        help="streaming fold-in worker: tail the event stream, solve "
+             "refreshed user rows against the deployed item factors, "
+             "hot-swap them into serving (docs/freshness.md)")
+    engine_dir_arg(x)
+    x.add_argument("--serving-url", default="http://127.0.0.1:8000",
+                   help="single-host deploy server to apply rows to")
+    x.add_argument("--router-url", default="",
+                   help="fleet router base URL — apply rows through the "
+                        "sharded fleet instead of --serving-url")
+    x.add_argument("--event-server-url", default="",
+                   help="tail a remote event server's GET /tail/events.json"
+                        " (default: read the event store directly)")
+    x.add_argument("--access-key", default="",
+                   help="event-server app access key "
+                        "(with --event-server-url)")
+    x.add_argument("--server-key", default="",
+                   help="serving/router server key (or PIO_SERVER_KEY)")
+    x.add_argument("--state-path", default="",
+                   help="durable cursor file (default $PIO_TPU_HOME/foldin/"
+                        "<engine>-<variant>.cursor)")
+    x.add_argument("--replay", action="store_true",
+                   help="a FRESH cursor replays the whole event log "
+                        "(re-fold every historical user) instead of "
+                        "starting at now")
+    x.add_argument("--interval", type=float, default=0.5,
+                   help="tail poll interval (seconds)")
+    x.add_argument("--max-batch-users", type=int, default=1024,
+                   help="fold batch cap per cycle")
+    x.add_argument("--staleness-budget", type=float, default=60.0,
+                   help="the folder's /readyz flips once event->servable "
+                        "staleness exceeds this many seconds")
+    x.add_argument("--once", action="store_true",
+                   help="run exactly one tail->solve->apply cycle, print "
+                        "its stats as JSON, and exit (cron-style fold-in)")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8100,
+                   help="health port (/healthz /readyz /metrics.json)")
+    x.set_defaults(fn=cmd_foldin)
 
     x = sub.add_parser(
         "batchpredict",

@@ -152,6 +152,12 @@ class QueryServer:
         )
         self.hedged_dispatches = 0
         self.last_reload_error: str | None = None
+        # streaming fold-in accounting (foldin_upsert): how many user
+        # rows the freshness subsystem has hot-swapped in, and the last
+        # batch's measured event-ingest -> servable staleness
+        self.foldin_applied_users = 0
+        self.foldin_last_time = None
+        self.foldin_last_staleness_s: float | None = None
         # serializes whole reloads (resolve + restore + swap) end to end
         # WITHOUT blocking queries: queries snapshot state under
         # self._lock, which a reload only takes for the final swap.
@@ -576,6 +582,100 @@ class QueryServer:
             prediction = dict(prediction, prId=new_pr_id)
         return prediction
 
+    # -- streaming fold-in (pio_tpu/freshness/) ------------------------------
+    def foldin_upsert(self, rows, staleness_s: float | None = None) -> dict:
+        """Hot-swap refreshed user factor rows into the serving model
+        (the freshness subsystem's apply surface): existing users'
+        rows are replaced in place, new users are APPENDED — id index
+        and factor table extended together, so ``recommend_topk`` and
+        the id decode stay aligned. Last-good semantics: the new model
+        is built completely OUTSIDE the lock and swapped atomically; a
+        failure anywhere leaves the previous model serving untouched.
+        ``rows`` maps user id → (k,)-float sequence."""
+        import dataclasses
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        if not rows:
+            with self._lock:
+                return {"applied": 0, "new": 0,
+                        "engineInstanceId": self.instance.id}
+        with self._lock:
+            models = list(self.models)
+            instance_id = self.instance.id
+        for mi, model in enumerate(models):
+            factors = getattr(model, "factors", None)
+            if (getattr(factors, "user_factors", None) is not None
+                    and getattr(model, "users", None) is not None):
+                break
+        else:
+            raise ValueError(
+                "fold-in needs a factor-table model (factors.user_factors "
+                "+ users index); none of the deployed models qualifies")
+        uf = model.factors.user_factors
+        k = int(uf.shape[1])
+        users = model.users
+        existing: list[tuple[int, list[float]]] = []
+        new_ids: list = []
+        new_rows: list = []
+        for uid, row in rows.items():
+            if len(row) != k:
+                raise ValueError(
+                    f"fold-in row for {uid!r} has {len(row)} dims, model "
+                    f"rank is {k}")
+            if uid in users:
+                existing.append((users.index_of(uid), row))
+            else:
+                new_ids.append(uid)
+                new_rows.append(row)
+        new_uf = uf
+        if existing:
+            idx = np.fromiter((i for i, _ in existing), np.int32,
+                              count=len(existing))
+            vals = np.asarray([r for _, r in existing], np.float32)
+            new_uf = new_uf.at[jnp.asarray(idx)].set(jnp.asarray(vals))
+        if new_ids:
+            new_uf = jnp.concatenate(
+                [new_uf, jnp.asarray(np.asarray(new_rows, np.float32))])
+        new_model = dataclasses.replace(
+            model,
+            factors=dataclasses.replace(model.factors, user_factors=new_uf),
+            users=users.extended(new_ids) if new_ids else users,
+        )
+        with self._lock:
+            # the model may have moved while we built the new one: a
+            # /reload (new instance — applying stale rows onto it would
+            # mix factor spaces) or a CONCURRENT fold-in apply (swapping
+            # over it would silently drop the other batch's rows, which
+            # the folder then never refolds — its cursor advanced).
+            # Object identity catches both; report instead of guessing
+            if (self.instance.id != instance_id
+                    or self.models[mi] is not model):
+                raise ValueError(
+                    f"serving model changed (instance {instance_id} -> "
+                    f"{self.instance.id}, or a concurrent fold-in apply) "
+                    "during fold-in apply; retry")
+            models = list(self.models)
+            models[mi] = new_model
+            self.models = models
+            self.foldin_applied_users += len(rows)
+            self.foldin_last_time = utcnow()
+            if staleness_s is not None:
+                self.foldin_last_staleness_s = float(staleness_s)
+        return {"applied": len(rows), "new": len(new_ids),
+                "engineInstanceId": instance_id}
+
+    def foldin_status(self) -> dict:
+        """Bounded-staleness accounting for /readyz + /metrics.json."""
+        with self._lock:
+            return {
+                "appliedUsers": self.foldin_applied_users,
+                "lastAppliedTime": (format_time(self.foldin_last_time)
+                                    if self.foldin_last_time else None),
+                "stalenessSeconds": self.foldin_last_staleness_s,
+            }
+
     # -- status -------------------------------------------------------------
     @property
     def request_count(self) -> int:
@@ -614,6 +714,7 @@ class QueryServer:
             "startTime": format_time(self.start_time),
             "spans": self.tracer.snapshot(),
             "hedgedDispatches": self.hedged_dispatches,
+            "foldin": self.foldin_status(),
         }
 
 
@@ -852,6 +953,27 @@ def build_serving_app(server: QueryServer) -> HttpApp:
             return 200, []
         return _budgeted(lambda: server.query_batch(qs))
 
+    @app.route("POST", r"/model/upsert_users")
+    def upsert_users(req: Request):
+        """Streaming fold-in apply surface (pio_tpu/freshness/): body
+        ``{"users": {id: [row]}, "stalenessSeconds"?: s}``. Guarded like
+        /reload — it mutates the serving model."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        try:
+            body = req.json()
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"Invalid body: {e}"}
+        if not isinstance(body, dict) or not isinstance(
+                body.get("users"), dict):
+            return 400, {"message": "body must be {\"users\": {id: [row]}}"}
+        try:
+            out = server.foldin_upsert(
+                body["users"], body.get("stalenessSeconds"))
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        return 200, out
+
     @app.route("GET", r"/reload")
     def reload(req: Request):
         if not check_server_key(req):
@@ -935,6 +1057,11 @@ def build_serving_app(server: QueryServer) -> HttpApp:
             "engineInstanceId": inst.id if inst is not None else None,
             "lastReloadError": server.last_reload_error,
         }
+        # fold-in visibility, NEVER a readiness gate: a stale/absent
+        # folder means batch-stale serving (degraded freshness), and
+        # flipping serving readyz for it would turn that degradation
+        # into the outage the freshness contract forbids
+        checks["freshness"] = {"ok": True, **server.foldin_status()}
         # bucket-warm gate: NOT ready while a micro-batch warm sweep is
         # owed or in flight — a balancer that routes on /readyz never
         # lands traffic in a bucket-miss XLA compile (BENCH_r05's 187 ms

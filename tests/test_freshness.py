@@ -1,0 +1,733 @@
+"""Streaming fold-in subsystem tests (pio_tpu/freshness/):
+
+  * ops-level batch-composition invariance of `als_fold_in` (a user's
+    refreshed row is bit-identical solo or in any batch — the property
+    every oracle assertion below rests on),
+  * the ISSUE 7 oracle: fold-in of user u's events produces user rows
+    BIT-identical to a cold solve of the same events against the same
+    item factors, explicit + implicit, on single-host AND fleet serving,
+  * durable-cursor resume after a chaos `foldin.solve` kill mid-batch:
+    no lost fold-ins, no duplicated fold-ins, serving never 5xxs,
+  * apply-breaker backoff, staleness-budget /readyz flip, unknown-item
+    skip, boundary-microsecond dedup,
+  * the HTTP surfaces: event-server `GET /tail/events.json`, serving
+    `POST /model/upsert_users`, shard `POST /shard/upsert_users`
+    mis-route rejection, router `POST /fleet/upsert_users` failed-group
+    accounting, and `pio doctor --fleet`'s fold-in lag column.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from pio_tpu.data import DataMap, Event
+from pio_tpu.freshness import (
+    CursorStore,
+    FoldCursor,
+    FoldInApplyError,
+    FoldInConfig,
+    FoldInWorker,
+    LocalServingApplier,
+    RouterFleetApplier,
+    build_foldin_app,
+)
+from pio_tpu.freshness.tail import HttpEventSource, LocalEventSource, _micros
+from pio_tpu.ops import als
+from pio_tpu.resilience import CircuitOpenError, chaos
+from pio_tpu.utils.time import utcnow
+from tests.test_serve import call as http_call
+from tests.test_serve import seed_and_train
+
+
+def app_get(app, path):
+    """Dispatch a GET straight into an HttpApp (no socket)."""
+    from pio_tpu.server.http import Request
+
+    return app.dispatch(Request(method="GET", path=path, params={},
+                                headers={}))
+
+
+def train(storage, implicit=False):
+    """seed_and_train with the engine knobs fold-in mirrors."""
+    from pio_tpu.controller import EngineParams
+    from pio_tpu.data.dao import AccessKey, App
+    from pio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.train import run_train
+
+    from tests.test_serve import T0
+
+    app_id = storage.get_metadata_apps().insert(App(0, "mlapp"))
+    storage.get_metadata_access_keys().insert(AccessKey("AK", app_id, ()))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    m = 0
+    for u in range(20):
+        for i in range(12):
+            match = (u % 2) == (i % 2)
+            if rng.random() < (0.8 if match else 0.1):
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5 if match else 1}),
+                    event_time=T0 + timedelta(minutes=m)), app_id)
+                m += 1
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="mlapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=4, num_iterations=2, lambda_=0.05, alpha=0.6,
+            implicit_prefs=implicit, chunk=1024))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    iid = run_train(engine, ep, storage, engine_id="rec", ctx=ctx)
+    return engine, ep, ctx, iid, app_id
+
+
+def foldin_config(tmp_path, implicit=False, **kw):
+    return FoldInConfig(
+        app_name="mlapp", engine_id="rec",
+        als_params=als.ALSParams(rank=4, reg=0.05, alpha=0.6,
+                                 implicit=implicit),
+        state_path=str(tmp_path / "cursor.bin"),
+        **kw)
+
+
+def ingest(storage, app_id, user, pairs, event="rate"):
+    """Insert fresh (now-stamped) interaction events; returns them."""
+    ev = storage.get_events()
+    out = []
+    for item, rating in pairs:
+        e = Event(
+            event=event, entity_type="user", entity_id=user,
+            target_entity_type="item", target_entity_id=item,
+            properties=DataMap({} if rating is None else {"rating": rating}),
+            event_time=utcnow())
+        ev.insert(e, app_id)
+        out.append(e)
+    return out
+
+
+def oracle_row(model, events, params):
+    """The cold oracle: the SAME events, deduplicated with the training
+    read's exact semantics (latest value per item wins; rate events read
+    properties.rating, others take the 4.0 implicit value), solved SOLO
+    through `als_fold_in` against the deployed item factors. Built here
+    from scratch — not via the freshness helpers — so the subsystem
+    cannot be tested against itself."""
+    vals: dict = {}
+    for e in sorted(events, key=lambda ev: ev.event_time):
+        v = (float(e.properties.get_or_else("rating", 4.0))
+             if e.event == "rate" else 4.0)
+        vals[e.target_entity_id] = v
+    known = [(model.items.bimap[i], v) for i, v in vals.items()
+             if i in model.items]
+    rows = als.als_fold_in(
+        model.factors.item_factors,
+        np.zeros(len(known), np.int32),
+        np.asarray([i for i, _ in known], np.int32),
+        np.asarray([v for _, v in known], np.float32),
+        1, params)
+    return np.asarray(rows)[0]
+
+
+# -- ops: the invariance the oracle rests on ---------------------------------
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_fold_in_batch_composition_invariant(implicit):
+    """User u's refreshed row is BIT-identical whether u folds alone or
+    among any batch mates, explicit and implicit — `fold_in_params`
+    pins the bit-conservative kernel variant, `_solve_rows_invariant`
+    runs one unbatched Cholesky per row."""
+    rng = np.random.default_rng(7)
+    item_factors = rng.standard_normal((17, 6)).astype(np.float32)
+    params = als.ALSParams(rank=6, reg=0.03, alpha=0.9, implicit=implicit)
+    u = np.asarray([0, 0, 1, 1, 1, 2, 3, 3], np.int32)
+    i = np.asarray([3, 9, 0, 4, 16, 7, 2, 11], np.int32)
+    v = rng.uniform(1, 5, size=8).astype(np.float32)
+    batch = np.asarray(als.als_fold_in(item_factors, u, i, v, 4, params))
+    for uid in range(4):
+        m = u == uid
+        solo = np.asarray(als.als_fold_in(
+            item_factors, np.zeros(m.sum(), np.int32), i[m], v[m],
+            1, params))
+        assert (solo[0] == batch[uid]).all(), uid
+    # empty users get the zero row
+    assert (np.asarray(als.als_fold_in(
+        item_factors, u, i, v, 6, params))[4:] == 0).all()
+
+
+# -- the oracle: single-host --------------------------------------------------
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_foldin_oracle_parity_single_host(memory_storage, tmp_path,
+                                          implicit):
+    """Fold-in of a brand-new user's events AND an existing user's new
+    events lands rows bit-identical to the cold oracle, served by the
+    single-host QueryServer."""
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage, implicit=implicit)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec"), ctx=ctx)
+    http.start()
+    try:
+        worker = FoldInWorker(
+            storage, foldin_config(tmp_path, implicit=implicit),
+            LocalServingApplier(qs))
+        # a mixed history for the NEW user: rated twice (latest wins),
+        # one un-rated buy (the 4.0 implicit-value rule)
+        newbie = ingest(storage, app_id, "newbie",
+                        [("i1", 2), ("i1", 5), ("i4", 3)])
+        newbie += ingest(storage, app_id, "newbie", [("i6", None)],
+                         event="buy")
+        fresh = ingest(storage, app_id, "u0", [("i9", 1)])
+        stats = worker.run_once()
+        assert stats["folded"] == 2 and stats["skipped"] == 0
+        assert worker.queue_depth() == 0
+        assert worker.staleness_seconds() == 0.0
+
+        with qs._lock:
+            model = qs.models[0]
+        assert "newbie" in model.users
+        served = np.asarray(model.factors.user_factors)
+        got = served[model.users.index_of("newbie")]
+        want = oracle_row(model, newbie, worker.config.als_params)
+        assert (got == want).all(), (got, want)
+        # the existing user's row was REPLACED by a fold of the FULL
+        # history (old trained events + the new one)
+        u0_events = [e for e in storage.get_events().find(
+            app_id=app_id, entity_type="user", entity_id="u0", limit=-1)]
+        got0 = served[model.users.index_of("u0")]
+        want0 = oracle_row(model, u0_events, worker.config.als_params)
+        assert (got0 == want0).all()
+        assert fresh  # (events exist; history read includes them)
+        # and the refreshed user actually serves recommendations
+        st, body = http_call(http.port, "POST", "/queries.json",
+                             {"user": "newbie", "num": 3})
+        assert st == 200 and len(body["itemScores"]) == 3
+    finally:
+        http.stop()
+        qs.close()
+
+
+# -- the oracle: fleet --------------------------------------------------------
+
+@pytest.mark.parametrize("implicit", [False, True])
+def test_foldin_oracle_parity_fleet(memory_storage, tmp_path, implicit):
+    """The same oracle through the sharded fleet: the router crc32c-
+    routes the fold to the owner shard group, EVERY replica lands the
+    bit-identical row, and the new user serves through /queries.json."""
+    from pio_tpu.serving_fleet.fleet import deploy_fleet
+    from pio_tpu.serving_fleet.plan import shard_of
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage, implicit=implicit)
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=2)
+    try:
+        worker = FoldInWorker(
+            storage, foldin_config(tmp_path, implicit=implicit),
+            RouterFleetApplier(
+                f"http://127.0.0.1:{handle.router_http.port}"))
+        events = ingest(storage, app_id, "newbie",
+                        [("i1", 5), ("i4", 2), ("i7", 4)])
+        stats = worker.run_once()
+        assert stats["folded"] == 1
+        with worker._lock:
+            model = worker._model
+        want = oracle_row(model, events, worker.config.als_params)
+        owner = shard_of("newbie", 2)
+        for rep in range(2):
+            _http, srv = handle.shards[owner * 2 + rep]
+            assert srv.config.shard_index == owner
+            row = srv.user_row("newbie")
+            assert row is not None, f"replica {rep} missed the fold"
+            assert (np.asarray(row, np.float32) == want).all(), rep
+        # the non-owner group never saw (and must not hold) the row
+        for rep in range(2):
+            _http, srv = handle.shards[(1 - owner) * 2 + rep]
+            assert srv.user_row("newbie") is None
+        st, body = http_call(handle.router_http.port, "POST",
+                             "/queries.json", {"user": "newbie", "num": 3})
+        assert st == 200 and len(body["itemScores"]) == 3
+        assert not body.get("degraded")
+    finally:
+        handle.close()
+
+
+# -- durable cursor + chaos resume -------------------------------------------
+
+def test_chaos_solve_kill_then_restart_resumes_without_loss_or_dup(
+        memory_storage, tmp_path):
+    """The freshness-chaos CI drill's in-process core: `foldin.solve`
+    chaos kills the folder mid-batch (after the window was read, before
+    any row lands) -> the durable cursor does NOT advance and serving
+    never 5xxs; a RESTARTED folder (fresh process state, same cursor
+    file) re-reads the window and folds each event exactly once."""
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec"), ctx=ctx)
+    http.start()
+    try:
+        w1 = FoldInWorker(storage, foldin_config(tmp_path),
+                          LocalServingApplier(qs))
+        disk_before = CursorStore(w1.config.state_path).load()
+        events = ingest(storage, app_id, "newbie", [("i1", 5), ("i4", 2)])
+        with chaos.inject("foldin.solve", error=1.0, seed=3) as monkey:
+            with pytest.raises(chaos.ChaosError):
+                w1.run_once()
+            assert "foldin.solve" in monkey.injected
+        # mid-gap: the cursor never advanced, serving answers fine
+        assert CursorStore(w1.config.state_path).load() == disk_before
+        st, _ = http_call(http.port, "POST", "/queries.json",
+                          {"user": "u0", "num": 3})
+        assert st == 200
+        assert "newbie" not in qs.models[0].users
+
+        # "restart": a brand-new worker over the same cursor file
+        w2 = FoldInWorker(storage, foldin_config(tmp_path),
+                          LocalServingApplier(qs))
+        stats = w2.run_once()
+        assert stats["folded"] == 1          # not lost
+        assert w2.folded_total == 1
+        stats = w2.run_once()
+        assert stats["folded"] == 0          # not duplicated
+        assert w2.folded_total == 1
+        # the advanced cursor carries the lifetime count durably
+        assert CursorStore(w2.config.state_path).load().folded_total == 1
+        with qs._lock:
+            model = qs.models[0]
+        want = oracle_row(model, events, w2.config.als_params)
+        got = np.asarray(model.factors.user_factors)[
+            model.users.index_of("newbie")]
+        assert (got == want).all()
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_boundary_microsecond_straggler_not_dropped(memory_storage,
+                                                    tmp_path):
+    """An event landing at EXACTLY the cursor's boundary microsecond
+    between polls changes the boundary signature and refolds the user —
+    the inclusive re-read + per-user count dedup contract."""
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+
+    class Sink:
+        def __init__(self):
+            self.batches = []
+
+        def apply(self, rows, staleness_s=None):
+            self.batches.append(dict(rows))
+            return {"applied": len(rows)}
+
+    sink = Sink()
+    worker = FoldInWorker(storage, foldin_config(tmp_path), sink)
+    t = utcnow()
+    ev = storage.get_events()
+    ev.insert(Event(event="rate", entity_type="user", entity_id="ub",
+                    target_entity_type="item", target_entity_id="i1",
+                    properties=DataMap({"rating": 5}), event_time=t),
+              app_id)
+    assert worker.run_once()["folded"] == 1
+    assert worker.cursor.time_us == _micros(t)
+    assert worker.cursor.boundary == {"ub": 1}
+    # steady state: nothing new -> nothing refolds
+    assert worker.run_once()["folded"] == 0
+    # the straggler: SAME user, SAME microsecond
+    ev.insert(Event(event="rate", entity_type="user", entity_id="ub",
+                    target_entity_type="item", target_entity_id="i3",
+                    properties=DataMap({"rating": 1}), event_time=t),
+              app_id)
+    assert worker.run_once()["folded"] == 1
+    assert worker.cursor.boundary == {"ub": 2}
+    assert worker.run_once()["folded"] == 0
+    # the refold saw the FULL history (both boundary events)
+    assert set(sink.batches[-1]) == {"ub"}
+    assert len(sink.batches) == 2
+
+
+def test_window_bigger_than_batch_cap_drains_and_cursor_advances(
+        memory_storage, tmp_path):
+    """A window holding MORE distinct users than max_batch_users must
+    drain fully inside one cycle (multiple apply batches) and then
+    advance the cursor — folding one batch per cycle would wedge the
+    cursor forever: the next poll re-reads the same window and re-pends
+    the users just served, so the pending set never empties."""
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+
+    class Sink:
+        def __init__(self):
+            self.batches = []
+
+        def apply(self, rows, staleness_s=None):
+            self.batches.append(dict(rows))
+            return {"applied": len(rows)}
+
+    sink = Sink()
+    worker = FoldInWorker(
+        storage, foldin_config(tmp_path, max_batch_users=2), sink)
+    for n in range(5):
+        ingest(storage, app_id, f"burst{n}", [("i1", 5)])
+    stats = worker.run_once()
+    assert stats["folded"] == 5
+    assert len(sink.batches) == 3               # 2 + 2 + 1
+    assert all(len(b) <= 2 for b in sink.batches)
+    assert worker.queue_depth() == 0
+    # the cursor ADVANCED to the window boundary and survives on disk
+    assert worker.cursor.time_us > 0
+    assert CursorStore(worker.config.state_path).load() == worker.cursor
+    # steady state: the next poll refolds nothing
+    assert worker.run_once()["folded"] == 0
+    assert len(sink.batches) == 3
+
+
+def test_router_upsert_rejected_rows_not_counted_as_applied(
+        memory_storage, tmp_path):
+    """A shard answering 200 but REJECTING rows (plan mismatch, e.g.
+    mid-rolling-redeploy) must not count as a successful apply: the
+    group lands in failedGroups and the applier raises, so the folder
+    keeps those users pending instead of dropping fold-ins that never
+    became servable."""
+    from pio_tpu.serving_fleet.fleet import deploy_fleet
+    from pio_tpu.serving_fleet.plan import shard_of
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    try:
+        url = f"http://127.0.0.1:{handle.router_http.port}"
+        u0 = next(u for u in ("a", "b", "c", "d") if shard_of(u, 2) == 0)
+        # group 0's only replica now claims to be shard 1: every row the
+        # router routes to it is refused — the plan-mismatch shape
+        handle.shards[0][1].config.shard_index = 1
+        st, out = http_call(handle.router_http.port, "POST",
+                            "/fleet/upsert_users",
+                            {"users": {u0: [0.5, 0.5, 0.5, 0.5]}})
+        assert st == 200
+        assert out["ok"] is False and out["failedGroups"] == [0]
+        assert out["groups"]["0"]["ok"] is False
+        assert out["groups"]["0"]["replicas"]["0"]["rejected"] == [u0]
+        with pytest.raises(FoldInApplyError, match="incomplete"):
+            RouterFleetApplier(url).apply({u0: [0.5, 0.5, 0.5, 0.5]})
+        # an answered 200 is not a transport failure: the replica's
+        # breaker stays closed (rejection is an application verdict)
+        assert handle.router.replicas[0][0].breaker.snapshot() \
+            .state == "closed"
+    finally:
+        handle.close()
+
+
+def test_cursor_store_durable_roundtrip_and_corrupt_fallback(tmp_path):
+    path = str(tmp_path / "c" / "cursor.bin")
+    store = CursorStore(path)
+    assert store.load() == FoldCursor()     # absent -> fresh
+    cur = FoldCursor(time_us=123456789, boundary={"u1": 2},
+                     folded_total=7)
+    store.save(cur)
+    assert store.load() == cur
+    # the file is CRC32C-framed (utils/durable.py): bit-rot is detected
+    # and treated as absent, not silently half-parsed
+    from pio_tpu.utils.durable import unframe
+
+    raw = open(path, "rb").read()
+    unframe(raw)                            # frames verify
+    with open(path, "wb") as f:
+        f.write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+    assert store.load() == FoldCursor()
+
+
+# -- degradation: breaker, staleness budget, unknown items --------------------
+
+def test_apply_breaker_opens_and_keeps_users_pending(memory_storage,
+                                                     tmp_path):
+    """A down serving layer trips the apply breaker: the folder backs
+    off (CircuitOpenError, an expected state — not a crash), users stay
+    pending, staleness grows, and the folder's /readyz flips."""
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+
+    class Down:
+        def apply(self, rows, staleness_s=None):
+            raise FoldInApplyError("serving is down")
+
+    worker = FoldInWorker(storage, foldin_config(tmp_path), Down())
+    ingest(storage, app_id, "newbie", [("i1", 5)])
+    with pytest.raises(FoldInApplyError):
+        worker.run_once()
+    with pytest.raises(FoldInApplyError):
+        worker.run_once()
+    with pytest.raises(FoldInApplyError):
+        worker.run_once()
+    with pytest.raises(CircuitOpenError):   # breaker open: backoff
+        worker.run_once()
+    assert worker.queue_depth() == 1
+    assert worker.staleness_seconds() > 0.0
+    app = build_foldin_app(worker)
+    status, body = app_get(app, "/readyz")
+    assert status == 503 and not body["ready"]
+    assert not body["checks"]["applyBreaker"]["ok"]
+    # /healthz stays ALIVE with the gauges inline — a wedged folder is
+    # degraded freshness, not a dead process
+    status, body = app_get(app, "/healthz")
+    assert status == 200
+    assert body["staleness_seconds"] > 0.0
+    assert body["foldin_queue_depth"] == 1
+
+
+def test_staleness_budget_flips_foldin_readyz(memory_storage, tmp_path):
+    storage = memory_storage
+    train(storage)
+    worker = FoldInWorker(storage,
+                          foldin_config(tmp_path, staleness_budget_s=0.05),
+                          LocalServingApplier(None))
+    app = build_foldin_app(worker)
+    status, body = app_get(app, "/readyz")
+    assert status == 200 and body["ready"]          # caught up
+    with worker._lock:
+        worker._pending["slow-user"] = _micros(utcnow()) - 10_000_000
+    status, body = app_get(app, "/readyz")
+    assert status == 503
+    assert not body["checks"]["freshness"]["ok"]
+    assert body["checks"]["freshness"]["stalenessSeconds"] > 0.05
+
+
+def test_unknown_item_users_skipped_not_busy_looped(memory_storage,
+                                                    tmp_path):
+    """Events referencing only items the model has never seen cannot be
+    folded (nothing to score against until the next train): the user is
+    counted skipped and cleared, and the cursor still advances."""
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+
+    class Sink:
+        def apply(self, rows, staleness_s=None):
+            return {"applied": len(rows)}
+
+    worker = FoldInWorker(storage, foldin_config(tmp_path), Sink())
+    ingest(storage, app_id, "martian", [("unreleased-item", 5)])
+    stats = worker.run_once()
+    assert stats == {"windowRows": 1, "touched": 1, "folded": 0,
+                     "skipped": 1}
+    assert worker.queue_depth() == 0
+    assert worker.skipped_unknown_items == 1
+    assert worker.cursor.time_us > 0
+    assert worker.run_once()["touched"] == 0
+
+
+# -- HTTP surfaces ------------------------------------------------------------
+
+def test_tail_route_and_http_source_match_local_source(memory_storage,
+                                                       tmp_path):
+    """`GET /tail/events.json` (columnar window over HTTP) drives
+    `HttpEventSource` to the same window verdict and the same histories
+    as the in-process `LocalEventSource`."""
+    from pio_tpu.server.eventserver import (
+        EventServerConfig, create_event_server,
+    )
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    srv = create_event_server(
+        storage, EventServerConfig(ip="127.0.0.1", port=0)).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        remote = HttpEventSource(url, "AK")
+        local = LocalEventSource(storage, "mlapp")
+        ingest(storage, app_id, "newbie", [("i1", 5), ("i2", 3)])
+        cursor = FoldCursor()        # from the beginning
+        rw, lw = remote.window(cursor), local.window(cursor)
+        assert rw.to_fold == lw.to_fold
+        assert rw.time_us == lw.time_us
+        assert rw.boundary == lw.boundary
+        assert "newbie" in rw.to_fold
+        rh = remote.history("newbie")
+        lh = local.history("newbie")
+        assert [(e.event, e.target_entity_id, dict(e.properties.fields))
+                for e in rh] == \
+               [(e.event, e.target_entity_id, dict(e.properties.fields))
+                for e in lh]
+        # auth is the event-server's usual contract
+        st, _ = http_call(srv.port, "GET", "/tail/events.json",
+                          accessKey="WRONG")
+        assert st == 401
+        # sinceUs narrows the window: past the newest event -> empty
+        st, out = http_call(srv.port, "GET", "/tail/events.json",
+                            accessKey="AK", sinceUs=str(rw.time_us + 1))
+        assert st == 200 and out["count"] == 0
+        assert out["nextUs"] == rw.time_us + 1
+    finally:
+        srv.stop()
+
+
+def test_upsert_users_route_guarded_and_validated(memory_storage):
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      server_key="sk"), ctx=ctx)
+    http.start()
+    try:
+        row = [0.1, 0.2, 0.3, 0.4]
+        st, _ = http_call(http.port, "POST", "/model/upsert_users",
+                          {"users": {"nu": row}})
+        assert st == 401                       # guarded like /reload
+        st, _ = http_call(http.port, "POST", "/model/upsert_users",
+                          {"rows": []}, accessKey="sk")
+        assert st == 400
+        st, body = http_call(http.port, "POST", "/model/upsert_users",
+                             {"users": {"nu": [1.0, 2.0]}}, accessKey="sk")
+        assert st == 400 and "rank" in body["message"]
+        st, body = http_call(http.port, "POST", "/model/upsert_users",
+                             {"users": {"nu": row},
+                              "stalenessSeconds": 1.25}, accessKey="sk")
+        assert st == 200
+        assert body == {"applied": 1, "new": 1, "engineInstanceId": iid}
+        assert np.allclose(
+            np.asarray(qs.models[0].factors.user_factors)[
+                qs.models[0].users.index_of("nu")], row)
+        # accounting lands on the metrics surface
+        st, body = http_call(http.port, "GET", "/metrics.json")
+        assert st == 200
+        assert body["foldin"]["appliedUsers"] == 1
+        assert body["foldin"]["stalenessSeconds"] == 1.25
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_shard_upsert_rejects_misrouted_rows(memory_storage):
+    """A row whose crc32c owner is ANOTHER shard is rejected loudly —
+    a mis-routed fold must never shadow the owner shard's copy."""
+    from pio_tpu.serving_fleet.fleet import resolve_fleet_model
+    from pio_tpu.serving_fleet.plan import persist_fleet_artifacts, shard_of
+    from pio_tpu.serving_fleet.shard import ShardConfig, ShardServer
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    _, model = resolve_fleet_model(storage, "rec")
+    persist_fleet_artifacts(storage, iid, model, 2, 1)
+    srv = ShardServer(storage, ShardConfig(
+        shard_index=0, n_shards=2, engine_id="rec", instance_id=iid))
+    mine = next(u for u in ("a", "b", "c", "d") if shard_of(u, 2) == 0)
+    theirs = next(u for u in ("a", "b", "c", "d") if shard_of(u, 2) == 1)
+    row = [1.0, 0.0, 0.0, 0.0]
+    out = srv.upsert_user_rows({mine: row, theirs: row})
+    assert out["applied"] == 1 and out["rejected"] == [theirs]
+    assert srv.user_row(mine) == row
+    assert srv.user_row(theirs) is None
+
+
+def test_router_upsert_reports_failed_group_and_applier_raises(
+        memory_storage, tmp_path):
+    """With one shard group down, the router applies what it can,
+    reports the dead group in failedGroups, and RouterFleetApplier
+    raises FoldInApplyError so the folder keeps those users pending."""
+    from pio_tpu.serving_fleet.fleet import deploy_fleet
+    from pio_tpu.serving_fleet.plan import shard_of
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    try:
+        url = f"http://127.0.0.1:{handle.router_http.port}"
+        users = ["a", "b", "c", "d", "e"]
+        live_u = next(u for u in users if shard_of(u, 2) == 0)
+        dead_u = next(u for u in users if shard_of(u, 2) == 1)
+        handle.shards[1][0].stop()              # kill group 1
+        row = [0.5, 0.5, 0.5, 0.5]
+        st, out = http_call(handle.router_http.port, "POST",
+                            "/fleet/upsert_users",
+                            {"users": {live_u: row, dead_u: row}})
+        assert st == 200
+        assert out["ok"] is False and out["failedGroups"] == [1]
+        assert out["groups"]["0"]["ok"] and out["groups"]["0"]["fullyApplied"]
+        assert handle.shards[0][1].user_row(live_u) == row
+        with pytest.raises(FoldInApplyError, match="incomplete"):
+            RouterFleetApplier(url).apply({dead_u: row})
+    finally:
+        handle.close()
+
+
+def test_serving_readyz_never_gated_on_foldin(memory_storage):
+    """The availability floor: serving /readyz reports fold-in status
+    but stays READY with no folder running at all — stale freshness is
+    degraded, never an outage."""
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec"), ctx=ctx)
+    http.start()
+    try:
+        st, body = http_call(http.port, "GET", "/readyz")
+        assert st == 200 and body["ready"]
+        fr = body["checks"]["freshness"]
+        assert fr["ok"] is True and fr["appliedUsers"] == 0
+    finally:
+        http.stop()
+        qs.close()
+
+
+# -- doctor -------------------------------------------------------------------
+
+def test_doctor_fleet_foldin_lag_column(memory_storage, tmp_path, cli):
+    storage = memory_storage
+    engine, ep, ctx, iid, app_id = train(storage)
+    from pio_tpu.serving_fleet.fleet import deploy_fleet
+
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    try:
+        url = f"http://127.0.0.1:{handle.router_http.port}"
+        worker = FoldInWorker(storage, foldin_config(tmp_path),
+                              RouterFleetApplier(url))
+        ingest(storage, app_id, "newbie", [("i1", 5)])
+        assert worker.run_once()["folded"] == 1
+        owner = str(int(__import__(
+            "pio_tpu.serving_fleet.plan", fromlist=["shard_of"]
+        ).shard_of("newbie", 2)))
+
+        code, captured = cli("doctor", "--fleet", "--router-url", url,
+                             "--json")
+        assert code == 0
+        report = json.loads(captured.out)
+        lag = report["foldinLag"]
+        assert lag[owner]["maxStalenessSeconds"] is not None
+        assert lag[owner]["overBudget"] is False
+        assert lag[owner]["appliedUsers"] == [1]
+        other = str(1 - int(owner))
+        assert lag[other]["maxStalenessSeconds"] is None
+        # an exceeded budget warns in the table view
+        code, captured = cli("doctor", "--fleet", "--router-url", url,
+                             "--staleness-budget", "1e-12")
+        assert "fold-in lag" in captured.out
+        assert "[WARN] fold-in staleness over" in captured.out
+    finally:
+        handle.close()

@@ -405,6 +405,47 @@ def test_durable_write_suppressible():
     assert fs == []
 
 
+def test_foldin_cursor_any_write_in_freshness_fires():
+    from pio_tpu.analysis import lint_text
+    src = """
+        import json
+        import pickle
+
+        def save(path, cursor):
+            with open(path, "w") as f:        # text write: still flagged
+                json.dump(cursor, f)
+            open(path + ".bak", mode="wb").write(b"x")
+            pickle.dump(cursor, open(path, "r+b"))
+    """
+    fs = lint_text(textwrap.dedent(src),
+                   path="pio_tpu/freshness/cursor.py",
+                   select=["foldin-cursor"])
+    # open("w"), json.dump, open("wb"), pickle.dump, open("r+b")
+    assert [f.rule for f in fs] == ["foldin-cursor"] * 5
+    # identical code OUTSIDE the freshness package is out of scope
+    assert lint_text(textwrap.dedent(src),
+                     path="pio_tpu/workflow/cursor.py",
+                     select=["foldin-cursor"]) == []
+
+
+def test_foldin_cursor_durable_and_reads_silent():
+    from pio_tpu.analysis import lint_text
+    src = """
+        from pio_tpu.utils.durable import durable_read, durable_write
+
+        def save(path, cursor_json):
+            durable_write(path, cursor_json.encode("utf-8"))
+
+        def load(path):
+            with open(path, "rb") as f:      # plain read: fine
+                f.read()
+            return durable_read(path)
+    """
+    assert lint_text(textwrap.dedent(src),
+                     path="pio_tpu/freshness/cursor.py",
+                     select=["foldin-cursor"]) == []
+
+
 # -- bench hygiene ----------------------------------------------------------
 
 def test_time_time_fires():

@@ -710,13 +710,16 @@ def test_doctor_reports_surface_health(memory_storage, capsys):
         args = argparse.Namespace(
             ip="127.0.0.1", eventserver_port=srv.port, serving_port=dead,
             adminserver_port=dead, storageserver_port=dead,
-            dashboard_port=dead, timeout=2.0, json=True)
+            dashboard_port=dead, foldin_port=dead, timeout=2.0, json=True)
         rc = cmd_doctor(args)
         out = json.loads(capsys.readouterr().out)
         assert rc == 0  # the one live surface is ready; down ones reported
         assert out["surfaces"]["eventserver"]["live"] is True
         assert out["surfaces"]["eventserver"]["ready"] is True
         assert out["surfaces"]["serving"]["live"] is False
+        # the freshness row: a batch-only deployment (no folder running)
+        # is reported down, never failed
+        assert out["surfaces"]["foldin"]["live"] is False
     finally:
         srv.stop()
         spill = getattr(srv.app, "spill", None)
