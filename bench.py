@@ -863,13 +863,13 @@ def phase_smoke() -> dict:
     )
     http.start()
     try:
-        def one_rep() -> float:
+        def one_rep(port: int) -> tuple[float, float]:
             lat = []
             for r in range(120):
                 q = json.dumps(
                     {"user": f"u{r % n_users}", "num": 10}).encode()
                 req = urllib.request.Request(
-                    f"http://127.0.0.1:{http.port}/queries.json", data=q,
+                    f"http://127.0.0.1:{port}/queries.json", data=q,
                     method="POST")
                 t0 = time.monotonic()
                 with urllib.request.urlopen(req, timeout=30) as resp:
@@ -877,21 +877,57 @@ def phase_smoke() -> dict:
                 if r >= 20:
                     lat.append(time.monotonic() - t0)
             lat.sort()
-            return lat[len(lat) // 2] * 1e3
+            return (lat[len(lat) // 2] * 1e3,
+                    lat[max(0, int(len(lat) * 0.99) - 1)] * 1e3)
 
-        # best-of-3 p50: a scheduler stall on a loaded CI box can double
-        # a single rep's median; the BEST rep is the stable capability
-        # number a regression gate needs
-        out["serving_p50_ms"] = round(min(one_rep() for _ in range(3)), 3)
+        # best-of-3: a scheduler stall on a loaded CI box can double a
+        # single rep's numbers; the BEST rep is the stable capability
+        # number a regression gate needs (p99 keyed — the fleet gate
+        # below compares tails)
+        single = min((one_rep(http.port) for _ in range(3)),
+                     key=lambda t: t[1])
+        out["serving_p50_ms"] = round(single[0], 3)
+        out["serving_p99_ms"] = round(single[1], 3)
         out["freshness"] = _smoke_freshness_cell(
             storage, ev, app_id, qs, http.port, n_users)
+        out["fleet"] = _smoke_fleet_cell(storage, one_rep, single[1])
     finally:
         http.stop()
         qs.close()
     out["freshness_new_user_seconds"] = out["freshness"][
         "new_user_seconds"]
+    out["fleet_p99_x_single_host"] = out["fleet"]["p99_x_single_host"]
     out["kernel_lab"] = _smoke_kernel_cell()
     return out
+
+
+def _smoke_fleet_cell(storage, one_rep, single_p99_ms: float) -> dict:
+    """Fleet serving cell (the remaining ROADMAP item 1 measurement):
+    the same query stream through a 2-shard fleet router, best-of-3
+    p50/p99, against the single-host numbers measured moments earlier
+    on the same box (so host noise largely cancels). The gate
+    (BASELINE.json `fleet_p99_x_single_host`) bounds the ROUTER TAIL:
+    router p99 must stay within 2x the single-host oracle's p99 —
+    sharding buys capacity with two RPC hops, and this cell keeps those
+    hops honest on every PR."""
+    from pio_tpu.serving_fleet.fleet import deploy_fleet
+
+    handle = deploy_fleet(storage, engine_id="smoke", n_shards=2,
+                          n_replicas=1)
+    try:
+        port = handle.router_http.port
+        one_rep(port)  # warm: first queries pay jit on each shard
+        p50, p99 = min((one_rep(port) for _ in range(3)),
+                       key=lambda t: t[1])
+    finally:
+        handle.close()
+    return {
+        "router_p50_ms": round(p50, 3),
+        "router_p99_ms": round(p99, 3),
+        "single_p99_ms": round(single_p99_ms, 3),
+        "p99_x_single_host": round(p99 / single_p99_ms, 3)
+        if single_p99_ms > 0 else None,
+    }
 
 
 def _smoke_freshness_cell(storage, ev, app_id, qs, port: int,
@@ -1293,6 +1329,18 @@ def smoke_main() -> int:
             base["freshness_new_user_seconds"],
             res["freshness_new_user_seconds"]
             <= base["freshness_new_user_seconds"])
+    if "fleet_p99_x_single_host" in base:
+        # the fleet tail bound is a CONTRACT ceiling too (ROADMAP item
+        # 1: router p99 within 2x the single-host oracle, both measured
+        # best-of-3 on the same box moments apart so host noise
+        # cancels) — compared absolutely, never refreshed by
+        # --update-baseline
+        checks["fleet_p99_x_single_host"] = (
+            res["fleet_p99_x_single_host"],
+            base["fleet_p99_x_single_host"],
+            res["fleet_p99_x_single_host"] is not None
+            and res["fleet_p99_x_single_host"]
+            <= base["fleet_p99_x_single_host"])
     ok = all(passed for _, _, passed in checks.values())
     print(json.dumps({
         "smoke": "pass" if ok else "FAIL",

@@ -41,12 +41,22 @@ pools) and an asyncio event loop side by side, so the hazards are:
     Stricter than `durable-write` on purpose: in this package there is
     no benign direct write, so the rule needs no artifact-name
     heuristic.
+  * `rollout-state`  — inside `pio_tpu/rollout/`, (a) ANY assignment to
+    a stage/verdict attribute (`*.stage`, `*.stage_index`,
+    `*.stage_pct`, `*.verdict`) outside the controller's `_transition`
+    method (or `__init__`), and (b) ANY direct file-write persistence
+    (the `foldin-cursor` shapes). Rollout stage/verdict IS the record
+    of which model production traffic rides: a write that bypasses the
+    transition method skips both the lock and the durable
+    `state.save_record` persist (utils/durable framing), so a restart
+    would resurrect a traffic split the guards already rejected.
 
 Scope gate: modules that import threading/asyncio/concurrent.futures/
 multiprocessing — shared-state writes in single-threaded scripts are not
-hazards. (`async-blocking`, `bare-retry`, `durable-write`, and
-`foldin-cursor` apply regardless: blocking an event loop, hand-rolling
-retries, and tearable artifact/cursor writes are hazards in any module.)
+hazards. (`async-blocking`, `bare-retry`, `durable-write`,
+`foldin-cursor`, and `rollout-state` apply regardless: blocking an event
+loop, hand-rolling retries, and tearable artifact/cursor/verdict writes
+are hazards in any module.)
 """
 
 from __future__ import annotations
@@ -105,6 +115,13 @@ _ARTIFACT_RE = re.compile(r"model|ckpt|checkpoint", re.IGNORECASE)
 
 # foldin-cursor scope: every module of the freshness subsystem
 _FRESHNESS_PATHS = ("pio_tpu/freshness/",)
+# rollout-state scope + the attribute names that ARE rollout state
+_ROLLOUT_PATHS = ("pio_tpu/rollout/",)
+_ROLLOUT_STATE_ATTRS = frozenset({"stage", "stage_index", "stage_pct",
+                                  "verdict"})
+# functions allowed to write rollout state: the controller's single
+# transition method, plus construction
+_ROLLOUT_WRITERS = frozenset({"_transition", "__init__"})
 # direct-persistence calls beyond open(): the serializer-to-path and
 # Path-method shapes that also bypass utils/durable.py
 _PERSIST_CALLS = frozenset({"json.dump", "pickle.dump", "numpy.save",
@@ -115,13 +132,14 @@ _PERSIST_METHODS = frozenset({"write_text", "write_bytes"})
 class ConcurrencyRule:
     id = "concurrency"
     ids = ("attr-no-lock", "global-no-lock", "async-blocking", "bare-retry",
-           "durable-write", "foldin-cursor")
+           "durable-write", "foldin-cursor", "rollout-state")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         yield from self._async_blocking(ctx)
         yield from self._bare_retry(ctx)
         yield from self._durable_write(ctx)
         yield from self._foldin_cursor(ctx)
+        yield from self._rollout_state(ctx)
         if not ctx.imports_any("threading", "asyncio", "multiprocessing",
                                "concurrent"):
             return
@@ -349,6 +367,14 @@ class ConcurrencyRule:
                "pio_tpu.utils.durable (durable_write/durable_read — "
                "tmp + fsync + atomic rename + CRC32C); a torn cursor "
                "either replays from event 0 or silently loses fold-ins")
+        for node, what in self._direct_file_writes(ctx):
+            yield self._f("foldin-cursor", ctx, node, msg.format(what=what))
+
+    @staticmethod
+    def _direct_file_writes(ctx: ModuleContext):
+        """The direct-persistence call shapes that bypass utils/durable:
+        write-mode open(), serializer-to-path dumps, Path write methods.
+        Shared by `foldin-cursor` and `rollout-state`."""
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -360,18 +386,50 @@ class ConcurrencyRule:
                 if (isinstance(mode, ast.Constant)
                         and isinstance(mode.value, str)
                         and any(c in mode.value for c in "wax+")):
-                    yield self._f(
-                        "foldin-cursor", ctx, node,
-                        msg.format(what=f"`open(..., {mode.value!r})`"))
+                    yield node, f"`open(..., {mode.value!r})`"
             elif name in _PERSIST_CALLS:
-                yield self._f("foldin-cursor", ctx, node,
-                              msg.format(what=f"`{name}(...)`"))
+                yield node, f"`{name}(...)`"
             elif (isinstance(node.func, ast.Attribute)
                   and node.func.attr in _PERSIST_METHODS):
+                yield node, f"`.{node.func.attr}(...)`"
+
+    # -- rollout stage/verdict writes -----------------------------------------
+    def _rollout_state(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """Flag, inside `pio_tpu/rollout/`: stage/verdict attribute
+        writes outside `_transition`/`__init__` (they bypass the lock
+        AND the durable persist), and any direct file write (verdict
+        persistence must ride utils/durable — see module docstring)."""
+        path = ctx.path.replace("\\", "/")
+        if not any(p in path for p in _ROLLOUT_PATHS):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and t.attr in _ROLLOUT_STATE_ATTRS):
+                    continue
+                fn = enclosing_function(node)
+                if fn is not None and fn.name in _ROLLOUT_WRITERS:
+                    continue
                 yield self._f(
-                    "foldin-cursor", ctx, node,
-                    msg.format(
-                        what=f"`.{node.func.attr}(...)`"))
+                    "rollout-state", ctx, node,
+                    f"write to rollout state `{ast.unparse(t)}` outside "
+                    "the controller's _transition method: stage/verdict "
+                    "changes must go through _transition so they happen "
+                    "under the lock AND persist via utils/durable "
+                    "(state.save_record) — an unpersisted verdict "
+                    "resurrects a rejected traffic split on restart")
+        msg = ("direct file write in pio_tpu/rollout/ ({what}): rollout "
+               "records must ride pio_tpu.utils.durable framing via "
+               "state.save_record; a torn verdict record makes a "
+               "rolled-back instance look eligible again")
+        for node, what in self._direct_file_writes(ctx):
+            yield self._f("rollout-state", ctx, node, msg.format(what=what))
 
     # -- blocking calls on the event loop ------------------------------------
     def _async_blocking(self, ctx: ModuleContext) -> Iterator[Finding]:

@@ -144,12 +144,15 @@ class FoldInWorker:
         id check per cycle, blob read only on change. Fold-in solves
         against THESE item factors, which are the ones serving scores
         with — the oracle contract."""
+        from pio_tpu.rollout.state import latest_eligible_completed
         from pio_tpu.serving_fleet.fleet import resolve_fleet_model
 
         c = self.config
-        latest = self.storage.get_metadata_engine_instances() \
-            .get_latest_completed(c.engine_id, c.engine_version,
-                                  c.engine_variant)
+        # rollout-eligibility (pio_tpu/rollout/): fold-in must solve
+        # against the instance traffic actually rides — never a
+        # rolled-back or still-in-canary one serving wouldn't auto-load
+        latest = latest_eligible_completed(
+            self.storage, c.engine_id, c.engine_version, c.engine_variant)
         if latest is None:
             raise ValueError(
                 f"no COMPLETED instance of engine {c.engine_id} "

@@ -41,14 +41,19 @@ def resolve_fleet_model(storage, engine_id: str, engine_version: str = "1",
     """-> (EngineInstance, factor model) from the persisted blob — the
     RAW persisted model (host numpy), which is all partitioning needs;
     no algorithm deploy-prep, no full-model device residency."""
+    from pio_tpu.rollout.state import latest_eligible_completed
+
     instances = storage.get_metadata_engine_instances()
     if instance_id:
         instance = instances.get(instance_id)
         if instance is None:
             raise ValueError(f"Engine instance {instance_id} not found")
     else:
-        instance = instances.get_latest_completed(
-            engine_id, engine_version, engine_variant)
+        # rollout-eligibility gates auto-resolution (rolled-back /
+        # in-flight canaries are skipped); explicit pins don't fall
+        # under it — the operator asked for THAT instance
+        instance = latest_eligible_completed(
+            storage, engine_id, engine_version, engine_variant)
         if instance is None:
             raise ValueError(
                 f"No COMPLETED engine instance found for engine "

@@ -287,12 +287,20 @@ def partitioned_instances(storage, engine_id: str, engine_version: str,
                           engine_variant: str,
                           n_shards: int) -> list:
     """COMPLETED instances of the engine that were partitioned with this
-    topology, most recent first — the shard/router resolution order (the
-    fleet analogue of deploy's get_latest_completed contract)."""
+    topology AND are rollout-eligible, most recent first — the shard/
+    router resolution order (the fleet analogue of deploy's
+    get_latest_completed contract). Rollout verdicts gate the list the
+    same way they gate single-host serve: an instance the guards
+    ROLLED_BACK (or whose canary is still in flight) is skipped, so a
+    fleet /reload can never auto-advance onto a rejected model."""
+    from pio_tpu.rollout.state import is_auto_advance_eligible
+
     instances = storage.get_metadata_engine_instances()
     out = []
     for inst in instances.get_completed(engine_id, engine_version,
                                         engine_variant):
+        if not is_auto_advance_eligible(storage, inst.id):
+            continue
         try:
             plan = load_plan(storage, inst.id)
         except ModelIntegrityError as e:

@@ -38,9 +38,11 @@ from dataclasses import dataclass, field
 
 from pio_tpu.resilience import (
     CircuitBreaker, CircuitOpenError, Deadline, DeadlineExceeded,
+    is_transient,
 )
 from pio_tpu.resilience import chaos
 from pio_tpu.resilience.health import install_health_routes, shedder_check
+from pio_tpu.rollout import ARM_ACTIVE, ARM_CANDIDATE, install_rollout_routes
 from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
     server_key_ok,
@@ -122,6 +124,13 @@ class FleetRouter:
         self._stop_requested = threading.Event()
         self.degraded_count = 0
         self.rerouted_count = 0
+        # guarded rollout (pio_tpu/rollout/): the controller splitting
+        # traffic and the candidate instance's shard plan. Each shard
+        # group serves candidate partitions from the already-recorded
+        # `<iid>:shard<i>` blobs; the ROUTER carries the split by
+        # stamping {"arm": "candidate"} on canary-arm RPCs.
+        self.rollout = None
+        self.candidate_plan: ShardPlan | None = None
         self.replicas: list[list[_Replica]] = [
             [
                 _Replica(
@@ -184,19 +193,36 @@ class FleetRouter:
         for r in self._replica_order(shard):
             Deadline.check(f"shard {shard} {op} replica {r}")
             rep = group[r]
-            try:
-                with rep.breaker.guard():
-                    out = rep.client.request("POST", path, body)
-            except CircuitOpenError as e:
-                last_error = e
+            if not rep.breaker.allow():
+                last_error = CircuitOpenError(
+                    rep.breaker.name,
+                    retry_after_s=rep.breaker.retry_after_s() or 1.0)
                 continue
+            try:
+                out = rep.client.request("POST", path, body)
             except HttpClientError as e:
+                if (e.status == 503 and isinstance(e.message, str)
+                        and e.message.startswith("candidate-arm-missing")):
+                    # the replica is HEALTHY — it just has no staged
+                    # candidate arm (restarted mid-canary, or its
+                    # load_candidate failed while a sibling's
+                    # succeeded). Fail over to a replica that has it
+                    # WITHOUT charging this replica's breaker, or
+                    # active-arm traffic would lose the replica too
+                    rep.breaker.record(True)
+                    last_error = e
+                    log.warning("shard %d replica %d (%s) has no "
+                                "candidate arm for %s; trying next",
+                                shard, r, rep.url, op)
+                    continue
+                rep.breaker.record(not is_transient(e))
                 if e.status and e.status not in (408, 429, 502, 503, 504):
                     raise  # application error: the shard DID answer
                 last_error = e
                 log.warning("shard %d replica %d (%s) failed %s: %s",
                             shard, r, rep.url, op, e)
                 continue
+            rep.breaker.record(True)
             with self._lock:
                 if self._preferred[shard] != r:
                     self.rerouted_count += 1
@@ -205,39 +231,75 @@ class FleetRouter:
         raise ShardUnavailable(shard, last_error)
 
     # -- query path ---------------------------------------------------------
+    def _plan_for(self, arm: str) -> ShardPlan:
+        with self._lock:
+            if arm == ARM_CANDIDATE and self.candidate_plan is not None:
+                return self.candidate_plan
+            return self.plan
+
+    @staticmethod
+    def _arm_body(body: dict, arm: str) -> dict:
+        if arm != ARM_ACTIVE:
+            body["arm"] = arm
+        return body
+
     def query(self, q: dict) -> dict:
         """Single-host-oracle-equivalent prediction, or a flagged
-        degraded response when part of the fleet is unreachable."""
+        degraded response when part of the fleet is unreachable. With a
+        rollout in flight the controller picks the arm (sticky crc32c
+        user split — the SAME split function the single-host server
+        uses, so a user rides the same arm fleet-wide)."""
         t0 = time.monotonic()
         user = q["user"]
         num = int(q.get("num", 10))
         black = set(q.get("blackList") or ())
         white = q.get("whiteList")
+        rollout = self.rollout
+        arm = rollout.arm_for(q) if rollout is not None else ARM_ACTIVE
         # RAW id value, no str() coercion: the single-host oracle treats
         # a non-string id as unknown (dict-keyed id index), and the
         # fleet must agree; shard_of str-coerces only for hashing
-        out = self._query_inner(user, num, black, white)
+        out = self._query_inner(user, num, black, white, arm=arm)
         if out.get("degraded"):
             with self._lock:
                 self.degraded_count += 1
         self.tracer.record("query", time.monotonic() - t0)
+        if rollout is not None:
+            rollout.observe(arm, q, out, time.monotonic() - t0)
         return out
 
+    def shadow_predict(self, q: dict, arm: str) -> dict:
+        """Score `q` on one arm without stats — the rollout
+        controller's divergence sampler."""
+        return self._query_inner(
+            q["user"], int(q.get("num", 10)),
+            set(q.get("blackList") or ()), q.get("whiteList"), arm=arm)
+
     def _query_inner(self, user, num: int, black: set,
-                     white) -> dict:
-        owner = shard_of(user, self.plan.n_shards)
+                     white, arm: str = ARM_ACTIVE) -> dict:
+        if arm == ARM_CANDIDATE:
+            # a candidate query racing a just-finished rollback/promote
+            # rides the ACTIVE arm (the single-host _arm_snapshot
+            # contract: a dropped arm is never served) — stamping the
+            # dead arm would 503 on every replica and degrade to the
+            # popularity fallback instead
+            with self._lock:
+                if self.candidate_plan is None:
+                    arm = ARM_ACTIVE
+        owner = shard_of(user, self._plan_for(arm).n_shards)
         with self.tracer.span("user_row"):
             try:
-                row_resp = self._call(owner, "user_row", "/shard/user_row",
-                                      {"user": user})
+                row_resp = self._call(
+                    owner, "user_row", "/shard/user_row",
+                    self._arm_body({"user": user}, arm))
             except ShardUnavailable as e:
-                return self._fallback(num, black, str(e))
+                return self._fallback(num, black, str(e), arm=arm)
         if not row_resp.get("found"):
             return {"itemScores": []}  # unknown user: same as single-host
         row = row_resp["row"]
         if white:
-            return self._white_query(row, num, black, white)
-        return self._topk_query(row, num, black)
+            return self._white_query(row, num, black, white, arm=arm)
+        return self._topk_query(row, num, black, arm=arm)
 
     def _fan(self, op: str, path: str, body,
              shards=None) -> tuple[dict[int, dict], list[int]]:
@@ -265,15 +327,17 @@ class FleetRouter:
                 down.append(s)
         return results, down
 
-    def _topk_query(self, row: list[float], num: int, black: set) -> dict:
+    def _topk_query(self, row: list[float], num: int, black: set,
+                    arm: str = ARM_ACTIVE) -> dict:
         # over-fetch exactly like ALSAlgorithm.predict: k = num + |black|
         # capped at the (global) item count, so blacklist filtering can
         # never starve the result below the single-host answer
-        n_items = sum(self.plan.item_counts)
+        n_items = sum(self._plan_for(arm).item_counts)
         k = min(num + len(black), n_items)
         with self.tracer.span("score"):
-            results, down = self._fan("topk", "/shard/topk",
-                                      {"row": row, "k": k})
+            results, down = self._fan(
+                "topk", "/shard/topk",
+                self._arm_body({"row": row, "k": k}, arm))
         merged: list[tuple[float, int, str]] = []
         for res in results.values():
             merged.extend(zip(res["scores"], res["indices"], res["items"]))
@@ -290,10 +354,11 @@ class FleetRouter:
         if not down:
             return {"itemScores": out}
         return self._blend(out, num, black,
-                           f"shard group(s) {sorted(down)} unavailable")
+                           f"shard group(s) {sorted(down)} unavailable",
+                           arm=arm)
 
     def _white_query(self, row: list[float], num: int, black: set,
-                     white: list) -> dict:
+                     white: list, arm: str = ARM_ACTIVE) -> dict:
         # row-fetch the candidates' factor rows from their owning shards
         # ONLY (a non-owner group being down is irrelevant to this
         # query and must not flag it degraded), then score HERE in one
@@ -301,11 +366,12 @@ class FleetRouter:
         # uses (n candidates at once) — shard-side per-subset scoring
         # drifts by an ULP because XLA's einsum lowering is
         # shape-sensitive
-        owners = sorted({shard_of(w, self.plan.n_shards) for w in white})
+        owners = sorted({shard_of(w, self._plan_for(arm).n_shards)
+                         for w in white})
         with self.tracer.span("score"):
             results, down = self._fan(
                 "item_rows", "/shard/item_rows",
-                {"items": list(white)}, shards=owners)
+                self._arm_body({"items": list(white)}, arm), shards=owners)
         rows: dict[str, list[float]] = {}
         for res in results.values():
             rows.update(res["rows"])
@@ -349,11 +415,12 @@ class FleetRouter:
         return _rank_candidates(cand, scores, num)
 
     def _blend(self, partial: list[dict], num: int, black: set,
-               reason: str) -> dict:
-        """Partial real results + popularity fallback fill, flagged."""
+               reason: str, arm: str = ARM_ACTIVE) -> dict:
+        """Partial real results + popularity fallback fill, flagged
+        (the arm's own plan carries its popularity list)."""
         have = {s["item"] for s in partial}
         out = list(partial)
-        for fb in self.plan.fallback:
+        for fb in self._plan_for(arm).fallback:
             if len(out) >= num:
                 break
             if fb["item"] in have or fb["item"] in black:
@@ -363,8 +430,138 @@ class FleetRouter:
         return {"itemScores": out, "degraded": True,
                 "degradedReason": reason}
 
-    def _fallback(self, num: int, black: set, reason: str) -> dict:
-        return self._blend([], num, black, reason)
+    def _fallback(self, num: int, black: set, reason: str,
+                  arm: str = ARM_ACTIVE) -> dict:
+        return self._blend([], num, black, reason, arm=arm)
+
+    # -- guarded rollout (pio_tpu/rollout/) ----------------------------------
+    def rollout_active_instance_id(self) -> str:
+        with self._lock:
+            return self.plan.instance_id
+
+    def _fan_control(self, op: str, path: str, body: dict) -> dict:
+        """Fan a candidate-control RPC to EVERY replica concurrently on
+        the query pool (per-replica breaker + ambient Deadline + the
+        fleet.shard<i>.<op> chaos family, like every other shard RPC) —
+        staging a candidate on N×R replicas pays one blob-load
+        wall-clock, not N×R serial ones, and a breach-triggered
+        rollback's drop fan doesn't hold the observing request thread
+        for the serial sum. Returns
+        {shard: {"ok": n_replicas_ok, "errors": [...]}}."""
+        import contextvars
+
+        key = self.config.server_key
+
+        def one(s: int, r: int, rep) -> str | None:
+            Deadline.check(f"shard {s} {op} replica {r}")
+            try:
+                chaos.maybe_inject(f"fleet.shard{s}.{op}")
+                with rep.breaker.guard():
+                    rep.client.request(
+                        "POST", path, body,
+                        params={"accessKey": key} if key else None)
+                return None
+            except (CircuitOpenError, HttpClientError,
+                    ConnectionError) as e:
+                return f"replica{r}: {e}"
+
+        futs = {
+            (s, r): self._pool.submit(
+                contextvars.copy_context().run, one, s, r, rep)
+            for s, group in enumerate(self.replicas)
+            for r, rep in enumerate(group)
+        }
+        out: dict[int, dict] = {
+            s: {"ok": 0, "errors": []} for s in range(len(self.replicas))
+        }
+        for (s, r), f in futs.items():
+            err = f.result()
+            if err is None:
+                out[s]["ok"] += 1
+            else:
+                out[s]["errors"].append(err)
+        return out
+
+    def load_candidate(self, instance_id: str) -> None:
+        """Stage the candidate on every shard replica from its
+        already-recorded `<iid>:shard<i>` blobs (partitioning them
+        first if this instance was never fleet-deployed). EVERY shard
+        group needs at least one replica holding the candidate or the
+        canary cannot serve its partition — a fully-failed group
+        (corrupt blob, group down) unwinds the load and raises, which
+        the rollout controller records as an automatic rollback."""
+        if self.storage is None:
+            raise ValueError(
+                "router has no storage; cannot resolve candidate "
+                "partitions")
+        from pio_tpu.serving_fleet.plan import (
+            load_plan, persist_fleet_artifacts,
+        )
+
+        plan = load_plan(self.storage, instance_id)
+        if plan is None or plan.n_shards != self.plan.n_shards:
+            from pio_tpu.serving_fleet.fleet import resolve_fleet_model
+
+            c = self.config
+            _, model = resolve_fleet_model(
+                self.storage, c.engine_id, c.engine_version,
+                c.engine_variant, instance_id)
+            plan = persist_fleet_artifacts(
+                self.storage, instance_id, model, self.plan.n_shards,
+                self.plan.n_replicas)
+        results = self._fan_control("load_candidate",
+                                    "/shard/load_candidate",
+                                    {"instanceId": instance_id})
+        failed = {s: g["errors"] for s, g in results.items()
+                  if g["ok"] == 0}
+        if failed:
+            # unwind: replicas that DID load must not keep a half-staged
+            # arm around (best-effort — traffic never routed to it)
+            self._fan_control("drop_candidate", "/shard/drop_candidate", {})
+            raise ConnectionError(
+                f"candidate {instance_id} failed to load on shard "
+                f"group(s) {sorted(failed)}: {failed}")
+        with self._lock:
+            self.candidate_plan = plan
+        log.info("candidate arm staged fleet-wide: instance %s",
+                 instance_id)
+
+    def promote_candidate(self) -> None:
+        """Every replica swaps its candidate partition in; the router
+        then switches to the candidate plan. A replica that fails keeps
+        serving the old instance — visible as instanceSkew — but a
+        FULLY-failed group aborts (its partition of the new instance
+        would be unreachable). The shard-side swap is IDEMPOTENT
+        against the instance id, so retrying `pio promote` after a
+        partial failure converges: already-swapped replicas answer
+        success, only the stragglers swap."""
+        with self._lock:
+            plan = self.candidate_plan
+        if plan is None:
+            raise ValueError("no candidate plan to promote")
+        results = self._fan_control(
+            "promote_candidate", "/shard/promote_candidate",
+            {"instanceId": plan.instance_id})
+        failed = {s: g["errors"] for s, g in results.items()
+                  if g["ok"] == 0}
+        if failed:
+            raise ConnectionError(
+                f"promote failed on whole shard group(s) "
+                f"{sorted(failed)}: {failed}; fleet may be skewed — "
+                "retry `pio promote` (idempotent: already-swapped "
+                "replicas no-op) or `pio rollback` + POST /reload to "
+                "revert every group to the last eligible instance")
+        with self._lock:
+            self.plan = plan
+            self.candidate_plan = None
+
+    def drop_candidate(self) -> None:
+        """Rollback: best-effort drop everywhere; the router stops
+        stamping candidate arms the instant the plan clears, so a
+        replica that misses the drop merely holds a cold partition."""
+        with self._lock:
+            self.candidate_plan = None
+        self._fan_control("drop_candidate", "/shard/drop_candidate", {})
 
     # -- streaming fold-in (pio_tpu/freshness/) ------------------------------
     def upsert_users(self, rows: dict,
@@ -485,6 +682,9 @@ class FleetRouter:
                     "opened": snap.opened_count,
                     "healthy": healthy,
                     "engineInstanceId": info.get("engineInstanceId"),
+                    # guarded rollout: which candidate (if any) this
+                    # replica has staged — doctor --fleet's coverage
+                    "candidateInstanceId": info.get("candidateInstanceId"),
                 })
             shards[str(s)] = {
                 "ok": routable > 0,
@@ -502,6 +702,8 @@ class FleetRouter:
         }
         with self._lock:
             degraded, rerouted = self.degraded_count, self.rerouted_count
+            candidate_plan = self.candidate_plan
+        rollout = self.rollout
         return {
             "plan": {
                 "instanceId": self.plan.instance_id,
@@ -517,6 +719,9 @@ class FleetRouter:
             "degradedResponses": degraded,
             "reroutedCalls": rerouted,
             "startTime": format_time(self.start_time),
+            "candidatePlanInstanceId": (candidate_plan.instance_id
+                                        if candidate_plan else None),
+            "rollout": rollout.status() if rollout is not None else None,
         }
 
     def reload(self) -> dict:
@@ -534,7 +739,7 @@ class FleetRouter:
             for r, rep in enumerate(group):
                 try:
                     out = rep.client.request(
-                        "GET", "/reload",
+                        "POST", "/reload",
                         params={"accessKey": key} if key else None)
                     results[f"shard{s}/replica{r}"] = {
                         "ok": True,
@@ -558,6 +763,8 @@ class FleetRouter:
 
     def close(self) -> None:
         self._stop_requested.set()
+        if self.rollout is not None:
+            self.rollout.close()
         self._pool.shutdown(wait=False)
         if self._prober is not None:
             self._prober.join(timeout=2)
@@ -662,7 +869,9 @@ def build_router_app(router: FleetRouter) -> HttpApp:
             "reroutedCalls": rerouted,
         }
 
-    @app.route("GET", r"/reload")
+    @app.route("POST", r"/reload")
+    @app.route("GET", r"/reload")  # deprecated alias (docs/serving.md:
+    # reload mutates serving state, POST is canonical)
     def reload(req: Request):
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
@@ -697,10 +906,25 @@ def build_router_app(router: FleetRouter) -> HttpApp:
             "planHash": router.plan.plan_hash,
             "instanceSkew": len(instances) > 1,
         }
+        # rollout visibility, never a gate (a breached canary already
+        # rolled itself back to the active plan)
+        rollout = router.rollout
+        if rollout is not None:
+            st = rollout.status()
+            checks["rollout"] = {
+                "ok": True,
+                "stagePct": st["stagePct"],
+                "verdict": st["verdict"],
+                "candidateInstanceId": st["candidateInstanceId"],
+            }
         checks.update(shedder_check(getattr(app, "transport", None)))
         return checks
 
     install_health_routes(app, readiness)
+    # guarded rollout verbs (pio_tpu/rollout/): same surface as the
+    # single-host server, so `pio deploy --canary` / `pio promote` /
+    # `pio rollback` speak to either
+    install_rollout_routes(app, router, router.storage, check_server_key)
     return app
 
 

@@ -59,6 +59,13 @@ class ShardMemoryBudgetExceeded(RuntimeError):
     — the deployment needs more shards, not a bigger lie."""
 
 
+class CandidateArmMissing(RuntimeError):
+    """A candidate-arm RPC hit a replica with no candidate loaded. The
+    route answers 503 so the router fails over to a replica that has
+    it (or degrades the group) instead of silently serving the wrong
+    arm."""
+
+
 @dataclass
 class ShardConfig:
     ip: str = "127.0.0.1"
@@ -77,6 +84,31 @@ class ShardConfig:
     backend: str = "threaded"     # many shards ride one test process
 
 
+@dataclass
+class _ArmState:
+    """One loaded partition + its lookup state. The ACTIVE arm is the
+    shard's normal serving state; a guarded rollout (pio_tpu/rollout/)
+    loads a CANDIDATE arm alongside it from the candidate instance's
+    already-recorded ``<iid>:shard<i>`` blob — no repartitioning, no
+    swap until promote."""
+
+    partition: ShardPartition
+    item_factors_dev: object
+    user_row_of: dict
+    item_local_of: dict
+
+
+def _prepare_arm(part: ShardPartition) -> "_ArmState":
+    import jax
+
+    return _ArmState(
+        partition=part,
+        item_factors_dev=jax.device_put(part.item_rows),
+        user_row_of={u: i for i, u in enumerate(part.user_ids)},
+        item_local_of={it: i for i, it in enumerate(part.item_ids)},
+    )
+
+
 class ShardServer:
     """Partition holder + scorer (the fleet's per-host serving runtime)."""
 
@@ -92,6 +124,10 @@ class ShardServer:
         self._item_factors_dev = None   # device copy of the item rows
         self._user_row_of: dict[str, int] = {}
         self._item_local_of: dict[str, int] = {}
+        # guarded rollout: candidate partition served alongside the
+        # active one (queries carry {"arm": "candidate"} to ride it)
+        self.candidate: _ArmState | None = None
+        self._candidate_foldin_pending: dict = {}
         # streaming fold-in accounting (upsert_user_rows): surfaced on
         # /shard/info so `pio doctor --fleet` can compare fold-in lag
         # across shard groups
@@ -157,16 +193,12 @@ class ShardServer:
                     f"bytes but the shard's budget is {budget} — deploy "
                     "with more shards"
                 )
-            import jax
-
-            item_dev = jax.device_put(part.item_rows)
-            user_row_of = {u: i for i, u in enumerate(part.user_ids)}
-            item_local_of = {it: i for i, it in enumerate(part.item_ids)}
+            arm = _prepare_arm(part)
             with self._lock:
                 self.partition = part
-                self._item_factors_dev = item_dev
-                self._user_row_of = user_row_of
-                self._item_local_of = item_local_of
+                self._item_factors_dev = arm.item_factors_dev
+                self._user_row_of = arm.user_row_of
+                self._item_local_of = arm.item_local_of
             log.info("shard %d serving instance %s (%d users, %d items, "
                      "%d bytes)", self.config.shard_index, part.instance_id,
                      len(part.user_ids), len(part.item_ids), part.nbytes())
@@ -181,24 +213,117 @@ class ShardServer:
         with self._lock:
             return self.partition.instance_id
 
-    # -- RPC bodies ---------------------------------------------------------
-    def user_row(self, user) -> list[float] | None:
+    # -- guarded rollout arms (pio_tpu/rollout/) -----------------------------
+    def load_candidate(self, instance_id: str) -> None:
+        """Load the candidate instance's ALREADY-RECORDED partition blob
+        for this shard alongside the active one. No last-good fallback —
+        a corrupt candidate blob raises (ModelIntegrityError), which is
+        exactly the guard breach the rollout controller rolls back on."""
+        with self._load_lock:
+            part = load_partition(self.storage, instance_id,
+                                  self.config.shard_index)
+            if part is None:
+                raise ValueError(
+                    f"instance {instance_id} has no partition blob for "
+                    f"shard {self.config.shard_index} — was it deployed "
+                    "with this topology?")
+            budget = self.config.memory_budget_bytes
+            if budget and part.nbytes() > budget:
+                raise ShardMemoryBudgetExceeded(
+                    f"candidate partition of instance {instance_id} needs "
+                    f"{part.nbytes()} bytes over shard "
+                    f"{self.config.shard_index}'s {budget}-byte budget")
+            arm = _prepare_arm(part)
+            with self._lock:
+                self.candidate = arm
+                self._candidate_foldin_pending = {}
+        log.info("shard %d candidate arm loaded: instance %s",
+                 self.config.shard_index, instance_id)
+
+    def drop_candidate(self) -> None:
         with self._lock:
-            part = self.partition
-            row = self._user_row_of.get(user)
+            self.candidate = None
+            self._candidate_foldin_pending = {}
+
+    def promote_candidate(self, expected_instance_id: str | None = None
+                          ) -> str:
+        """The candidate partition becomes the active one (one pointer
+        swap under the lock — the same shape /reload's swap uses).
+        Queued candidate fold-ins flush FIRST so the promoted arm is as
+        fresh as the active one was (the single-host contract — see
+        QueryServer.promote_candidate). IDEMPOTENT against
+        ``expected_instance_id``: a replica that already swapped (the
+        router retrying a partially-failed promote fan) answers success
+        instead of 409, so a retry converges instead of aborting on the
+        replicas that succeeded the first time."""
+        with self._load_lock:
+            with self._lock:
+                has_pending = bool(self._candidate_foldin_pending)
+            if has_pending:
+                left = self._upsert_candidate_rows({})
+                if left:
+                    log.warning(
+                        "shard %d: %d queued candidate fold-in row(s) "
+                        "could not apply at promote and are dropped "
+                        "(next fold-in cycle re-solves those users)",
+                        self.config.shard_index, left)
+            with self._lock:
+                cand = self.candidate
+                if cand is None:
+                    if (expected_instance_id is not None
+                            and self.partition is not None
+                            and self.partition.instance_id
+                            == expected_instance_id):
+                        return self.partition.instance_id  # already done
+                    raise ValueError("no candidate partition to promote")
+                if (expected_instance_id is not None
+                        and cand.partition.instance_id
+                        != expected_instance_id):
+                    raise ValueError(
+                        f"candidate arm holds instance "
+                        f"{cand.partition.instance_id}, promote expected "
+                        f"{expected_instance_id}")
+                self.partition = cand.partition
+                self._item_factors_dev = cand.item_factors_dev
+                self._user_row_of = cand.user_row_of
+                self._item_local_of = cand.item_local_of
+                self.candidate = None
+                self._candidate_foldin_pending = {}
+                return self.partition.instance_id
+
+    def _arm(self, arm: str):
+        """-> (partition, item_dev, user_row_of, item_local_of) for one
+        arm. Unlike the single-host server this does NOT silently fall
+        back for a missing candidate: a replica without the candidate
+        loaded must 503 so the router fails over, never serve the wrong
+        model as if it were the right one."""
+        with self._lock:
+            if arm == "candidate":
+                c = self.candidate
+                if c is None:
+                    raise CandidateArmMissing(
+                        f"shard {self.config.shard_index} replica has no "
+                        "candidate arm loaded")
+                return (c.partition, c.item_factors_dev, c.user_row_of,
+                        c.item_local_of)
+            return (self.partition, self._item_factors_dev,
+                    self._user_row_of, self._item_local_of)
+
+    # -- RPC bodies ---------------------------------------------------------
+    def user_row(self, user, arm: str = "active") -> list[float] | None:
+        part, _, row_of, _ = self._arm(arm)
+        row = row_of.get(user)
         if row is None:
             return None
         return [float(x) for x in part.user_rows[row]]
 
-    def topk(self, row: list[float], k: int) -> dict:
+    def topk(self, row: list[float], k: int, arm: str = "active") -> dict:
         """Partial top-k of the query user's row against this shard's
         item slice — same kernel as the single-host path, so the per-item
         scores are bit-identical and the router's merge is exact."""
         from pio_tpu.ops import als
 
-        with self._lock:
-            part = self.partition
-            item_dev = self._item_factors_dev
+        part, item_dev, _, _ = self._arm(arm)
         n_local = len(part.item_ids)
         if n_local == 0:
             return {"items": [], "indices": [], "scores": []}
@@ -213,7 +338,7 @@ class ShardServer:
             "scores": [float(s) for s in scores],
         }
 
-    def item_rows(self, items: list) -> dict:
+    def item_rows(self, items: list, arm: str = "active") -> dict:
         """Factor ROWS for the subset of `items` this shard owns (the
         whiteList path's row-fetch) — keyed by item id; unowned ids are
         simply absent, which is how the router learns ownership. The
@@ -221,10 +346,8 @@ class ShardServer:
         shapes the single-host oracle uses: per-pair scores computed
         shard-side in smaller batches drift by an ULP (XLA's einsum
         lowering is shape-sensitive), which would break bit-parity."""
-        with self._lock:
-            part = self.partition
-            owned = [(it, self._item_local_of[it]) for it in items
-                     if it in self._item_local_of]
+        part, _, _, local_of = self._arm(arm)
+        owned = [(it, local_of[it]) for it in items if it in local_of]
         return {"rows": {
             it: [float(x) for x in part.item_rows[i]] for it, i in owned
         }}
@@ -301,8 +424,74 @@ class ShardServer:
                 self.foldin_last_time = utcnow()
                 if staleness_s is not None:
                     self.foldin_last_staleness_s = float(staleness_s)
+        # second arm (guarded rollout): best-effort-with-queue, so fleet
+        # freshness never silently diverges the experiment; the ACTIVE
+        # apply above is the durable one the folder's cursor rides
+        queued = self._upsert_candidate_rows(dict(owned))
         return {"applied": len(owned), "rejected": rejected,
-                "engineInstanceId": part.instance_id}
+                "engineInstanceId": part.instance_id,
+                "candidateQueued": queued}
+
+    def _upsert_candidate_rows(self, owned: dict) -> int:
+        """Apply owned fold-in rows (plus anything queued) to the
+        candidate arm; returns the queue depth left (0 = applied).
+        Never raises — a canary hiccup must not fail the active apply
+        the folder just committed."""
+        import dataclasses
+
+        with self._lock:
+            cand = self.candidate
+            if cand is None:
+                self._candidate_foldin_pending = {}
+                return 0
+            pending = dict(self._candidate_foldin_pending)
+            pending.update(owned)
+            part = cand.partition
+        k = int(part.user_rows.shape[1]) if part.user_rows.size else (
+            int(part.item_rows.shape[1]))
+        if any(len(r) != k for r in pending.values()):
+            with self._lock:
+                self._candidate_foldin_pending = pending
+            log.warning("fold-in rows queued for shard %d candidate arm "
+                        "(%d users): rank mismatch",
+                        self.config.shard_index, len(pending))
+            return len(pending)
+        user_rows = np.array(part.user_rows, dtype=np.float32, copy=True)
+        user_ids = list(part.user_ids)
+        row_of = dict(cand.user_row_of)
+        appended: list[np.ndarray] = []
+        for uid, row in pending.items():
+            at = row_of.get(uid)
+            vec = np.asarray(row, dtype=np.float32)
+            if at is not None:
+                user_rows[at] = vec
+            else:
+                row_of[uid] = len(user_ids)
+                user_ids.append(uid)
+                appended.append(vec)
+        if appended:
+            user_rows = np.concatenate(
+                [user_rows.reshape(-1, k),
+                 np.stack(appended)]).astype(np.float32)
+        new_part = dataclasses.replace(
+            part, user_ids=user_ids, user_rows=user_rows)
+        with self._lock:
+            cand2 = self.candidate
+            if cand2 is None:
+                self._candidate_foldin_pending = {}
+                return 0
+            if cand2.partition is not part:
+                # arm moved mid-build (promote/drop/reload-candidate):
+                # queue and land on the next apply
+                self._candidate_foldin_pending = pending
+                return len(pending)
+            self.candidate = _ArmState(
+                partition=new_part,
+                item_factors_dev=cand2.item_factors_dev,
+                user_row_of=row_of,
+                item_local_of=cand2.item_local_of)
+            self._candidate_foldin_pending = {}
+        return 0
 
     def foldin_status(self) -> dict:
         with self._lock:
@@ -316,6 +505,8 @@ class ShardServer:
     def info(self) -> dict:
         with self._lock:
             part = self.partition
+            cand = self.candidate
+            cand_queued = len(self._candidate_foldin_pending)
         return {
             "shardIndex": self.config.shard_index,
             "nShards": self.config.n_shards,
@@ -327,6 +518,11 @@ class ShardServer:
             "startTime": format_time(self.start_time),
             "lastReloadError": self.last_reload_error,
             "foldin": self.foldin_status(),
+            # guarded rollout: what `pio doctor --fleet` aggregates into
+            # the per-group candidate-coverage column
+            "candidateInstanceId": (cand.partition.instance_id
+                                    if cand else None),
+            "candidateFoldinQueued": cand_queued,
         }
 
 
@@ -345,15 +541,32 @@ def build_shard_app(server: ShardServer) -> HttpApp:
     def shard_info(req: Request):
         return 200, server.info()
 
+    def _arm_of(body: dict):
+        """The arm a scoring RPC rides ({"arm": "candidate"} during a
+        guarded rollout; absent = active). Returns (arm, error)."""
+        arm = body.get("arm", "active")
+        if arm not in ("active", "candidate"):
+            return None, (400, {"message": f"unknown arm {arm!r}"})
+        return arm, None
+
     @app.route("POST", r"/shard/user_row")
     def shard_user_row(req: Request):
         body = req.json()
         if not isinstance(body, dict) or "user" not in body:
             return 400, {"message": "body must be {\"user\": id}"}
+        arm, err = _arm_of(body)
+        if err:
+            return err
         # RAW value lookup, no str() coercion: the single-host oracle
         # treats a non-string id as unknown (not in the id index), and
         # the fleet must agree
-        row = server.user_row(body["user"])
+        try:
+            row = server.user_row(body["user"], arm=arm)
+        except CandidateArmMissing as e:
+            # the "candidate-arm-missing:" prefix is the router's cue to
+            # fail over WITHOUT charging this replica's breaker: the
+            # replica is healthy, it just has no staged arm
+            return 503, {"message": f"candidate-arm-missing: {e}"}
         if row is None:
             return 200, {"found": False}
         return 200, {"found": True, "row": row}
@@ -364,7 +577,16 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         if (not isinstance(body, dict) or "row" not in body
                 or "k" not in body):
             return 400, {"message": "body must be {\"row\": [...], \"k\": n}"}
-        return 200, server.topk(body["row"], int(body["k"]))
+        arm, err = _arm_of(body)
+        if err:
+            return err
+        try:
+            return 200, server.topk(body["row"], int(body["k"]), arm=arm)
+        except CandidateArmMissing as e:
+            # the "candidate-arm-missing:" prefix is the router's cue to
+            # fail over WITHOUT charging this replica's breaker: the
+            # replica is healthy, it just has no staged arm
+            return 503, {"message": f"candidate-arm-missing: {e}"}
 
     @app.route("POST", r"/shard/item_rows")
     def shard_item_rows(req: Request):
@@ -372,9 +594,60 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         if not isinstance(body, dict) or not isinstance(
                 body.get("items"), list):
             return 400, {"message": "body must be {\"items\": [...]}"}
+        arm, err = _arm_of(body)
+        if err:
+            return err
         # raw values: see /shard/user_row — membership must match the
         # single-host id-index semantics exactly
-        return 200, server.item_rows(list(body["items"]))
+        try:
+            return 200, server.item_rows(list(body["items"]), arm=arm)
+        except CandidateArmMissing as e:
+            # the "candidate-arm-missing:" prefix is the router's cue to
+            # fail over WITHOUT charging this replica's breaker: the
+            # replica is healthy, it just has no staged arm
+            return 503, {"message": f"candidate-arm-missing: {e}"}
+
+    @app.route("POST", r"/shard/load_candidate")
+    def shard_load_candidate(req: Request):
+        """Guarded rollout: load the candidate instance's recorded
+        partition alongside the active one. Server-key guarded — it
+        stages a model for production traffic."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        body = req.json()
+        if not isinstance(body, dict) or not body.get("instanceId"):
+            return 400, {"message": "body must be {\"instanceId\": id}"}
+        try:
+            server.load_candidate(str(body["instanceId"]))
+        except ShardMemoryBudgetExceeded as e:
+            return 507, {"message": str(e)}
+        except Exception as e:  # noqa: BLE001 - corrupt blob/missing ->
+            # the rollout controller rolls back on this 503
+            return 503, {"message": f"{type(e).__name__}: {e}"}
+        return 200, {"message": "candidate loaded",
+                     "candidateInstanceId": body["instanceId"]}
+
+    @app.route("POST", r"/shard/promote_candidate")
+    def shard_promote_candidate(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        try:
+            body = req.json() or {}
+        except Exception:  # noqa: BLE001 - body is optional
+            body = {}
+        expected = body.get("instanceId") if isinstance(body, dict) else None
+        try:
+            instance_id = server.promote_candidate(expected)
+        except ValueError as e:
+            return 409, {"message": str(e)}
+        return 200, {"message": "Promoted", "engineInstanceId": instance_id}
+
+    @app.route("POST", r"/shard/drop_candidate")
+    def shard_drop_candidate(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        server.drop_candidate()
+        return 200, {"message": "candidate dropped"}
 
     @app.route("POST", r"/shard/upsert_users")
     def shard_upsert_users(req: Request):
@@ -395,7 +668,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
             return 400, {"message": str(e)}
         return 200, out
 
-    @app.route("GET", r"/reload")
+    @app.route("POST", r"/reload")
+    @app.route("GET", r"/reload")  # deprecated alias (docs/serving.md:
+    # reload mutates serving state, POST is canonical)
     def reload(req: Request):
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}

@@ -181,18 +181,22 @@ def _doctor_fleet(args) -> int:
         return _fail(f"fleet router at {router_url} unreachable: "
                      f"{e.message}")
     plan = fleet.get("plan", {})
+    rollout = fleet.get("rollout")
     rows = []
     foldin_lag: dict[str, dict] = {}
+    candidate_coverage: dict[str, dict] = {}
     exit_code = 0
     for s, group in sorted(fleet.get("shards", {}).items(),
                            key=lambda kv: int(kv[0])):
         group_ready = 0
         group_stale: list[float] = []
         group_applied: list[int] = []
+        group_candidates: list = []
         for rep in group["replicas"]:
             probe = JsonHttpClient(rep["url"], timeout=args.timeout)
             live = ready = False
             instance = rep.get("engineInstanceId")
+            candidate = rep.get("candidateInstanceId")
             foldin = None
             try:
                 probe.request("GET", "/healthz")
@@ -201,10 +205,12 @@ def _doctor_fleet(args) -> int:
                 ready = True
                 info = probe.request("GET", "/shard/info")
                 instance = info.get("engineInstanceId", instance)
+                candidate = info.get("candidateInstanceId", candidate)
                 foldin = info.get("foldin")
             except HttpClientError:
                 pass
             group_ready += ready
+            group_candidates.append(candidate)
             if foldin:
                 group_applied.append(int(foldin.get("appliedUsers") or 0))
                 if foldin.get("stalenessSeconds") is not None:
@@ -213,8 +219,17 @@ def _doctor_fleet(args) -> int:
                 "shard": int(s), "replica": rep["replica"],
                 "url": rep["url"], "live": live, "ready": ready,
                 "breaker": rep["breaker"], "instance": instance,
+                "candidate": candidate,
                 "foldin": foldin,
             })
+        # per-group candidate coverage (guarded rollout): how many
+        # replicas have the canary candidate staged — a group at 0/N
+        # cannot serve its slice of the candidate's partition
+        candidate_coverage[s] = {
+            "staged": sum(1 for c in group_candidates if c),
+            "total": len(group_candidates),
+            "instances": sorted({c for c in group_candidates if c}),
+        }
         # per-group fold-in lag: MAX staleness any replica recorded at
         # its last apply, plus replica skew (a replica that missed
         # upserts — e.g. it was down during a fold — serves older rows
@@ -249,6 +264,8 @@ def _doctor_fleet(args) -> int:
             "degradedResponses": fleet.get("degradedResponses", 0),
             "foldinLag": foldin_lag,
             "stalenessBudgetSeconds": args.staleness_budget,
+            "rollout": rollout,
+            "candidateCoverage": candidate_coverage,
         }, indent=2))
         return exit_code
     print(f"fleet router {router_url}: instance {plan.get('instanceId')} "
@@ -282,6 +299,23 @@ def _doctor_fleet(args) -> int:
         print(f"[WARN] fold-in staleness over the "
               f"{args.staleness_budget:.0f}s budget in shard group(s): "
               f"{', '.join(over)}")
+    if rollout and rollout.get("candidateInstanceId"):
+        state = rollout.get("verdict") or f"{rollout.get('stagePct')}%"
+        print(f"rollout: candidate {rollout['candidateInstanceId']} "
+              f"[{state}] {rollout.get('timeInStageSeconds', 0):.0f}s "
+              "in stage")
+        cov_cells = [
+            f"shard {s}: {c['staged']}/{c['total']}"
+            for s, c in sorted(candidate_coverage.items(),
+                               key=lambda kv: int(kv[0]))
+        ]
+        print("candidate coverage (staged/total): " + ", ".join(cov_cells))
+        under = [s for s, c in candidate_coverage.items()
+                 if rollout.get("verdict") is None
+                 and c["staged"] < c["total"]]
+        if under:
+            print(f"[WARN] candidate not staged on every replica of "
+                  f"shard group(s): {', '.join(sorted(under, key=int))}")
     if open_breakers:
         print(f"[WARN] open breakers: {', '.join(open_breakers)}")
     if fleet.get("instanceSkew"):
@@ -348,6 +382,19 @@ def cmd_doctor(args) -> int:
             exit_code = 1
         report[name] = entry
 
+    # guarded rollout row: what (if anything) is canarying on the
+    # serving surface — stage, verdict, per-arm guard stats
+    rollout = None
+    if report.get("serving", {}).get("live"):
+        try:
+            status = JsonHttpClient(
+                report["serving"]["url"], timeout=args.timeout
+            ).request("GET", "/rollout/status")
+            if status and status.get("candidateInstanceId"):
+                rollout = status
+        except HttpClientError:
+            pass
+
     # training-lifecycle sweep: kill -9'd runs leave INIT/TRAINING
     # instances whose heartbeat went stale; report them (and, with
     # --sweep-zombies, transition them to FAILED so they become
@@ -378,6 +425,8 @@ def cmd_doctor(args) -> int:
     chaos_spec = os.environ.get("PIO_TPU_CHAOS", "")
     if args.json:
         out = {"surfaces": report, "zombies": zombies}
+        if rollout is not None:
+            out["rollout"] = rollout
         if sweep_error:
             out["zombieSweepError"] = sweep_error
         if chaos_spec:
@@ -399,6 +448,20 @@ def cmd_doctor(args) -> int:
             print(f"  [{ok}] {check}: {rest}")
         if not entry.get("ready") and "detail" in entry:
             print(f"  detail: {entry['detail']}")
+    if rollout is not None:
+        state = rollout.get("verdict") or f"{rollout.get('stagePct')}%"
+        arms = rollout.get("arms", {})
+        cells = ", ".join(
+            f"{arm}: {s.get('requests', 0)} req / {s.get('errors', 0)} err "
+            f"/ {s.get('empty', 0)} empty"
+            for arm, s in sorted(arms.items()))
+        print(f"rollout        candidate {rollout.get('candidateInstanceId')}"
+              f" [{state}] {rollout.get('timeInStageSeconds', 0):.0f}s "
+              f"in stage — {cells}")
+        div = (rollout.get("shadow") or {}).get("meanDivergence")
+        if div is not None:
+            print(f"  shadow divergence: {div} over "
+                  f"{rollout['shadow'].get('samples', 0)} sample(s)")
     if sweep_error:
         print(f"[WARN] zombie check failed: {sweep_error}")
     for z in zombies:
@@ -687,6 +750,12 @@ def cmd_deploy(args) -> int:
     from pio_tpu.workflow.context import create_workflow_context
     from pio_tpu.workflow.serve import ServingConfig, create_query_server
 
+    if args.canary:
+        # canary mode is a CLIENT verb: it tells the ALREADY-RUNNING
+        # serving process (single-host server or fleet router — same
+        # /rollout surface) to stage a candidate, rather than booting a
+        # new one (docs/serving.md "Guarded rollout")
+        return _deploy_canary_cmd(args)
     variant = _load_variant(args.engine_dir)
     engine, ep = _engine_from_variant(variant, args.engine_dir)
     engine_id, engine_version, engine_variant = _engine_ids(
@@ -796,6 +865,68 @@ def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
     handle.close()
     print("Fleet stopped.")
     return 0
+
+
+def _rollout_call(args, method: str, path: str, body=None) -> int:
+    """Shared client for the rollout verbs: POST to the running serving
+    process's /rollout surface (single-host server and fleet router
+    expose the identical routes), print the JSON answer."""
+    from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+    ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+    url = f"http://{ip}:{args.port}"
+    key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
+    client = JsonHttpClient(url, timeout=getattr(args, "timeout", 30.0))
+    try:
+        out = client.request(method, path, body,
+                             params={"accessKey": key} if key else None)
+    except HttpClientError as e:
+        if e.status == 0:
+            return _fail(f"no serving process at {url}: {e.message}")
+        return _fail(f"{path} answered HTTP {e.status}: {e.message}")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _deploy_canary_cmd(args) -> int:
+    """`pio deploy --canary <pct|auto>` — begin a guarded rollout of the
+    latest eligible COMPLETED instance (or --engine-instance-id) on the
+    running server. `auto` ramps 1% -> 5% -> 25% -> 100% while guards
+    stay green; a fixed pct holds there until `pio promote` /
+    `pio rollback`."""
+    spec = args.canary.strip().lower()
+    body: dict = {}
+    if spec == "auto":
+        body["auto"] = True
+    else:
+        try:
+            body["pct"] = int(spec)
+        except ValueError:
+            return _fail(f"--canary takes a percentage or 'auto', "
+                         f"got {args.canary!r}")
+    if args.engine_instance_id:
+        body["instanceId"] = args.engine_instance_id
+    if args.canary_min_stage_seconds is not None:
+        body["minStageSeconds"] = args.canary_min_stage_seconds
+    if args.canary_min_stage_samples is not None:
+        body["minStageSamples"] = args.canary_min_stage_samples
+    return _rollout_call(args, "POST", "/rollout/deploy", body)
+
+
+def cmd_promote(args) -> int:
+    """`pio promote` — conclude a green canary: the candidate becomes
+    the active instance at 100% and the PROMOTED verdict is persisted
+    (it survives restarts; docs/serving.md "Guarded rollout")."""
+    return _rollout_call(args, "POST", "/rollout/promote", {})
+
+
+def cmd_rollback(args) -> int:
+    """`pio rollback` — one-command instant rollback: 100% of traffic
+    reverts to the last-good instance atomically and the ROLLED_BACK
+    verdict is persisted, so no reload ever auto-advances onto the
+    rejected instance again."""
+    return _rollout_call(args, "POST", "/rollout/rollback",
+                         {"reason": args.reason or "operator rollback"})
 
 
 def cmd_foldin(args) -> int:
@@ -1435,7 +1566,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="hard cap (MB) each shard may hold; a partition "
                         "over budget fails deploy instead of lying about "
                         "capacity. 0 = unlimited")
+    x.add_argument("--canary", default="", metavar="PCT|auto",
+                   help="guarded rollout: tell the RUNNING serving "
+                        "process at --ip/--port to stage the latest "
+                        "eligible instance (or --engine-instance-id) as "
+                        "a canary at PCT percent of traffic, or 'auto' "
+                        "to ramp 1->5->25->100 while live guards stay "
+                        "green (docs/serving.md). Conclude with `pio "
+                        "promote` / `pio rollback`")
+    x.add_argument("--canary-min-stage-seconds", type=float, default=None,
+                   help="with --canary auto: minimum seconds per stage")
+    x.add_argument("--canary-min-stage-samples", type=int, default=None,
+                   help="with --canary auto: minimum candidate-arm "
+                        "requests per stage")
     x.set_defaults(fn=cmd_deploy)
+
+    for verb, fn, descr in (
+        ("promote", cmd_promote,
+         "conclude a green canary: candidate becomes the active "
+         "instance at 100% (verdict persisted; survives restart)"),
+        ("rollback", cmd_rollback,
+         "instant rollback: revert 100% of traffic to the last-good "
+         "instance and persist ROLLED_BACK (reloads never auto-advance "
+         "onto it again)"),
+    ):
+        x = sub.add_parser(verb, help=descr)
+        x.add_argument("--ip", default="127.0.0.1")
+        x.add_argument("--port", type=int, default=8000,
+                       help="serving server or fleet router port")
+        x.add_argument("--server-key")
+        if verb == "rollback":
+            x.add_argument("--reason", default="",
+                           help="recorded on the rollout verdict")
+        x.set_defaults(fn=fn)
 
     x = sub.add_parser(
         "foldin",

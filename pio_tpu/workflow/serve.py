@@ -6,8 +6,11 @@ Mirrors reference core/.../workflow/CreateServer.scala:
   POST /queries.json   -> supplement -> per-algo predict -> serve
                           (+ optional feedback event, plugins, latency
                           bookkeeping; reference :492-615)
-  GET  /reload         -> hot-swap to the latest COMPLETED instance
-                          (reference MasterActor ReloadServer :334-360)
+  POST /reload         -> hot-swap to the latest eligible COMPLETED
+                          instance (reference MasterActor ReloadServer
+                          :334-360; GET kept as a deprecated alias)
+  POST /rollout/*      -> guarded canary deploy/promote/rollback
+                          (pio_tpu/rollout/, docs/serving.md)
   POST /stop           -> shut down (server-key auth, reference
                           KeyAuthentication + :277-302)
   GET  /plugins.json   -> plugin listing; /plugins/<name>/* -> plugin REST
@@ -41,6 +44,10 @@ from pio_tpu.data.storage import Storage
 from pio_tpu.resilience import CircuitOpenError, Deadline, DeadlineExceeded
 from pio_tpu.resilience.health import (
     breaker_checks, install_health_routes, shedder_check,
+)
+from pio_tpu.rollout import (
+    ARM_ACTIVE, ARM_CANDIDATE, install_rollout_routes,
+    is_auto_advance_eligible,
 )
 from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
@@ -114,6 +121,19 @@ class ServingConfig:
     request_budget_s: float = 0.0
 
 
+@dataclass
+class _CandidateArm:
+    """The second model slot a guarded rollout serves its canary from
+    (pio_tpu/rollout/): a fully-restored instance living BEHIND the
+    same swap lock as the active one, so promote is one pointer move
+    and rollback is one pointer drop — never a reload."""
+
+    instance: Any
+    models: list
+    algorithms: list
+    serving: Any
+
+
 class QueryServer:
     """Serving runtime: engine + params + restored models (reference
     ServerActor state, CreateServer.scala:407-431)."""
@@ -158,6 +178,16 @@ class QueryServer:
         self.foldin_applied_users = 0
         self.foldin_last_time = None
         self.foldin_last_staleness_s: float | None = None
+        # guarded rollout (pio_tpu/rollout/): the candidate arm and the
+        # controller splitting traffic onto it. Both live behind the
+        # existing locks — queries snapshot whichever arm serves them
+        # exactly like they snapshot the active model today.
+        self.rollout = None                       # RolloutController
+        self.candidate: _CandidateArm | None = None
+        # fold-in rows that could not land on the candidate arm yet
+        # (arm mid-swap, rank mismatch): queued and retried on the next
+        # apply so freshness never silently diverges the experiment
+        self._candidate_foldin_pending: dict = {}
         # serializes whole reloads (resolve + restore + swap) end to end
         # WITHOUT blocking queries: queries snapshot state under
         # self._lock, which a reload only takes for the final swap.
@@ -221,11 +251,20 @@ class QueryServer:
             candidates = instances.get_completed(
                 c.engine_id, c.engine_version, c.engine_variant
             )
+            # rollout verdicts gate AUTO-advancement: an instance the
+            # guards ROLLED_BACK (or whose canary is still in flight)
+            # is skipped, so no reload/restart quietly re-serves a
+            # rejected model. Operators can still pin one explicitly.
+            candidates = [
+                cand for cand in candidates
+                if is_auto_advance_eligible(self.storage, cand.id)
+            ]
             if not candidates:
                 raise ValueError(
-                    f"No COMPLETED engine instance found for engine "
-                    f"{c.engine_id} {c.engine_version} {c.engine_variant}. "
-                    "Run train first."
+                    f"No COMPLETED engine instance eligible for engine "
+                    f"{c.engine_id} {c.engine_version} "
+                    f"{c.engine_variant} (rolled-back canaries are "
+                    "skipped). Run train first."
                 )
         else:
             instance = instances.get(instance_id)
@@ -263,16 +302,7 @@ class QueryServer:
             # external engine's child process) — but on a delay: queries
             # that snapshotted the old algorithms may still be mid-predict,
             # and closing under them would kill their child mid-call
-            retired = [
-                close for algo in getattr(self, "algorithms", [])
-                if callable(close := getattr(algo, "close", None))
-            ]
-            if retired:
-                t = threading.Timer(
-                    30.0, lambda: [c() for c in retired]
-                )
-                t.daemon = True
-                t.start()
+            self._retire_algorithms(getattr(self, "algorithms", []))
             self.instance = instance
             self.models = models
             self.algorithms = algorithms
@@ -291,6 +321,115 @@ class QueryServer:
         self.last_reload_error = None
         return self.instance.id
 
+    # -- guarded rollout arms (pio_tpu/rollout/) -----------------------------
+    def rollout_active_instance_id(self) -> str:
+        with self._lock:
+            return self.instance.id
+
+    def load_candidate(self, instance_id: str) -> None:
+        """Restore `instance_id` into the CANDIDATE slot alongside the
+        active model. Every failable step runs before the slot is set
+        (same atomicity contract as _load); no last-good fallback — a
+        canary candidate is THAT instance or nothing."""
+        with self._load_lock:
+            instance = self.storage.get_metadata_engine_instances().get(
+                instance_id)
+            if instance is None:
+                raise ValueError(f"Engine instance {instance_id} not found")
+            if instance.status != "COMPLETED":
+                raise ValueError(
+                    f"candidate instance {instance_id} is "
+                    f"{instance.status}, not COMPLETED")
+            models = load_models(
+                self.storage, self.engine, self.engine_params,
+                instance.id, ctx=self.ctx,
+            )
+            _, _, algorithms, serving = self.engine._doers(self.engine_params)
+            with self._lock:
+                self._retire_algorithms(
+                    self.candidate.algorithms if self.candidate else [])
+                self.candidate = _CandidateArm(
+                    instance=instance, models=models,
+                    algorithms=algorithms, serving=serving)
+                self._candidate_foldin_pending = {}
+        log.info("candidate arm loaded: instance %s", instance_id)
+
+    def drop_candidate(self) -> None:
+        """Discard the candidate arm (rollback). The active arm is
+        untouched — in-flight queries that snapshotted the candidate
+        finish on their snapshot; new ones never see it."""
+        with self._lock:
+            cand, self.candidate = self.candidate, None
+            self._candidate_foldin_pending = {}
+            if cand is not None:
+                self._retire_algorithms(cand.algorithms)
+
+    def promote_candidate(self) -> None:
+        """The candidate becomes the active instance (100%): one
+        pointer swap under the lock, the exact shape _load uses. The
+        outgoing active arm's resources retire on the usual delay.
+        Queued candidate fold-ins flush under ``_load_lock`` (an upsert
+        landing between an unlocked flush and the swap would be
+        silently discarded); anything STILL pending at the swap — rank
+        mismatch, or an apply racing the swap itself — is logged, and
+        the next fold-in cycle re-solves those users."""
+        with self._load_lock:
+            self._flush_candidate_foldin()
+            with self._lock:
+                cand = self.candidate
+                if cand is None:
+                    raise ValueError("no candidate arm to promote")
+                dropped = len(self._candidate_foldin_pending)
+                if dropped:
+                    log.warning(
+                        "%d queued candidate fold-in row(s) could not "
+                        "apply at promote and are dropped (next fold-in "
+                        "cycle re-solves those users)", dropped)
+                self._retire_algorithms(self.algorithms)
+                self.instance = cand.instance
+                self.models = cand.models
+                self.algorithms = cand.algorithms
+                self.serving = cand.serving
+                self.candidate = None
+                self._candidate_foldin_pending = {}
+        log.info("candidate promoted: instance %s now active",
+                 self.instance.id)
+
+    def _retire_algorithms(self, algorithms) -> None:
+        """Close an arm's algorithm resources on a delay (see
+        _load_locked: queries that snapshotted them may be mid-predict).
+        Callers hold self._lock."""
+        retired = [
+            close for algo in algorithms
+            if callable(close := getattr(algo, "close", None))
+        ]
+        if retired:
+            t = threading.Timer(30.0, lambda: [c() for c in retired])
+            t.daemon = True
+            t.start()
+
+    def _arm_snapshot(self, arm: str):
+        """-> (models, algorithms, serving, instance_id) for the arm a
+        query rides. A candidate request that races a just-finished
+        rollback falls through to the active arm — a dropped arm is
+        never served."""
+        with self._lock:
+            if arm == ARM_CANDIDATE and self.candidate is not None:
+                c = self.candidate
+                return c.models, c.algorithms, c.serving, c.instance.id
+            return (self.models, self.algorithms, self.serving,
+                    self.instance.id)
+
+    def shadow_predict(self, q: dict, arm: str) -> Any:
+        """Score `q` on one arm without stats, feedback, or plugins —
+        the rollout controller's divergence sampler."""
+        models, algorithms, serving, _ = self._arm_snapshot(arm)
+        supplemented = serving.supplement(dict(q))
+        predictions = [
+            a.predict(m, supplemented) for a, m in zip(algorithms, models)
+        ]
+        return serving.serve(q, predictions)
+
     def close(self) -> None:
         """Release serving resources (predict pool, batcher thread, and any
         algorithm-held children such as external engine processes). The
@@ -301,7 +440,12 @@ class QueryServer:
             self.bucket_registry.flush()
         self._predict_pool.shutdown(wait=False)
         self._hedge_pool.shutdown(wait=False)
-        for algo in getattr(self, "algorithms", []):
+        if self.rollout is not None:
+            self.rollout.close()
+        arms = list(getattr(self, "algorithms", []))
+        if self.candidate is not None:
+            arms += self.candidate.algorithms
+        for algo in arms:
             close = getattr(algo, "close", None)
             if callable(close):
                 close()
@@ -385,30 +529,41 @@ class QueryServer:
     def query(self, q: dict, record: bool = True) -> Any:
         t0 = time.monotonic()
         tr = self.tracer
+        # guarded rollout: the controller picks the arm (sticky crc32c
+        # user split); warm-ups (record=False) always ride active
+        rollout = self.rollout if record else None
+        arm = rollout.arm_for(q) if rollout is not None else ARM_ACTIVE
         # warm-up calls (record=False) must not enter the stage
         # histograms: their compile-heavy spans would pollute dashboard
         # quantiles AND the hedge-arming median (_hedge_timeout)
         span = tr.span if record else (lambda _n: nullcontext())
-        with span("supplement"):
-            supplemented = self.serving.supplement(q)
-        with self._lock:
-            models = self.models
-            algorithms = self.algorithms
-            instance_id = self.instance.id
-        with span("predict"):
-            if len(algorithms) > 1:
-                # concurrent per-algo predict (the parallelization the
-                # reference left as TODO, CreateServer.scala:516); device
-                # dispatch releases the GIL so the algos genuinely overlap
-                futures = [
-                    self._predict_pool.submit(a.predict, m, supplemented)
-                    for a, m in zip(algorithms, models)
-                ]
-                predictions = [f.result() for f in futures]
-            else:
-                predictions = [algorithms[0].predict(models[0], supplemented)]
-        with span("serve"):
-            prediction = self.serving.serve(q, predictions)
+        models, algorithms, serving, instance_id = self._arm_snapshot(arm)
+        try:
+            with span("supplement"):
+                supplemented = serving.supplement(q)
+            with span("predict"):
+                if len(algorithms) > 1:
+                    # concurrent per-algo predict (the parallelization
+                    # the reference left as TODO, CreateServer.scala:516);
+                    # device dispatch releases the GIL so the algos
+                    # genuinely overlap
+                    futures = [
+                        self._predict_pool.submit(a.predict, m, supplemented)
+                        for a, m in zip(algorithms, models)
+                    ]
+                    predictions = [f.result() for f in futures]
+                else:
+                    predictions = [
+                        algorithms[0].predict(models[0], supplemented)]
+            with span("serve"):
+                prediction = serving.serve(q, predictions)
+        except Exception:
+            if rollout is not None:
+                rollout.observe(arm, q, None, time.monotonic() - t0,
+                                error=True)
+            raise
+        if rollout is not None:
+            rollout.observe(arm, q, prediction, time.monotonic() - t0)
         if record:
             self._auto_warm_buckets(q)
         return self._postprocess(q, prediction, instance_id, record, t0)
@@ -483,17 +638,61 @@ class QueryServer:
     def query_batch(self, queries: list[dict], record: bool = True) -> list:
         """Serve several queries as one batch_predict per algorithm (the
         micro-batching execution path; also the bulk path behind
-        /batch/queries.json)."""
+        /batch/queries.json). With a rollout in flight the batch is
+        partitioned by arm — each sub-batch executes against its own
+        arm's models, results reassemble in request order."""
         t0 = time.monotonic()
+        rollout = self.rollout if record else None
+        if rollout is not None:
+            arms = [rollout.arm_for(q) for q in queries]
+            if ARM_CANDIDATE in arms:
+                out: list = [None] * len(queries)
+                for arm in (ARM_ACTIVE, ARM_CANDIDATE):
+                    idx = [i for i, a in enumerate(arms) if a == arm]
+                    if not idx:
+                        continue
+                    sub = self._query_batch_arm(
+                        [queries[i] for i in idx], arm, record, t0,
+                        rollout)
+                    for i, r in zip(idx, sub):
+                        out[i] = r
+                return out
+        return self._query_batch_arm(queries, ARM_ACTIVE, record, t0,
+                                     rollout)
+
+    def _query_batch_arm(self, queries: list[dict], arm: str, record: bool,
+                         t0: float, rollout) -> list:
         tr = self.tracer
         # see query(): warm-up spans stay out of the histograms
         span = tr.span if record else (lambda _n: nullcontext())
+        # per-ARM clock for the rollout stats (t0 stays the whole-batch
+        # clock for _postprocess bookkeeping): the arms execute
+        # sequentially, so charging candidate observations from the
+        # whole-batch start would bill the active sub-batch's time to
+        # the candidate and trip the latency-ratio guard on perfectly
+        # healthy canaries
+        arm_t0 = time.monotonic()
+        models, algorithms, serving, instance_id = self._arm_snapshot(arm)
+        try:
+            return self._query_batch_body(
+                queries, arm, record, t0, arm_t0, rollout, span, models,
+                algorithms, serving, instance_id)
+        except Exception:
+            if rollout is not None:
+                # per-QUERY time (sub-batch wall / size): whole-batch
+                # time would make each arm's mean scale with its share
+                # of the split — at 25% the candidate would look 3x
+                # faster (slow canary promoted) and at 80% 4x slower
+                # (healthy canary rolled back)
+                dt = (time.monotonic() - arm_t0) / max(1, len(queries))
+                for q in queries:
+                    rollout.observe(arm, q, None, dt, error=True)
+            raise
+
+    def _query_batch_body(self, queries, arm, record, t0, arm_t0, rollout,
+                          span, models, algorithms, serving, instance_id):
         with span("supplement"):
-            supplemented = [self.serving.supplement(q) for q in queries]
-        with self._lock:
-            models = self.models
-            algorithms = self.algorithms
-            instance_id = self.instance.id
+            supplemented = [serving.supplement(q) for q in queries]
         with span("predict"):
             if len(algorithms) > 1:
                 futures = [
@@ -521,9 +720,15 @@ class QueryServer:
                         self.config.batch_max))
         with span("serve"):
             predictions = [
-                self.serving.serve(q, [algo_out[i] for algo_out in per_algo])
+                serving.serve(q, [algo_out[i] for algo_out in per_algo])
                 for i, q in enumerate(queries)
             ]
+        if rollout is not None:
+            # per-query time, not whole-sub-batch time — see the error
+            # path above
+            dt = (time.monotonic() - arm_t0) / max(1, len(queries))
+            for q, p in zip(queries, predictions):
+                rollout.observe(arm, q, p, dt)
         return [
             self._postprocess(q, p, instance_id, record, t0)
             for q, p in zip(queries, predictions)
@@ -591,12 +796,9 @@ class QueryServer:
         the id decode stay aligned. Last-good semantics: the new model
         is built completely OUTSIDE the lock and swapped atomically; a
         failure anywhere leaves the previous model serving untouched.
-        ``rows`` maps user id → (k,)-float sequence."""
-        import dataclasses
-
-        import jax.numpy as jnp
-        import numpy as np
-
+        ``rows`` maps user id → (k,)-float sequence. With a rollout in
+        flight the rows land on BOTH arms (or queue for the candidate),
+        so streaming freshness never silently diverges the experiment."""
         if not rows:
             with self._lock:
                 return {"applied": 0, "new": 0,
@@ -604,45 +806,7 @@ class QueryServer:
         with self._lock:
             models = list(self.models)
             instance_id = self.instance.id
-        for mi, model in enumerate(models):
-            factors = getattr(model, "factors", None)
-            if (getattr(factors, "user_factors", None) is not None
-                    and getattr(model, "users", None) is not None):
-                break
-        else:
-            raise ValueError(
-                "fold-in needs a factor-table model (factors.user_factors "
-                "+ users index); none of the deployed models qualifies")
-        uf = model.factors.user_factors
-        k = int(uf.shape[1])
-        users = model.users
-        existing: list[tuple[int, list[float]]] = []
-        new_ids: list = []
-        new_rows: list = []
-        for uid, row in rows.items():
-            if len(row) != k:
-                raise ValueError(
-                    f"fold-in row for {uid!r} has {len(row)} dims, model "
-                    f"rank is {k}")
-            if uid in users:
-                existing.append((users.index_of(uid), row))
-            else:
-                new_ids.append(uid)
-                new_rows.append(row)
-        new_uf = uf
-        if existing:
-            idx = np.fromiter((i for i, _ in existing), np.int32,
-                              count=len(existing))
-            vals = np.asarray([r for _, r in existing], np.float32)
-            new_uf = new_uf.at[jnp.asarray(idx)].set(jnp.asarray(vals))
-        if new_ids:
-            new_uf = jnp.concatenate(
-                [new_uf, jnp.asarray(np.asarray(new_rows, np.float32))])
-        new_model = dataclasses.replace(
-            model,
-            factors=dataclasses.replace(model.factors, user_factors=new_uf),
-            users=users.extended(new_ids) if new_ids else users,
-        )
+        mi, model, new_model, new_ids = _fold_rows_into(models, rows)
         with self._lock:
             # the model may have moved while we built the new one: a
             # /reload (new instance — applying stale rows onto it would
@@ -663,8 +827,65 @@ class QueryServer:
             self.foldin_last_time = utcnow()
             if staleness_s is not None:
                 self.foldin_last_staleness_s = float(staleness_s)
-        return {"applied": len(rows), "new": len(new_ids),
-                "engineInstanceId": instance_id}
+        out = {"applied": len(rows), "new": len(new_ids),
+               "engineInstanceId": instance_id}
+        # second arm: the ACTIVE apply above is the durable one (the
+        # folder's cursor advances on it); the candidate apply is
+        # best-effort-with-queue — a failure parks the rows in
+        # _candidate_foldin_pending and retries on the next apply (and
+        # at promote), never blocking freshness on the experiment
+        with self._lock:
+            has_candidate = self.candidate is not None
+        if has_candidate:
+            out["candidateQueued"] = self._apply_foldin_to_candidate(rows)
+        return out
+
+    def _apply_foldin_to_candidate(self, rows) -> int:
+        """Apply `rows` (plus anything previously queued) to the
+        candidate arm. Returns the queue depth left behind (0 = fully
+        applied). Never raises: the active apply already succeeded and
+        the folder must not re-solve the window for a canary hiccup."""
+        with self._lock:
+            cand = self.candidate
+            if cand is None:
+                self._candidate_foldin_pending = {}
+                return 0
+            pending = dict(self._candidate_foldin_pending)
+            pending.update(rows)
+            models = list(cand.models)
+        try:
+            mi, model, new_model, _ = _fold_rows_into(models, pending)
+        except ValueError as e:
+            with self._lock:
+                self._candidate_foldin_pending = pending
+            log.warning("fold-in rows queued for candidate arm (%d "
+                        "users): %s", len(pending), e)
+            return len(pending)
+        with self._lock:
+            cand = self.candidate
+            if cand is None:
+                self._candidate_foldin_pending = {}
+                return 0
+            if cand.models[mi] is not model:
+                # the arm moved mid-build (promote/drop/another apply):
+                # queue and let the next apply land on the new arm
+                self._candidate_foldin_pending = pending
+                return len(pending)
+            cand_models = list(cand.models)
+            cand_models[mi] = new_model
+            self.candidate = _CandidateArm(
+                instance=cand.instance, models=cand_models,
+                algorithms=cand.algorithms, serving=cand.serving)
+            self._candidate_foldin_pending = {}
+        return 0
+
+    def _flush_candidate_foldin(self) -> None:
+        """Drain queued candidate fold-ins (called before promote so
+        the promoted arm is as fresh as the active one was)."""
+        with self._lock:
+            pending = dict(self._candidate_foldin_pending)
+        if pending:
+            self._apply_foldin_to_candidate(pending)
 
     def foldin_status(self) -> dict:
         """Bounded-staleness accounting for /readyz + /metrics.json."""
@@ -674,6 +895,7 @@ class QueryServer:
                 "lastAppliedTime": (format_time(self.foldin_last_time)
                                     if self.foldin_last_time else None),
                 "stalenessSeconds": self.foldin_last_staleness_s,
+                "candidateQueued": len(self._candidate_foldin_pending),
             }
 
     # -- status -------------------------------------------------------------
@@ -716,6 +938,61 @@ class QueryServer:
             "hedgedDispatches": self.hedged_dispatches,
             "foldin": self.foldin_status(),
         }
+
+
+def _fold_rows_into(models: list, rows) -> tuple:
+    """Build an updated factor-table model with `rows` upserted —
+    existing users replaced in place, new users appended with the id
+    index extended in lockstep. Pure with respect to serving state (the
+    caller swaps under its lock): returns
+    ``(model_index, old_model, new_model, new_ids)``. Raises ValueError
+    when no deployed model has a factor table or a row's rank
+    mismatches."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    for mi, model in enumerate(models):
+        factors = getattr(model, "factors", None)
+        if (getattr(factors, "user_factors", None) is not None
+                and getattr(model, "users", None) is not None):
+            break
+    else:
+        raise ValueError(
+            "fold-in needs a factor-table model (factors.user_factors "
+            "+ users index); none of the deployed models qualifies")
+    uf = model.factors.user_factors
+    k = int(uf.shape[1])
+    users = model.users
+    existing: list[tuple[int, list[float]]] = []
+    new_ids: list = []
+    new_rows: list = []
+    for uid, row in rows.items():
+        if len(row) != k:
+            raise ValueError(
+                f"fold-in row for {uid!r} has {len(row)} dims, model "
+                f"rank is {k}")
+        if uid in users:
+            existing.append((users.index_of(uid), row))
+        else:
+            new_ids.append(uid)
+            new_rows.append(row)
+    new_uf = uf
+    if existing:
+        idx = np.fromiter((i for i, _ in existing), np.int32,
+                          count=len(existing))
+        vals = np.asarray([r for _, r in existing], np.float32)
+        new_uf = new_uf.at[jnp.asarray(idx)].set(jnp.asarray(vals))
+    if new_ids:
+        new_uf = jnp.concatenate(
+            [new_uf, jnp.asarray(np.asarray(new_rows, np.float32))])
+    new_model = dataclasses.replace(
+        model,
+        factors=dataclasses.replace(model.factors, user_factors=new_uf),
+        users=users.extended(new_ids) if new_ids else users,
+    )
+    return mi, model, new_model, new_ids
 
 
 def _depth_for_rtt(rtt_s: float) -> int:
@@ -974,7 +1251,10 @@ def build_serving_app(server: QueryServer) -> HttpApp:
             return 400, {"message": str(e)}
         return 200, out
 
-    @app.route("GET", r"/reload")
+    @app.route("POST", r"/reload")
+    @app.route("GET", r"/reload")  # deprecated alias: reload MUTATES
+    # serving state, so POST is the canonical route (docs/serving.md);
+    # GET remains for pre-PR-8 clients and scripts
     def reload(req: Request):
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
@@ -1075,10 +1355,26 @@ def build_serving_app(server: QueryServer) -> HttpApp:
                 "registry": (server.bucket_registry.buckets()
                              if server.bucket_registry else None),
             }
+        # rollout visibility, never a readiness gate: a breached canary
+        # auto-rolls-back to the active arm — the server stays ready
+        # throughout (that atomic revert is the whole point)
+        rollout = server.rollout
+        if rollout is not None:
+            st = rollout.status()
+            checks["rollout"] = {
+                "ok": True,
+                "stagePct": st["stagePct"],
+                "verdict": st["verdict"],
+                "candidateInstanceId": st["candidateInstanceId"],
+            }
         checks.update(shedder_check(getattr(app, "transport", None)))
         return checks
 
     install_health_routes(app, readiness)
+    # guarded rollout verbs (pio_tpu/rollout/): /rollout/deploy,
+    # /rollout/promote, /rollout/rollback (server-key guarded) +
+    # /rollout/status
+    install_rollout_routes(app, server, server.storage, check_server_key)
 
     @app.route("GET", r"/plugins\.json")
     def plugins_list(req: Request):

@@ -23,12 +23,37 @@ from pio_tpu.utils.jaxcompat import ensure_jax_compat  # noqa: E402
 
 ensure_jax_compat()  # jax<0.5: tests call jax.shard_map directly
 
+# Persistent XLA compile cache for the WHOLE suite, not just the
+# run_train/serve paths that enable it themselves: the suite's dominant
+# cost is XLA compiles of the same kernels run to run, and a warm cache
+# cuts the compile-heavy suites 2-3x (tier-1 must stay inside its time
+# budget as the suite grows). MUST happen at import time: jax binds its
+# cache instance on the FIRST compile and never re-reads the dir config
+# unless reset, and module-scoped test fixtures compile before any
+# function-scoped fixture could run. PIO_TPU_COMPILE_CACHE=off disables.
+from pio_tpu.utils.compilecache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 import pytest  # noqa: E402
 
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running end-to-end tests (subprocess CLI)")
+
+
+@pytest.fixture(autouse=True)
+def _persistent_compile_cache():
+    """Re-assert the import-time compile-cache enablement (above) before
+    every test: tests that deliberately reset the module state and point
+    jax at their own directory (test_compilecache.py's cache_dir
+    fixture) would otherwise leave the rest of the suite compiling
+    cache-less. Idempotent no-op when already enabled."""
+    from pio_tpu.utils import compilecache
+
+    compilecache.enable_compile_cache()
+    yield
 
 
 @pytest.fixture()

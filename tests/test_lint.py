@@ -446,6 +446,65 @@ def test_foldin_cursor_durable_and_reads_silent():
                      select=["foldin-cursor"]) == []
 
 
+def test_rollout_state_write_outside_transition_fires():
+    from pio_tpu.analysis import lint_text
+    src = """
+        import json
+
+        class Controller:
+            def __init__(self):
+                self.stage_index = 0          # construction: allowed
+                self.verdict = None
+
+            def _transition(self, verdict):
+                self.verdict = verdict        # the sanctioned writer
+
+            def hack(self):
+                self.verdict = "PROMOTED"     # bypasses lock + persist
+                self.stage_index += 1
+                self.stage_pct = 100
+
+            def persist(self, path, record):
+                with open(path, "w") as f:    # bypasses utils/durable
+                    json.dump(record, f)
+    """
+    fs = lint_text(textwrap.dedent(src),
+                   path="pio_tpu/rollout/controller.py",
+                   select=["rollout-state"])
+    # verdict, stage_index +=, stage_pct, open("w"), json.dump
+    assert [f.rule for f in fs] == ["rollout-state"] * 5
+    # identical code OUTSIDE the rollout package is out of scope
+    assert lint_text(textwrap.dedent(src),
+                     path="pio_tpu/workflow/controller.py",
+                     select=["rollout-state"]) == []
+
+
+def test_rollout_state_transition_and_reads_silent():
+    from pio_tpu.analysis import lint_text
+    src = """
+        from pio_tpu.rollout import state as rstate
+
+        class Controller:
+            def __init__(self):
+                self.stage_index = 0
+                self.verdict = None
+
+            def _transition(self, stage_index=None, verdict=None):
+                if stage_index is not None:
+                    self.stage_index = stage_index
+                if verdict is not None:
+                    self.verdict = verdict
+                rstate.save_record(self.storage, self._record())
+
+            def status(self):
+                return {"verdict": self.verdict,
+                        "stage": self.stage_index}
+    """
+    assert lint_text(textwrap.dedent(src),
+                     path="pio_tpu/rollout/controller.py",
+                     select=["rollout-state"]) == []
+
+
 # -- bench hygiene ----------------------------------------------------------
 
 def test_time_time_fires():

@@ -1,0 +1,590 @@
+"""Guarded model rollout tests (pio_tpu/rollout/):
+
+  * deterministic sticky canary split (same split fn single-host AND
+    fleet — crc32c, never salted hash()),
+  * single-host e2e: canary at a fixed pct serves BIT-IDENTICAL to the
+    candidate oracle for canary users and the active oracle for the
+    rest; a chaos'd guard breach auto-rolls-back 100% of traffic with
+    zero 5xx and a persisted ROLLED_BACK verdict that reload/restart
+    paths never auto-advance onto again,
+  * promote: green canary -> 100%, verdict PROMOTED, survives process
+    restart (read back from storage),
+  * both-arm fold-in (freshness never silently diverges the
+    experiment) + the rollback-during-in-flight-upsert regression,
+  * fleet: router-carried split over candidate partitions served from
+    the recorded `<iid>:shard<i>` blobs, promote, doctor coverage, and
+    the rollout-chaos drill (corrupt candidate blob on one shard group
+    => auto-rollback, zero 5xx, zero candidate-arm responses),
+  * POST /reload as the canonical route (GET kept as deprecated alias).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller import EngineParams
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.dao import App, Model
+from pio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+from pio_tpu.resilience import chaos
+from pio_tpu.rollout import (
+    VERDICT_PROMOTED,
+    VERDICT_ROLLED_BACK,
+    canary_bucket,
+    in_canary,
+    load_record,
+)
+from pio_tpu.serving_fleet.fleet import deploy_fleet
+from pio_tpu.serving_fleet.plan import shard_model_id
+from pio_tpu.workflow.context import create_workflow_context
+from pio_tpu.workflow.serve import QueryServer, ServingConfig, create_query_server
+from pio_tpu.workflow.train import run_train
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+N_USERS = 20
+
+
+def seed_events(storage):
+    app_id = storage.get_metadata_apps().insert(App(0, "mlapp"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    m = 0
+    for u in range(N_USERS):
+        for i in range(12):
+            match = (u % 2) == (i % 2)
+            if rng.random() < (0.8 if match else 0.1):
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5 if match else 1}),
+                    event_time=T0 + timedelta(minutes=m)), app_id)
+                m += 1
+    return app_id
+
+
+def train_instance(storage, n_iter):
+    """One COMPLETED instance; different n_iter -> different factors,
+    so the two arms' predictions are distinguishable bit-for-bit."""
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="mlapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=4, num_iterations=n_iter, lambda_=0.05, chunk=1024))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    iid = run_train(engine, ep, storage, engine_id="rec", ctx=ctx)
+    return engine, ep, ctx, iid
+
+
+def oracle(storage, engine, ep, ctx, instance_id):
+    """A pinned in-process QueryServer: the bit-exact reference for what
+    one arm should answer."""
+    return QueryServer(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec"),
+        ctx=ctx, instance_id=instance_id)
+
+
+def call(port, method, path, body=None, **params):
+    import urllib.parse
+
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+@pytest.fixture()
+def two_instances(memory_storage):
+    seed_events(memory_storage)
+    engine, ep, ctx, iid_a = train_instance(memory_storage, n_iter=3)
+    _, _, _, iid_b = train_instance(memory_storage, n_iter=6)
+    return memory_storage, engine, ep, ctx, iid_a, iid_b
+
+
+def serve_pinned(storage, engine, ep, ctx, instance_id):
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec"),
+        ctx=ctx, instance_id=instance_id)
+    http.start()
+    return http, qs
+
+
+# -- split -------------------------------------------------------------------
+
+def test_split_deterministic_sticky_monotone():
+    # stable across calls (and, by construction, across processes:
+    # crc32c of the id bytes, never the salted stdlib hash())
+    assert canary_bucket("u7") == canary_bucket("u7")
+    assert 0 <= canary_bucket("anyone") < 100
+    for u in range(200):
+        uid = f"u{u}"
+        # monotone in pct: ramping up only ADDS users to the canary
+        joined = False
+        for pct in (0, 1, 5, 25, 50, 100):
+            now = in_canary(uid, pct)
+            assert now or not joined  # once in, never out as pct grows
+            joined = joined or now
+        assert in_canary(uid, 100) and not in_canary(uid, 0)
+
+
+# -- single-host e2e ---------------------------------------------------------
+
+def test_canary_split_guard_breach_and_rollback_e2e(two_instances):
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    qs_a = oracle(storage, engine, ep, ctx, iid_a)
+    qs_b = oracle(storage, engine, ep, ctx, iid_b)
+    try:
+        pct = 40
+        code, out = call(http.port, "POST", "/rollout/deploy",
+                         {"pct": pct, "shadowEvery": 1, "checkEvery": 1,
+                          "guards": {"maxDivergence": 1.0}})
+        assert code == 200, out
+        assert out["rollout"]["candidateInstanceId"] == iid_b
+        assert out["rollout"]["baselineInstanceId"] == iid_a
+
+        # sticky deterministic split: canary users get the candidate
+        # oracle's answer BIT-identically, the rest the active oracle's
+        statuses = []
+        for rep in range(2):          # twice: same users, same arms
+            for u in range(N_USERS):
+                uid = f"u{u}"
+                code, got = call(http.port, "POST", "/queries.json",
+                                 {"user": uid, "num": 5})
+                statuses.append(code)
+                want = (qs_b if in_canary(uid, pct) else qs_a).query(
+                    {"user": uid, "num": 5})
+                assert got == want, f"user {uid} rep {rep}"
+        assert all(s == 200 for s in statuses)
+        _, st = call(http.port, "GET", "/rollout/status")
+        assert st["stagePct"] == pct and st["verdict"] is None
+        assert st["arms"]["candidate"]["requests"] > 0
+        assert st["arms"]["active"]["requests"] > 0
+        assert st["shadow"]["samples"] > 0      # divergence sampled
+
+        # guard breach via chaos => automatic 100% revert, zero 5xx
+        canary_user = next(f"u{u}" for u in range(N_USERS)
+                           if in_canary(f"u{u}", pct))
+        with chaos.inject("rollout.guard", error=1.0):
+            code, got = call(http.port, "POST", "/queries.json",
+                             {"user": canary_user, "num": 5})
+            assert code == 200          # the breach never 5xxes traffic
+        _, st = call(http.port, "GET", "/rollout/status")
+        assert st["verdict"] == VERDICT_ROLLED_BACK
+        assert st["stagePct"] == 0
+        assert "chaos" in st["reason"] or "guard" in st["reason"]
+
+        # 100% of traffic is back on the active arm, bit-identically
+        for u in range(N_USERS):
+            uid = f"u{u}"
+            code, got = call(http.port, "POST", "/queries.json",
+                             {"user": uid, "num": 5})
+            assert code == 200
+            assert got == qs_a.query({"user": uid, "num": 5})
+
+        # the verdict is durable, with the guard evidence attached
+        record = load_record(storage, iid_b)
+        assert record.verdict == VERDICT_ROLLED_BACK
+        assert record.baseline_instance_id == iid_a
+        assert record.evidence
+
+        # reload (POST is canonical now) never auto-advances onto the
+        # rolled-back instance
+        code, out = call(http.port, "POST", "/reload")
+        assert code == 200 and out["engineInstanceId"] == iid_a
+        # ... and neither does a process restart
+        qs2 = oracle(storage, engine, ep, ctx, None)
+        try:
+            assert qs2.instance.id == iid_a
+        finally:
+            qs2.close()
+    finally:
+        http.stop()
+        qs.close()
+        qs_a.close()
+        qs_b.close()
+
+
+def test_promote_reaches_100_and_survives_restart(two_instances):
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    qs_b = oracle(storage, engine, ep, ctx, iid_b)
+    try:
+        code, out = call(http.port, "POST", "/rollout/deploy", {"pct": 25})
+        assert code == 200, out
+        code, out = call(http.port, "POST", "/rollout/promote")
+        assert code == 200, out
+        assert out["rollout"]["verdict"] == VERDICT_PROMOTED
+        assert out["rollout"]["stagePct"] == 100
+        # EVERY user now rides the promoted instance, bit-identically
+        for u in range(N_USERS):
+            uid = f"u{u}"
+            code, got = call(http.port, "POST", "/queries.json",
+                             {"user": uid, "num": 5})
+            assert code == 200
+            assert got == qs_b.query({"user": uid, "num": 5})
+        assert load_record(storage, iid_b).verdict == VERDICT_PROMOTED
+        # restart: the verdict is read back from storage and the
+        # promoted instance resolves as the latest eligible one
+        qs2 = oracle(storage, engine, ep, ctx, None)
+        try:
+            assert qs2.instance.id == iid_b
+        finally:
+            qs2.close()
+    finally:
+        http.stop()
+        qs.close()
+        qs_b.close()
+
+
+def test_deploy_conflicts_and_promote_without_rollout(two_instances):
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    try:
+        code, _ = call(http.port, "POST", "/rollout/promote")
+        assert code == 409                      # nothing in flight
+        code, _ = call(http.port, "POST", "/rollout/rollback")
+        assert code == 409
+        code, out = call(http.port, "POST", "/rollout/deploy", {"pct": 10})
+        assert code == 200, out
+        code, _ = call(http.port, "POST", "/rollout/deploy", {"pct": 20})
+        assert code == 409                      # one rollout at a time
+        code, out = call(http.port, "POST", "/rollout/rollback",
+                         {"reason": "drill over"})
+        assert code == 200
+        assert out["rollout"]["verdict"] == VERDICT_ROLLED_BACK
+        # after the verdict, deploying the SAME instance again is
+        # refused by candidate resolution (it is no longer eligible)
+        code, out = call(http.port, "POST", "/rollout/deploy", {"pct": 10})
+        assert code == 409, out
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_auto_ramp_advances_stages_while_green(two_instances):
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    try:
+        code, out = call(http.port, "POST", "/rollout/deploy",
+                         {"auto": True, "stages": [50, 100],
+                          "minStageSamples": 3, "minStageSeconds": 0.0,
+                          "checkEvery": 1, "shadowEvery": 0,
+                          "tickIntervalS": 0,
+                          "guards": {"minSamples": 1000}})
+        assert code == 200, out
+        canary_users = [f"u{u}" for u in range(N_USERS)
+                        if in_canary(f"u{u}", 50)]
+        assert len(canary_users) >= 3
+        for uid in canary_users:
+            call(http.port, "POST", "/queries.json", {"user": uid, "num": 5})
+        _, st = call(http.port, "GET", "/rollout/status")
+        assert st["stagePct"] == 100 and st["verdict"] is None
+        # at 100% every user rides the candidate (still revocable)
+        code, out = call(http.port, "POST", "/rollout/rollback")
+        assert code == 200
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_all_error_candidate_rolls_back_without_ticker(two_instances):
+    """The error_rate guard must fire from ERRORED candidate requests
+    alone: in fixed-pct mode there is no ticker, so observe() is the
+    only trigger — a candidate that 500s every request would otherwise
+    never be judged at all."""
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    from pio_tpu.rollout import (
+        GuardConfig, RolloutConfig, RolloutController,
+    )
+
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    try:
+        ctl = RolloutController.begin(
+            storage, qs, iid_b,
+            RolloutConfig(stages=(50,), shadow_every=0, check_every=1,
+                          guards=GuardConfig(min_samples=5)))
+        for i in range(6):
+            ctl.observe("candidate", {"user": f"u{i}", "num": 3}, None,
+                        0.01, error=True)
+        assert ctl.verdict == VERDICT_ROLLED_BACK
+        assert "error_rate" in ctl.reason
+        assert load_record(storage, iid_b).verdict == VERDICT_ROLLED_BACK
+    finally:
+        http.stop()
+        qs.close()
+
+
+# -- fold-in interplay -------------------------------------------------------
+
+def test_foldin_applies_to_both_arms(two_instances):
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    try:
+        code, _ = call(http.port, "POST", "/rollout/deploy", {"pct": 50})
+        assert code == 200
+        row = [0.5, -0.25, 0.125, 1.0]
+        out = qs.foldin_upsert({"brand-new-user": row})
+        assert out["applied"] == 1 and out["new"] == 1
+        assert out["candidateQueued"] == 0      # landed on BOTH arms
+        for arm in ("active", "candidate"):
+            got = qs.shadow_predict({"user": "brand-new-user", "num": 3},
+                                    arm)
+            assert got["itemScores"], f"arm {arm} did not serve the row"
+        assert qs.foldin_status()["candidateQueued"] == 0
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_rollback_during_inflight_foldin_keeps_active_bit_identical(
+        two_instances):
+    """The ISSUE-8 regression: a rollback landing mid-`upsert_users`
+    must leave the active arm bit-identical to its pre-canary state for
+    every untouched user — the rows either apply cleanly on the active
+    arm or raise for the folder to retry, never a mixed/partial swap."""
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    try:
+        from pio_tpu.rollout import RolloutConfig, RolloutController
+
+        model = qs.models[0]
+        pre = np.asarray(model.factors.user_factors).copy()
+        folded_uid = "u0"
+        fold_idx = model.users.index_of(folded_uid)
+        row = [2.0, 2.0, 2.0, 2.0]
+        for it in range(10):
+            ctl = RolloutController.begin(
+                storage, qs, iid_b,
+                RolloutConfig(stages=(30,), shadow_every=0))
+            errors: list = []
+
+            def fold():
+                try:
+                    qs.foldin_upsert({folded_uid: row})
+                except ValueError as e:
+                    errors.append(e)    # acceptable: folder replays
+
+            t = threading.Thread(target=fold)
+            t.start()
+            ctl.rollback(reason="race drill")
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert qs.candidate is None
+            # every OTHER user's active row is bit-identical to the
+            # pre-canary state on every iteration
+            now = np.asarray(qs.models[0].factors.user_factors)
+            mask = np.ones(len(pre), dtype=bool)
+            mask[fold_idx] = False
+            assert np.array_equal(now[:len(pre)][mask], pre[mask]), \
+                f"iteration {it} corrupted untouched active rows"
+            # the folded user's row either fully applied or (exception
+            # raised) stayed pre-canary — never a third value
+            assert (np.array_equal(now[fold_idx], np.asarray(
+                row, np.float32))
+                or (errors and np.array_equal(now[fold_idx],
+                                              pre[fold_idx])))
+            # reset the record so the next iteration can re-canary B
+            from pio_tpu.rollout import RolloutRecord, save_record
+            save_record(storage, RolloutRecord(
+                instance_id=iid_b, baseline_instance_id=iid_a,
+                stages=(30,), stage_pct=100, verdict=VERDICT_PROMOTED))
+    finally:
+        http.stop()
+        qs.close()
+
+
+# -- fleet -------------------------------------------------------------------
+
+def _query_all(port, oracle_of, pct=None):
+    """Query every user on the router; assert 200s and bit-parity with
+    the per-arm oracle chosen by `oracle_of(uid)`."""
+    for u in range(N_USERS):
+        uid = f"u{u}"
+        code, got = call(port, "POST", "/queries.json",
+                         {"user": uid, "num": 5})
+        assert code == 200, got
+        want = oracle_of(uid).query({"user": uid, "num": 5})
+        assert got == want, f"user {uid}"
+
+
+def test_fleet_canary_sticky_split_promote_and_doctor(two_instances, cli):
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1, instance_id=iid_a)
+    qs_a = oracle(storage, engine, ep, ctx, iid_a)
+    qs_b = oracle(storage, engine, ep, ctx, iid_b)
+    try:
+        port = handle.router_http.port
+        pct = 40
+        code, out = call(port, "POST", "/rollout/deploy",
+                         {"pct": pct, "shadowEvery": 0})
+        assert code == 200, out
+        assert out["rollout"]["candidateInstanceId"] == iid_b
+        # the fleet carries the SAME sticky split as the single-host
+        # server: canary users get the candidate fleet answer, which is
+        # bit-identical to the candidate single-host oracle
+        _query_all(port, lambda uid: qs_b if in_canary(uid, pct)
+                   else qs_a)
+        # doctor --fleet: rollout row + per-group candidate coverage
+        code, captured = cli("doctor", "--fleet", "--router-url",
+                             f"http://127.0.0.1:{port}", "--json")
+        assert code == 0
+        report = json.loads(captured.out)
+        assert report["rollout"]["candidateInstanceId"] == iid_b
+        assert report["candidateCoverage"] == {
+            "0": {"staged": 1, "total": 1, "instances": [iid_b]},
+            "1": {"staged": 1, "total": 1, "instances": [iid_b]},
+        }
+        # promote: candidate plan becomes THE plan, 100% of users ride
+        # the promoted instance bit-identically, verdict persisted
+        code, out = call(port, "POST", "/rollout/promote")
+        assert code == 200, out
+        _, fleet = call(port, "GET", "/fleet.json")
+        assert fleet["plan"]["instanceId"] == iid_b
+        _query_all(port, lambda uid: qs_b)
+        assert load_record(storage, iid_b).verdict == VERDICT_PROMOTED
+    finally:
+        handle.close()
+        qs_a.close()
+        qs_b.close()
+
+
+def test_fleet_corrupt_candidate_blob_auto_rolls_back(two_instances):
+    """The rollout-chaos drill: one shard group's candidate blob is
+    corrupt => the staged load breaches => automatic rollback with the
+    verdict persisted, zero 5xx, and zero requests ever served from the
+    bad arm."""
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=2, instance_id=iid_a)
+    qs_a = oracle(storage, engine, ep, ctx, iid_a)
+    try:
+        port = handle.router_http.port
+        # record B's fleet artifacts, then corrupt shard 1's blob (bit
+        # rot / torn write: CRC32C catches it at load)
+        from pio_tpu.serving_fleet.fleet import resolve_fleet_model
+        from pio_tpu.serving_fleet.plan import persist_fleet_artifacts
+
+        _, model_b = resolve_fleet_model(storage, "rec",
+                                         instance_id=iid_b)
+        persist_fleet_artifacts(storage, iid_b, model_b, 2, 2)
+        models = storage.get_model_data_models()
+        good = bytearray(models.get(shard_model_id(iid_b, 1)).models)
+        good[len(good) // 2] ^= 0xFF
+        models.insert(Model(shard_model_id(iid_b, 1), bytes(good)))
+
+        code, out = call(port, "POST", "/rollout/deploy", {"pct": 30})
+        assert code == 503, out
+        assert out["verdict"] == VERDICT_ROLLED_BACK
+        record = load_record(storage, iid_b)
+        assert record.verdict == VERDICT_ROLLED_BACK
+        assert "load failed" in record.reason
+
+        # zero 5xx, zero candidate-arm responses: every user still gets
+        # the active oracle's answer bit-identically
+        _query_all(port, lambda uid: qs_a)
+        # no replica holds a candidate arm after the unwind
+        _, fleet = call(port, "GET", "/fleet.json")
+        for group in fleet["shards"].values():
+            for rep in group["replicas"]:
+                assert rep["candidateInstanceId"] is None
+        # a fleet reload never auto-advances onto the rolled-back B
+        code, out = call(port, "POST", "/reload")
+        assert code == 200
+        assert out["planInstanceId"] == iid_a
+    finally:
+        handle.close()
+        qs_a.close()
+
+
+# -- POST /reload canonical route + CLI verbs --------------------------------
+
+def test_post_reload_canonical_get_alias(two_instances):
+    storage, engine, ep, ctx, iid_a, _ = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    try:
+        code, out = call(http.port, "POST", "/reload")
+        assert code == 200 and out["engineInstanceId"]
+        code, out = call(http.port, "GET", "/reload")  # deprecated alias
+        assert code == 200 and out["engineInstanceId"]
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_rollback_concludes_abandoned_inflight_record(two_instances):
+    """A process that dies mid-canary leaves an IN_FLIGHT record no
+    controller owns. It must keep blocking auto-advance (restart stays
+    on the baseline), but `pio rollback` against the fresh process must
+    conclude it — not answer 409 forever."""
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    from pio_tpu.rollout import RolloutRecord, save_record
+
+    # the crash leftover: B's canary record frozen IN_FLIGHT
+    save_record(storage, RolloutRecord(
+        instance_id=iid_b, baseline_instance_id=iid_a,
+        stages=(5,), stage_pct=5, verdict="IN_FLIGHT"))
+    # a fresh (restarted) server resolves the baseline, not the orphan
+    http, qs = serve_pinned(storage, engine, ep, ctx, None)
+    try:
+        assert qs.instance.id == iid_a
+        code, out = call(http.port, "POST", "/rollout/rollback",
+                         {"reason": "operator cleanup"})
+        assert code == 200, out
+        assert out["instanceId"] == iid_b
+        assert out["verdict"] == VERDICT_ROLLED_BACK
+        record = load_record(storage, iid_b)
+        assert record.verdict == VERDICT_ROLLED_BACK
+        assert "abandoned" in record.reason
+        # idempotent-ish: nothing left in flight now
+        code, _ = call(http.port, "POST", "/rollout/rollback")
+        assert code == 409
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_cli_canary_promote_rollback_verbs(two_instances, cli):
+    storage, engine, ep, ctx, iid_a, iid_b = two_instances
+    http, qs = serve_pinned(storage, engine, ep, ctx, iid_a)
+    try:
+        port = str(http.port)
+        code, captured = cli("deploy", "--canary", "15",
+                             "--ip", "127.0.0.1", "--port", port)
+        assert code == 0, captured.err
+        out = json.loads(captured.out)
+        assert out["rollout"]["stagePct"] == 15
+        code, captured = cli("rollback", "--port", port,
+                             "--reason", "cli drill")
+        assert code == 0
+        assert json.loads(captured.out)["rollout"]["verdict"] \
+            == VERDICT_ROLLED_BACK
+        # nothing in flight now: promote is a clean CLI error, not a
+        # traceback
+        code, captured = cli("promote", "--port", port)
+        assert code == 1
+        # bad spec is a clean error too
+        code, captured = cli("deploy", "--canary", "nope",
+                             "--port", port)
+        assert code == 1
+    finally:
+        http.stop()
+        qs.close()
