@@ -12,6 +12,7 @@ from pio_tpu.analysis.rules.bench_hygiene import (
     BenchHygieneRule, HotLoopAllocRule,
 )
 from pio_tpu.analysis.rules.concurrency import ConcurrencyRule
+from pio_tpu.analysis.rules.obs import ObsRule
 from pio_tpu.analysis.rules.shard_spec import ShardSpecRule
 from pio_tpu.analysis.rules.trace_purity import TracePurityRule
 from pio_tpu.analysis.rules.workflow_contract import WorkflowContractRule
@@ -23,6 +24,7 @@ ALL_RULES = [
     BenchHygieneRule(),
     HotLoopAllocRule(),
     WorkflowContractRule(),
+    ObsRule(),
 ]
 
 ALL_RULE_IDS = tuple(i for r in ALL_RULES for i in r.ids)

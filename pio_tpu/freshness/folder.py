@@ -88,6 +88,7 @@ class FoldInConfig:
     ip: str = "127.0.0.1"
     port: int = 8100
     backend: str = "threaded"
+    server_key: str = ""    # guards /debug trace routes ("" = open)
 
 
 class FoldInWorker:
@@ -118,6 +119,16 @@ class FoldInWorker:
             self.cursor = FoldCursor(time_us=_micros(utcnow()))
             self.cursor_store.save(self.cursor)
         self.start_time = utcnow()
+        # distributed tracing (pio_tpu/obs/): each fold cycle is one
+        # root trace (there is no inbound HTTP to join), so a slow or
+        # failed cycle is inspectable span-by-span — tail read, solve,
+        # apply — and the apply's outbound HTTP (router/serving upsert)
+        # carries the trace into the serving fleet
+        from pio_tpu.obs import make_recorder
+        from pio_tpu.utils.tracing import Tracer
+
+        self.recorder = make_recorder("folder")
+        self.tracer = Tracer(recorder=self.recorder)
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -170,7 +181,16 @@ class FoldInWorker:
     # -- one cycle -----------------------------------------------------------
     def run_once(self) -> dict:
         """One tail→solve→apply cycle; returns cycle stats. Raises on
-        failure (the loop catches; tests call this directly)."""
+        failure (the loop catches; tests call this directly). With
+        tracing on the cycle is one root trace — failed cycles are
+        always retained (tail-based error retention), so the runbook's
+        first stop for a wedged folder is its /debug/traces.json."""
+        if self.recorder is not None:
+            with self.recorder.trace("foldin.cycle"):
+                return self._run_budgeted()
+        return self._run_budgeted()
+
+    def _run_budgeted(self) -> dict:
         if self.config.cycle_budget_s > 0:
             with Deadline.budget(self.config.cycle_budget_s):
                 return self._cycle()
@@ -178,7 +198,8 @@ class FoldInWorker:
 
     def _cycle(self) -> dict:
         self._refresh_model()
-        window = self.source.window(self.cursor)
+        with self.tracer.span("tail"):
+            window = self.source.window(self.cursor)
         with self._lock:
             for u, oldest in window.to_fold.items():
                 prev = self._pending.get(u)
@@ -204,10 +225,12 @@ class FoldInWorker:
             if not batch_users:
                 break
             Deadline.check("foldin batch")
-            histories = {u: self.source.history(u) for u in batch_users}
-            rows = self.solver.solve(
-                self._model.factors.item_factors, self._model.items,
-                histories, self.value_fn)
+            with self.tracer.span("solve", users=len(batch_users)):
+                histories = {u: self.source.history(u)
+                             for u in batch_users}
+                rows = self.solver.solve(
+                    self._model.factors.item_factors, self._model.items,
+                    histories, self.value_fn)
             unplaceable = [u for u in batch_users if u not in rows]
             if rows:
                 with self._lock:
@@ -215,7 +238,8 @@ class FoldInWorker:
                                     if u in self._pending)
                 staleness = max(
                     0.0, (_micros(utcnow()) - oldest_us) / 1e6)
-                with self.apply_breaker.guard():
+                with self.tracer.span("apply", users=len(rows)), \
+                        self.apply_breaker.guard():
                     chaos.maybe_inject("foldin.apply")
                     result = self.applier.apply(rows, staleness)
                 with self._lock:
@@ -373,7 +397,49 @@ def build_foldin_app(worker: FoldInWorker) -> HttpApp:
 
     @app.route("GET", r"/metrics\.json")
     def metrics(req: Request):
-        return 200, worker.snapshot()
+        out = worker.snapshot()
+        out["spans"] = worker.tracer.snapshot()
+        if worker.recorder is not None:
+            out["exemplars"] = worker.recorder.exemplars()
+        return 200, out
+
+    @app.route("GET", r"/metrics")
+    def metrics_prometheus(req: Request):
+        """Prometheus twin of /metrics.json through the shared renderer:
+        the freshness SLO gauges (staleness_seconds, queue depth) become
+        scrapeable — not just doctor-visible — plus the cycle-stage span
+        summaries, all under `surface="folder"`."""
+        from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.tracing import (
+            PROMETHEUS_CONTENT_TYPE, prometheus_text,
+        )
+
+        snap = worker.snapshot()
+        return 200, RawResponse(
+            prometheus_text(
+                worker.tracer.snapshot(),
+                {"staleness_seconds": snap["stalenessSeconds"],
+                 "staleness_budget_seconds":
+                     snap["stalenessBudgetSeconds"],
+                 "foldin_queue_depth": float(snap["queueDepth"]),
+                 "foldin_folded_total": float(snap["foldedTotal"]),
+                 "foldin_applied_batches_total":
+                     float(snap["appliedBatches"]),
+                 "foldin_failures_total": float(snap["failures"]),
+                 "uptime_seconds":
+                     (utcnow() - worker.start_time).total_seconds()},
+                labels={"surface": "folder"}),
+            PROMETHEUS_CONTENT_TYPE)
+
+    # distributed tracing (pio_tpu/obs/): per-cycle traces fetchable
+    # from the folder's own surface (FoldInConfig.server_key guards)
+    from pio_tpu.obs.http import install_trace_routes
+    from pio_tpu.server.http import server_key_ok
+
+    app.tracer = worker.tracer
+    install_trace_routes(
+        app, worker.recorder,
+        lambda req: server_key_ok(req, worker.config.server_key))
 
     return app
 

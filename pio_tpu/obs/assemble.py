@@ -1,0 +1,165 @@
+"""Cross-process trace assembly: collect span records from every
+surface, merge them into one tree, and render it with per-hop self-time.
+
+``pio trace <id>`` drives ``collect_trace`` → ``build_tree`` →
+``render_tree``; ``pio top`` drives ``collect_span_tables`` →
+``render_span_table``. Surfaces are addressed by base URL; given the
+fleet router's URL, its ``/fleet.json`` is used to discover every shard
+replica automatically, so the operator needs one address for the whole
+fleet.
+"""
+
+from __future__ import annotations
+
+from pio_tpu.obs.recorder import SpanRecord
+from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+
+def discover_fleet_urls(router_url: str, timeout: float = 5.0) -> list[str]:
+    """router URL -> [router URL, every shard replica URL] (best-effort:
+    an unreachable router just yields itself, and `pio trace` reports
+    the miss per surface)."""
+    urls = [router_url.rstrip("/")]
+    try:
+        fleet = JsonHttpClient(router_url, timeout=timeout).request(
+            "GET", "/fleet.json")
+    except HttpClientError:
+        return urls
+    for group in (fleet.get("shards") or {}).values():
+        for rep in group.get("replicas", ()):
+            url = (rep.get("url") or "").rstrip("/")
+            if url and url not in urls:
+                urls.append(url)
+    return urls
+
+
+def collect_trace(urls: list[str], trace_id: str, server_key: str = "",
+                  timeout: float = 5.0
+                  ) -> tuple[list[SpanRecord], dict[str, str]]:
+    """Fetch `/debug/traces.json?traceId=` from every surface ->
+    (merged span records, {url: why} for surfaces that had nothing)."""
+    spans: list[SpanRecord] = []
+    seen: set[str] = set()
+    misses: dict[str, str] = {}
+    params = {"traceId": trace_id}
+    if server_key:
+        params["accessKey"] = server_key
+    for url in urls:
+        try:
+            out = JsonHttpClient(url, timeout=timeout).request(
+                "GET", "/debug/traces.json", params=params)
+        except HttpClientError as e:
+            misses[url] = e.message if e.status == 404 else str(e)
+            continue
+        for d in (out or {}).get("spans", ()):
+            rec = SpanRecord.from_dict(d)
+            if rec.span_id in seen:
+                continue    # replicas sharing a process, repeat polls
+            seen.add(rec.span_id)
+            spans.append(rec)
+    return spans, misses
+
+
+def build_tree(spans: list[SpanRecord]) -> list[dict]:
+    """Span records -> root nodes, each ``{"span", "children",
+    "self_s"}``. Parentage follows ``parent_id``; spans whose parent was
+    not collected (an unreachable surface, a never-sampled hop) become
+    roots so nothing silently disappears. ``self_s`` is the per-hop
+    self-time: the span's duration minus its direct children's — where
+    the time actually went, not just where it passed through."""
+    nodes = {s.span_id: {"span": s, "children": [], "self_s": s.duration_s}
+             for s in spans}
+    roots = []
+    for s in sorted(spans, key=lambda r: r.start_s):
+        node = nodes[s.span_id]
+        parent = nodes.get(s.parent_id) if s.parent_id else None
+        if parent is None:
+            roots.append(node)
+        else:
+            parent["children"].append(node)
+            parent["self_s"] = max(0.0, parent["self_s"] - s.duration_s)
+    return roots
+
+
+def _render_node(node: dict, prefix: str, is_last: bool,
+                 lines: list[str]) -> None:
+    s: SpanRecord = node["span"]
+    branch = "" if prefix == "" and is_last is None else (
+        "└─ " if is_last else "├─ ")
+    flags = ""
+    if s.status == "error":
+        flags = " ERROR" + (f" ({s.error})" if s.error else "")
+    labels = " ".join(
+        f"{k}={v}" for k, v in sorted(s.labels.items())
+        if k not in ("method", "path", "status"))
+    lines.append(
+        f"{prefix}{branch}{s.name} [{s.surface}] "
+        f"{s.duration_s * 1e3:.2f}ms (self {node['self_s'] * 1e3:.2f}ms)"
+        + (f" {labels}" if labels else "") + flags)
+    child_prefix = prefix + ("" if is_last is None else
+                             ("   " if is_last else "│  "))
+    kids = sorted(node["children"], key=lambda n: n["span"].start_s)
+    for i, child in enumerate(kids):
+        _render_node(child, child_prefix, i == len(kids) - 1, lines)
+
+
+def render_tree(trace_id: str, spans: list[SpanRecord],
+                misses: dict[str, str] | None = None) -> str:
+    if not spans:
+        return (f"trace {trace_id}: no spans found"
+                + _render_misses(misses))
+    roots = build_tree(spans)
+    surfaces = sorted({s.surface for s in spans})
+    duration = max(s.duration_s for s in spans)
+    status = ("error" if any(s.status == "error" for s in spans)
+              else "ok")
+    lines = [f"trace {trace_id}  status={status}  "
+             f"{duration * 1e3:.2f}ms  {len(spans)} spans over "
+             f"{len(surfaces)} surface(s): {', '.join(surfaces)}"]
+    for root in roots:
+        _render_node(root, "", None, lines)
+    return "\n".join(lines) + _render_misses(misses)
+
+
+def _render_misses(misses: dict[str, str] | None) -> str:
+    if not misses:
+        return ""
+    return "\n" + "\n".join(
+        f"  (no spans from {url}: {why})" for url, why in misses.items())
+
+
+def collect_span_tables(urls: list[str], server_key: str = "",
+                        timeout: float = 5.0
+                        ) -> tuple[list[dict], dict[str, str]]:
+    rows: list[dict] = []
+    errors: dict[str, str] = {}
+    params = {"accessKey": server_key} if server_key else None
+    for url in urls:
+        try:
+            out = JsonHttpClient(url, timeout=timeout).request(
+                "GET", "/debug/spans.json", params=params)
+        except HttpClientError as e:
+            errors[url] = str(e)
+            continue
+        rows.extend((out or {}).get("spans", ()))
+    return rows, errors
+
+
+def render_span_table(rows: list[dict],
+                      errors: dict[str, str] | None = None) -> str:
+    header = (f"{'SURFACE':<12} {'SPAN':<28} {'ARM':<9} "
+              f"{'RATE/S':>8} {'P50 MS':>9} {'P99 MS':>9} {'ERR%':>6}")
+    lines = [header]
+    for r in sorted(rows, key=lambda r: (-r.get("ratePerSec", 0.0),
+                                         r.get("surface", ""),
+                                         r.get("span", ""))):
+        lines.append(
+            f"{r.get('surface', '?'):<12} {r.get('span', '?')[:28]:<28} "
+            f"{r.get('arm', 'active'):<9} {r.get('ratePerSec', 0):>8.2f} "
+            f"{r.get('p50Ms', 0):>9.2f} {r.get('p99Ms', 0):>9.2f} "
+            f"{r.get('errorPct', 0):>6.2f}")
+    if len(lines) == 1:
+        lines.append("(no spans in the recent window)")
+    for url, why in (errors or {}).items():
+        lines.append(f"  (no span table from {url}: {why})")
+    return "\n".join(lines)

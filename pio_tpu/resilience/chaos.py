@@ -50,12 +50,23 @@ ENV_VAR = "PIO_TPU_CHAOS"
 
 
 class ChaosError(ConnectionError):
-    """Injected storage/transport failure."""
+    """Injected storage/transport failure. Carries the injection
+    ``point`` so failed trace spans can be labeled ``chaos=<point>``
+    (pio_tpu/obs/recorder.py chaos_point_of walks the cause chain)."""
+
+    def __init__(self, message: str, point: str | None = None):
+        super().__init__(message)
+        self.point = point
 
 
 class ChaosReset(ConnectionResetError):
     """Injected connection reset (ConnectionResetError -> ConnectionError
-    subclass, like a peer RST mid-call)."""
+    subclass, like a peer RST mid-call). Carries ``point`` like
+    ChaosError."""
+
+    def __init__(self, message: str, point: str | None = None):
+        super().__init__(message)
+        self.point = point
 
 
 @dataclass(frozen=True)
@@ -136,10 +147,12 @@ class ChaosMonkey:
                 roll = self._rng.random()
                 if roll < spec.error:
                     self._count(point, "error")
-                    raise ChaosError(f"chaos: injected failure at {point}")
+                    raise ChaosError(
+                        f"chaos: injected failure at {point}", point)
                 if roll < spec.error + spec.reset:
                     self._count(point, "reset")
-                    raise ChaosReset(f"chaos: connection reset at {point}")
+                    raise ChaosReset(
+                        f"chaos: connection reset at {point}", point)
                 if roll < spec.error + spec.reset + spec.slow:
                     self._count(point, "slow")
                     stall = max(stall, spec.slow_s)

@@ -98,6 +98,15 @@ def build_event_app(
 
     app = HttpApp("eventserver")
     app.stats = stats  # exposed for tests/ops
+    # distributed tracing (pio_tpu/obs/): the ingest edge joins client
+    # traces (traceparent) and the `request` histogram feeds /metrics;
+    # /debug routes installed at the bottom of this builder
+    from pio_tpu.obs import make_recorder
+    from pio_tpu.utils.tracing import Tracer
+
+    recorder = make_recorder("eventserver")
+    tracer = Tracer(recorder=recorder)
+    app.tracer = tracer
     # degraded-mode buffer: events that could not reach the store park
     # here and drain in the background (resilience/spill.py)
     spill = (SpillQueue(events_dao.insert, config.spill_capacity,
@@ -585,34 +594,48 @@ def build_event_app(
 
     @app.route("GET", r"/metrics")
     def get_metrics(req: Request):
-        """Prometheus text exposition of lifetime ingest counters
-        (monotonic, unlike /stats.json's hourly windows). Requires
-        --stats AND a configured metrics key: the counters span every
-        app, so /stats.json's per-app accessKey gate cannot apply, and
-        an open endpoint would leak tenant app ids + event vocabulary
-        to any ingest client."""
-        if not (config.stats and config.metrics_key):
+        """Prometheus text exposition through the SHARED renderer
+        (uniform `surface` label, docs/observability.md): request-span
+        summaries always, plus the lifetime ingest counters when
+        --stats is on (monotonic, unlike /stats.json's hourly windows).
+        Requires a configured metrics key: the counters span every app,
+        so /stats.json's per-app accessKey gate cannot apply, and an
+        open endpoint would leak tenant app ids + event vocabulary to
+        any ingest client."""
+        if not config.metrics_key:
             return 404, {
                 "message": "To see metrics, launch Event Server with "
-                           "--stats and --metrics-key"
+                           "--metrics-key (and --stats for ingest "
+                           "counters)"
             }
         if req.params.get("accessKey", "") != config.metrics_key:
             return 401, {"message": "Invalid accessKey."}
         from pio_tpu.server.http import RawResponse
         from pio_tpu.utils.tracing import (
             PROMETHEUS_CONTENT_TYPE, prometheus_labeled_counter,
+            prometheus_text,
         )
 
-        rows = [
-            ({"app_id": k.app_id, "event": k.event,
-              "entity_type": k.entity_type, "status": k.status}, float(n))
-            for k, n in sorted(stats.totals().items(),
-                               key=lambda kv: (kv[0].app_id, kv[0].event,
-                                               kv[0].status))
-        ]
-        lines = prometheus_labeled_counter("events_ingested_total", rows)
-        return 200, RawResponse("\n".join(lines) + "\n",
-                                PROMETHEUS_CONTENT_TYPE)
+        counters = {}
+        if spill is not None:
+            s = spill.snapshot()
+            counters["spill_queue_depth"] = float(s["size"])
+        text = prometheus_text(tracer.snapshot(), counters,
+                               labels={"surface": "eventserver"})
+        if config.stats:
+            rows = [
+                ({"surface": "eventserver", "app_id": k.app_id,
+                  "event": k.event, "entity_type": k.entity_type,
+                  "status": k.status}, float(n))
+                for k, n in sorted(stats.totals().items(),
+                                   key=lambda kv: (kv[0].app_id,
+                                                   kv[0].event,
+                                                   kv[0].status))
+            ]
+            lines = prometheus_labeled_counter("events_ingested_total",
+                                               rows)
+            text += "\n".join(lines) + "\n"
+        return 200, RawResponse(text, PROMETHEUS_CONTENT_TYPE)
 
     # -- webhooks (reference api/Webhooks.scala:44-151) ---------------------
     @app.route("POST", r"/webhooks/([^/]+)\.json")
@@ -676,6 +699,19 @@ def build_event_app(
         return checks
 
     install_health_routes(app, readiness)
+
+    # distributed tracing (pio_tpu/obs/): the event server faces
+    # untrusted ingest clients and trace records carry request paths +
+    # timing, so the /debug routes REQUIRE the metrics key (401 until
+    # --metrics-key is configured) — stricter than the other surfaces'
+    # optional server_key by design. The traced edge itself (trace ids
+    # on every ingest request) costs nothing to expose.
+    from pio_tpu.obs.http import install_trace_routes
+
+    install_trace_routes(
+        app, recorder,
+        lambda req: bool(config.metrics_key)
+        and req.params.get("accessKey", "") == config.metrics_key)
 
     return app
 

@@ -27,6 +27,8 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
+from pio_tpu.obs import context as _tracectx
+from pio_tpu.obs.recorder import SpanRecord as _SpanRecord
 from pio_tpu.resilience.policies import LoadShedder, RetryPolicy
 
 log = logging.getLogger("pio_tpu.http")
@@ -140,7 +142,7 @@ class HttpApp:
         return 404, {"message": "Not Found"}
 
 
-def dispatch_safe(app: HttpApp, req: Request) -> tuple[int, Any]:
+def _dispatch_plain(app: HttpApp, req: Request) -> tuple[int, Any]:
     """Dispatch with the error policy both transports share."""
     try:
         return app.dispatch(req)
@@ -148,6 +150,80 @@ def dispatch_safe(app: HttpApp, req: Request) -> tuple[int, Any]:
         return 400, {"message": "Invalid JSON body"}
     except Exception as e:  # noqa: BLE001 - last-resort 500
         return 500, {"message": f"{type(e).__name__}: {e}"}
+
+
+def dispatch_safe(app: HttpApp, req: Request) -> tuple[int, Any]:
+    """Dispatch with the shared error policy — and, on surfaces that
+    installed a TraceRecorder (``app.recorder``, set by
+    obs/http.py install_trace_routes), the DISTRIBUTED TRACING EDGE:
+
+      * the inbound ``traceparent`` header joins the caller's trace (a
+        missing/malformed header starts a fresh one), activated for the
+        handler's dynamic extent so every ``Tracer.span`` and outbound
+        ``JsonHttpClient`` call underneath parents correctly;
+      * the whole request becomes the surface-local edge span
+        (status=error on 5xx), the per-surface ``request`` histogram is
+        fed (``app.tracer``), and tail-based retention runs;
+      * a client that sent ``X-Pio-Trace: 1`` gets the trace id echoed
+        back as ``X-Pio-Trace-Id`` and the trace pinned on every
+        surface it crossed (the pin rides the traceparent flags).
+
+    Health probes, metrics scrapes, the /debug read surfaces, and the
+    prober's /shard/info poll stay untraced (UNTRACED_PATHS) — their
+    fixed cadence would only churn the recorders they serve.
+    """
+    recorder = getattr(app, "recorder", None)
+    if recorder is None or req.path in UNTRACED_PATHS:
+        return _dispatch_plain(app, req)
+    ctx = _tracectx.parse_traceparent(
+        req.header(_tracectx.TRACEPARENT_HEADER))
+    echo = bool(req.header(_tracectx.TRACE_ECHO_REQUEST_HEADER))
+    if ctx is None:
+        ctx = _tracectx.new_trace(pinned=echo)
+    elif echo and not ctx.pinned:
+        import dataclasses
+
+        ctx = dataclasses.replace(ctx, pinned=True)
+    t0 = time.monotonic()
+    # pio: lint-ok[bench-clock] span start is wall-clock on purpose: it
+    # orders spans across processes in the merged tree; duration rides
+    # the monotonic clock
+    t0_wall = time.time()
+    with _tracectx.use(ctx, recorder):
+        status, payload = _dispatch_plain(app, req)
+    dt = time.monotonic() - t0
+    tracer = getattr(app, "tracer", None)
+    if tracer is not None:
+        tracer.record("request", dt)
+    error = None
+    if status >= 500 and isinstance(payload, dict):
+        error = str(payload.get("message", ""))[:200] or None
+    recorder.record(_SpanRecord(
+        trace_id=ctx.trace_id, span_id=ctx.span_id,
+        parent_id=ctx.parent_id, name=f"{req.method} {req.path}",
+        surface=recorder.surface, start_s=t0_wall, duration_s=dt,
+        status="error" if status >= 500 else "ok", error=error,
+        labels={"method": req.method, "path": req.path,
+                "status": str(status)}))
+    recorder.finish_trace(ctx.trace_id, pinned=ctx.pinned)
+    if echo:
+        payload = _with_header(
+            payload, _tracectx.TRACE_ECHO_RESPONSE_HEADER, ctx.trace_id)
+    return status, payload
+
+
+def _with_header(payload: Any, name: str, value: str) -> "RawResponse":
+    """Attach one response header to any handler payload shape (the
+    trace-id echo): RawResponse gains the header on a copy; plain
+    payloads are pre-encoded into one."""
+    if isinstance(payload, RawResponse):
+        return RawResponse(payload.body, payload.content_type,
+                           {**(payload.headers or {}), name: value})
+    if isinstance(payload, (bytes, str)):
+        return RawResponse(payload, "text/html; charset=utf-8",
+                           {name: value})
+    return RawResponse(json.dumps(payload).encode("utf-8"),
+                       "application/json; charset=utf-8", {name: value})
 
 
 @dataclass
@@ -297,6 +373,19 @@ _MAX_BODY = 64 * 1024 * 1024
 # resilience/health.py, which imports this constant): the async
 # transport special-cases them — no shedding, no worker pool
 HEALTH_PATHS = ("/healthz", "/readyz")
+
+# paths the tracing edge skips (dispatch_safe): health probes, the
+# observability READ surfaces themselves, and the router prober's
+# /shard/info poll. All of these are polled on a fixed cadence
+# (Prometheus scrape, `pio top --watch`, the replica prober), so
+# tracing them would let the pollers churn the recorders they read —
+# on a low-traffic surface, scrape traces would fill the slowest-N
+# retention and dominate the span table, evicting real query traces.
+UNTRACED_PATHS = HEALTH_PATHS + (
+    "/metrics", "/metrics.json",
+    "/debug/traces.json", "/debug/spans.json",
+    "/shard/info",
+)
 
 
 class AsyncHttpServer:

@@ -243,10 +243,17 @@ def build_storage_app(
 ) -> HttpApp:
     from pio_tpu.utils.tracing import Tracer
 
+    from pio_tpu.obs import make_recorder
+
     storage = storage or get_storage()
     config = config or StorageServerConfig()
     app = HttpApp("storage")
-    tracer = Tracer()   # span per family.method: cardinality is bounded
+    # span per family.method: cardinality is bounded. With tracing on,
+    # each RPC span joins the CALLER's trace (the remote backend's
+    # JsonHttpClient carries traceparent), so a slow serving request
+    # shows its storage hops in `pio trace`
+    recorder = make_recorder("storage")
+    tracer = Tracer(recorder=recorder)
     app.tracer = tracer  # exposed for tests / embedding processes
 
     @app.route("GET", r"/health")
@@ -269,15 +276,26 @@ def build_storage_app(
         """Prometheus text exposition of per-RPC latency summaries —
         the storage server is the multi-host hub, so its scrape surface
         matters most under load. Span names come from the fixed method
-        table (never client data): no escaping or cardinality concerns."""
+        table (never client data): no escaping or cardinality concerns.
+        Served through the shared renderer under the uniform metric
+        name + `surface="storage"` label (docs/observability.md; the
+        pre-PR-9 `pio_storage_` prefix is replaced by the label)."""
         from pio_tpu.server.http import RawResponse
         from pio_tpu.utils.tracing import (
             PROMETHEUS_CONTENT_TYPE, prometheus_text,
         )
 
         return 200, RawResponse(
-            prometheus_text(tracer.snapshot(), {}, prefix="pio_storage"),
+            prometheus_text(tracer.snapshot(), {},
+                            labels={"surface": "storage"}),
             PROMETHEUS_CONTENT_TYPE)
+
+    @app.route("GET", r"/metrics\.json")
+    def metrics_json(req: Request):
+        out = {"spans": tracer.snapshot()}
+        if recorder is not None:
+            out["exemplars"] = recorder.exemplars()
+        return 200, out
 
     @app.route("POST", r"/rpc")
     def rpc(req: Request):
@@ -307,6 +325,14 @@ def build_storage_app(
             return 400, {"message": f"{type(e).__name__}: {e}",
                          "error": type(e).__name__}
         return 200, {"result": result}
+
+    # distributed tracing (pio_tpu/obs/): /debug routes + traced edge,
+    # guarded by the server key like /rpc itself
+    from pio_tpu.obs.http import install_trace_routes
+    from pio_tpu.server.http import server_key_ok
+
+    install_trace_routes(app, recorder,
+                         lambda req: server_key_ok(req, config.server_key))
 
     return app
 

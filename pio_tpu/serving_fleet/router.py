@@ -119,7 +119,13 @@ class FleetRouter:
         self.config = config
         self.plan = plan
         self.start_time = utcnow()
-        self.tracer = Tracer()
+        # distributed tracing (pio_tpu/obs/): the router is where a
+        # fleet trace fans out, so its recorder holds the hop spans
+        # (`shard.rpc`) that stitch the per-shard trees together
+        from pio_tpu.obs import make_recorder
+
+        self.recorder = make_recorder("router")
+        self.tracer = Tracer(recorder=self.recorder)
         self._lock = threading.RLock()
         self._stop_requested = threading.Event()
         self.degraded_count = 0
@@ -177,7 +183,18 @@ class FleetRouter:
     def _call(self, shard: int, op: str, path: str, body) -> dict:
         """One shard-group RPC: replicas in preference order, per-replica
         breaker guard, transient failures roll to the next replica.
-        Raises ShardUnavailable when the whole group is down."""
+        Raises ShardUnavailable when the whole group is down. The whole
+        group attempt is one `shard.rpc` trace span (labels shard/op/
+        arm); a whole-group failure — including an injected
+        fleet.shard<i>.<op> chaos fault — records as a FAILED span
+        tagged with the chaos point, so `pio trace` shows exactly which
+        hop a drill (or real outage) took down."""
+        arm = (body.get("arm", ARM_ACTIVE) if isinstance(body, dict)
+               else ARM_ACTIVE)
+        with self.tracer.span("shard.rpc", shard=shard, op=op, arm=arm):
+            return self._call_group(shard, op, path, body)
+
+    def _call_group(self, shard: int, op: str, path: str, body) -> dict:
         Deadline.check(f"shard {shard} {op}")
         try:
             # drill point: a spec targeting fleet.shard<i> takes that
@@ -862,12 +879,38 @@ def build_router_app(router: FleetRouter) -> HttpApp:
     def metrics(req: Request):
         with router._lock:
             degraded, rerouted = router.degraded_count, router.rerouted_count
-        return 200, {
+        out = {
             "startTime": format_time(router.start_time),
             "spans": router.tracer.snapshot(),
             "degradedResponses": degraded,
             "reroutedCalls": rerouted,
         }
+        if router.recorder is not None:
+            # slow-trace exemplars: each span's slowest recent trace id,
+            # fetchable with `pio trace <id>` for the full fan-out tree
+            out["exemplars"] = router.recorder.exemplars()
+        return 200, out
+
+    @app.route("GET", r"/metrics")
+    def metrics_prometheus(req: Request):
+        """Prometheus twin of /metrics.json through the shared renderer
+        (uniform `surface` label — docs/observability.md)."""
+        from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.tracing import (
+            PROMETHEUS_CONTENT_TYPE, prometheus_text,
+        )
+
+        with router._lock:
+            degraded, rerouted = router.degraded_count, router.rerouted_count
+        return 200, RawResponse(
+            prometheus_text(
+                router.tracer.snapshot(),
+                {"degraded_responses_total": float(degraded),
+                 "rerouted_calls_total": float(rerouted),
+                 "uptime_seconds":
+                     (utcnow() - router.start_time).total_seconds()},
+                labels={"surface": "router"}),
+            PROMETHEUS_CONTENT_TYPE)
 
     @app.route("POST", r"/reload")
     @app.route("GET", r"/reload")  # deprecated alias (docs/serving.md:
@@ -921,6 +964,11 @@ def build_router_app(router: FleetRouter) -> HttpApp:
         return checks
 
     install_health_routes(app, readiness)
+    # distributed tracing (pio_tpu/obs/): /debug routes + traced edge
+    from pio_tpu.obs.http import install_trace_routes
+
+    app.tracer = router.tracer
+    install_trace_routes(app, router.recorder, check_server_key)
     # guarded rollout verbs (pio_tpu/rollout/): same surface as the
     # single-host server, so `pio deploy --canary` / `pio promote` /
     # `pio rollback` speak to either

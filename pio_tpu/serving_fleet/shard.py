@@ -116,6 +116,15 @@ class ShardServer:
         self.storage = storage
         self.config = config
         self.start_time = utcnow()
+        # distributed tracing (pio_tpu/obs/): shard-local model spans
+        # (user_row/topk/item_rows) join the router's trace via the
+        # traceparent the RPC carried; the surface name carries the
+        # shard index so the merged tree shows WHICH process served
+        from pio_tpu.obs import make_recorder
+        from pio_tpu.utils.tracing import Tracer
+
+        self.recorder = make_recorder(f"shard{config.shard_index}")
+        self.tracer = Tracer(recorder=self.recorder)
         self._lock = threading.RLock()
         self._load_lock = threading.Lock()
         self._stop_requested = threading.Event()
@@ -311,16 +320,24 @@ class ShardServer:
 
     # -- RPC bodies ---------------------------------------------------------
     def user_row(self, user, arm: str = "active") -> list[float] | None:
-        part, _, row_of, _ = self._arm(arm)
-        row = row_of.get(user)
-        if row is None:
-            return None
-        return [float(x) for x in part.user_rows[row]]
+        with self.tracer.span("user_row",
+                              shard=self.config.shard_index, arm=arm):
+            part, _, row_of, _ = self._arm(arm)
+            row = row_of.get(user)
+            if row is None:
+                return None
+            return [float(x) for x in part.user_rows[row]]
 
     def topk(self, row: list[float], k: int, arm: str = "active") -> dict:
         """Partial top-k of the query user's row against this shard's
         item slice — same kernel as the single-host path, so the per-item
-        scores are bit-identical and the router's merge is exact."""
+        scores are bit-identical and the router's merge is exact. The
+        `topk` span IS this shard's model span in the merged trace."""
+        with self.tracer.span("topk",
+                              shard=self.config.shard_index, arm=arm):
+            return self._topk(row, k, arm)
+
+    def _topk(self, row: list[float], k: int, arm: str) -> dict:
         from pio_tpu.ops import als
 
         part, item_dev, _, _ = self._arm(arm)
@@ -346,11 +363,13 @@ class ShardServer:
         shapes the single-host oracle uses: per-pair scores computed
         shard-side in smaller batches drift by an ULP (XLA's einsum
         lowering is shape-sensitive), which would break bit-parity."""
-        part, _, _, local_of = self._arm(arm)
-        owned = [(it, local_of[it]) for it in items if it in local_of]
-        return {"rows": {
-            it: [float(x) for x in part.item_rows[i]] for it, i in owned
-        }}
+        with self.tracer.span("item_rows",
+                              shard=self.config.shard_index, arm=arm):
+            part, _, _, local_of = self._arm(arm)
+            owned = [(it, local_of[it]) for it in items if it in local_of]
+            return {"rows": {
+                it: [float(x) for x in part.item_rows[i]] for it, i in owned
+            }}
 
     def upsert_user_rows(self, rows: dict,
                          staleness_s: float | None = None) -> dict:
@@ -541,6 +560,42 @@ def build_shard_app(server: ShardServer) -> HttpApp:
     def shard_info(req: Request):
         return 200, server.info()
 
+    @app.route("GET", r"/metrics\.json")
+    def metrics_json(req: Request):
+        out = {
+            "startTime": format_time(server.start_time),
+            "spans": server.tracer.snapshot(),
+            "shardIndex": config.shard_index,
+            "foldin": server.foldin_status(),
+        }
+        if server.recorder is not None:
+            out["exemplars"] = server.recorder.exemplars()
+        return 200, out
+
+    @app.route("GET", r"/metrics")
+    def metrics_prometheus(req: Request):
+        """Prometheus exposition through the shared renderer with the
+        uniform label set: `surface="shard", shard="<i>"` on every
+        sample (docs/observability.md)."""
+        from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.tracing import (
+            PROMETHEUS_CONTENT_TYPE, prometheus_text,
+        )
+
+        with server._lock:
+            part = server.partition
+            applied = server.foldin_applied_users
+        return 200, RawResponse(
+            prometheus_text(
+                server.tracer.snapshot(),
+                {"partition_bytes": float(part.nbytes() if part else 0),
+                 "foldin_applied_users_total": float(applied),
+                 "uptime_seconds":
+                     (utcnow() - server.start_time).total_seconds()},
+                labels={"surface": "shard",
+                        "shard": str(config.shard_index)}),
+            PROMETHEUS_CONTENT_TYPE)
+
     def _arm_of(body: dict):
         """The arm a scoring RPC rides ({"arm": "candidate"} during a
         guarded rollout; absent = active). Returns (arm, error)."""
@@ -707,6 +762,12 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         return checks
 
     install_health_routes(app, readiness)
+    # distributed tracing (pio_tpu/obs/): /debug routes + traced edge,
+    # so shard-local spans are fetchable by `pio trace` per process
+    from pio_tpu.obs.http import install_trace_routes
+
+    app.tracer = server.tracer
+    install_trace_routes(app, server.recorder, check_server_key)
     return app
 
 

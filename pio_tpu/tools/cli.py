@@ -22,7 +22,6 @@ import importlib
 import json
 import os
 import sys
-import urllib.request
 
 from pio_tpu import __version__
 from pio_tpu.data.dao import AccessKey, Channel
@@ -929,6 +928,73 @@ def cmd_rollback(args) -> int:
                          {"reason": args.reason or "operator rollback"})
 
 
+def _obs_urls(args) -> list[str]:
+    """The surfaces `pio trace` / `pio top` poll: explicit --url flags,
+    plus (given --router-url) the router AND every shard replica it
+    knows from /fleet.json — one address covers the whole fleet."""
+    from pio_tpu.obs.assemble import discover_fleet_urls
+
+    urls = [u.rstrip("/") for u in (args.url or [])]
+    if args.router_url:
+        for u in discover_fleet_urls(args.router_url,
+                                     timeout=args.timeout):
+            if u not in urls:
+                urls.append(u)
+    if not urls:
+        urls = [f"http://127.0.0.1:{args.port}"]
+    return urls
+
+
+def cmd_trace(args) -> int:
+    """`pio trace <trace_id>` — collect span records from every surface
+    (router, its shard replicas, serving, storage, folder) and print the
+    MERGED span tree with per-hop self-time (docs/observability.md).
+    Get a trace id from a response's X-Pio-Trace-Id echo header (send
+    `X-Pio-Trace: 1`), from /metrics.json exemplars, or from a
+    surface's /debug/traces.json listing."""
+    from pio_tpu.obs.assemble import collect_trace, render_tree
+
+    urls = _obs_urls(args)
+    spans, misses = collect_trace(urls, args.trace_id,
+                                  server_key=args.server_key or "",
+                                  timeout=args.timeout)
+    if args.json:
+        print(json.dumps({
+            "traceId": args.trace_id,
+            "spans": [s.to_dict() for s in spans],
+            "misses": misses,
+        }, indent=2))
+        return 0 if spans else 1
+    print(render_tree(args.trace_id, spans, misses))
+    return 0 if spans else 1
+
+
+def cmd_top(args) -> int:
+    """`pio top` — the live span table across surfaces: rate, p50, p99,
+    error% per span per arm over each recorder's recent window. One
+    shot by default; --watch N refreshes every N seconds."""
+    import time as _time
+
+    from pio_tpu.obs.assemble import collect_span_tables, render_span_table
+
+    urls = _obs_urls(args)
+    while True:
+        rows, errors = collect_span_tables(
+            urls, server_key=args.server_key or "", timeout=args.timeout)
+        if args.json:
+            print(json.dumps({"spans": rows, "errors": errors}))
+        else:
+            print(render_span_table(rows, errors))
+        if not args.watch:
+            return 0 if rows or not errors else 1
+        try:
+            _time.sleep(args.watch)
+            if not args.json:
+                print()
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_foldin(args) -> int:
     """`pio foldin` — the streaming fold-in worker (docs/freshness.md):
     tail the event stream, solve refreshed user rows against the
@@ -969,6 +1035,7 @@ def cmd_foldin(args) -> int:
     state_path = args.state_path or os.path.join(
         os.path.expanduser(os.environ.get("PIO_TPU_HOME", "~/.pio_tpu")),
         "foldin", f"{engine_id}-{engine_variant}.cursor")
+    key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
     config = FoldInConfig(
         app_name=app_name,
         channel_name=getattr(ds, "channel_name", None),
@@ -984,8 +1051,11 @@ def cmd_foldin(args) -> int:
         max_batch_users=args.max_batch_users,
         staleness_budget_s=args.staleness_budget,
         ip=args.ip, port=args.port,
+        # the same key that authenticates the applies guards the
+        # folder's own /debug trace routes (traces carry request paths
+        # + user-batch timing)
+        server_key=key,
     )
-    key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
     if args.router_url:
         applier = RouterFleetApplier(args.router_url, key)
         target = args.router_url
@@ -1063,15 +1133,17 @@ def cmd_batchpredict(args) -> int:
 
 
 def cmd_undeploy(args) -> int:
-    """POST /stop to a running deploy server (reference Console.undeploy)."""
-    url = f"http://{args.ip}:{args.port}/stop"
+    """POST /stop to a running deploy server (reference Console.undeploy).
+    Rides utils/httpclient like every other outbound call (the obs:
+    raw-http contract — raw urllib would drop trace/deadline context)."""
+    from pio_tpu.utils.httpclient import JsonHttpClient
+
     key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
-    if key:
-        url += f"?accessKey={key}"
     try:
-        req = urllib.request.Request(url, data=b"", method="POST")
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            print(resp.read().decode())
+        out = JsonHttpClient(f"http://{args.ip}:{args.port}",
+                             timeout=10).request(
+            "POST", "/stop", params={"accessKey": key} if key else None)
+        print(json.dumps(out) if out is not None else "")
         return 0
     except Exception as e:  # noqa: BLE001
         return _fail(f"undeploy failed: {e}")
@@ -1599,6 +1671,44 @@ def build_parser() -> argparse.ArgumentParser:
             x.add_argument("--reason", default="",
                            help="recorded on the rollout verdict")
         x.set_defaults(fn=fn)
+
+    def obs_args(q):
+        q.add_argument("--url", action="append", default=None,
+                       help="surface base URL to poll (repeatable: "
+                            "serving, event server, storage server, "
+                            "folder, shard)")
+        q.add_argument("--router-url", default="",
+                       help="fleet router base URL; its /fleet.json "
+                            "auto-discovers every shard replica")
+        q.add_argument("--port", type=int, default=8000,
+                       help="default single-host serving port when no "
+                            "--url/--router-url is given")
+        q.add_argument("--server-key", default="",
+                       help="accessKey for the /debug trace routes")
+        q.add_argument("--timeout", type=float, default=5.0)
+        q.add_argument("--json", action="store_true")
+
+    x = sub.add_parser(
+        "trace",
+        help="print one request's merged span tree (router + shards + "
+             "serving/storage/folder) with per-hop self-time",
+    )
+    x.add_argument("trace_id", help="32-hex trace id (from the "
+                                    "X-Pio-Trace-Id echo header, "
+                                    "/metrics.json exemplars, or "
+                                    "/debug/traces.json)")
+    obs_args(x)
+    x.set_defaults(fn=cmd_trace)
+
+    x = sub.add_parser(
+        "top",
+        help="live span table across surfaces: rate/p50/p99/error% per "
+             "span, per arm",
+    )
+    obs_args(x)
+    x.add_argument("--watch", type=float, default=0.0,
+                   help="refresh every N seconds (0 = print once)")
+    x.set_defaults(fn=cmd_top)
 
     x = sub.add_parser(
         "foldin",

@@ -19,8 +19,6 @@ import signal
 import subprocess
 import sys
 import time
-import urllib.error
-import urllib.request
 from dataclasses import dataclass
 
 def default_pid_dir() -> str:
@@ -101,8 +99,10 @@ def _alive(pid: int) -> bool:
 
 def _healthy(service: Service, ip: str, timeout_s: float = 20.0,
              child: subprocess.Popen | None = None) -> bool:
+    from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
     host = "127.0.0.1" if ip in ("0.0.0.0", "") else ip
-    url = f"http://{host}:{service.port}{service.health_path}"
+    client = JsonHttpClient(f"http://{host}:{service.port}", timeout=2)
     deadline = time.monotonic() + timeout_s
     # pio: lint-ok[bare-retry] deadline-paced startup-readiness poll at a
     # fixed cadence, not an I/O retry — backoff/jitter would only delay
@@ -111,12 +111,12 @@ def _healthy(service: Service, ip: str, timeout_s: float = 20.0,
         if child is not None and child.poll() is not None:
             return False  # died at startup: fail now, not after the timeout
         try:
-            with urllib.request.urlopen(url, timeout=2):
-                return True
-        except urllib.error.HTTPError:
-            return True  # listening; 4xx (e.g. auth) still means "up"
-        except (urllib.error.URLError, OSError):
-            time.sleep(0.3)
+            client.request("GET", service.health_path)
+            return True
+        except HttpClientError as e:
+            if e.status:
+                return True  # listening; 4xx (e.g. auth) still means "up"
+            time.sleep(0.3)   # status 0: transport-level, not up yet
     return False
 
 

@@ -2,21 +2,33 @@
 
 One implementation of the urllib dance (TLS-noverify context, JSON bodies,
 error-message extraction, timeout/reset normalization) for every in-repo
-client: the SDK (pio_tpu/sdk.py) and the remote storage backend
-(data/backends/remote.py). All failures surface as HttpClientError with
-`status` (0 = transport-level: unreachable, timeout, reset) and the
-server's message when one exists.
+client: the SDK (pio_tpu/sdk.py), the remote storage backend
+(data/backends/remote.py), the fleet router's shard RPCs, and the
+fold-in appliers. All failures surface as HttpClientError with `status`
+(0 = transport-level: unreachable, timeout, reset) and the server's
+message when one exists.
+
+Being the ONE outbound client is load-bearing for observability: when a
+trace context is active (pio_tpu/obs/context.py), every request injects
+a child ``traceparent`` header — so the receiving surface joins the
+caller's trace — and emits a client span record to the ambient
+TraceRecorder. Raw urllib/http.client calls elsewhere in pio_tpu/ would
+silently drop both trace context and chaos/deadline instrumentation;
+the ``obs:raw-http`` lint rule keeps them out.
 """
 
 from __future__ import annotations
 
 import json
 import ssl
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any
 
+from pio_tpu.obs import context as tracectx
+from pio_tpu.obs.recorder import SpanRecord, error_fields
 from pio_tpu.resilience.chaos import maybe_inject
 
 
@@ -41,7 +53,43 @@ class JsonHttpClient:
 
     def request(self, method: str, path: str, body: Any = None,
                 params: dict | None = None) -> Any:
-        """-> parsed JSON body (None when empty). Raises HttpClientError."""
+        """-> parsed JSON body (None when empty). Raises HttpClientError.
+
+        Under an active trace context the call becomes one client span:
+        a child context rides the outbound ``traceparent`` header (the
+        receiving server parents its own spans under it) and the span
+        record — error status, chaos point label when the failure was
+        injected — lands in the ambient recorder."""
+        ctx = tracectx.current()
+        if ctx is None:
+            return self._request(method, path, body, params, None)
+        child = ctx.child()
+        recorder = tracectx.current_recorder()
+        t0 = time.monotonic()
+        # pio: lint-ok[bench-clock] span start is wall-clock on purpose
+        # (cross-process ordering in the merged tree); duration is
+        # monotonic
+        t0_wall = time.time()
+        status, errmsg = "ok", None
+        labels = {"method": method, "path": path}
+        try:
+            return self._request(method, path, body, params,
+                                 tracectx.format_traceparent(child))
+        except BaseException as e:
+            status = "error"
+            errmsg, labels = error_fields(e, labels)
+            raise
+        finally:
+            if recorder is not None:
+                recorder.record(SpanRecord(
+                    trace_id=ctx.trace_id, span_id=child.span_id,
+                    parent_id=ctx.span_id, name=f"call {path}",
+                    surface=recorder.surface, start_s=t0_wall,
+                    duration_s=time.monotonic() - t0,
+                    status=status, error=errmsg, labels=labels))
+
+    def _request(self, method: str, path: str, body: Any,
+                 params: dict | None, traceparent: str | None) -> Any:
         # chaos point: injected ConnectionError/reset/stall surfaces to
         # callers exactly like a real transport failure (normalized to
         # HttpClientError(status=0) below)
@@ -55,12 +103,16 @@ class JsonHttpClient:
         # clear error instead of a 400/500 round trip
         data = (json.dumps(body, allow_nan=False).encode()
                 if body is not None else None)
+        headers = {"Content-Type": "application/json"}
+        if traceparent is not None:
+            headers[tracectx.TRACEPARENT_HEADER] = traceparent
         req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            url, data=data, method=method, headers=headers,
         )
         try:
             maybe_inject(f"http.{method} {path}")
+            # pio: lint-ok[raw-http] this IS the sanctioned client — the
+            # one place the raw urllib call is allowed to live
             with urllib.request.urlopen(
                 req, timeout=self.timeout, context=self._ctx
             ) as resp:

@@ -21,6 +21,13 @@ import threading
 import time
 from contextlib import contextmanager
 
+# stdlib-only modules, hot-path imported once (a per-span `from ... import`
+# costs ~1us in sys.modules lookups — measurable against the bench smoke
+# tracing-overhead gate)
+from pio_tpu.obs import context as _tracectx
+from pio_tpu.obs.recorder import SpanRecord as _SpanRecord
+from pio_tpu.obs.recorder import error_fields as _error_fields
+
 
 class LatencyHistogram:
     """Bounded-reservoir latency recorder.
@@ -84,11 +91,22 @@ class LatencyHistogram:
 
 
 class Tracer:
-    """Named span histograms for a request pipeline."""
+    """Named span histograms for a request pipeline.
 
-    def __init__(self):
+    With a ``TraceRecorder`` attached (pio_tpu/obs/), every
+    ``span(...)`` entered under an active trace context ALSO emits a
+    span record — a child of the ambient span, with the given labels
+    (``shard=3 arm=candidate ...``), error status on exception, and the
+    chaos injection point when the failure was injected — so the same
+    one-liner that feeds the histograms feeds the distributed span
+    tree. Without a recorder (or outside any trace) the span is exactly
+    the pre-existing histogram-only fast path.
+    """
+
+    def __init__(self, recorder=None):
         self._spans: dict[str, LatencyHistogram] = {}
         self._lock = threading.Lock()
+        self.recorder = recorder          # obs.recorder.TraceRecorder | None
 
     def histogram(self, name: str) -> LatencyHistogram:
         with self._lock:
@@ -98,12 +116,40 @@ class Tracer:
             return h
 
     @contextmanager
-    def span(self, name: str):
+    def span(self, name: str, **labels):
+        recorder = self.recorder
+        ctx = _tracectx.current() if recorder is not None else None
+        if ctx is None:
+            t0 = time.monotonic()
+            try:
+                yield
+            finally:
+                self.histogram(name).record(time.monotonic() - t0)
+            return
+        child = ctx.child()
+        token = _tracectx.push(child)  # nested spans/outbound RPCs parent here
         t0 = time.monotonic()
+        # pio: lint-ok[bench-clock] span start is wall-clock on purpose
+        # (cross-process ordering in the merged tree); duration is
+        # monotonic
+        t0_wall = time.time()
+        status, errmsg = "ok", None
         try:
             yield
+        except BaseException as e:
+            status = "error"
+            errmsg, labels = _error_fields(e, labels)
+            raise
         finally:
-            self.histogram(name).record(time.monotonic() - t0)
+            _tracectx.pop(token)
+            dt = time.monotonic() - t0
+            self.histogram(name).record(dt)
+            recorder.record(_SpanRecord(
+                trace_id=ctx.trace_id, span_id=child.span_id,
+                parent_id=ctx.span_id, name=name,
+                surface=recorder.surface, start_s=t0_wall, duration_s=dt,
+                status=status, error=errmsg,
+                labels={str(k): str(v) for k, v in labels.items()}))
 
     def record(self, name: str, seconds: float) -> None:
         self.histogram(name).record(seconds)
@@ -201,12 +247,22 @@ def prometheus_labeled_counter(
 
 
 def prometheus_text(spans: dict[str, dict], counters: dict[str, float],
-                    prefix: str = "pio") -> str:
+                    prefix: str = "pio",
+                    labels: dict[str, str] | None = None) -> str:
     """Prometheus text exposition of the tracer's span histograms plus
     scalar counters — the scrape surface every monitoring stack expects
     next to the JSON `/metrics.json`. Quantiles map to the summary-type
     convention; `_count` is all-time, quantiles are over the recent
-    window (same semantics as LatencyHistogram.snapshot)."""
+    window (same semantics as LatencyHistogram.snapshot).
+
+    `labels` are rendered into EVERY sample (span summaries AND
+    counters/gauges) — the uniform-plane convention (docs/
+    observability.md): every surface stamps ``surface=...`` (plus
+    ``shard=...`` on shard servers), so one scrape config aggregates the
+    whole topology without per-surface relabeling."""
+    base = "".join(
+        f'{k}="{escape_label_value(str(v))}",'
+        for k, v in (labels or {}).items())
     lines = [f"# TYPE {prefix}_span_latency_seconds summary"]
     for name in sorted(spans):
         h = spans[name]
@@ -217,19 +273,21 @@ def prometheus_text(spans: dict[str, dict], counters: dict[str, float],
             if q in h:
                 lines.append(
                     f'{prefix}_span_latency_seconds'
-                    f'{{span="{esc}",quantile="0.{q[1:]}"}} {h[q]:.6g}')
+                    f'{{{base}span="{esc}",quantile="0.{q[1:]}"}} {h[q]:.6g}')
         lines.append(
-            f'{prefix}_span_latency_seconds_count{{span="{esc}"}} '
+            f'{prefix}_span_latency_seconds_count{{{base}span="{esc}"}} '
             f'{h["count"]}')
         # exact cumulative sum at full precision: .6g on a week-old
         # server quantizes the sum and freezes rate() over it. KeyError
         # on a dict without "total" is deliberate — a silent count*avg
         # fallback would reintroduce exactly that bug
         lines.append(
-            f'{prefix}_span_latency_seconds_sum{{span="{esc}"}} '
+            f'{prefix}_span_latency_seconds_sum{{{base}span="{esc}"}} '
             f'{h["total"]!r}')
+    scalar_labels = f"{{{base[:-1]}}}" if base else ""
     for cname in sorted(counters):
         lines.append(f"# TYPE {prefix}_{cname} "
                      + ("counter" if cname.endswith("_total") else "gauge"))
-        lines.append(f"{prefix}_{cname} {_prom_value(counters[cname])}")
+        lines.append(f"{prefix}_{cname}{scalar_labels} "
+                     f"{_prom_value(counters[cname])}")
     return "\n".join(lines) + "\n"

@@ -157,7 +157,13 @@ class QueryServer:
         self._lock = threading.RLock()
         # per-stage latency histograms (replaces the reference's rolling
         # average, CreateServer.scala:420-422; SURVEY.md §5 real tracing)
-        self.tracer = Tracer()
+        # + distributed span records (pio_tpu/obs/): every span under an
+        # active trace context lands in the recorder, and the HTTP edge
+        # (dispatch_safe) opens that context per request
+        from pio_tpu.obs import make_recorder
+
+        self.recorder = make_recorder("serving")
+        self.tracer = Tracer(recorder=self.recorder)
         self.start_time = utcnow()
         self._stop_requested = threading.Event()
         self._predict_pool = ThreadPoolExecutor(
@@ -536,12 +542,12 @@ class QueryServer:
         # warm-up calls (record=False) must not enter the stage
         # histograms: their compile-heavy spans would pollute dashboard
         # quantiles AND the hedge-arming median (_hedge_timeout)
-        span = tr.span if record else (lambda _n: nullcontext())
+        span = tr.span if record else (lambda _n, **_kw: nullcontext())
         models, algorithms, serving, instance_id = self._arm_snapshot(arm)
         try:
-            with span("supplement"):
+            with span("supplement", arm=arm):
                 supplemented = serving.supplement(q)
-            with span("predict"):
+            with span("predict", arm=arm):
                 if len(algorithms) > 1:
                     # concurrent per-algo predict (the parallelization
                     # the reference left as TODO, CreateServer.scala:516);
@@ -555,7 +561,7 @@ class QueryServer:
                 else:
                     predictions = [
                         algorithms[0].predict(models[0], supplemented)]
-            with span("serve"):
+            with span("serve", arm=arm):
                 prediction = serving.serve(q, predictions)
         except Exception:
             if rollout is not None:
@@ -664,7 +670,7 @@ class QueryServer:
                          t0: float, rollout) -> list:
         tr = self.tracer
         # see query(): warm-up spans stay out of the histograms
-        span = tr.span if record else (lambda _n: nullcontext())
+        span = tr.span if record else (lambda _n, **_kw: nullcontext())
         # per-ARM clock for the rollout stats (t0 stays the whole-batch
         # clock for _postprocess bookkeeping): the arms execute
         # sequentially, so charging candidate observations from the
@@ -691,9 +697,9 @@ class QueryServer:
 
     def _query_batch_body(self, queries, arm, record, t0, arm_t0, rollout,
                           span, models, algorithms, serving, instance_id):
-        with span("supplement"):
+        with span("supplement", arm=arm):
             supplemented = [serving.supplement(q) for q in queries]
-        with span("predict"):
+        with span("predict", arm=arm):
             if len(algorithms) > 1:
                 futures = [
                     self._predict_pool.submit(
@@ -718,7 +724,7 @@ class QueryServer:
                 self.bucket_registry.record(
                     min(1 << (len(queries) - 1).bit_length(),
                         self.config.batch_max))
-        with span("serve"):
+        with span("serve", arm=arm):
             predictions = [
                 serving.serve(q, [algo_out[i] for algo_out in per_algo])
                 for i, q in enumerate(queries)
@@ -931,13 +937,18 @@ class QueryServer:
 
     def metrics(self) -> dict:
         """Per-stage latency histograms (p50/p90/p95/p99 over the recent
-        window, all-time count/avg) — the serving observability surface."""
-        return {
+        window, all-time count/avg) — the serving observability surface.
+        ``exemplars`` link each span's slowest recent occurrence to a
+        trace id fetchable with ``pio trace <id>``."""
+        out = {
             "startTime": format_time(self.start_time),
             "spans": self.tracer.snapshot(),
             "hedgedDispatches": self.hedged_dispatches,
             "foldin": self.foldin_status(),
         }
+        if self.recorder is not None:
+            out["exemplars"] = self.recorder.exemplars()
+        return out
 
 
 def _fold_rows_into(models: list, rows) -> tuple:
@@ -1288,7 +1299,9 @@ def build_serving_app(server: QueryServer) -> HttpApp:
     @app.route("GET", r"/metrics")
     def metrics_prometheus(req: Request):
         """Prometheus text exposition of the same data as /metrics.json
-        (span latency summaries + counters) for scrape-based stacks."""
+        (span latency summaries + counters) for scrape-based stacks —
+        through the shared renderer with the uniform `surface` label
+        (docs/observability.md)."""
         from pio_tpu.server.http import RawResponse
         from pio_tpu.utils.tracing import (
             PROMETHEUS_CONTENT_TYPE, prometheus_text,
@@ -1298,8 +1311,11 @@ def build_serving_app(server: QueryServer) -> HttpApp:
             prometheus_text(
                 server.tracer.snapshot(),
                 {"hedged_dispatches_total": float(server.hedged_dispatches),
+                 "foldin_applied_users_total":
+                     float(server.foldin_applied_users),
                  "uptime_seconds":
-                     (utcnow() - server.start_time).total_seconds()}),
+                     (utcnow() - server.start_time).total_seconds()},
+                labels={"surface": "serving"}),
             PROMETHEUS_CONTENT_TYPE)
 
     @app.route("POST", r"/profile/start")
@@ -1371,6 +1387,14 @@ def build_serving_app(server: QueryServer) -> HttpApp:
         return checks
 
     install_health_routes(app, readiness)
+    # distributed tracing (pio_tpu/obs/): /debug/traces.json +
+    # /debug/spans.json, and app.recorder switches the dispatch edge
+    # into traced mode; app.tracer feeds the per-surface `request`
+    # histogram
+    from pio_tpu.obs.http import install_trace_routes
+
+    app.tracer = server.tracer
+    install_trace_routes(app, server.recorder, check_server_key)
     # guarded rollout verbs (pio_tpu/rollout/): /rollout/deploy,
     # /rollout/promote, /rollout/rollback (server-key guarded) +
     # /rollout/status

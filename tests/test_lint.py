@@ -505,6 +505,46 @@ def test_rollout_state_transition_and_reads_silent():
                      select=["rollout-state"]) == []
 
 
+# -- obs: outbound HTTP must ride utils/httpclient ---------------------------
+
+def test_raw_http_fires_in_pio_tpu():
+    from pio_tpu.analysis import lint_text
+    src = """
+        import urllib.request
+        from http.client import HTTPConnection
+        import requests
+
+        def poll(url):
+            with urllib.request.urlopen(url, timeout=2):
+                pass
+            HTTPConnection("host", 80)
+            requests.get(url)
+    """
+    fs = lint_text(textwrap.dedent(src),
+                   path="pio_tpu/tools/poller.py", select=["raw-http"])
+    assert [f.rule for f in fs] == ["raw-http"] * 3
+    # the same code OUTSIDE pio_tpu/ (tests, bench drivers) is exempt:
+    # raw clients there measure the servers from outside the topology
+    assert lint_text(textwrap.dedent(src),
+                     path="tests/test_poller.py",
+                     select=["raw-http"]) == []
+
+
+def test_raw_http_sanctioned_client_and_parse_silent():
+    from pio_tpu.analysis import lint_text
+    src = """
+        import urllib.parse
+        from pio_tpu.utils.httpclient import JsonHttpClient
+
+        def call(base, path, params):
+            qs = urllib.parse.urlencode(params)   # parsing: not a request
+            return JsonHttpClient(base).request("GET", path + "?" + qs)
+    """
+    assert lint_text(textwrap.dedent(src),
+                     path="pio_tpu/tools/caller.py",
+                     select=["raw-http"]) == []
+
+
 # -- bench hygiene ----------------------------------------------------------
 
 def test_time_time_fires():

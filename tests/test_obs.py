@@ -1,0 +1,521 @@
+"""End-to-end distributed tracing + uniform Prometheus plane (pio_tpu/obs/):
+
+  * traceparent wire-format round trip + garbage tolerance,
+  * tail-based retention (errors + slowest-N + pinned survive churn),
+  * single-host serving: one HTTP query -> one trace with correct
+    parentage and the X-Pio-Trace-Id echo,
+  * the ISSUE 9 acceptance path: one query through the fleet router ->
+    ONE merged span tree spanning router + BOTH shard processes with
+    per-hop self-time; a `fleet.shard0.topk` chaos fault -> a failed
+    span labeled with the chaos point,
+  * all six surfaces serve Prometheus /metrics via the shared renderer
+    (surface/shard labels), label escaping fuzzed,
+  * `pio trace` / `pio top` CLI verbs over a live fleet.
+"""
+
+import json
+import random
+import re
+import urllib.request
+
+import pytest
+
+from pio_tpu.obs import context as tracectx
+from pio_tpu.obs.assemble import build_tree, collect_trace, render_tree
+from pio_tpu.obs.recorder import SpanRecord, TraceRecorder
+from pio_tpu.resilience import chaos
+from pio_tpu.serving_fleet.fleet import deploy_fleet
+
+from tests.test_fleet import seed_and_train
+
+
+def http_call(port, method, path, body=None, headers=None):
+    """-> (status, parsed body, response headers). Raw urllib on purpose:
+    tests drive the servers from OUTSIDE the traced topology."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=dict(headers or {}))
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        raw = resp.read()
+        return (resp.status,
+                json.loads(raw.decode()) if raw else None,
+                dict(resp.headers))
+
+
+# -- wire format -------------------------------------------------------------
+
+def test_traceparent_roundtrip_and_garbage():
+    ctx = tracectx.new_trace()
+    header = tracectx.format_traceparent(ctx)
+    assert re.fullmatch(r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", header)
+    parsed = tracectx.parse_traceparent(header)
+    assert parsed is not None
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.parent_id == ctx.span_id      # sender's span = our parent
+    assert parsed.span_id != ctx.span_id        # fresh server-side span
+    assert parsed.pinned is False
+    # the pin extension flag survives the wire
+    pinned = tracectx.format_traceparent(tracectx.new_trace(pinned=True))
+    assert pinned.endswith("-03")
+    assert tracectx.parse_traceparent(pinned).pinned is True
+    # garbage and all-zero ids never break a request edge
+    for bad in ("", "junk", "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+                "00-" + "a" * 32 + "-" + "0" * 16 + "-01",
+                "00-zz-yy-01", None):
+        assert tracectx.parse_traceparent(bad) is None
+
+
+def test_child_context_parentage():
+    root = tracectx.new_trace()
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+
+
+# -- tail-based retention ----------------------------------------------------
+
+def _one_span_trace(rec, trace_id, duration, error=False, pinned=False):
+    rec.record(SpanRecord(
+        trace_id=trace_id, span_id=f"s{trace_id}", parent_id=None,
+        name="request", surface=rec.surface, start_s=0.0,
+        duration_s=duration, status="error" if error else "ok"))
+    rec.finish_trace(trace_id, pinned=pinned)
+
+
+def test_tail_retention_keeps_errors_slowest_and_pinned_under_churn():
+    rec = TraceRecorder("t", max_errors=4, max_slow=4, max_sampled=2,
+                        max_pinned=4, sample_rate=0.0,
+                        rng=random.Random(0))
+    _one_span_trace(rec, "pin", 0.001, pinned=True)
+    for i in range(200):                       # fast-OK churn
+        _one_span_trace(rec, f"fast{i}", 0.001)
+    for i in range(3):                         # errors
+        _one_span_trace(rec, f"err{i}", 0.002, error=True)
+    slow_ids = []
+    for i in range(6):                         # slow tail
+        slow_ids.append(f"slow{i}")
+        _one_span_trace(rec, f"slow{i}", 0.5 + i * 0.1)
+    # errors survive the churn
+    for i in range(3):
+        assert rec.trace_of(f"err{i}") is not None
+    # the 4 slowest survive; the 2 earliest slow ones were evicted by
+    # slower arrivals
+    assert rec.trace_of("slow5") is not None
+    assert rec.trace_of("slow2") is not None
+    # the pinned trace survives even at sample_rate 0 with tiny duration
+    assert rec.trace_of("pin") is not None
+    # churn itself was dropped (sample_rate=0), and the store is bounded
+    assert rec.trace_of("fast150") is None
+    assert rec.stats()["retainedTraces"] <= 4 + 4 + 2 + 4 + 4
+    assert rec.dropped_traces > 150
+
+
+def test_reused_trace_id_cannot_grow_a_retained_entry_unboundedly():
+    """A client replaying one traceparent (retry loop on a pinned
+    trace) must not grow the retained entry linearly with traffic —
+    the per-trace span cap holds, surplus spans count as dropped."""
+    rec = TraceRecorder("t", max_spans_per_trace=10, sample_rate=0.0,
+                        rng=random.Random(0))
+    for i in range(100):
+        rec.record(SpanRecord("abuse", f"s{i}", None, "request", "t",
+                              float(i), 0.001))
+        rec.finish_trace("abuse", pinned=True)
+    got = rec.trace_of("abuse")
+    assert got is not None
+    assert len(got["spans"]) == 10
+    assert rec.stats()["droppedSpans"] == 90
+
+
+def test_exemplars_only_reference_fetchable_traces():
+    """An exemplar must never dangle: it decays with the recent window
+    and is restricted to traces still retained/assembling, so `pio
+    trace <exemplar id>` always resolves."""
+    rec = TraceRecorder("t", max_errors=1, max_slow=1, max_sampled=1,
+                        max_pinned=1, sample_rate=0.0,
+                        recent_capacity=64, rng=random.Random(0))
+    _one_span_trace(rec, "old-slowest", 9.0)       # all-time max...
+    for i in range(50):                            # ...evicted by churn
+        _one_span_trace(rec, f"mid{i}", 10.0 + i * 0.1)
+    assert rec.trace_of("old-slowest") is None     # no longer retained
+    ex = rec.exemplars()["request"]
+    assert ex["traceId"] != "old-slowest"
+    assert rec.trace_of(ex["traceId"]) is not None  # always fetchable
+
+
+def test_trace_merges_multiple_edge_finishes():
+    """The router fanning to one shard twice => two server edges on the
+    shard for ONE trace; the second finish must merge, not duplicate."""
+    rec = TraceRecorder("shard0", sample_rate=1.0, rng=random.Random(0))
+    rec.record(SpanRecord("t1", "a", None, "POST /shard/topk", "shard0",
+                          0.0, 0.01))
+    rec.finish_trace("t1")
+    rec.record(SpanRecord("t1", "b", None, "POST /shard/item_rows",
+                          "shard0", 0.1, 0.02))
+    rec.finish_trace("t1")
+    got = rec.trace_of("t1")
+    assert got is not None
+    assert {s["spanId"] for s in got["spans"]} == {"a", "b"}
+    assert got["durationS"] == pytest.approx(0.02)
+
+
+# -- fleet e2e (the ISSUE 9 acceptance path) ---------------------------------
+
+@pytest.fixture(scope="module")
+def fleet(module_memory_storage):
+    storage = module_memory_storage
+    seed_and_train(storage)
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    yield storage, handle
+    handle.close()
+
+
+@pytest.fixture(scope="module")
+def module_memory_storage():
+    from pio_tpu.data.storage import Storage
+
+    return Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+
+
+def _fleet_urls(handle):
+    return ([f"http://127.0.0.1:{handle.router_http.port}"]
+            + [url for group in handle.endpoints for url in group])
+
+
+def test_fleet_query_yields_one_merged_tree_across_processes(fleet):
+    """One routed query -> `pio trace` assembles ONE tree spanning the
+    router and BOTH shard surfaces, with correct parentage (shard edge
+    spans parent under the router's client spans) and per-hop
+    self-time."""
+    _storage, handle = fleet
+    port = handle.router_http.port
+    status, out, resp_headers = http_call(
+        port, "POST", "/queries.json", {"user": "u1", "num": 5},
+        headers={"X-Pio-Trace": "1"})
+    assert status == 200 and out["itemScores"]
+    trace_id = resp_headers.get("X-Pio-Trace-Id")
+    assert trace_id and re.fullmatch(r"[0-9a-f]{32}", trace_id)
+
+    spans, misses = collect_trace(_fleet_urls(handle), trace_id)
+    assert not misses, misses
+    surfaces = {s.surface for s in spans}
+    # router + BOTH shard processes contributed spans
+    assert "router" in surfaces
+    assert {"shard0", "shard1"} <= surfaces
+    by_id = {s.span_id: s for s in spans}
+    # every shard-side span's parentage resolves back into the router's
+    # spans (via the traceparent the RPC carried) — ONE tree, no orphans
+    roots = build_tree(spans)
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["span"].surface == "router"
+    assert root["span"].name == "POST /queries.json"
+    for s in spans:
+        if s.surface.startswith("shard") and s.name.startswith("POST "):
+            assert s.parent_id in by_id
+            assert by_id[s.parent_id].surface == "router"
+    # the shard model span (topk) is in the tree, one per shard group
+    topk_spans = [s for s in spans if s.name == "topk"]
+    assert {s.surface for s in topk_spans} == {"shard0", "shard1"}
+    # per-hop self-time: the root's self-time is its duration minus its
+    # direct children's — strictly less once children exist
+    assert root["children"]
+    assert 0.0 <= root["self_s"] < root["span"].duration_s
+    # rendering mentions every surface and self-times
+    text = render_tree(trace_id, spans)
+    assert "shard0" in text and "shard1" in text and "self " in text
+
+
+def test_fleet_chaos_fault_is_a_failed_span_with_chaos_point(fleet):
+    """An injected fleet.shard0.topk fault appears in the trace as a
+    FAILED span labeled with the chaos point (the response itself
+    degrades to 200, so only the trace shows WHERE the fault hit)."""
+    _storage, handle = fleet
+    port = handle.router_http.port
+    with chaos.inject("fleet.shard0.topk", error=1.0):
+        status, out, resp_headers = http_call(
+            port, "POST", "/queries.json", {"user": "u1", "num": 5},
+            headers={"X-Pio-Trace": "1"})
+    assert status == 200 and out.get("degraded")
+    trace_id = resp_headers["X-Pio-Trace-Id"]
+    spans, _ = collect_trace(_fleet_urls(handle), trace_id)
+    failed = [s for s in spans
+              if s.name == "shard.rpc" and s.status == "error"]
+    assert failed, [s.to_dict() for s in spans]
+    assert failed[0].labels.get("chaos") == "fleet.shard0.topk"
+    assert failed[0].labels.get("shard") == "0"
+    assert failed[0].labels.get("op") == "topk"
+    assert failed[0].labels.get("arm") == "active"
+
+
+def test_span_table_and_exemplars(fleet):
+    _storage, handle = fleet
+    port = handle.router_http.port
+    for i in range(3):
+        http_call(port, "POST", "/queries.json", {"user": f"u{i}"})
+    status, out, _ = http_call(port, "GET", "/debug/spans.json")
+    assert status == 200
+    names = {r["span"] for r in out["spans"]}
+    assert "shard.rpc" in names and "POST /queries.json" in names
+    row = next(r for r in out["spans"] if r["span"] == "shard.rpc")
+    assert row["count"] > 0 and row["p50Ms"] >= 0
+    # /metrics.json exemplars link span names to fetchable trace ids
+    status, met, _ = http_call(port, "GET", "/metrics.json")
+    assert status == 200 and "exemplars" in met
+    ex = met["exemplars"].get("shard.rpc")
+    assert ex and re.fullmatch(r"[0-9a-f]{32}", ex["traceId"])
+
+
+def test_debug_routes_respect_server_key(module_memory_storage):
+    from pio_tpu.serving_fleet.router import RouterConfig
+
+    handle = deploy_fleet(module_memory_storage, engine_id="rec",
+                          n_shards=1, n_replicas=1, server_key="SK",
+                          router_config=RouterConfig(server_key="SK"))
+    try:
+        port = handle.router_http.port
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http_call(port, "GET", "/debug/traces.json")
+        assert e.value.code == 401
+        status, out, _ = http_call(
+            port, "GET", "/debug/traces.json?accessKey=SK")
+        assert status == 200 and "traces" in out
+    finally:
+        handle.close()
+
+
+# -- single-host serving e2e -------------------------------------------------
+
+def test_single_host_trace_parentage_and_echo(fleet):
+    from pio_tpu.controller import EngineParams
+    from pio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+
+    storage, _handle = fleet
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name="mlapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=4, num_iterations=2, lambda_=0.05, chunk=1024))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    http, qs = create_query_server(
+        engine, ep, storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="rec",
+                      backend="async"),
+        ctx=ctx)
+    http.start()
+    try:
+        status, out, headers = http_call(
+            http.port, "POST", "/queries.json", {"user": "u1", "num": 3},
+            headers={"X-Pio-Trace": "1"})
+        assert status == 200
+        trace_id = headers["X-Pio-Trace-Id"]
+        trace = qs.recorder.trace_of(trace_id)
+        assert trace is not None
+        names = {s["name"] for s in trace["spans"]}
+        assert {"POST /queries.json", "supplement", "predict",
+                "serve"} <= names
+        edge = next(s for s in trace["spans"]
+                    if s["name"] == "POST /queries.json")
+        stage = next(s for s in trace["spans"] if s["name"] == "predict")
+        assert stage["parentId"] == edge["spanId"]
+        assert stage["labels"]["arm"] == "active"
+        # an inbound traceparent is JOINED, not replaced
+        parent = tracectx.new_trace()
+        http_call(http.port, "POST", "/queries.json", {"user": "u1"},
+                  headers={"traceparent":
+                           tracectx.format_traceparent(parent),
+                           "X-Pio-Trace": "1"})
+        joined = qs.recorder.trace_of(parent.trace_id)
+        assert joined is not None
+        edge = next(s for s in joined["spans"]
+                    if s["name"] == "POST /queries.json")
+        assert edge["parentId"] == parent.span_id
+    finally:
+        http.stop()
+        qs.close()
+
+
+# -- the fold-in folder ------------------------------------------------------
+
+def test_folder_cycle_is_a_root_trace(fleet, tmp_path):
+    from pio_tpu.freshness.folder import FoldInConfig, FoldInWorker
+
+    storage, _handle = fleet
+
+    class _NullApplier:
+        def apply(self, rows, staleness):
+            return {"engineInstanceId": "x"}
+
+    worker = FoldInWorker(
+        storage,
+        FoldInConfig(app_name="mlapp", engine_id="rec",
+                     state_path=str(tmp_path / "cursor.bin")),
+        applier=_NullApplier())
+    worker.run_once()
+    traces = worker.recorder.traces()
+    assert traces, "cycle trace must be retained (slowest-N catches it)"
+    got = worker.recorder.trace_of(traces[0]["traceId"])
+    names = {s["name"] for s in got["spans"]}
+    assert "foldin.cycle" in names and "tail" in names
+    cycle = next(s for s in got["spans"] if s["name"] == "foldin.cycle")
+    tail = next(s for s in got["spans"] if s["name"] == "tail")
+    assert tail["parentId"] == cycle["spanId"]
+
+
+# -- the uniform Prometheus plane --------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{([a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*",?)*\})?'
+    r' -?[0-9][0-9a-zA-Z_.+-]*$')
+
+
+def assert_prometheus_parses(text: str):
+    """Every non-comment line must be a well-formed sample (one metric,
+    optional label set with properly escaped values, one value)."""
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"unparseable sample: {line!r}"
+
+
+def test_all_six_surfaces_serve_prometheus_metrics(fleet, tmp_path):
+    """Event server, query server, router, shard, storage server, and
+    folder all expose GET /metrics through the shared renderer with the
+    uniform surface label (ISSUE 9 acceptance)."""
+    from pio_tpu.freshness.folder import (
+        FoldInConfig, FoldInWorker, build_foldin_app,
+    )
+    from pio_tpu.server.eventserver import (
+        EventServerConfig, build_event_app,
+    )
+    from pio_tpu.server.http import Request, dispatch_safe, encode_payload
+    from pio_tpu.server.storageserver import build_storage_app
+
+    storage, handle = fleet
+
+    def scrape(app, params=None):
+        status, payload = dispatch_safe(app, Request(
+            method="GET", path="/metrics", params=params or {},
+            headers={}))
+        assert status == 200, payload
+        body, ctype, _ = encode_payload(payload)
+        assert ctype.startswith("text/plain")
+        return body.decode()
+
+    # router + shard (live fleet HTTP)
+    for url_label, port in [
+        ("router", handle.router_http.port),
+        ("shard", int(handle.endpoints[0][0].rsplit(":", 1)[1])),
+    ]:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert_prometheus_parses(text)
+        assert f'surface="{url_label}"' in text
+    assert 'shard="0"' in text    # the shard scrape carries its index
+
+    # event server (metrics key required), storage server, folder (apps
+    # dispatched directly — the renderer and labels are what's under test)
+    ev_app = build_event_app(storage, EventServerConfig(
+        stats=True, metrics_key="MK"))
+    text = scrape(ev_app, {"accessKey": "MK"})
+    assert_prometheus_parses(text)
+    assert 'surface="eventserver"' in text
+
+    st_app = build_storage_app(storage)
+    # one RPC so the span summaries have samples to label
+    status, _ = dispatch_safe(st_app, Request(
+        method="POST", path="/rpc", params={}, headers={},
+        body=json.dumps({"family": "apps", "method": "get_all",
+                         "kwargs": {}}).encode()))
+    assert status == 200
+    text = scrape(st_app)
+    assert_prometheus_parses(text)
+    assert 'surface="storage"' in text
+
+    class _NullApplier:
+        def apply(self, rows, staleness):
+            return {}
+
+    worker = FoldInWorker(
+        storage,
+        FoldInConfig(app_name="mlapp", engine_id="rec",
+                     state_path=str(tmp_path / "c.bin")),
+        applier=_NullApplier())
+    text = scrape(build_foldin_app(worker))
+    assert_prometheus_parses(text)
+    assert 'surface="folder"' in text
+    assert "pio_staleness_seconds" in text
+    assert "pio_foldin_queue_depth" in text
+
+
+def test_prometheus_label_escaping_fuzzed():
+    """Hostile span names / label values (quotes, backslashes, newlines,
+    unicode) must never corrupt the exposition — every fuzzed rendering
+    still parses line-by-line."""
+    from pio_tpu.utils.tracing import (
+        prometheus_labeled_counter, prometheus_text,
+    )
+
+    rng = random.Random(42)
+    alphabet = 'ab"\\\n\té{},=$🙂'
+    for _ in range(50):
+        name = "".join(rng.choice(alphabet)
+                       for _ in range(rng.randrange(1, 12)))
+        spans = {name: {"count": 3, "total": 0.5, "p50": 0.1,
+                        "p99": 0.4}}
+        text = prometheus_text(spans, {"up_total": 1.0},
+                               labels={"surface": name})
+        assert_prometheus_parses(text)
+        lines = prometheus_labeled_counter(
+            "events_ingested_total", [({"event": name}, 2.0)])
+        assert_prometheus_parses("\n".join(lines) + "\n")
+
+
+# -- CLI verbs ---------------------------------------------------------------
+
+def test_cli_trace_and_top(fleet, capsys):
+    from pio_tpu.tools.cli import main
+
+    _storage, handle = fleet
+    port = handle.router_http.port
+    _status, _out, headers = http_call(
+        port, "POST", "/queries.json", {"user": "u3", "num": 5},
+        headers={"X-Pio-Trace": "1"})
+    trace_id = headers["X-Pio-Trace-Id"]
+    # --router-url alone discovers every shard replica via /fleet.json
+    rc = main(["trace", trace_id,
+               "--router-url", f"http://127.0.0.1:{port}"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"trace {trace_id}" in out
+    assert "router" in out and "shard0" in out and "shard1" in out
+    assert "self " in out
+
+    rc = main(["top", "--router-url", f"http://127.0.0.1:{port}"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SPAN" in out and "shard.rpc" in out
+
+    rc = main(["trace", "f" * 32,
+               "--url", f"http://127.0.0.1:{port}"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "no spans found" in out

@@ -268,10 +268,12 @@ def test_storage_server_metrics(server):
         assert resp.status == 200
         assert resp.headers["Content-Type"].startswith("text/plain")
         text = resp.read().decode()
-    assert "# TYPE pio_storage_span_latency_seconds summary" in text
+    # uniform-plane naming (docs/observability.md): shared metric name
+    # + surface label, replacing the pre-PR-9 pio_storage_ prefix
+    assert "# TYPE pio_span_latency_seconds summary" in text
     assert 'span="apps.insert"' in text and 'span="apps.get_all"' in text
-    assert 'pio_storage_span_latency_seconds_count{span="apps.insert"} 1' \
-        in text
+    assert ('pio_span_latency_seconds_count'
+            '{surface="storage",span="apps.insert"} 1') in text
 
 
 def test_unbounded_find_pages_transparently(server, monkeypatch):
