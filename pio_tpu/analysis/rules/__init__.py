@@ -15,7 +15,9 @@ from pio_tpu.analysis.rules.concurrency import ConcurrencyRule
 from pio_tpu.analysis.rules.obs import ObsRule
 from pio_tpu.analysis.rules.shard_spec import ShardSpecRule
 from pio_tpu.analysis.rules.trace_purity import TracePurityRule
-from pio_tpu.analysis.rules.workflow_contract import WorkflowContractRule
+from pio_tpu.analysis.rules.workflow_contract import (
+    WireCodecRule, WorkflowContractRule,
+)
 
 ALL_RULES = [
     TracePurityRule(),
@@ -24,6 +26,7 @@ ALL_RULES = [
     BenchHygieneRule(),
     HotLoopAllocRule(),
     WorkflowContractRule(),
+    WireCodecRule(),
     ObsRule(),
 ]
 

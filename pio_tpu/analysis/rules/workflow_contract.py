@@ -107,3 +107,79 @@ class WorkflowContractRule:
                     if dname == "abstractmethod":
                         return True
         return False
+
+
+# wire-packing call names (canonical, via ImportMap): the struct codecs
+# and the numpy cast primitives that define a binary layout
+_WIRE_PACK_CALLS = frozenset({
+    "struct.pack", "struct.unpack", "struct.pack_into",
+    "struct.unpack_from", "struct.Struct", "struct.calcsize",
+    "numpy.frombuffer", "np.frombuffer",
+})
+# method names that serialize an array/buffer into wire bytes; matched
+# by attribute name because the receiver's type is not resolvable
+_WIRE_PACK_METHODS = frozenset({"tobytes", "frombuffer"})
+
+# modules that legitimately OWN a binary format, each already the single
+# centralized implementation of its protocol (the justified standing
+# suppressions of this rule):
+#   data/columnar.py          — THE pio columnar wire codec this rule
+#                               protects (encode/decode live here only)
+#   utils/durable.py          — the CRC32C envelope the codec frames with
+#   native/eventlog.py        — the Python half of the C++ event-log
+#                               record codec (layout owned by eventlog.cpp)
+#   data/backends/mywire.py   — the MySQL client protocol (foreign format)
+#   data/backends/pgwire.py   — the Postgres client protocol (foreign
+#                               format)
+_WIRE_CODEC_OWNERS = (
+    "pio_tpu/data/columnar.py",
+    "pio_tpu/utils/durable.py",
+    "pio_tpu/native/eventlog.py",
+    "pio_tpu/data/backends/mywire.py",
+    "pio_tpu/data/backends/pgwire.py",
+)
+
+
+class WireCodecRule:
+    """`wire-codec` (DASE-contracts family): struct/frombuffer/tobytes
+    wire packing in ``pio_tpu/`` outside ``data/columnar.py`` (and the
+    sanctioned protocol-owner modules above) is a finding.
+
+    The binary columnar wire format's encode/decode deliberately live in
+    ONE codec — the Event.from_api_dict lesson: two implementations of
+    the same wire rules WILL drift, and a drifted binary layout corrupts
+    silently (the bytes still parse, the values are wrong). A struct.pack
+    or frombuffer call sprouting next to a route handler or client is the
+    first commit of a second codec; this rule reports it while it is
+    still one call. Genuinely new binary formats suppress inline with a
+    justification, like every other rule.
+    """
+
+    id = "dase"
+    ids = ("wire-codec",)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if "pio_tpu/" not in path:
+            return
+        if any(path.endswith(owner) for owner in _WIRE_CODEC_OWNERS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.imports.canonical(node.func)
+            method = (node.func.attr
+                      if isinstance(node.func, ast.Attribute) else "")
+            if name not in _WIRE_PACK_CALLS \
+                    and method not in _WIRE_PACK_METHODS:
+                continue
+            what = name or f"*.{method}"
+            yield Finding(
+                "wire-codec", Severity.WARNING, ctx.path, node.lineno,
+                node.col_offset,
+                f"binary wire packing via {what}() outside the sanctioned "
+                "codec modules: encode/decode of every pio wire/storage "
+                "format must live in ONE codec (data/columnar.py for the "
+                "columnar wire format) so the two sides cannot drift — "
+                "call the codec, or suppress with a justification if this "
+                "is genuinely a new self-contained binary format")

@@ -74,6 +74,10 @@ class _Namespace:
 
             pos = 0
             while pos + 2 <= len(self._tomb_blob):
+                # pio: lint-ok[wire-codec] reads the tombstone file
+                # pack_tombstones (native/eventlog.py, the sanctioned
+                # record-codec owner) writes — same module-pair as the
+                # event records themselves, not a second codec
                 (n,) = struct.unpack_from("<H", self._tomb_blob, pos)
                 pos += 2
                 self.tombstones.add(

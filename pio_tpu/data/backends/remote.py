@@ -55,6 +55,16 @@ class RemoteBackend(Backend):
         )
 
     # -- transport ----------------------------------------------------------
+    def storage_error(self, what: str, e: HttpClientError) -> StorageError:
+        """The ONE HttpClientError -> StorageError translation (server
+        fault vs unreachable) for every route this backend speaks —
+        /rpc and /rpc/columnar must not drift on error reporting."""
+        if e.status:
+            return StorageError(
+                f"storage server {self._url}: {what}: {e.message}")
+        return StorageError(
+            f"storage server {self._url} unreachable: {e.message}")
+
     def call(self, family: str, method: str, kwargs: dict):
         params = {"accessKey": self._key} if self._key else None
         try:
@@ -64,14 +74,7 @@ class RemoteBackend(Backend):
                 params,
             )
         except HttpClientError as e:
-            if e.status:
-                raise StorageError(
-                    f"storage server {self._url}: {family}.{method}: "
-                    f"{e.message}"
-                ) from e
-            raise StorageError(
-                f"storage server {self._url} unreachable: {e.message}"
-            ) from e
+            raise self.storage_error(f"{family}.{method}", e) from e
         return (payload or {}).get("result")
 
     def close(self):
@@ -299,6 +302,64 @@ class _RemoteEvents(_Remote, d.EventsDAO):
         return bool(self.call(
             "delete", event_id=event_id, app_id=app_id, channel_id=channel_id
         ))
+
+    def find_columnar(
+        self,
+        app_id,
+        channel_id=None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
+        target_entity_type=...,
+        target_entity_id=...,
+    ):
+        """Bulk columnar read over the BINARY wire (POST /rpc/columnar):
+        the server ships one CRC32C-framed columnar batch — dictionary
+        codes + µs timestamps + the lazy raw-JSON property sidecar —
+        and this client decodes it by ``frombuffer`` pointer-cast
+        (data/columnar.py), instead of paging per-event JSON through
+        ``find`` and re-columnarizing client-side. A pre-binary server
+        (404/405 on the route) falls back to exactly that JSON path."""
+        from pio_tpu.data.columnar import (
+            COLUMNAR_CONTENT_TYPE, WireFormatError, decode_columnar_events,
+        )
+
+        q = w.find_kwargs_to_wire(
+            start_time=start_time, until_time=until_time,
+            entity_type=entity_type, entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+        params = {"accessKey": self.b._key} if self.b._key else None
+        try:
+            blob = self.b._http.request(
+                "POST", "/rpc/columnar",
+                {"app_id": app_id, "channel_id": channel_id, "query": q},
+                params, accept=COLUMNAR_CONTENT_TYPE)
+        except HttpClientError as e:
+            if e.status in (404, 405):
+                # pre-binary storage server: the JSON scatter-gather path
+                return super().find_columnar(
+                    app_id=app_id, channel_id=channel_id,
+                    start_time=start_time, until_time=until_time,
+                    entity_type=entity_type, entity_id=entity_id,
+                    event_names=event_names,
+                    target_entity_type=target_entity_type,
+                    target_entity_id=target_entity_id)
+            raise self.b.storage_error("events.find_columnar", e) from e
+        if not isinstance(blob, bytes):
+            raise StorageError(
+                f"storage server {self.b._url}: events.find_columnar "
+                "answered JSON where a columnar frame was negotiated")
+        try:
+            return decode_columnar_events(blob)
+        except WireFormatError as e:
+            raise StorageError(
+                f"storage server {self.b._url}: events.find_columnar "
+                f"frame rejected: {e}") from e
 
     def columnarize(
         self,

@@ -188,6 +188,43 @@ class ShardedEventsDAO(daomod.EventsDAO):
                 break
             yield ev
 
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+    ):
+        """Region-parallel bulk columnar read: every shard answers its
+        own binary columnar frame (the remote backend's /rpc/columnar,
+        decoded by pointer-cast) concurrently, and the per-shard batches
+        are concatenated with codes remapped into one global dictionary
+        and rows stable-sorted by event time (columnar.concat_columnar)
+        — the exact row sequence the scatter ``find`` heap-merge
+        produces, so tail/aggregate/interaction folds are bit-identical
+        to the single-host read. An entity-pinned read (both filters
+        set) pushes down to the one shard that owns the entity."""
+        from pio_tpu.data.columnar import concat_columnar
+
+        kw = dict(
+            channel_id=channel_id, start_time=start_time,
+            until_time=until_time, entity_type=entity_type,
+            entity_id=entity_id, event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+        if entity_type is not None and entity_id is not None:
+            shard = self.shards[
+                shard_for(entity_type, entity_id, len(self.shards))]
+            return shard.find_columnar(app_id, **kw)
+        return concat_columnar(
+            self._all(lambda s: s.find_columnar(app_id, **kw)))
+
     def columnarize(
         self,
         app_id: int,

@@ -51,11 +51,13 @@ class Event:
             object.__setattr__(self, "tags", tuple(self.tags))
 
     def with_id(self, event_id: str) -> "Event":
-        # shallow copy + setattr, NOT dataclasses.replace: replace re-runs
-        # __init__/__post_init__ (tz coercion, DataMap/tuple checks) on
-        # every insert — the hottest line of the ingest pipeline
-        e = copy.copy(self)
-        object.__setattr__(e, "event_id", event_id)
+        # bare __dict__ copy, NOT dataclasses.replace (re-runs
+        # __init__/__post_init__ tz/DataMap coercion) and NOT copy.copy
+        # (routes through __reduce_ex__, ~6x slower) — this is the
+        # hottest line of the ingest pipeline, one call per insert
+        e = object.__new__(Event)
+        e.__dict__.update(self.__dict__)
+        e.__dict__["event_id"] = event_id
         return e
 
     # -- wire format (reference EventJson4sSupport.scala APISerializer) -----

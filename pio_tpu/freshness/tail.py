@@ -171,6 +171,14 @@ class HttpEventSource:
         return p
 
     def window(self, cursor: FoldCursor) -> TailWindow:
+        # negotiate the binary columnar tail (one CRC32C-framed batch,
+        # decoded by pointer-cast — no per-event JSON on either end); a
+        # pre-binary event server ignores the Accept header and answers
+        # the JSON shape, which lands in the same tail_window fold
+        from pio_tpu.data.columnar import (
+            COLUMNAR_CONTENT_TYPE, decode_columnar_events,
+        )
+
         out = self.client.request(
             "GET", "/tail/events.json",
             params=self._params(
@@ -179,7 +187,14 @@ class HttpEventSource:
                 entityType=self.entity_type,
                 targetEntityType=self.target_entity_type,
                 events=",".join(self.event_names),
-            ))
+            ),
+            accept=COLUMNAR_CONTENT_TYPE)
+        if isinstance(out, bytes):
+            cols = decode_columnar_events(out)
+            ids = np.asarray(cols.entity_ids, dtype=object)[
+                np.asarray(cols.entity_code)]
+            return tail_window(ids, np.asarray(cols.time_us, np.int64),
+                               cursor)
         return tail_window(out.get("entityIds", []),
                            np.asarray(out.get("timesUs", []), np.int64),
                            cursor)

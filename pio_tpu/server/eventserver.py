@@ -21,8 +21,9 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Sequence
 
 from pio_tpu.data.backends.common import new_event_id
 from pio_tpu.data.dao import AccessKey, Channel
@@ -35,12 +36,21 @@ from pio_tpu.resilience.health import (
 from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
 )
+from pio_tpu.data.columnar import (
+    COLUMNAR_CONTENT_TYPE, decode_api_batch_binary,
+)
 from pio_tpu.server.plugins import PluginContext, PluginRejection
 from pio_tpu.server.stats import Stats
 from pio_tpu.server.webhooks import ConnectorException, default_connectors
 from pio_tpu.utils.time import parse_time
 
 MAX_EVENTS_PER_BATCH = 50  # reference EventServer.scala:68
+# the binary columnar route's own ceiling: the 50-event JSON limit is a
+# reference-compat contract, but the binary frame exists precisely to
+# amortize per-request costs over bulk batches — per-event isolation
+# still applies slot by slot, and a 10k-event frame is well under the
+# transport's 64 MB body cap (~100 bytes/event on the wire)
+MAX_EVENTS_PER_BINARY_BATCH = 10_000
 
 
 @dataclass
@@ -228,33 +238,74 @@ def build_event_app(
             stats.update(ak.appid, 201, event.event, event.entity_type)
         return event_id, spilled
 
-    def insert_many(ak: AccessKey, channel_id: int | None,
-                    body: list) -> list[dict]:
-        """The Python batch-ingest pipeline, columnarized: ONE decode pass
-        over the JSON batch (columnar.decode_api_batch — shared receive
-        timestamp, fast Event construction), ids minted in bulk (one
-        entropy syscall), and ONE insert_batch DAO call instead of a
-        guarded per-event insert.  Per-event isolation is preserved: a
-        slot's validation/auth/plugin failure becomes its own 400/403
-        while the rest of the batch proceeds, and a store failure falls
-        back to the per-event insert/spill path so degraded-mode
-        semantics match the single-event route exactly."""
-        from pio_tpu.data.backends.common import new_event_ids
-        from pio_tpu.data.columnar import decode_api_batch
+    # -- per-wire-codec ingest counters (docs/observability.md): the
+    # JSON -> binary migration must be visible on the Prometheus plane,
+    # so the batch route records events/bytes/decode-seconds under a
+    # `codec` label. Lifetime-monotonic, exported by GET /metrics.
+    wire_lock = threading.Lock()
+    wire_stats: dict[str, dict[str, float]] = {
+        codec: {"batches": 0, "events": 0, "bytes": 0, "decode_seconds": 0.0}
+        for codec in ("json", "binary")
+    }
+    app.wire_stats = wire_stats  # exposed for tests/ops
 
-        decoded = decode_api_batch(body)
-        results: list[dict | None] = [None] * len(body)
+    def record_wire(codec: str, results: list, nbytes: int,
+                    decode_s: float) -> None:
+        accepted = sum(1 for r in results
+                       if isinstance(r, dict) and r.get("status") == 201)
+        with wire_lock:
+            w = wire_stats[codec]
+            w["batches"] += 1
+            w["events"] += accepted
+            w["bytes"] += nbytes
+            w["decode_seconds"] += decode_s
+
+    def insert_decoded(ak: AccessKey, channel_id: int | None,
+                       decoded: Sequence[Event | EventValidationError],
+                       dicts: Sequence | None = None) -> list[dict]:
+        """The Python batch-ingest pipeline behind BOTH wire codecs: the
+        decode pass (columnar.decode_api_batch for JSON bodies,
+        columnar.decode_api_batch_binary for binary frames — shared
+        receive timestamp, fast Event construction) happens at the
+        route, ids are minted in bulk (one entropy syscall), and ONE
+        insert_batch DAO call replaces a guarded per-event insert.
+        Per-event isolation is preserved: a slot's validation/auth/
+        plugin failure becomes its own 400/403 while the rest of the
+        batch proceeds, and a store failure falls back to the per-event
+        insert/spill path so degraded-mode semantics match the
+        single-event route exactly. ``dicts`` carries the original API
+        dicts for the plugin hooks (the JSON route); the binary route
+        materializes one per slot only when plugins are registered."""
+        from pio_tpu.data.backends.common import new_event_ids
+
+        have_plugins = bool(plugins.input_blockers or plugins.input_sniffers)
+
+        results: list[dict | None] = [None] * len(decoded)
         ctx = {"appId": ak.appid, "channelId": channel_id}
         to_insert: list[tuple[int, Event]] = []
+        whitelist = bool(ak.events)
         for i, item in enumerate(decoded):
             if isinstance(item, EventValidationError):
                 results[i] = {"status": 400, "message": str(item)}
                 continue
             event = item
+            if not whitelist and not have_plugins:
+                # nothing left that can reject this slot pre-insert
+                to_insert.append((i, event))
+                continue
+            # ONE dict per slot shared by every hook (the JSON route's
+            # body[i] aliasing: a blocker's annotation is visible to
+            # later blockers and sniffers), materialized only when
+            # plugins are registered
+            d = None
+            if have_plugins:
+                d = dicts[i] if dicts is not None else event.to_api_dict()
             try:
-                check_event_allowed(ak, event.event)
-                for blocker in plugins.input_blockers:
-                    blocker.process(body[i], ctx)
+                if whitelist:
+                    check_event_allowed(ak, event.event)
+                if have_plugins:
+                    for blocker in plugins.input_blockers:
+                        blocker.process(d, ctx)
             except AuthError as e:
                 results[i] = {"status": e.status, "message": e.message}
                 continue
@@ -275,21 +326,22 @@ def build_event_app(
                     "message": str(e),
                 }
                 continue
-            for sniffer in plugins.input_sniffers:
-                try:
-                    sniffer.process(body[i], ctx)
-                except Exception:  # noqa: BLE001 - sniffers cannot fail
-                    pass
+            if have_plugins:
+                for sniffer in plugins.input_sniffers:
+                    try:
+                        sniffer.process(d, ctx)
+                    except Exception:  # noqa: BLE001 - sniffers cannot fail
+                        pass
             to_insert.append((i, event))
         # mint ids at the edge in bulk (same idempotency contract as
-        # insert_one: a retried/spilled insert always carries its id)
-        fresh = new_event_ids(
-            sum(1 for _, e in to_insert if e.event_id is None))
-        it = iter(fresh)
-        to_insert = [
-            (i, e if e.event_id is not None else e.with_id(next(it)))
-            for i, e in to_insert
-        ]
+        # insert_one: a retried/spilled insert always carries its id).
+        # Assigned IN PLACE: these Events came fresh out of the decode
+        # pass and are aliased nowhere else, so skipping 50 with_id
+        # copies is safe — the one spot allowed to touch a frozen
+        # Event's __dict__ besides with_id itself.
+        missing = [e for _, e in to_insert if e.event_id is None]
+        for e, eid in zip(missing, new_event_ids(len(missing))):
+            e.__dict__["event_id"] = eid
 
         def ok(i: int, event: Event, spilled: bool) -> None:
             r: dict = {"status": 201, "eventId": event.event_id}
@@ -334,8 +386,14 @@ def build_event_app(
                 for i, event in to_insert:
                     insert_fallback(i, event)
             else:
-                for i, event in to_insert:
-                    ok(i, event, False)
+                if config.stats:
+                    for i, event in to_insert:
+                        ok(i, event, False)
+                else:
+                    # the all-accepted hot path: result dicts inline
+                    for i, event in to_insert:
+                        results[i] = {"status": 201,
+                                      "eventId": event.event_id}
         return results  # type: ignore[return-value]
 
     # -- routes -------------------------------------------------------------
@@ -498,10 +556,17 @@ def build_event_app(
         consumers dedupe the boundary microsecond, see
         pio_tpu/freshness/cursor.py). ``events`` is a comma-separated
         event-name filter; ``entityType``/``targetEntityType`` filter
-        like GET /events.json."""
+        like GET /events.json.
+
+        ``Accept: application/x-pio-columnar`` negotiates the binary
+        columnar frame instead (the same sorted/limited window as one
+        CRC32C-framed ColumnarEvents batch — consumers derive count and
+        nextUs from the time column); JSON stays the default."""
         import numpy as np
 
-        from pio_tpu.data.columnar import _restore_time
+        from pio_tpu.data.columnar import (
+            ColumnarEvents, _restore_time, encode_columnar_events,
+        )
 
         p = req.params
         since_us = int(p.get("sinceUs", -1))
@@ -519,6 +584,36 @@ def build_event_app(
         )
         t = np.asarray(cols.time_us)
         order = np.argsort(t, kind="stable")[:limit]
+        if COLUMNAR_CONTENT_TYPE in req.header("accept").lower():
+            from pio_tpu.server.http import RawResponse
+
+            def compact(codes: np.ndarray, table):
+                """Renumber codes over the SHIPPED rows only — a
+                limit-truncated window must not drag the whole store's
+                dictionary onto the wire (-1 absent markers survive)."""
+                uniq, inv = np.unique(codes, return_inverse=True)
+                if len(uniq) and uniq[0] == -1:
+                    return (inv.astype(np.int32) - 1,
+                            [table[c] for c in uniq[1:]])
+                return inv.astype(np.int32), [table[c] for c in uniq]
+
+            ev_c, ev_tab = compact(
+                np.asarray(cols.event_code)[order], cols.event_names)
+            en_c, en_tab = compact(
+                np.asarray(cols.entity_code)[order], cols.entity_ids)
+            tg_c, tg_tab = compact(
+                np.asarray(cols.target_code)[order], cols.target_ids)
+            sub = ColumnarEvents(
+                event_code=ev_c, entity_code=en_c, target_code=tg_c,
+                time_us=t[order],
+                tz_min=np.asarray(cols.tz_min)[order],
+                event_names=ev_tab, entity_ids=en_tab,
+                target_ids=tg_tab,
+                # parity with the JSON tail: no property payload ships
+                properties=[None] * int(order.shape[0]),
+            )
+            return 200, RawResponse(encode_columnar_events(sub),
+                                    COLUMNAR_CONTENT_TYPE)
         ent = np.asarray(cols.entity_ids, dtype=object)
         evn = np.asarray(cols.event_names, dtype=object)
         tgt = np.asarray(cols.target_ids, dtype=object)
@@ -539,6 +634,39 @@ def build_event_app(
     @app.route("POST", r"/batch/events\.json")
     @authed
     def batch_events(req: Request, ak, channel_id):
+        """Batch ingest, two wire codecs on ONE route:
+
+          * ``Content-Type: application/x-pio-columnar`` — the binary
+            columnar frame (data/columnar.py): CRC32C-verified at the
+            edge (corrupt/truncated frames 400 with nothing stored),
+            columns decoded by frombuffer pointer-cast, per-event
+            verdicts/spill fallback identical to the JSON route.
+          * anything else — the JSON array (kept for compatibility),
+            through the native C fast path when available.
+        """
+        ctype = req.header("content-type").split(";")[0].strip().lower()
+        if ctype == COLUMNAR_CONTENT_TYPE:
+            from pio_tpu.data.columnar import wire_batch_row_count
+
+            over_limit = {
+                "message": "Batch request must have less than or "
+                f"equal to {MAX_EVENTS_PER_BINARY_BATCH} events"
+            }
+            # size check BEFORE the decode pass (the JSON route's
+            # ordering): the row count sits at a fixed header offset,
+            # so an oversized frame costs microseconds, not a
+            # million-event construction loop thrown away at the end
+            peek = wire_batch_row_count(req.body)
+            if peek is not None and peek > MAX_EVENTS_PER_BINARY_BATCH:
+                return 400, over_limit
+            t0 = time.monotonic()
+            decoded = decode_api_batch_binary(req.body)
+            decode_s = time.monotonic() - t0
+            if len(decoded) > MAX_EVENTS_PER_BINARY_BATCH:
+                return 400, over_limit  # backstop: peek declined to read
+            results = insert_decoded(ak, channel_id, decoded)
+            record_wire("binary", results, len(req.body), decode_s)
+            return 200, results
         fast = _native_fast_path()
         if fast is not None:
             from pio_tpu.native.eventlog import BatchTooLarge
@@ -572,7 +700,13 @@ def build_event_app(
                         out.append({"status": 403, "message": payload})
                     else:
                         out.append({"status": 400, "message": payload})
+                # decode is fused with the append inside the C call, so
+                # only events/bytes are separable for the native exit
+                record_wire("json", out, len(req.body), 0.0)
                 return 200, out
+        from pio_tpu.data.columnar import decode_api_batch
+
+        t0 = time.monotonic()
         body = req.json()
         if not isinstance(body, list):
             return 400, {"message": "request body must be a JSON array"}
@@ -581,7 +715,11 @@ def build_event_app(
                 "message": "Batch request must have less than or equal to "
                 f"{MAX_EVENTS_PER_BATCH} events"
             }
-        return 200, insert_many(ak, channel_id, body)
+        decoded = decode_api_batch(body)
+        decode_s = time.monotonic() - t0
+        results = insert_decoded(ak, channel_id, decoded, dicts=body)
+        record_wire("json", results, len(req.body), decode_s)
+        return 200, results
 
     @app.route("GET", r"/stats\.json")
     @authed
@@ -622,6 +760,17 @@ def build_event_app(
             counters["spill_queue_depth"] = float(s["size"])
         text = prometheus_text(tracer.snapshot(), counters,
                                labels={"surface": "eventserver"})
+        # per-wire-codec ingest counters: the JSON -> binary migration
+        # shows up as rate moving between the codec labels
+        with wire_lock:
+            wire_snap = {c: dict(v) for c, v in wire_stats.items()}
+        for metric in ("events", "bytes", "batches", "decode_seconds"):
+            rows = [
+                ({"surface": "eventserver", "codec": c}, v[metric])
+                for c, v in sorted(wire_snap.items())
+            ]
+            text += "\n".join(prometheus_labeled_counter(
+                f"ingest_wire_{metric}_total", rows)) + "\n"
         if config.stats:
             rows = [
                 ({"surface": "eventserver", "app_id": k.app_id,

@@ -326,6 +326,46 @@ def build_storage_app(
                          "error": type(e).__name__}
         return 200, {"result": result}
 
+    @app.route("POST", r"/rpc/columnar")
+    def rpc_columnar(req: Request):
+        """``find_columnar`` over the binary columnar wire format
+        (data/columnar.py): the request is the usual JSON find-kwargs
+        envelope, the response is ONE CRC32C-framed columnar batch —
+        dictionary-coded columns + the lazy raw-JSON property sidecar —
+        instead of per-event JSON. The remote backend decodes it by
+        pointer-cast; the sharded backend fans this route out per shard
+        and concatenates. A separate route (not a /rpc method) because
+        the /rpc envelope is JSON by contract and re-encoding the frame
+        into it would put the per-event tax right back."""
+        from pio_tpu.data.columnar import (
+            COLUMNAR_CONTENT_TYPE, encode_columnar_events,
+        )
+        from pio_tpu.server.http import RawResponse
+
+        if config.server_key and (
+            req.params.get("accessKey", "") != config.server_key
+        ):
+            return 401, {"message": "Invalid accessKey."}
+        body = req.json()
+        if not isinstance(body, dict):
+            return 400, {"message": "body must be a JSON object"}
+        fkw = w.find_kwargs_from_wire(body.get("query") or {})
+        fkw.pop("limit", None)        # find_columnar is an unbounded read
+        fkw.pop("reversed", None)
+        dao = _dao_for(storage, "events")
+        try:
+            with tracer.span("events.find_columnar"):
+                cols = dao.find_columnar(
+                    app_id=body["app_id"],
+                    channel_id=body.get("channel_id"), **fkw)
+                blob = encode_columnar_events(cols)
+        except StorageError as e:
+            return 409, {"message": str(e), "error": "StorageError"}
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"message": f"{type(e).__name__}: {e}",
+                         "error": type(e).__name__}
+        return 200, RawResponse(blob, COLUMNAR_CONTENT_TYPE)
+
     # distributed tracing (pio_tpu/obs/): /debug routes + traced edge,
     # guarded by the server key like /rpc itself
     from pio_tpu.obs.http import install_trace_routes

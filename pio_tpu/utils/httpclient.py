@@ -33,11 +33,16 @@ from pio_tpu.resilience.chaos import maybe_inject
 
 
 class HttpClientError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after: float | None = None):
         super().__init__(f"HTTP {status}: {message}" if status
                          else message)
         self.status = status
         self.message = message
+        # the server's Retry-After hint (seconds), when the error
+        # response carried one — backpressure-aware callers (the SDK's
+        # 429 retry loop) floor their backoff at it
+        self.retry_after = retry_after
 
 
 class JsonHttpClient:
@@ -52,8 +57,18 @@ class JsonHttpClient:
             self._ctx.verify_mode = ssl.CERT_NONE
 
     def request(self, method: str, path: str, body: Any = None,
-                params: dict | None = None) -> Any:
+                params: dict | None = None, *,
+                raw: bytes | None = None,
+                content_type: str | None = None,
+                accept: str | None = None) -> Any:
         """-> parsed JSON body (None when empty). Raises HttpClientError.
+
+        Binary wire support (the columnar codec, data/columnar.py):
+        ``raw`` sends pre-encoded bytes with ``content_type`` instead of
+        a JSON body; ``accept`` adds an Accept header, and a response
+        whose Content-Type matches it is returned as raw bytes — a
+        server that ignores the negotiation still answers JSON and the
+        caller sees the parsed object, so old servers degrade cleanly.
 
         Under an active trace context the call becomes one client span:
         a child context rides the outbound ``traceparent`` header (the
@@ -62,7 +77,8 @@ class JsonHttpClient:
         injected — lands in the ambient recorder."""
         ctx = tracectx.current()
         if ctx is None:
-            return self._request(method, path, body, params, None)
+            return self._request(method, path, body, params, None,
+                                 raw, content_type, accept)
         child = ctx.child()
         recorder = tracectx.current_recorder()
         t0 = time.monotonic()
@@ -74,7 +90,8 @@ class JsonHttpClient:
         labels = {"method": method, "path": path}
         try:
             return self._request(method, path, body, params,
-                                 tracectx.format_traceparent(child))
+                                 tracectx.format_traceparent(child),
+                                 raw, content_type, accept)
         except BaseException as e:
             status = "error"
             errmsg, labels = error_fields(e, labels)
@@ -89,7 +106,10 @@ class JsonHttpClient:
                     status=status, error=errmsg, labels=labels))
 
     def _request(self, method: str, path: str, body: Any,
-                 params: dict | None, traceparent: str | None) -> Any:
+                 params: dict | None, traceparent: str | None,
+                 raw: bytes | None = None,
+                 content_type: str | None = None,
+                 accept: str | None = None) -> Any:
         # chaos point: injected ConnectionError/reset/stall surfaces to
         # callers exactly like a real transport failure (normalized to
         # HttpClientError(status=0) below)
@@ -101,9 +121,14 @@ class JsonHttpClient:
         # allow_nan=False: the servers reject the non-standard NaN token
         # (server/http.py Request.json), so fail at the SENDER with a
         # clear error instead of a 400/500 round trip
-        data = (json.dumps(body, allow_nan=False).encode()
-                if body is not None else None)
-        headers = {"Content-Type": "application/json"}
+        if raw is not None:
+            data = raw
+        else:
+            data = (json.dumps(body, allow_nan=False).encode()
+                    if body is not None else None)
+        headers = {"Content-Type": content_type or "application/json"}
+        if accept is not None:
+            headers["Accept"] = accept
         if traceparent is not None:
             headers[tracectx.TRACEPARENT_HEADER] = traceparent
         req = urllib.request.Request(
@@ -117,6 +142,10 @@ class JsonHttpClient:
                 req, timeout=self.timeout, context=self._ctx
             ) as resp:
                 payload = resp.read()
+                resp_ct = (resp.headers.get("Content-Type") or "") \
+                    .split(";")[0].strip().lower()
+                if accept is not None and resp_ct == accept.lower():
+                    return payload  # negotiated binary body, verbatim
                 try:
                     return json.loads(payload) if payload else None
                 except ValueError as e:
@@ -130,15 +159,20 @@ class JsonHttpClient:
                         resp.status,
                         f"malformed JSON response body: {e}") from e
         except urllib.error.HTTPError as e:
-            raw = e.read().decode(errors="replace")
-            msg = raw or str(e)
+            err_body = e.read().decode(errors="replace")
+            msg = err_body or str(e)
             try:
-                parsed = json.loads(raw)
+                parsed = json.loads(err_body)
                 if isinstance(parsed, dict):
-                    msg = parsed.get("message", raw)
+                    msg = parsed.get("message", err_body)
             except json.JSONDecodeError:
                 pass
-            raise HttpClientError(e.code, msg) from e
+            try:
+                retry_after = float(e.headers.get("Retry-After", ""))
+            except (TypeError, ValueError):
+                retry_after = None
+            raise HttpClientError(e.code, msg,
+                                  retry_after=retry_after) from e
         except urllib.error.URLError as e:
             raise HttpClientError(
                 0, f"{self.base} unreachable: {e.reason}"

@@ -781,6 +781,59 @@ def test_abstract_intermediate_exempt_but_leaf_checked():
     assert fs[0].message.startswith("class 'Leaf'")
 
 
+# -- wire-codec (DASE-contracts family) -------------------------------------
+
+def test_wire_codec_packing_outside_codec_fires():
+    from pio_tpu.analysis import lint_text
+    src = """
+        import struct
+        import numpy as np
+
+        def handle(req):
+            head = struct.pack("<I", 7)        # a second codec sprouting
+            rows = np.frombuffer(req.body, "<i4", 10, 4)
+            return head + rows.tobytes()
+    """
+    fs = lint_text(textwrap.dedent(src),
+                   path="pio_tpu/server/someroute.py",
+                   select=["wire-codec"])
+    assert [f.rule for f in fs] == ["wire-codec"] * 3
+    assert "ONE codec" in fs[0].message
+    # the same code outside pio_tpu/ (tests bit-flipping frames, bench
+    # drivers) is exempt
+    assert lint_text(textwrap.dedent(src),
+                     path="tests/test_frames.py",
+                     select=["wire-codec"]) == []
+
+
+def test_wire_codec_owner_modules_and_suppression_silent():
+    from pio_tpu.analysis import lint_text
+    src = """
+        import struct
+
+        HEAD = struct.Struct("<HHIIQQ")
+
+        def pack(n):
+            return HEAD.pack(1, 0, n, 0, 0, 0)
+    """
+    # the codec module itself (and every sanctioned protocol owner) is
+    # exactly where this packing belongs
+    for owner in ("pio_tpu/data/columnar.py", "pio_tpu/utils/durable.py",
+                  "pio_tpu/data/backends/pgwire.py"):
+        assert lint_text(textwrap.dedent(src), path=owner,
+                         select=["wire-codec"]) == []
+    suppressed = """
+        import struct
+
+        def read_tomb(blob):
+            # pio: lint-ok[wire-codec] reads the record codec's own file
+            return struct.unpack_from("<H", blob, 0)
+    """
+    assert lint_text(textwrap.dedent(suppressed),
+                     path="pio_tpu/data/backends/x.py",
+                     select=["wire-codec"]) == []
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_suppression_same_line_and_block_above():

@@ -53,8 +53,15 @@ def test_event_client_crud(event_server):
     assert len(statuses) == 10
     assert all(s["status"] == 201 for s in statuses)
 
+    # the binary wire (default) accepts bulk batches up to its own
+    # ceiling; the JSON wire keeps the reference's 50-event limit
+    from pio_tpu.sdk import BINARY_BATCH_LIMIT
+
     with pytest.raises(ValueError, match="batch limit"):
-        c.create_events_batch([{}] * (BATCH_LIMIT + 1))
+        c.create_events_batch([{}] * (BINARY_BATCH_LIMIT + 1))
+    cj = EventClient("SDKKEY", f"http://127.0.0.1:{srv.port}", wire="json")
+    with pytest.raises(ValueError, match="batch limit"):
+        cj.create_events_batch([{}] * (BATCH_LIMIT + 1))
 
 
 def test_event_client_auth_errors(event_server):
@@ -93,3 +100,221 @@ def test_engine_client_roundtrip(memory_storage):
     finally:
         http.stop()
         qs.close()
+
+
+def test_event_client_json_wire_still_supported(event_server):
+    srv, storage, app_id = event_server
+    c = EventClient("SDKKEY", f"http://127.0.0.1:{srv.port}", wire="json")
+    statuses = c.create_events_batch([
+        {"event": "rate", "entityType": "user", "entityId": "uj",
+         "targetEntityType": "item", "targetEntityId": "ij"}
+    ])
+    assert statuses[0]["status"] == 201
+    with pytest.raises(ValueError, match="wire"):
+        EventClient("K", wire="msgpack")
+
+
+def _scripted_server(responses):
+    """A bare HttpApp server whose /batch + /events routes pop scripted
+    (status, payload, headers) triples — the 429 choreography driver."""
+    from pio_tpu.server.http import HttpApp, HttpServer, json_response
+
+    app = HttpApp("scripted")
+    seen = {"bodies": [], "ctypes": []}
+
+    def pop(req):
+        seen["bodies"].append(req.body)
+        seen["ctypes"].append(req.header("content-type"))
+        status, payload, headers = responses.pop(0)
+        if headers:
+            return status, json_response(payload, headers)
+        return status, payload
+
+    app.route("POST", r"/batch/events\.json")(pop)
+    app.route("POST", r"/events\.json")(pop)
+    srv = HttpServer(app, host="127.0.0.1", port=0)
+    srv.start()
+    return srv, seen
+
+
+def test_sdk_absorbs_whole_request_429_with_retry_after():
+    """A 429 + Retry-After from the spill high-water mark is retried by
+    the RetryPolicy (backoff floored at the server hint) instead of
+    surfacing to the caller; stats count the shed/retried."""
+    srv, seen = _scripted_server([
+        (429, {"message": "spill queue past high water"},
+         {"Retry-After": "3"}),
+        (429, {"message": "spill queue past high water"},
+         {"Retry-After": "3"}),
+        (201, {"eventId": "ok1"}, None),
+    ])
+    try:
+        c = EventClient("K", f"http://127.0.0.1:{srv.port}")
+        sleeps = []
+        c._sleep = sleeps.append
+        eid = c.create_event(event="rate", entity_type="user",
+                             entity_id="u1")
+        assert eid == "ok1"
+        assert c.stats == {"shed": 2, "retried": 2}
+        # backoff floored at the Retry-After hint (policy max_delay 2.0)
+        assert len(sleeps) == 2 and all(s >= 2.0 for s in sleeps)
+    finally:
+        srv.stop()
+
+
+def test_sdk_surfaces_429_only_after_policy_exhausted():
+    from pio_tpu.resilience import RetryPolicy
+
+    srv, _ = _scripted_server([
+        (429, {"message": "busy"}, {"Retry-After": "0.01"})
+        for _ in range(3)
+    ])
+    try:
+        c = EventClient("K", f"http://127.0.0.1:{srv.port}",
+                        retry=RetryPolicy(attempts=3, base_delay_s=0.001,
+                                          max_delay_s=0.002))
+        c._sleep = lambda d: None
+        with pytest.raises(PIOError) as err:
+            c.create_event(event="rate", entity_type="user",
+                           entity_id="u1")
+        assert err.value.status == 429
+        assert c.stats["shed"] == 3  # every verdict counted
+        assert c.stats["retried"] == 2  # attempts - 1 resubmissions
+    finally:
+        srv.stop()
+
+
+def test_sdk_resends_per_slot_429s_binary_wire():
+    """Per-slot 429s inside a 200 batch response (the batch route's
+    spill-saturation shape) are re-submitted — only the shed slots —
+    and statuses merge back in input order; the resend rides the binary
+    wire like the original."""
+    from pio_tpu.data.columnar import (
+        COLUMNAR_CONTENT_TYPE, decode_api_batch_binary,
+    )
+
+    srv, seen = _scripted_server([
+        (200, [{"status": 201, "eventId": "a"},
+               {"status": 429, "message": "shed"},
+               {"status": 201, "eventId": "c"},
+               {"status": 429, "message": "shed"}], None),
+        (200, [{"status": 201, "eventId": "b"},
+               {"status": 201, "eventId": "d"}], None),
+    ])
+    try:
+        c = EventClient("K", f"http://127.0.0.1:{srv.port}")
+        c._sleep = lambda d: None
+        batch = [{"event": "rate", "entityType": "user",
+                  "entityId": f"u{i}"} for i in range(4)]
+        out = c.create_events_batch(batch)
+        assert [r.get("eventId") for r in out] == ["a", "b", "c", "d"]
+        assert c.stats == {"shed": 2, "retried": 2}
+        assert all(ct.startswith(COLUMNAR_CONTENT_TYPE)
+                   for ct in seen["ctypes"])
+        # the resend carried ONLY the shed slots, binary-encoded
+        resent = decode_api_batch_binary(seen["bodies"][1])
+        assert [e.entity_id for e in resent] == ["u1", "u3"]
+    finally:
+        srv.stop()
+
+
+def test_sdk_downgrades_to_json_wire_against_pre_binary_server():
+    """A pre-binary server answers its dispatch-level 'Invalid JSON
+    body' 400 to a columnar frame (it ran req.json() on the bytes); the
+    client downgrades to the JSON wire for its lifetime instead of
+    hard-failing every batch — symmetric with the read paths' 404 and
+    Accept fallbacks."""
+    # the EMPIRICAL pre-binary shape: authed catches the
+    # UnicodeDecodeError from req.json() on frame bytes and 400s str(e)
+    srv, seen = _scripted_server([
+        (400, {"message": "'utf-8' codec can't decode byte 0xa1 in "
+                          "position 5: invalid start byte"}, None),
+        (200, [{"status": 201, "eventId": "j1"}], None),
+        (200, [{"status": 201, "eventId": "j2"}], None),
+    ])
+    try:
+        c = EventClient("K", f"http://127.0.0.1:{srv.port}")
+        out = c.create_events_batch(
+            [{"event": "rate", "entityType": "user", "entityId": "u1"}])
+        assert out[0]["eventId"] == "j1"
+        assert c.wire == "json"  # sticky downgrade
+        c.create_events_batch(
+            [{"event": "rate", "entityType": "user", "entityId": "u2"}])
+        cts = [ct.split(";")[0] for ct in seen["ctypes"]]
+        assert cts[0] == "application/x-pio-columnar"
+        assert cts[1] == cts[2] == "application/json"
+        # a genuine 400 (not the pre-binary marker) still surfaces
+        srv2, _ = _scripted_server([(400, {"message": "bad batch"}, None)])
+        try:
+            c2 = EventClient("K", f"http://127.0.0.1:{srv2.port}")
+            with pytest.raises(PIOError, match="bad batch"):
+                c2.create_events_batch(
+                    [{"event": "rate", "entityType": "user",
+                      "entityId": "u1"}])
+            assert c2.wire == "binary"
+        finally:
+            srv2.stop()
+    finally:
+        srv.stop()
+
+
+def test_sdk_downgrade_detection_matches_real_pre_binary_server(
+        memory_storage):
+    """The downgrade sentinel must match what a pre-binary server
+    ACTUALLY answers to frame bytes: drive a server whose batch route
+    runs req.json() exactly like the old authed wrapper did."""
+    import json as _json
+
+    from pio_tpu.server.http import HttpApp, HttpServer
+
+    app = HttpApp("prebinary")
+    calls = {"n": 0}
+
+    @app.route("POST", r"/batch/events\.json")
+    def old_batch(req):
+        calls["n"] += 1
+        try:
+            body = req.json()  # the pre-binary route's first act
+        except ValueError as e:  # authed's 400 net (JSONDecodeError too)
+            return 400, {"message": str(e)}
+        return 200, [{"status": 201, "eventId": f"old{i}"}
+                     for i in range(len(body))]
+
+    srv = HttpServer(app, host="127.0.0.1", port=0).start()
+    try:
+        c = EventClient("K", f"http://127.0.0.1:{srv.port}")
+        out = c.create_events_batch(
+            [{"event": "rate", "entityType": "user", "entityId": "u1"}])
+        assert out[0]["status"] == 201
+        assert c.wire == "json" and calls["n"] == 2
+        # the encoded frame genuinely failed the old server's JSON parse
+        _json  # (imported for clarity of what the route emulates)
+    finally:
+        srv.stop()
+
+
+def test_sdk_keeps_receipts_when_resend_fails():
+    """A resend that itself errors must not discard the first response's
+    accepted eventIds — the caller keeps partial receipts plus honest
+    per-slot 429s instead of an exception that would invite a duplicate
+    full-batch replay."""
+    from pio_tpu.resilience import RetryPolicy
+
+    srv, _ = _scripted_server([
+        (200, [{"status": 201, "eventId": "a"},
+               {"status": 429, "message": "shed"}], None),
+        (429, {"message": "still busy"}, {"Retry-After": "0.01"}),
+        (429, {"message": "still busy"}, {"Retry-After": "0.01"}),
+    ])
+    try:
+        c = EventClient("K", f"http://127.0.0.1:{srv.port}",
+                        retry=RetryPolicy(attempts=2, base_delay_s=0.001,
+                                          max_delay_s=0.002))
+        c._sleep = lambda d: None
+        out = c.create_events_batch(
+            [{"event": "rate", "entityType": "user", "entityId": "u1"},
+             {"event": "rate", "entityType": "user", "entityId": "u2"}])
+        assert out[0] == {"status": 201, "eventId": "a"}
+        assert out[1]["status"] == 429
+    finally:
+        srv.stop()
