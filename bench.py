@@ -872,6 +872,10 @@ def phase_smoke() -> dict:
         r["events_per_sec_sequential"] for r in ingest_reps)
     out["binary_ingest"] = _smoke_binary_ingest_cell()
     out["binary_ingest_x_native"] = out["binary_ingest"].get("x_native")
+    out["replicated_ingest"] = _smoke_replicated_ingest_cell(
+        out["binary_ingest"]["binary_events_per_sec"])
+    out["replicated_ingest_x_single"] = out["replicated_ingest"].get(
+        "x_single")
 
     from pio_tpu.controller import EngineParams
     from pio_tpu.data import DataMap, Event
@@ -1213,6 +1217,46 @@ def _smoke_binary_ingest_cell() -> dict:
     return out
 
 
+def _smoke_replicated_ingest_cell(single_eps: float) -> dict:
+    """Replicated-store ingest overhead (ISSUE 12 acceptance): the same
+    binary-wire ingest as the single-backend cell, through a
+    ReplicatedEventsDAO fanning every batch to R=3 in-process memory
+    replicas at W=2. The ratio vs the single-backend number measured
+    moments earlier on the same box is the BASELINE.json
+    `replicated_ingest_x_single` absolute contract FLOOR (0.7, never
+    --update-baseline'd): replication durability may cost at most 30%
+    of ingest throughput on this profile."""
+    import shutil
+    import tempfile
+
+    hint_dir = tempfile.mkdtemp(prefix="pio_smoke_hints_")
+    env = {
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_R_TYPE": "replicated",
+        "PIO_STORAGE_SOURCES_R_TYPES": "memory,memory,memory",
+        "PIO_STORAGE_SOURCES_R_WRITE_QUORUM": "2",
+        "PIO_STORAGE_SOURCES_R_HINT_DIR": hint_dir,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    }
+    try:
+        repl = max((_ingest_once(env, wire="binary") for _ in range(3)),
+                   key=lambda r: r["events_per_sec"])
+    finally:
+        shutil.rmtree(hint_dir, ignore_errors=True)
+    return {
+        "replicated_events_per_sec": repl["events_per_sec"],
+        "single_events_per_sec": single_eps,
+        "x_single": (round(repl["events_per_sec"] / single_eps, 3)
+                     if single_eps else None),
+        "replicas": 3,
+        "write_quorum": 2,
+        "shed_events": repl["shed_events"],
+        "retried_batches": repl["retried_batches"],
+    }
+
+
 def _smoke_kernel_cell() -> dict:
     """Kernel-lab microcell for the smoke gate: the interpret-mode
     streaming gather (ops/als_pallas.py gather_rows_stream) vs the XLA
@@ -1535,6 +1579,20 @@ def smoke_main() -> int:
             res["binary_ingest_x_native"] is not None
             and res["binary_ingest_x_native"]
             >= base["binary_ingest_x_native"])
+    if "replicated_ingest_x_single" in base:
+        # ISSUE 12 contract FLOOR, absolute and never refreshed by
+        # --update-baseline: W=2-of-3 replicated binary-wire ingest must
+        # hold >= this fraction of the single-backend binary-wire rate,
+        # both arms best-of-3 on the same box moments apart. Quorum
+        # durability may tax ingest, but a fan-out that serializes or
+        # re-encodes per replica would crater this ratio — that is the
+        # regression class the gate exists to catch.
+        checks["replicated_ingest_x_single"] = (
+            res["replicated_ingest_x_single"],
+            base["replicated_ingest_x_single"],
+            res["replicated_ingest_x_single"] is not None
+            and res["replicated_ingest_x_single"]
+            >= base["replicated_ingest_x_single"])
     if "tracing_overhead_p50_x" in base:
         # observability-cost CONTRACT ceiling (ISSUE 9): serving p50
         # with the TraceRecorder on must stay within 5% of recorder-off

@@ -41,6 +41,15 @@ pools) and an asyncio event loop side by side, so the hazards are:
     Stricter than `durable-write` on purpose: in this package there is
     no benign direct write, so the rule needs no artifact-name
     heuristic.
+  * `hint-log`       — ANY direct file-write persistence inside
+    `pio_tpu/data/backends/replicated.py` (the `foldin-cursor` shapes):
+    the hinted-handoff log IS the durability of every acknowledged
+    write a down replica missed, so every byte it persists must ride
+    `utils/durable.py` (FrameLog: per-record CRC32C frame + fsync'd
+    append + atomic compaction, or durable_write for state blobs). A
+    raw write that tears mid-crash silently loses an ACKED event on
+    the rejoining replica — the exact loss class the replicated store
+    exists to end.
   * `rollout-state`  — inside `pio_tpu/rollout/`, (a) ANY assignment to
     a stage/verdict attribute (`*.stage`, `*.stage_index`,
     `*.stage_pct`, `*.verdict`) outside the controller's `_transition`
@@ -115,6 +124,9 @@ _ARTIFACT_RE = re.compile(r"model|ckpt|checkpoint", re.IGNORECASE)
 
 # foldin-cursor scope: every module of the freshness subsystem
 _FRESHNESS_PATHS = ("pio_tpu/freshness/",)
+# hint-log scope: the replicated event backend (hinted handoff +
+# scrub-state persistence)
+_REPLICATED_PATHS = ("pio_tpu/data/backends/replicated.py",)
 # rollout-state scope + the attribute names that ARE rollout state
 _ROLLOUT_PATHS = ("pio_tpu/rollout/",)
 _ROLLOUT_STATE_ATTRS = frozenset({"stage", "stage_index", "stage_pct",
@@ -132,13 +144,14 @@ _PERSIST_METHODS = frozenset({"write_text", "write_bytes"})
 class ConcurrencyRule:
     id = "concurrency"
     ids = ("attr-no-lock", "global-no-lock", "async-blocking", "bare-retry",
-           "durable-write", "foldin-cursor", "rollout-state")
+           "durable-write", "foldin-cursor", "hint-log", "rollout-state")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         yield from self._async_blocking(ctx)
         yield from self._bare_retry(ctx)
         yield from self._durable_write(ctx)
         yield from self._foldin_cursor(ctx)
+        yield from self._hint_log(ctx)
         yield from self._rollout_state(ctx)
         if not ctx.imports_any("threading", "asyncio", "multiprocessing",
                                "concurrent"):
@@ -369,6 +382,24 @@ class ConcurrencyRule:
                "either replays from event 0 or silently loses fold-ins")
         for node, what in self._direct_file_writes(ctx):
             yield self._f("foldin-cursor", ctx, node, msg.format(what=what))
+
+    # -- hinted-handoff log persistence ---------------------------------------
+    def _hint_log(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """Flag EVERY direct file-write in the replicated event backend
+        (see module docstring): hint records and scrub state must ride
+        utils/durable (FrameLog / durable_write), and the module has no
+        other legitimate direct writes."""
+        path = ctx.path.replace("\\", "/")
+        if not any(p in path for p in _REPLICATED_PATHS):
+            return
+        msg = ("direct file write in the replicated event backend "
+               "({what}): hinted-handoff records and scrub state must "
+               "ride pio_tpu.utils.durable (FrameLog: CRC32C frame + "
+               "fsync'd append + atomic compaction; durable_write for "
+               "state blobs) — a torn hint silently loses an "
+               "acknowledged write on the rejoining replica")
+        for node, what in self._direct_file_writes(ctx):
+            yield self._f("hint-log", ctx, node, msg.format(what=what))
 
     @staticmethod
     def _direct_file_writes(ctx: ModuleContext):

@@ -21,6 +21,7 @@ actionable.
 
 from __future__ import annotations
 
+import logging
 from datetime import datetime
 from typing import Iterator, Sequence
 
@@ -29,6 +30,8 @@ from pio_tpu.data.backends import wire as w
 from pio_tpu.data.event import Event
 from pio_tpu.data.storage import Backend, StorageError
 from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+log = logging.getLogger("pio_tpu.remote")
 
 # page size for unbounded (limit=-1) remote finds; bounds each RPC
 # response while keeping round trips rare (10k events ≈ a few MB JSON)
@@ -270,6 +273,15 @@ class _RemoteModels(_Remote, d.ModelsDAO):
 class _RemoteEvents(_Remote, d.EventsDAO):
     family = "events"
 
+    def __init__(self, b: RemoteBackend):
+        super().__init__(b)
+        # sticky binary-read downgrade (the SDK wire downgrade's shape):
+        # a 404/405 on POST /rpc/columnar means a pre-binary storage
+        # server — logged ONCE per client, and every later
+        # find_columnar goes straight to the paged-JSON path instead of
+        # paying a doomed round trip (and silently hiding the downgrade)
+        self._columnar_downgraded = False
+
     def init(self, app_id, channel_id=None):
         return bool(self.call("init", app_id=app_id, channel_id=channel_id))
 
@@ -321,11 +333,25 @@ class _RemoteEvents(_Remote, d.EventsDAO):
         and this client decodes it by ``frombuffer`` pointer-cast
         (data/columnar.py), instead of paging per-event JSON through
         ``find`` and re-columnarizing client-side. A pre-binary server
-        (404/405 on the route) falls back to exactly that JSON path."""
+        (404/405 on the route) downgrades to exactly that JSON path —
+        STICKY for this client's lifetime and logged once (a silent
+        per-call fallback would hide a 100x-payload regression from
+        every operator dashboard)."""
         from pio_tpu.data.columnar import (
             COLUMNAR_CONTENT_TYPE, WireFormatError, decode_columnar_events,
         )
 
+        def json_fallback():
+            return super(_RemoteEvents, self).find_columnar(
+                app_id=app_id, channel_id=channel_id,
+                start_time=start_time, until_time=until_time,
+                entity_type=entity_type, entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id)
+
+        if self._columnar_downgraded:
+            return json_fallback()
         q = w.find_kwargs_to_wire(
             start_time=start_time, until_time=until_time,
             entity_type=entity_type, entity_id=entity_id,
@@ -341,14 +367,16 @@ class _RemoteEvents(_Remote, d.EventsDAO):
                 params, accept=COLUMNAR_CONTENT_TYPE)
         except HttpClientError as e:
             if e.status in (404, 405):
-                # pre-binary storage server: the JSON scatter-gather path
-                return super().find_columnar(
-                    app_id=app_id, channel_id=channel_id,
-                    start_time=start_time, until_time=until_time,
-                    entity_type=entity_type, entity_id=entity_id,
-                    event_names=event_names,
-                    target_entity_type=target_entity_type,
-                    target_entity_id=target_entity_id)
+                # pre-binary storage server: downgrade to the paged-JSON
+                # path, once and loudly
+                self._columnar_downgraded = True
+                log.warning(
+                    "storage server %s has no POST /rpc/columnar "
+                    "(HTTP %d) — downgrading find_columnar to paged "
+                    "JSON for this client's lifetime; upgrade the "
+                    "server to restore the binary read path",
+                    self.b._url, e.status)
+                return json_fallback()
             raise self.b.storage_error("events.find_columnar", e) from e
         if not isinstance(blob, bytes):
             raise StorageError(

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import hashlib
 import heapq
+import os
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from datetime import datetime
 from typing import Iterable, Iterator, Sequence
@@ -323,34 +324,164 @@ class ShardedEventsDAO(daomod.EventsDAO):
         return out
 
 
+class ReplicatedShardedEventsDAO(ShardedEventsDAO):
+    """Sharded composite whose shard groups are each a
+    ``ReplicatedEventsDAO``: aggregates the per-group replication
+    surface so ``pio doctor --storage`` and the event server's
+    ``/metrics`` replication gauges work on the composed topology too
+    (without this, the production config with the most moving parts
+    would be the one with zero replication observability)."""
+
+    def replication_status(self, probe: bool = False) -> dict:
+        per_group = [s.replication_status(probe=probe)
+                     for s in self.shards]
+        replicas = []
+        for si, st in enumerate(per_group):
+            for r in st["replicas"]:
+                replicas.append({**r, "replica": f"shard{si}/"
+                                                 f"{r['replica']}"})
+        counters: dict[str, int] = {}
+        for st in per_group:
+            for k, v in st["counters"].items():
+                counters[k] = counters.get(k, 0) + v
+        lat = {
+            "bucketsS": per_group[0]["quorumLatency"]["bucketsS"],
+            "counts": [
+                sum(st["quorumLatency"]["counts"][k]
+                    for st in per_group)
+                for k in range(len(per_group[0]["quorumLatency"]
+                               ["counts"]))],
+            "sumSeconds": sum(st["quorumLatency"]["sumSeconds"]
+                              for st in per_group),
+            "count": sum(st["quorumLatency"]["count"]
+                         for st in per_group),
+        }
+        # most recent group scrub stands in for the composite's row
+        scrubs = [st["scrub"] for st in per_group if st.get("scrub")]
+        scrub = max(scrubs, key=lambda s: s.get("lastScrubTs", 0),
+                    default={})
+        out = {
+            "replicas": replicas,
+            "n": sum(st["n"] for st in per_group),
+            # display-only on the composite: quorum is PER GROUP; the
+            # authoritative verdict is quorumOk below
+            "writeQuorum": max(st["writeQuorum"] for st in per_group),
+            "hintDepthTotal": sum(st["hintDepthTotal"]
+                                  for st in per_group),
+            "counters": counters,
+            "quorumLatency": lat,
+            "scrub": scrub,
+            "groups": [
+                {"shard": si, "n": st["n"],
+                 "writeQuorum": st["writeQuorum"],
+                 **({"liveReplicas": st["liveReplicas"],
+                     "quorumOk": st["quorumOk"]}
+                    if "quorumOk" in st else {})}
+                for si, st in enumerate(per_group)],
+        }
+        if probe:
+            out["liveReplicas"] = sum(st["liveReplicas"]
+                                      for st in per_group)
+            # EVERY group must hold its own quorum: one group below W
+            # means that slice of the keyspace is failing writes
+            out["quorumOk"] = all(st["quorumOk"] for st in per_group)
+        return out
+
+    def scrub(self, app_id: int, channel_id: int | None = None,
+              repair: bool = True) -> dict:
+        """Scrub every shard group's replica set (groups hold disjoint
+        slices, so per-group results sum)."""
+        parts = [s.scrub(app_id, channel_id, repair=repair)
+                 for s in self.shards]
+        return {
+            "appId": app_id, "channelId": channel_id,
+            "bucketsChecked": sum(p["bucketsChecked"] for p in parts),
+            "divergentBuckets": sum(p["divergentBuckets"] for p in parts),
+            "repairedEvents": sum(p["repairedEvents"] for p in parts),
+            "replicasScrubbed": sum(p["replicasScrubbed"] for p in parts),
+            "repair": repair,
+        }
+
+    def scrub_all(self, repair: bool = True) -> list[dict]:
+        out: list[dict] = []
+        for s in self.shards:
+            out.extend(s.scrub_all(repair=repair))
+        return out
+
+
 class ShardedBackend(Backend):
-    """Events-only composite over N remote storage servers."""
+    """Events-only composite over N remote storage servers.
+
+    Per-shard-group replication (docs/storage.md "Replication"): a URL
+    entry may itself be a ``|``-separated replica group —
+    ``URLS=a|b,c|d`` is 2 shards x 2 replicas, each shard group a
+    ``ReplicatedEventsDAO`` (quorum writes, hinted handoff, scrub) over
+    its replicas, with chaos points ``storage.shard<i>.replica<j>.*``
+    and hint logs under ``HINT_DIR/shard<i>/``. ``WRITE_QUORUM``/
+    ``SCRUB_INTERVAL_S``/``DRAIN_INTERVAL_S`` apply per group."""
 
     def __init__(self, config: StorageClientConfig):
         super().__init__(config)
         from pio_tpu.data.backends.remote import RemoteBackend
 
-        urls = [u.strip() for u in
-                config.properties.get("URLS", "").split(",") if u.strip()]
-        if not urls:
+        props = config.properties
+        groups = [
+            [u.strip() for u in g.split("|") if u.strip()]
+            for g in props.get("URLS", "").split(",") if g.strip()
+        ]
+        if not groups:
             raise StorageError(
                 "sharded backend: set PIO_STORAGE_SOURCES_<N>_URLS to a "
-                "comma-separated list of storage-server URLs")
-        self._children = [
-            RemoteBackend(StorageClientConfig(
+                "comma-separated list of storage-server URLs (each entry "
+                "optionally a |-separated replica group)")
+
+        def remote(u: str) -> RemoteBackend:
+            return RemoteBackend(StorageClientConfig(
                 properties={
                     "URL": u,
-                    "KEY": config.properties.get("KEY", ""),
-                    "TIMEOUT": config.properties.get("TIMEOUT", "30"),
-                    "VERIFY_TLS": config.properties.get(
-                        "VERIFY_TLS", "true"),
+                    "KEY": props.get("KEY", ""),
+                    "TIMEOUT": props.get("TIMEOUT", "30"),
+                    "VERIFY_TLS": props.get("VERIFY_TLS", "true"),
                 },
                 test=config.test,
             ))
-            for u in urls
-        ]
-        self._events = ShardedEventsDAO(
-            [c.events() for c in self._children])
+
+        self._children = []
+        shard_daos: list[daomod.EventsDAO] = []
+        replicated = any(len(g) > 1 for g in groups)
+        if replicated:
+            from pio_tpu.data.backends.replicated import (
+                ReplicatedEventsDAO, _hint_dir_default,
+            )
+
+            from pio_tpu.utils.httpclient import JsonHttpClient
+
+            hint_root = props.get("HINT_DIR") or _hint_dir_default()
+            quorum = int(props.get("WRITE_QUORUM", "0")) or None
+            for si, g in enumerate(groups):
+                members = [remote(u) for u in g]
+                self._children.extend(members)
+                probes = [
+                    (lambda c=JsonHttpClient(u, timeout=3.0):
+                     c.request("GET", "/healthz"))
+                    for u in g
+                ]
+                shard_daos.append(ReplicatedEventsDAO(
+                    [m.events() for m in members],
+                    probes=probes,
+                    write_quorum=min(quorum, len(g)) if quorum else None,
+                    hint_dir=os.path.join(hint_root, f"shard{si}"),
+                    drain_interval_s=float(
+                        props.get("DRAIN_INTERVAL_S", "0.5")),
+                    scrub_interval_s=float(
+                        props.get("SCRUB_INTERVAL_S", "0")),
+                    point_prefix=f"storage.shard{si}",
+                ))
+        else:
+            self._children = [remote(g[0]) for g in groups]
+            shard_daos = [c.events() for c in self._children]
+        self._events = (ReplicatedShardedEventsDAO(shard_daos)
+                        if replicated else ShardedEventsDAO(shard_daos))
 
     def events(self) -> daomod.EventsDAO:
         return self._events

@@ -95,6 +95,9 @@ _BACKEND_REGISTRY: dict[str, str] = {
     # entity-hash-sharded composite over N storage servers (the
     # reference's HBase region-distribution role, HBEventsUtil.scala:74)
     "sharded": "pio_tpu.data.backends.sharded:ShardedBackend",
+    # R-way replicated event store: quorum writes + hinted handoff +
+    # anti-entropy scrub (the reference's HBase replication role)
+    "replicated": "pio_tpu.data.backends.replicated:ReplicatedBackend",
     # standard networked multi-writer DB (reference JDBC/PostgreSQL role)
     "postgres": "pio_tpu.data.backends.postgres:PostgresBackend",
     "postgresql": "pio_tpu.data.backends.postgres:PostgresBackend",

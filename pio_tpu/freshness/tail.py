@@ -145,17 +145,29 @@ class LocalEventSource:
 class HttpEventSource:
     """Tail + history over the event server's REST API (the
     cross-process folder shape): ``GET /tail/events.json`` for the
-    columnar window, ``GET /events.json?entityId=…`` for histories."""
+    columnar window, ``GET /events.json?entityId=…`` for histories.
+
+    ``wait_s`` (default 10) turns the tail poll into a LONG-POLL push
+    subscription: an idle window blocks server-side until an ingest
+    lands, so event→fold latency is one store round trip instead of one
+    poll interval. A pre-long-poll event server ignores the parameter
+    and answers immediately — the folder's poll-interval loop then IS
+    the fallback, unchanged. ``wait_s=0`` restores plain polling."""
 
     def __init__(self, url: str, access_key: str,
                  channel_name: str | None = None,
                  entity_type: str = "user",
                  target_entity_type: str = "item",
                  event_names: Sequence[str] = ("rate", "buy"),
-                 timeout: float = 10.0, tail_limit: int = 20000):
+                 timeout: float = 10.0, tail_limit: int = 20000,
+                 wait_s: float = 10.0):
         from pio_tpu.utils.httpclient import JsonHttpClient
 
-        self.client = JsonHttpClient(url, timeout=timeout)
+        self.wait_s = max(0.0, wait_s)
+        # the transport timeout must outlive the server-side wait, or
+        # every idle long-poll would surface as a client timeout
+        self.client = JsonHttpClient(
+            url, timeout=max(timeout, self.wait_s + 5.0))
         self.access_key = access_key
         self.channel_name = channel_name
         self.entity_type = entity_type
@@ -179,15 +191,17 @@ class HttpEventSource:
             COLUMNAR_CONTENT_TYPE, decode_columnar_events,
         )
 
+        params = self._params(
+            sinceUs=str(cursor.time_us),
+            limit=str(self.tail_limit),
+            entityType=self.entity_type,
+            targetEntityType=self.target_entity_type,
+            events=",".join(self.event_names),
+        )
+        if self.wait_s > 0:
+            params["waitS"] = str(self.wait_s)
         out = self.client.request(
-            "GET", "/tail/events.json",
-            params=self._params(
-                sinceUs=str(cursor.time_us),
-                limit=str(self.tail_limit),
-                entityType=self.entity_type,
-                targetEntityType=self.target_entity_type,
-                events=",".join(self.event_names),
-            ),
+            "GET", "/tail/events.json", params=params,
             accept=COLUMNAR_CONTENT_TYPE)
         if isinstance(out, bytes):
             cols = decode_columnar_events(out)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Any, Callable
 
@@ -73,7 +74,11 @@ class SpillQueue:
                           if self.high_water else 0)
         self._base_interval_s = base_interval_s
         self._max_interval_s = max_interval_s
-        self._q: deque[tuple[Any, int, int | None]] = deque()
+        # (event, app_id, channel_id, enqueue monotonic time): the
+        # timestamp feeds the oldest-spilled-event age gauge — an aging
+        # backlog is the early-warning signal that the drain is losing
+        # to the spill rate, visible on /metrics before 429s start
+        self._q: deque[tuple[Any, int, int | None, float]] = deque()
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -93,7 +98,7 @@ class SpillQueue:
             if self._closed or len(self._q) >= self.capacity:
                 self.dropped_total += 1
                 return False
-            self._q.append((event, app_id, channel_id))
+            self._q.append((event, app_id, channel_id, time.monotonic()))
             self.spilled_total += 1
             if self.high_water and len(self._q) >= self.high_water:
                 self._saturated = True
@@ -129,20 +134,24 @@ class SpillQueue:
         with self._lock:
             if self._saturated and len(self._q) <= self.low_water:
                 self._saturated = False
+            oldest_age = (time.monotonic() - self._q[0][3]
+                          if self._q else 0.0)
             return {
                 "size": len(self._q), "capacity": self.capacity,
                 "highWater": self.high_water, "lowWater": self.low_water,
                 "saturated": self._saturated,
                 "spilled": self.spilled_total, "drained": self.drained_total,
                 "dropped": self.dropped_total, "shed": self.shed_total,
+                "oldestAgeSeconds": oldest_age,
             }
 
     # -- drain side ---------------------------------------------------------
-    def _pop(self) -> tuple[Any, int, int | None] | None:
+    def _pop(self) -> tuple[Any, int, int | None, float] | None:
         with self._lock:
             return self._q.popleft() if self._q else None
 
-    def _requeue_front(self, item: tuple[Any, int, int | None]) -> None:
+    def _requeue_front(self, item: tuple[Any, int, int | None, float]
+                       ) -> None:
         with self._lock:
             self._q.appendleft(item)
 
@@ -157,7 +166,7 @@ class SpillQueue:
                 return
             made_progress = False
             while (item := self._pop()) is not None:
-                event, app_id, channel_id = item
+                event, app_id, channel_id, _ = item
                 try:
                     self._insert(event, app_id, channel_id)
                 except Exception as e:  # noqa: BLE001 - classified below

@@ -51,6 +51,11 @@ MAX_EVENTS_PER_BATCH = 50  # reference EventServer.scala:68
 # still applies slot by slot, and a 10k-event frame is well under the
 # transport's 64 MB body cap (~100 bytes/event on the wire)
 MAX_EVENTS_PER_BINARY_BATCH = 10_000
+# ceiling on GET /tail/events.json?waitS= long-poll blocking: each
+# waiting subscriber holds one worker-pool thread, so the cap bounds
+# how much of the pool a slow consumer fleet can park (clients re-issue
+# on timeout — that IS the poll fallback)
+TAIL_WAIT_CAP_S = 30.0
 
 
 @dataclass
@@ -124,6 +129,21 @@ def build_event_app(
                         low_water=config.spill_low_water)
              if config.spill_capacity > 0 else None)
     app.spill = spill  # exposed for tests/ops (and readiness below)
+
+    # long-poll push subscription (GET /tail/events.json?waitS=): every
+    # accepted ingest bumps the sequence and wakes blocked tail readers,
+    # so the freshness folder sees an event within one store round trip
+    # instead of one poll interval. Spill-drain re-inserts bypass this
+    # hook; waiters cover that with a bounded re-read backstop.
+    tail_cond = threading.Condition()
+    tail_seq = [0]
+
+    def tail_notify() -> None:
+        with tail_cond:
+            tail_seq[0] += 1
+            tail_cond.notify_all()
+
+    app.tail_notify = tail_notify  # exposed for tests
 
     def offer_or_shed(event: Event, app_id: int,
                       channel_id: int | None) -> bool:
@@ -236,6 +256,7 @@ def build_event_app(
             event_id, spilled = event.event_id, True
         if config.stats:  # gated like reference EventServer.scala:284-285
             stats.update(ak.appid, 201, event.event, event.entity_type)
+        tail_notify()
         return event_id, spilled
 
     # -- per-wire-codec ingest counters (docs/observability.md): the
@@ -394,6 +415,9 @@ def build_event_app(
                     for i, event in to_insert:
                         results[i] = {"status": 201,
                                       "eventId": event.event_id}
+        if any(isinstance(r, dict) and r.get("status") == 201
+               for r in results):
+            tail_notify()  # wake long-poll tail subscribers
         return results  # type: ignore[return-value]
 
     # -- routes -------------------------------------------------------------
@@ -465,6 +489,7 @@ def build_event_app(
         if status == 0:
             if config.stats:
                 stats.update(ak.appid, 201, event_name, entity_type)
+            tail_notify()
             return 201, {"eventId": payload}
         if status == 2:
             return 403, {"message": payload}
@@ -561,7 +586,16 @@ def build_event_app(
         ``Accept: application/x-pio-columnar`` negotiates the binary
         columnar frame instead (the same sorted/limited window as one
         CRC32C-framed ColumnarEvents batch — consumers derive count and
-        nextUs from the time column); JSON stays the default."""
+        nextUs from the time column); JSON stays the default.
+
+        ``waitS`` turns the poll into a LONG-POLL push subscription:
+        when the window holds nothing strictly newer than ``sinceUs``,
+        the request blocks until an ingest lands (the notify hook) or
+        the wait elapses, then answers the normal shape — a pre-waitS
+        server ignores the parameter and degrades to plain polling
+        transparently. Capped at TAIL_WAIT_CAP_S; a 1s re-read backstop
+        inside the wait covers spill-drain inserts, which bypass the
+        notify hook."""
         import numpy as np
 
         from pio_tpu.data.columnar import (
@@ -571,19 +605,45 @@ def build_event_app(
         p = req.params
         since_us = int(p.get("sinceUs", -1))
         limit = max(1, min(int(p.get("limit", 20000)), 100_000))
+        wait_s = min(max(float(p.get("waitS", 0.0)), 0.0), TAIL_WAIT_CAP_S)
         names = [s for s in (p.get("events") or "").split(",") if s]
-        cols = events_dao.find_columnar(
-            app_id=ak.appid,
-            channel_id=channel_id,
-            start_time=(_restore_time(since_us, 0)
-                        if since_us >= 0 else None),
-            entity_type=p.get("entityType"),
-            event_names=names or None,
-            target_entity_type=(p["targetEntityType"]
-                                if "targetEntityType" in p else ...),
-        )
-        t = np.asarray(cols.time_us)
-        order = np.argsort(t, kind="stable")[:limit]
+
+        def read_window():
+            cols = events_dao.find_columnar(
+                app_id=ak.appid,
+                channel_id=channel_id,
+                start_time=(_restore_time(since_us, 0)
+                            if since_us >= 0 else None),
+                entity_type=p.get("entityType"),
+                event_names=names or None,
+                target_entity_type=(p["targetEntityType"]
+                                    if "targetEntityType" in p else ...),
+            )
+            t = np.asarray(cols.time_us)
+            return cols, t, np.argsort(t, kind="stable")[:limit]
+
+        def has_new(t, order) -> bool:
+            if not order.shape[0]:
+                return False
+            if since_us < 0:
+                return True
+            # boundary-microsecond rows re-read every poll are not news;
+            # only a strictly-newer row ends the wait
+            return int(t[order].max()) > since_us
+
+        deadline = time.monotonic() + wait_s if wait_s > 0 else None
+        while True:
+            with tail_cond:
+                seen = tail_seq[0]
+            cols, t, order = read_window()
+            if deadline is None or has_new(t, order):
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            with tail_cond:
+                if tail_seq[0] == seen:
+                    tail_cond.wait(min(remaining, 1.0))
         if COLUMNAR_CONTENT_TYPE in req.header("accept").lower():
             from pio_tpu.server.http import RawResponse
 
@@ -703,6 +763,8 @@ def build_event_app(
                 # decode is fused with the append inside the C call, so
                 # only events/bytes are separable for the native exit
                 record_wire("json", out, len(req.body), 0.0)
+                if any(s.get("status") == 201 for s in out):
+                    tail_notify()
                 return 200, out
         from pio_tpu.data.columnar import decode_api_batch
 
@@ -758,8 +820,72 @@ def build_event_app(
         if spill is not None:
             s = spill.snapshot()
             counters["spill_queue_depth"] = float(s["size"])
+            # drain health (docs/resilience.md): the drain-rate counter
+            # and the oldest-spilled-event age gauge make an aging
+            # backlog visible long before the high-water 429s start
+            counters["spill_spilled_total"] = float(s["spilled"])
+            counters["spill_drained_total"] = float(s["drained"])
+            counters["spill_dropped_total"] = float(s["dropped"])
+            counters["spill_oldest_age_seconds"] = float(
+                s["oldestAgeSeconds"])
         text = prometheus_text(tracer.snapshot(), counters,
                                labels={"surface": "eventserver"})
+        # replicated event store (docs/storage.md "Replication"): hint
+        # depth per replica, scrub divergence, and the quorum-write
+        # latency histogram, exported whenever the events DAO is a
+        # ReplicatedEventsDAO (duck-typed so every other backend skips)
+        repl_status = getattr(events_dao, "replication_status", None)
+        if callable(repl_status):
+            try:
+                rst = repl_status()
+            except Exception:  # noqa: BLE001 - metrics must never 500
+                rst = None
+            if rst:
+                base_l = {"surface": "eventserver"}
+                rows = [
+                    ({**base_l, "replica": str(r["replica"])},
+                     float(r["hintDepth"]))
+                    for r in rst["replicas"]
+                ]
+                # depth drains back to 0 and divergence clears: gauges,
+                # not counters (a counter TYPE would make every drain
+                # look like a reset to rate())
+                text += "\n".join(prometheus_labeled_counter(
+                    "replica_hint_depth", rows, mtype="gauge")) + "\n"
+                scrub_last = (rst.get("scrub") or {}).get("lastResult") or {}
+                text += "\n".join(prometheus_labeled_counter(
+                    "scrub_divergent_buckets",
+                    [(base_l, float(scrub_last.get("divergentBuckets", 0)))],
+                    mtype="gauge")) + "\n"
+                c = rst.get("counters", {})
+                for name, key in (("replica_hints_total", "hinted"),
+                                  ("replica_hints_drained_total", "drained"),
+                                  ("replica_read_repairs_total",
+                                   "readRepairs")):
+                    text += "\n".join(prometheus_labeled_counter(
+                        name, [(base_l, float(c.get(key, 0)))])) + "\n"
+                # one proper histogram family: ONE TYPE header, samples
+                # named _bucket/_sum/_count (cumulative le convention)
+                lat = rst.get("quorumLatency") or {}
+                lab = "".join(f'{k}="{v}",' for k, v in base_l.items())
+                hlines = ["# TYPE pio_quorum_write_seconds histogram"]
+                cum = 0
+                for ub, cnt in zip(lat.get("bucketsS", []),
+                                   lat.get("counts", [])):
+                    cum += cnt
+                    hlines.append(
+                        f'pio_quorum_write_seconds_bucket'
+                        f'{{{lab}le="{ub:g}"}} {float(cum)}')
+                hlines.append(
+                    f'pio_quorum_write_seconds_bucket{{{lab}le="+Inf"}} '
+                    f'{float(lat.get("count", 0))}')
+                hlines.append(
+                    f'pio_quorum_write_seconds_sum{{{lab[:-1]}}} '
+                    f'{float(lat.get("sumSeconds", 0.0))}')
+                hlines.append(
+                    f'pio_quorum_write_seconds_count{{{lab[:-1]}}} '
+                    f'{float(lat.get("count", 0))}')
+                text += "\n".join(hlines) + "\n"
         # per-wire-codec ingest counters: the JSON -> binary migration
         # shows up as rate moving between the codec labels
         with wire_lock:

@@ -326,6 +326,115 @@ def _doctor_fleet(args) -> int:
     return exit_code
 
 
+def _doctor_storage(args) -> int:
+    """`pio doctor --storage`: the replicated event store's health in
+    one table — per-replica live/breaker/hint-depth/oldest-hint-age,
+    quorum status (exit 1 on lost quorum: fewer live replicas than the
+    write quorum means acked writes would start failing), the last
+    scrub record, and a LIVE read-only convergence check (per-app
+    bucket-digest comparison; `--scrub` repairs divergent buckets in
+    the same pass). Reads THIS process's PIO_STORAGE_* config, like
+    `pio status` — run it where the event tier runs so it sees the
+    same replica set and hint directory."""
+    storage = get_storage()
+    try:
+        dao = storage.get_events()
+    except Exception as e:  # noqa: BLE001 - doctor reports, never dies
+        return _fail(f"could not open the EVENTDATA source: {e}")
+    status_fn = getattr(dao, "replication_status", None)
+    if status_fn is None:
+        return _fail(
+            "the EVENTDATA source is not replicated — `doctor --storage` "
+            "inspects a `replicated` backend (docs/storage.md)")
+    st = status_fn(probe=True)
+    live = st.get("liveReplicas",
+                  sum(1 for r in st["replicas"] if r["live"]))
+    # the sharded composition's verdict is per GROUP (every group must
+    # hold its own quorum); the flat live>=W test covers single-group
+    quorum_ok = st.get("quorumOk", live >= st["writeQuorum"])
+
+    # live convergence check across every known namespace (apps +
+    # channels from the metadata source); --scrub repairs in-pass
+    scrub_results: list[dict] = []
+    scrub_error = ""
+    try:
+        apps = storage.get_metadata_apps().get_all()
+        channels = storage.get_metadata_channels()
+        for app in apps:
+            namespaces: list[int | None] = [None]
+            namespaces += [c.id for c in channels.get_by_appid(app.id)]
+            for ch in namespaces:
+                try:
+                    scrub_results.append(dao.scrub(
+                        app.id, ch, repair=bool(args.scrub)))
+                except Exception as e:  # noqa: BLE001 - a namespace
+                    # that cannot be read is reported, not fatal
+                    scrub_results.append({
+                        "appId": app.id, "channelId": ch,
+                        "error": f"{type(e).__name__}: {e}"})
+    except Exception as e:  # noqa: BLE001 - doctor reports, never dies
+        scrub_error = f"{type(e).__name__}: {e}"
+    divergent = sum(r.get("divergentBuckets", 0) for r in scrub_results)
+    repaired = sum(r.get("repairedEvents", 0) for r in scrub_results)
+
+    if args.json:
+        print(json.dumps({
+            "replication": st,
+            "liveReplicas": live,
+            "quorumOk": quorum_ok,
+            "convergence": scrub_results,
+            "divergentBuckets": divergent,
+            "repairedEvents": repaired,
+            **({"scrubError": scrub_error} if scrub_error else {}),
+        }, indent=2))
+        return 0 if quorum_ok else 1
+
+    print(f"replicated event store: {st['n']} replicas, write quorum "
+          f"{st['writeQuorum']}, {live} live")
+    for g in st.get("groups", ()):
+        ok = ("ok" if g.get("quorumOk", True) else "QUORUM LOST")
+        print(f"  shard group {g['shard']}: "
+              f"{g.get('liveReplicas', '?')}/{g['n']} live, "
+              f"quorum {g['writeQuorum']} — {ok}")
+    print(f"{'replica':>7} {'live':<5} {'breaker':<9} {'hints':>6} "
+          f"{'oldest':>8} {'corrupt':>7}")
+    for r in st["replicas"]:
+        age = r["hintOldestAgeSeconds"]
+        print(f"{r['replica']:>7} {'up' if r['live'] else 'DOWN':<5} "
+              f"{r['breaker']:<9} {r['hintDepth']:>6} "
+              f"{'-' if age is None else f'{age:.0f}s':>8} "
+              f"{r['hintsCorrupt']:>7}")
+    c = st["counters"]
+    print(f"lifetime: hinted {c['hinted']}, drained {c['drained']}, "
+          f"dropped {c['hintsDropped']}, read-repairs {c['readRepairs']}")
+    last = (st.get("scrub") or {})
+    if last.get("lastScrubTs"):
+        import datetime as _dt
+
+        when = _dt.datetime.fromtimestamp(last["lastScrubTs"])
+        res = last.get("lastResult") or {}
+        print(f"last scrub: {when:%Y-%m-%d %H:%M:%S} — "
+              f"{res.get('divergentBuckets', '?')} divergent bucket(s), "
+              f"{res.get('repairedEvents', '?')} event(s) repaired")
+    else:
+        print("last scrub: never")
+    verb = "repair" if args.scrub else "check"
+    print(f"convergence {verb}: {len(scrub_results)} namespace(s), "
+          f"{divergent} divergent bucket(s)"
+          + (f", {repaired} event(s) repaired" if args.scrub else ""))
+    if scrub_error:
+        print(f"[WARN] convergence check failed: {scrub_error}")
+    for r in scrub_results:
+        if r.get("error"):
+            print(f"[WARN] app {r['appId']} channel {r['channelId']}: "
+                  f"{r['error']}")
+    if not quorum_ok:
+        print(f"[FAIL] write quorum LOST: {live} live < "
+              f"{st['writeQuorum']} required — acked writes will fail "
+              "until a replica rejoins")
+    return 0 if quorum_ok else 1
+
+
 def cmd_doctor(args) -> int:
     """Resilience doctor: poll every server surface's /healthz (liveness)
     + /readyz (readiness) and print the per-check detail — storage
@@ -333,11 +442,14 @@ def cmd_doctor(args) -> int:
     backlog, the serving model's instance. The aggregate view `pio
     status` cannot give: status inspects THIS process's storage config;
     doctor inspects the RUNNING stack's health surfaces. With --fleet,
-    inspects a sharded serving fleet through its router instead."""
+    inspects a sharded serving fleet through its router; with
+    --storage, the replicated event store's replicas/hints/convergence."""
     from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
 
     if getattr(args, "fleet", False):
         return _doctor_fleet(args)
+    if getattr(args, "storage", False):
+        return _doctor_storage(args)
 
     surfaces = {
         "eventserver": args.eventserver_port,
@@ -1068,6 +1180,7 @@ def cmd_foldin(args) -> int:
             args.event_server_url, args.access_key,
             channel_name=config.channel_name,
             event_names=config.event_names,
+            wait_s=args.tail_wait,
         )
     storage = get_storage()
     worker = FoldInWorker(storage, config, applier, source=source)
@@ -1495,6 +1608,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="inspect a sharded serving fleet via its router: "
                         "shard plan, per-replica health, replication "
                         "status, open breakers in one table")
+    x.add_argument("--storage", action="store_true",
+                   help="inspect the replicated event store (this "
+                        "process's PIO_STORAGE_* config): per-replica "
+                        "live/breaker/hint-depth/last-scrub + a live "
+                        "convergence check; exit 1 on lost write quorum")
+    x.add_argument("--scrub", action="store_true",
+                   help="with --storage: repair divergent buckets during "
+                        "the convergence pass instead of only reporting")
     x.add_argument("--router-url", default="",
                    help="fleet router base URL (default "
                         "http://<ip>:<serving-port>)")
@@ -1738,6 +1859,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "starting at now")
     x.add_argument("--interval", type=float, default=0.5,
                    help="tail poll interval (seconds)")
+    x.add_argument("--tail-wait", type=float, default=10.0,
+                   help="with --event-server-url: long-poll push "
+                        "subscription — an idle tail blocks server-side "
+                        "this many seconds for new events before "
+                        "answering (0 = plain polling; pre-long-poll "
+                        "servers degrade to polling automatically)")
     x.add_argument("--max-batch-users", type=int, default=1024,
                    help="fold batch cap per cycle")
     x.add_argument("--staleness-budget", type=float, default=60.0,

@@ -232,12 +232,15 @@ def _prom_value(v: float) -> str:
 
 
 def prometheus_labeled_counter(
-    name: str, rows, prefix: str = "pio",
+    name: str, rows, prefix: str = "pio", mtype: str = "counter",
 ) -> list[str]:
     """One `# TYPE` header + one sample per (labels, value) row, with
-    every label value escaped. The single renderer for labeled counters
-    so callers cannot drift on quoting/format details."""
-    lines = [f"# TYPE {prefix}_{name} counter"]
+    every label value escaped. The single renderer for labeled scalar
+    families so callers cannot drift on quoting/format details; `mtype`
+    selects the declared metric type (a drain-able depth is a `gauge` —
+    declaring it a counter makes every drain look like a counter reset
+    to rate())."""
+    lines = [f"# TYPE {prefix}_{name} {mtype}"]
     for labels, value in rows:
         lab = ",".join(
             f'{k}="{escape_label_value(str(v))}"'

@@ -548,3 +548,161 @@ def test_spill_high_water_backpressure_429_with_hysteresis(memory_storage):
         assert status == 200
     finally:
         app.spill.close()
+
+
+def test_tail_long_poll_blocks_until_ingest(server):
+    """GET /tail/events.json?waitS= long-poll (the push subscription):
+    an idle window blocks until an ingest wakes it, a window with
+    strictly-new events answers immediately, and the wait elapses
+    cleanly when nothing arrives."""
+    import threading
+    import time
+    import urllib.request
+
+    # seed one event + read the boundary
+    st, _ = call(server, "POST", "/events.json", body=RATE, accessKey="KEY")
+    assert st == 201
+    st, out = call(server, "GET", "/tail/events.json", accessKey="KEY",
+                   sinceUs="-1")
+    assert st == 200 and out["count"] >= 1
+    nxt = out["nextUs"]
+
+    def tail(wait_s, since):
+        url = (f"http://127.0.0.1:{server.port}/tail/events.json"
+               f"?accessKey=KEY&sinceUs={since}&waitS={wait_s}")
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    # data already newer than since -> immediate even with a long wait
+    t0 = time.monotonic()
+    out = tail(10, -1)
+    assert time.monotonic() - t0 < 2.0 and out["count"] >= 1
+
+    # idle window: blocks until the late insert wakes it
+    late = dict(RATE, entityId="u-late", eventTime=None)
+    late.pop("eventTime")
+
+    def insert_later():
+        time.sleep(0.4)
+        call(server, "POST", "/events.json", body=late, accessKey="KEY")
+
+    t = threading.Thread(target=insert_later)
+    t.start()
+    t0 = time.monotonic()
+    out = tail(10, nxt)
+    dt = time.monotonic() - t0
+    t.join()
+    assert 0.2 < dt < 5.0
+    assert any(tu > nxt for tu in out["timesUs"])
+
+    # nothing arrives: the wait elapses and answers the empty shape
+    t0 = time.monotonic()
+    out = tail(1, out["nextUs"])
+    dt = time.monotonic() - t0
+    assert 0.9 < dt < 3.0
+    assert not any(tu > out["sinceUs"] for tu in out["timesUs"])
+
+
+def test_http_event_source_long_polls_by_default(server):
+    """Satellite: HttpEventSource sends waitS by default, so the folder
+    sees a new event within one round trip instead of one poll
+    interval — and a boundary-only window (no strictly-new rows) still
+    deduplicates exactly as before."""
+    import threading
+    import time
+
+    from pio_tpu.freshness.cursor import FoldCursor
+    from pio_tpu.freshness.tail import HttpEventSource
+
+    src = HttpEventSource(
+        f"http://127.0.0.1:{server.port}", "KEY", wait_s=8.0)
+    st, _ = call(server, "POST", "/events.json", body=RATE, accessKey="KEY")
+    assert st == 201
+    w0 = src.window(FoldCursor())
+    assert "u1" in w0.to_fold
+    cursor = FoldCursor(time_us=w0.time_us, boundary=w0.boundary)
+
+    def insert_later():
+        time.sleep(0.4)
+        late = {k: v for k, v in RATE.items() if k != "eventTime"}
+        late["entityId"] = "u-push"
+        call(server, "POST", "/events.json", body=late, accessKey="KEY")
+
+    t = threading.Thread(target=insert_later)
+    t.start()
+    t0 = time.monotonic()
+    w1 = src.window(cursor)
+    dt = time.monotonic() - t0
+    t.join()
+    assert "u-push" in w1.to_fold
+    assert 0.2 < dt < 5.0                      # woke on the push, not 8s
+
+
+def test_spill_drain_health_on_metrics(memory_storage):
+    """Satellite: the spill queue's drain health — drain-rate counter +
+    oldest-spilled-event age gauge — is exported on /metrics, so an
+    aging backlog is visible before the 429s start."""
+    import time
+
+    from pio_tpu.resilience import chaos
+    from pio_tpu.server.eventserver import build_event_app
+    from pio_tpu.server.http import Request, dispatch_safe
+
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "smet"))
+    memory_storage.get_metadata_access_keys().insert(
+        AccessKey("SK", app_id, ()))
+    memory_storage.get_events().init(app_id)
+    app = build_event_app(
+        memory_storage,
+        EventServerConfig(spill_capacity=50, metrics_key="MM"))
+    try:
+        body = {"event": "rate", "entityType": "user", "entityId": "u1",
+                "targetEntityType": "item", "targetEntityId": "i1"}
+        with chaos.inject("storage.MEM.insert", error=1.0, seed=2):
+            status, out = dispatch_safe(app, Request(
+                "POST", "/events.json", {"accessKey": "SK"}, {},
+                json.dumps(body).encode()))
+            assert (status, out.get("spilled")) == (201, True)
+            # the drain may be holding the popped item mid-(failing)-
+            # retry, leaving the queue momentarily empty — poll until
+            # the requeue lands and the age gauge shows the backlog
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                snap = app.spill.snapshot()
+                if snap["size"] and snap["oldestAgeSeconds"] > 0.0:
+                    break
+                time.sleep(0.02)
+            assert snap["oldestAgeSeconds"] > 0.0
+
+            def metrics_text():
+                st, raw = dispatch_safe(app, Request(
+                    "GET", "/metrics", {"accessKey": "MM"}, {}))
+                assert st == 200
+                body = raw.body
+                return body if isinstance(body, str) else body.decode()
+
+            def sample(text, name):
+                line = next(l for l in text.splitlines()
+                            if name in l and not l.startswith("#"))
+                return float(line.rsplit(" ", 1)[1])
+
+            while time.monotonic() < deadline:
+                text = metrics_text()
+                if sample(text, "spill_oldest_age_seconds") > 0.0:
+                    break
+                time.sleep(0.02)   # same pop-window race as above
+            assert sample(text, "spill_oldest_age_seconds") > 0.0
+            assert sample(text, "spill_spilled_total") >= 1.0
+        # store heals: the drain empties the queue, the counter moves,
+        # the age gauge returns to zero
+        deadline = time.monotonic() + 15
+        while app.spill.size and time.monotonic() < deadline:
+            app.spill._wake.set()
+            time.sleep(0.02)
+        snap = app.spill.snapshot()
+        assert snap["drained"] >= 1 and snap["oldestAgeSeconds"] == 0.0
+        text = metrics_text()
+        assert sample(text, "spill_drained_total") >= 1.0
+        assert sample(text, "spill_oldest_age_seconds") == 0.0
+    finally:
+        app.spill.close()

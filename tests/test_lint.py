@@ -446,6 +446,45 @@ def test_foldin_cursor_durable_and_reads_silent():
                      select=["foldin-cursor"]) == []
 
 
+def test_hint_log_any_write_in_replicated_backend_fires():
+    from pio_tpu.analysis import lint_text
+    src = """
+        import json
+
+        def stash_hint(path, rec):
+            with open(path, "ab") as f:       # raw append: flagged
+                f.write(rec)
+            json.dump(rec, open(path + ".json", "w"))
+    """
+    fs = lint_text(textwrap.dedent(src),
+                   path="pio_tpu/data/backends/replicated.py",
+                   select=["hint-log"])
+    # open("ab"), open("w"), json.dump
+    assert [f.rule for f in fs] == ["hint-log"] * 3
+    # identical code in any OTHER backend is out of scope
+    assert lint_text(textwrap.dedent(src),
+                     path="pio_tpu/data/backends/memory.py",
+                     select=["hint-log"]) == []
+
+
+def test_hint_log_framelog_and_reads_silent():
+    from pio_tpu.analysis import lint_text
+    src = """
+        from pio_tpu.utils.durable import FrameLog, durable_write
+
+        def stash_hint(log: FrameLog, rec: bytes, state_path, state):
+            log.append(rec)                   # the sanctioned append
+            durable_write(state_path, state)  # the sanctioned blob
+
+        def load(path):
+            with open(path, "rb") as f:       # plain read: fine
+                return f.read()
+    """
+    assert lint_text(textwrap.dedent(src),
+                     path="pio_tpu/data/backends/replicated.py",
+                     select=["hint-log"]) == []
+
+
 def test_rollout_state_write_outside_transition_fires():
     from pio_tpu.analysis import lint_text
     src = """
