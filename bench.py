@@ -961,7 +961,107 @@ def phase_smoke() -> dict:
     out["fleet_p99_x_single_host"] = out["fleet"]["p99_x_single_host"]
     out["tracing_overhead_p50_x"] = out["tracing"]["p50_overhead_x"]
     out["kernel_lab"] = _smoke_kernel_cell()
+    out["sweep"] = _smoke_sweep_cell()
+    out["sweep_8pt_x_2seq"] = out["sweep"]["x_2seq"]
     return out
+
+
+def _smoke_sweep_cell() -> dict:
+    """Batched-sweep cell (ISSUE 13 / ROADMAP item 5 acceptance): the
+    wall-clock of an 8-point BATCHED hyperparameter sweep (read once,
+    2 seeded folds, all 8 candidates trained as one stacked vmapped
+    program per fold + vectorized scoring, per-fold results persisted)
+    vs 2x ONE candidate through the SHIPPED sequential evaluation path
+    (MetricEvaluator -> Engine.eval: datasource read, per-fold train,
+    batch_predict, QPA metric) on the same data. The BASELINE.json
+    `sweep_8pt_x_2seq: 1.0` ceiling is the contract: evaluating 8
+    param points must cost less than evaluating 2 sequentially —
+    batching must amortize the read/layout/dispatch work at least 4x.
+    Both arms best-of-3 on the same box moments apart, measured AFTER a
+    warm-up rep so XLA compiles (persistent-cached anyway) drop out."""
+    import numpy as np
+
+    from pio_tpu.controller import EngineParams
+    from pio_tpu.controller.evaluation import MetricEvaluator
+    from pio_tpu.data import DataMap, Event
+    from pio_tpu.data.dao import App
+    from pio_tpu.data.storage import Storage
+    from pio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from pio_tpu.tuning import SweepConfig, parse_metric
+    from pio_tpu.tuning.sweep import SweepRunner
+    from pio_tpu.workflow.context import create_workflow_context
+
+    n_users, n_items, n_events = 400, 100, 6_000
+    storage = Storage(env={
+        "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+    })
+    app_id = storage.get_metadata_apps().insert(App(0, "sweepapp"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(0)
+    ev.insert_batch([
+        Event(event="rate", entity_type="user",
+              entity_id=f"u{rng.integers(0, n_users)}",
+              target_entity_type="item",
+              target_entity_id=f"i{rng.integers(0, n_items)}",
+              properties=DataMap({"rating": int(rng.integers(1, 6))}))
+        for _ in range(n_events)
+    ], app_id)
+    engine = RecommendationEngine.apply()
+    ds = DataSourceParams(app_name="sweepapp", eval_k=2)
+    regs = (0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0)
+    candidates = [
+        EngineParams(
+            datasource=("", ds),
+            algorithms=[("als", ALSAlgorithmParams(
+                rank=8, num_iterations=2, lambda_=reg, chunk=2048))],
+        )
+        for reg in regs
+    ]
+    ctx = create_workflow_context(storage, use_mesh=False)
+    metric = parse_metric("map@10")
+
+    def seq_once():
+        # the shipped sequential arm: ONE candidate, full pipeline
+        return MetricEvaluator(metric).evaluate_base(
+            ctx, engine, [candidates[0]])
+
+    run_counter = [0]
+
+    def sweep_once():
+        run_counter[0] += 1
+        config = SweepConfig(metric=parse_metric("map@10"),
+                             split="kfold", folds=2, seed=42)
+        runner = SweepRunner(
+            engine, candidates, storage, config,
+            eval_id=f"bench-sweep-{run_counter[0]}")
+        return runner.run(ctx)
+
+    seq_once()
+    sweep_once()   # warm-up: compiles drop out of both arms
+    t_seq = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        seq_once()   # metric .calculate forces every score to host
+        t_seq.append(time.perf_counter() - t0)
+    t_sweep = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sweep_once()
+        t_sweep.append(time.perf_counter() - t0)
+    best_seq, best_sweep = min(t_seq), min(t_sweep)
+    return {
+        "n_candidates": len(regs),
+        "folds": 2,
+        "seq_one_candidate_ms": round(best_seq * 1e3, 1),
+        "batched_sweep_ms": round(best_sweep * 1e3, 1),
+        "x_2seq": round(best_sweep / (2 * best_seq), 4),
+    }
 
 
 def _smoke_tracing_cell(http, qs) -> dict:
@@ -1606,6 +1706,19 @@ def smoke_main() -> int:
             res["tracing_overhead_p50_x"] is not None
             and res["tracing_overhead_p50_x"]
             <= base["tracing_overhead_p50_x"])
+    if "sweep_8pt_x_2seq" in base:
+        # ISSUE 13 / ROADMAP item 5 contract CEILING, absolute and
+        # never refreshed by --update-baseline: an 8-point BATCHED
+        # sweep (stacked vmapped train+score, read amortized) must
+        # complete faster than 2x one candidate through the shipped
+        # sequential evaluation path on the same data — i.e. batching
+        # must amortize at least 4x, or the batched path has regressed
+        # into a loop with extra steps.
+        checks["sweep_8pt_x_2seq"] = (
+            res["sweep_8pt_x_2seq"],
+            base["sweep_8pt_x_2seq"],
+            res["sweep_8pt_x_2seq"] is not None
+            and res["sweep_8pt_x_2seq"] <= base["sweep_8pt_x_2seq"])
     ok = all(passed for _, _, passed in checks.values())
     print(json.dumps({
         "smoke": "pass" if ok else "FAIL",

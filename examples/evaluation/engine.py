@@ -13,6 +13,13 @@ Run from this directory:
     pio eval engine.RecEvaluation engine.RecParamsGenerator \
         --engine-dir . --workers 2
 
+or run the SAME grid batched — every shape-compatible candidate trains
+as one stacked device program (docs/evaluation.md):
+
+    pio eval --sweep --engine-dir . \
+        --grid '{"rank": [4, 8, 16], "lambda_": [0.01, 0.1]}' \
+        --metric precision@5 --other-metrics recall@5
+
 The engine's DataSource splits the app's rating events into eval_k
 index-mod-k folds; every params candidate trains on each fold's training
 split and is scored on the held-out queries; the best candidate's params

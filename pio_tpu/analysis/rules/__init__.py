@@ -12,6 +12,7 @@ from pio_tpu.analysis.rules.bench_hygiene import (
     BenchHygieneRule, HotLoopAllocRule,
 )
 from pio_tpu.analysis.rules.concurrency import ConcurrencyRule
+from pio_tpu.analysis.rules.eval_determinism import EvalDeterminismRule
 from pio_tpu.analysis.rules.obs import ObsRule
 from pio_tpu.analysis.rules.shard_spec import ShardSpecRule
 from pio_tpu.analysis.rules.trace_purity import TracePurityRule
@@ -25,6 +26,7 @@ ALL_RULES = [
     ConcurrencyRule(),
     BenchHygieneRule(),
     HotLoopAllocRule(),
+    EvalDeterminismRule(),
     WorkflowContractRule(),
     WireCodecRule(),
     ObsRule(),

@@ -1,0 +1,121 @@
+"""`eval-determinism` (bench family): non-reproducible constructs in the
+tuning subsystem's metric and split code.
+
+The sweep's crash-resume contract (docs/evaluation.md) is that a killed
+sweep resumed over the same data produces a result IDENTICAL to the
+uninterrupted run — which only holds if fold assignment, scoring, and
+candidate ordering are pure functions of (data, seed). Three construct
+classes silently break that:
+
+  * ``time.time()`` (or any wall/monotonic clock) feeding anything but
+    telemetry — a time-dependent fold boundary or tie-break moves
+    between runs;
+  * RNG draws without an explicit seed — ``np.random.default_rng()``
+    with no arguments, the legacy ``np.random.*`` module-level
+    distributions (their state is ambient), and stdlib ``random.*``
+    module-level draws;
+  * iteration over a ``set`` (literal, ``set()``/``frozenset()`` call,
+    or set comprehension) — string hashing is salted per process, so
+    set order differs across runs; an order-dependent fold/candidate
+    assignment is unreproducible by construction. (Dicts are
+    insertion-ordered and fine; sort the set if you must iterate it.)
+
+Scope: ``pio_tpu/tuning/`` only — the package whose outputs carry a
+bit-reproducibility contract. Clocks for *duration telemetry* are fine
+when the value only feeds spans/logs; those sites justify with
+``# pio: lint-ok[eval-determinism] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pio_tpu.analysis.engine import ModuleContext
+from pio_tpu.analysis.findings import Finding, Severity
+
+_SCOPE = ("pio_tpu/tuning/",)
+
+_CLOCKS = frozenset({"time.time"})
+# legacy ambient-state RNG entry points (module-level, no seed object)
+_AMBIENT_RNG = frozenset({
+    "numpy.random.rand", "numpy.random.randn", "numpy.random.random",
+    "numpy.random.randint", "numpy.random.integers",
+    "numpy.random.uniform", "numpy.random.normal",
+    "numpy.random.shuffle", "numpy.random.permutation",
+    "numpy.random.choice", "numpy.random.seed",
+    "random.random", "random.randint", "random.randrange",
+    "random.shuffle", "random.choice", "random.choices",
+    "random.sample", "random.uniform",
+})
+_SEEDED_CTORS = frozenset({
+    "numpy.random.default_rng", "numpy.random.Generator",
+    "random.Random",
+})
+
+
+class EvalDeterminismRule:
+    id = "bench"
+    ids = ("eval-determinism",)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not any(p in path for p in _SCOPE):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = ctx.imports.canonical(node.func) or ""
+                if name in _CLOCKS:
+                    yield Finding(
+                        "eval-determinism", Severity.WARNING, ctx.path,
+                        node.lineno, node.col_offset,
+                        "time.time() inside pio_tpu/tuning/: a "
+                        "wall-clock value reaching fold assignment or "
+                        "scoring breaks the sweep's bit-reproducible "
+                        "resume contract — thread times in as data, or "
+                        "justify telemetry-only use with "
+                        "# pio: lint-ok[eval-determinism]")
+                elif name in _AMBIENT_RNG:
+                    yield Finding(
+                        "eval-determinism", Severity.WARNING, ctx.path,
+                        node.lineno, node.col_offset,
+                        f"{name}() draws from ambient RNG state inside "
+                        "pio_tpu/tuning/: use a seeded "
+                        "np.random.default_rng(seed) so splits are "
+                        "bit-reproducible")
+                elif name in _SEEDED_CTORS and not node.args \
+                        and not node.keywords:
+                    yield Finding(
+                        "eval-determinism", Severity.WARNING, ctx.path,
+                        node.lineno, node.col_offset,
+                        f"{name}() without a seed inside "
+                        "pio_tpu/tuning/: an OS-entropy generator "
+                        "makes fold assignment unreproducible — pass "
+                        "the sweep's seed explicitly")
+            it = self._set_iteration(node)
+            if it is not None:
+                yield Finding(
+                    "eval-determinism", Severity.WARNING, ctx.path,
+                    it.lineno, it.col_offset,
+                    "iterating a set inside pio_tpu/tuning/: set order "
+                    "is hash-salted per process, so any order-dependent "
+                    "output differs across runs — iterate "
+                    "sorted(<set>) (or a list/dict) instead")
+
+    @staticmethod
+    def _set_iteration(node: ast.AST):
+        """The iterable expression when `node` loops over a set-typed
+        expression: for-loops and comprehension generators."""
+        iters = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters.extend(g.iter for g in node.generators)
+        for it in iters:
+            if isinstance(it, (ast.Set, ast.SetComp)):
+                return it
+            if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                    and it.func.id in ("set", "frozenset")):
+                return it
+        return None

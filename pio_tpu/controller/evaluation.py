@@ -236,6 +236,20 @@ def _jsonable(x):
     return x
 
 
+def pick_best_index(scores: Sequence[tuple], metric: Metric) -> int:
+    """Best-candidate index over [(engine_params, MetricScores)] with
+    the evaluator's NaN rule: NaN is never best, for either comparison
+    direction. The ONE selection policy — the classic evaluator and the
+    tuning sweep both call it, so their winners cannot drift."""
+    def sort_key(i: int):
+        s = scores[i][1].score
+        if isinstance(s, float) and math.isnan(s):
+            return -math.inf  # NaN is never best, for either direction
+        return s if metric.higher_is_better else -s
+
+    return max(range(len(scores)), key=sort_key)
+
+
 class MetricEvaluator:
     """Scores every EngineParams with the metric, picks the best
     (reference MetricEvaluator.scala evaluateBase:163, best selection +
@@ -289,13 +303,7 @@ class MetricEvaluator:
                 for ep in engine_params_list
             ]
 
-        def sort_key(i: int):
-            s = scores[i][1].score
-            if isinstance(s, float) and math.isnan(s):
-                return -math.inf  # NaN is never best, for either direction
-            return s if self.metric.higher_is_better else -s
-
-        best_idx = max(range(len(scores)), key=sort_key)
+        best_idx = pick_best_index(scores, self.metric)
         result = MetricEvaluatorResult(
             best_score=scores[best_idx][1],
             best_engine_params=scores[best_idx][0],

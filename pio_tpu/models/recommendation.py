@@ -37,6 +37,7 @@ class DataSourceParams(Params):
     rating_event: str = "rate"      # events carrying an explicit rating
     implicit_value: float = 4.0     # value assigned to non-rating events
     eval_k: int = 0                 # >0 -> read_eval produces k folds
+    eval_num: int = 10              # ranking depth of each fold query
     # fold queries blacklist the user's train-fold items (unseen-item
     # evaluation; see e2.crossvalidation.split_interactions)
     eval_exclude_seen: bool = True
@@ -79,7 +80,7 @@ class RecommendationDataSource(DataSource):
 
         data = self._read(ctx)
         return split_interactions(
-            data, self.params.eval_k,
+            data, self.params.eval_k, num=self.params.eval_num,
             exclude_seen=self.params.eval_exclude_seen,
         )
 

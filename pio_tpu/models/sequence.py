@@ -189,13 +189,12 @@ class SeqEncoder(nn.Module):
         return x, logits
 
 
-def build_sequences(events, max_len: int):
-    """Time-ordered per-user item sequences from user->item events.
-
-    Returns (seqs int32 (N, max_len) right-aligned & PAD-left-padded,
-    users EntityIdIndex over sequence owners, items EntityIdIndex with ids
-    offset by 1 for PAD). Users with < 2 interactions are dropped (no
-    next-item target exists)."""
+def user_histories(events):
+    """-> ({user id: time-ordered item-id list}, items EntityIdIndex
+    over EVERY item seen). The ONE event-grouping/ordering
+    implementation behind read_training (build_sequences) and
+    read_eval's rolling folds — so the two reads cannot drift on event
+    filtering or ordering."""
     by_user: dict[str, list[tuple[Any, str]]] = {}
     item_ids: dict[str, None] = {}
     for e in events:
@@ -206,12 +205,26 @@ def build_sequences(events, max_len: int):
         )
         item_ids.setdefault(e.target_entity_id, None)
     items = EntityIdIndex(item_ids.keys())
-    users, rows = [], []
+    hists = {}
     for uid, evs in by_user.items():
-        if len(evs) < 2:
-            continue
         evs.sort(key=lambda t: t[0])
-        seq = [items.index_of(i) + 1 for _, i in evs][-max_len:]  # +1: PAD=0
+        hists[uid] = [i for _, i in evs]
+    return hists, items
+
+
+def build_sequences(events, max_len: int):
+    """Time-ordered per-user item sequences from user->item events.
+
+    Returns (seqs int32 (N, max_len) right-aligned & PAD-left-padded,
+    users EntityIdIndex over sequence owners, items EntityIdIndex with ids
+    offset by 1 for PAD). Users with < 2 interactions are dropped (no
+    next-item target exists)."""
+    hists, items = user_histories(events)
+    users, rows = [], []
+    for uid, ids in hists.items():
+        if len(ids) < 2:
+            continue
+        seq = [items.index_of(i) + 1 for i in ids][-max_len:]  # +1: PAD=0
         rows.append(np.pad(seq, (max_len - len(seq), 0)))
         users.append(uid)
     if not rows:
@@ -510,6 +523,13 @@ class SequenceDataSourceParams(Params):
     app_name: str = ""
     event_names: tuple[str, ...] = ("view", "buy")
     max_len: int = 64
+    # >0 -> read_eval produces k ROLLING next-item folds: fold f holds
+    # out each user's (f+1)-th-from-last item and trains on the strict
+    # prefix — the time-respecting split for sequence models, and what
+    # lets `pio eval --sweep` tune this engine through the sequential
+    # fallback like the two-tower grid
+    eval_k: int = 0
+    eval_num: int = 10              # ranking depth of each fold query
 
 
 class SequenceDataSource(DataSource):
@@ -517,6 +537,19 @@ class SequenceDataSource(DataSource):
 
     def __init__(self, params: SequenceDataSourceParams):
         self.params = params
+
+    def _histories(self, ctx):
+        """-> (per-user time-ordered item-id lists, full items index)
+        via the SAME user_histories grouping read_training uses. The
+        items index spans EVERY fold so vocab/embedding shapes stay
+        identical across the sweep's candidates."""
+        events = ctx.event_store.find(
+            app_name=self.params.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.event_names),
+        )
+        return user_histories(events)
 
     def read_training(self, ctx) -> SequenceData:
         events = ctx.event_store.find(
@@ -527,6 +560,38 @@ class SequenceDataSource(DataSource):
         )
         seqs, users, items = build_sequences(events, self.params.max_len)
         return SequenceData(seqs, users, items)
+
+    def read_eval(self, ctx):
+        """k rolling next-item folds of (train, info, [(query, actual)]):
+        fold f trains each user on their history minus the last f+1
+        items and is scored on predicting the held-out item — strictly
+        past-only, like the tuning subsystem's time split."""
+        k = self.params.eval_k
+        max_len = self.params.max_len
+        hists, items = self._histories(ctx)
+        folds = []
+        for f in range(k):
+            cut = f + 1
+            users, rows, qa = [], [], []
+            for uid, ids in hists.items():
+                # >= 2 training items must remain (next-item training
+                # needs a target inside the train split)
+                if len(ids) < cut + 2:
+                    continue
+                train_ids = ids[:-cut]
+                seq = [items.index_of(i) + 1
+                       for i in train_ids][-max_len:]
+                rows.append(np.pad(seq, (max_len - len(seq), 0)))
+                users.append(uid)
+                qa.append(({"user": uid, "num": self.params.eval_num},
+                           [ids[-cut]]))
+            if not rows:
+                continue
+            train = SequenceData(
+                np.stack(rows).astype(np.int32),
+                EntityIdIndex(users), items)
+            folds.append((train, {"fold": f, "holdout": cut}, qa))
+        return folds
 
 
 @jax.tree_util.register_pytree_node_class

@@ -276,6 +276,13 @@ def param_shardings_for_opt(opt_state, params, p_shard, mesh: Mesh):
 class TwoTowerDataSourceParams(Params):
     app_name: str = ""
     event_names: tuple[str, ...] = ("view", "buy", "rate")
+    # >0 -> read_eval produces k index-mod-k folds: the tuning sweep's
+    # sequential path (pio eval --sweep on this engine) scores the
+    # two-tower grid through the SAME fold contract the ALS templates
+    # use — what promotes this engine from demo to tuned second class
+    eval_k: int = 0
+    eval_num: int = 10              # ranking depth of each fold query
+    eval_exclude_seen: bool = True
 
 
 class TwoTowerDataSource(DataSource):
@@ -293,6 +300,17 @@ class TwoTowerDataSource(DataSource):
             value_key=None,
             default_value=1.0,
             dedup="sum",
+        )
+
+    def read_eval(self, ctx):
+        """k folds of (train, info, [(query, heldout items)]) — the
+        recommendation-template eval contract over the two-tower read."""
+        from pio_tpu.e2.crossvalidation import split_interactions
+
+        data = self.read_training(ctx)
+        return split_interactions(
+            data, self.params.eval_k, num=self.params.eval_num,
+            exclude_seen=self.params.eval_exclude_seen,
         )
 
 

@@ -759,22 +759,33 @@ def _build_layouts(u, i, v, n_users: int, n_items: int, params: ALSParams):
 
 
 def _sweep_factory(by_user, by_item, n_users: int, n_items: int, cs: int,
-                   params: ALSParams):
+                   params: ALSParams, reg=None, alpha=None):
     """-> sweep_with(cg_u_n, cg_i_n): the scan body shared by the plain,
-    validated, and layout-resident trainers."""
+    validated, layout-resident, and stacked trainers.
+
+    ``reg``/``alpha`` override the params' values and may be TRACED
+    scalars — the stacked sweep vmaps candidates over them (they only
+    feed arithmetic: `alpha * v` in the accumulation weights and
+    `A + reg*I` in the solve), while everything shape- or
+    branch-determining in ALSParams stays static."""
+    if reg is None:
+        reg = params.reg
+    if alpha is None:
+        alpha = params.alpha
+
     def sweep_with(cg_u_n: int, cg_i_n: int):
         def sweep(carry, _):
             users, items = carry
             users = _solve_factors(
                 by_user, items, n_users,
-                params.reg, params.implicit, params.alpha, cs,
+                reg, params.implicit, alpha, cs,
                 x0=users, cg_iters=cg_u_n, bf16_gather=params.bf16_gather,
                 accum=params.accum, group_slots=params.group_slots,
                 gather=params.gather, packed=params.packed_a,
             )
             items = _solve_factors(
                 by_item, users, n_items,
-                params.reg, params.implicit, params.alpha, cs,
+                reg, params.implicit, alpha, cs,
                 x0=items, cg_iters=cg_i_n, bf16_gather=params.bf16_gather,
                 accum=params.accum, group_slots=params.group_slots,
                 gather=params.gather, packed=params.packed_a,
@@ -1079,6 +1090,126 @@ def als_train_validated(
         best_rmse=curve_h[best_sweep - 1],
         final_rmse=curve_h[-1],
     )
+
+
+# ---------------------------------------------------------------------------
+# stacked multi-candidate path — the hyperparameter sweep's batched train:
+# one layout build + one compiled program trains EVERY candidate that
+# shares the static shape config (rank, iterations, implicit, CG
+# schedule), vmapped over the continuous hyperparams (reg, alpha)
+# ---------------------------------------------------------------------------
+
+def sweep_safe_params(params: ALSParams) -> ALSParams:
+    """The static config the stacked trainer actually runs: the pure-XLA
+    accumulation paths (carry on CPU, stacked on accelerators) with the
+    plain XLA gather. The Pallas kernels (hybrid/stream/packed) are
+    written for a single candidate's block shapes and do not vmap; the
+    stacked program trades them for candidate-level batching — which is
+    the bigger lever for a sweep (Chiu et al. 1612.01437: batch the
+    work, amortize the data movement)."""
+    accum = "stacked" if _accelerator_backend() else "carry"
+    return dataclasses.replace(
+        params, accum=accum, gather="xla", packed_a=False)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class StackedALSModel:
+    """C candidates' factors as one stacked pytree:
+    user_factors (C, n_users, k), item_factors (C, n_items, k)."""
+
+    user_factors: jax.Array
+    item_factors: jax.Array
+
+    def __len__(self) -> int:
+        return int(self.user_factors.shape[0])
+
+    def candidate(self, c: int) -> ALSModel:
+        return ALSModel(self.user_factors[c], self.item_factors[c])
+
+    def tree_flatten(self):
+        return (self.user_factors, self.item_factors), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@partial(jax.jit, static_argnames=("n_users", "n_items", "params"))
+def _train_stacked_jit(u, i, v, regs, alphas, n_users: int, n_items: int,
+                       params: ALSParams, user0, item0):
+    by_user, by_item, cs = _build_layouts(u, i, v, n_users, n_items, params)
+    cg_u = params.resolved_cg_iters(n_users)
+    cg_i = params.resolved_cg_iters(n_items)
+
+    def train_one(reg, alpha):
+        sweep_with = _sweep_factory(
+            by_user, by_item, n_users, n_items, cs, params,
+            reg=reg, alpha=alpha,
+        )
+        return _run_schedule(sweep_with, params, cg_u, cg_i, (user0, item0))
+
+    # vmap over the candidate axis: the slot layouts and the init
+    # factors broadcast (closure), only (reg, alpha) and the factor
+    # carries batch — so the gather/einsum work is shared-shape and XLA
+    # fuses the C candidates into batched MXU ops instead of C dispatches
+    return jax.vmap(train_one)(regs, alphas)
+
+
+def als_train_stacked(
+    user_idx, item_idx, values,
+    n_users: int, n_items: int,
+    params: ALSParams,
+    regs, alphas,
+    mesh: Mesh | None = None,
+) -> StackedALSModel:
+    """Train C candidates sharing ``params``' static config as ONE
+    batched program, differing per candidate only in (reg, alpha).
+
+    The candidate count is pow2-bucketed (padding repeats the last
+    candidate) so sweeps of 5, 7 or 8 points hit the same compiled
+    program in the persistent compile cache; the pad is trimmed before
+    returning. All candidates start from the identical seeded init, so
+    candidate c's result matches a sequential ``als_train`` with the
+    same (reg, alpha) up to batched-op reassociation (the parity suite
+    in tests/test_tuning.py pins the tolerance).
+
+    With a multi-device ``mesh`` whose data axis divides the bucketed
+    candidate count, the candidate axis is sharded across devices (the
+    SNIPPETS.md [1] pjit pattern: annotate the inputs, let GSPMD
+    partition the embarrassingly-parallel candidate dimension)."""
+    params = sweep_safe_params(params)
+    # reg/alpha are fully overridden by the traced vectors below, but
+    # ALSParams is a STATIC jit arg — normalize them so two sweeps whose
+    # grids merely start at different values hash to the same compiled
+    # program (the pow2-bucketing would otherwise be defeated by the
+    # first candidate's values leaking into the cache key)
+    params = dataclasses.replace(params, reg=0.0, alpha=1.0)
+    regs = np.ascontiguousarray(regs, dtype=np.float32)
+    alphas = np.ascontiguousarray(alphas, dtype=np.float32)
+    if regs.shape != alphas.shape or regs.ndim != 1 or not len(regs):
+        raise ValueError(
+            f"regs/alphas must be equal-length 1-d vectors, got "
+            f"{regs.shape} / {alphas.shape}")
+    n_cand = len(regs)
+    bucket = pow2_bucket(n_cand)
+    if bucket != n_cand:
+        regs = np.concatenate(
+            [regs, np.full(bucket - n_cand, regs[-1], np.float32)])
+        alphas = np.concatenate(
+            [alphas, np.full(bucket - n_cand, alphas[-1], np.float32)])
+    u, i, v = _prep_coo(user_idx, item_idx, values, n_users, n_items, params)
+    user0, item0 = _init_or(None, n_users, n_items, params)
+    regs_d, alphas_d = jnp.asarray(regs), jnp.asarray(alphas)
+    if mesh is not None and mesh.devices.size > 1:
+        n_dev = mesh.devices.size
+        if bucket % n_dev == 0:
+            cand_sharding = NamedSharding(mesh, P(DATA_AXIS))
+            regs_d = jax.device_put(regs_d, cand_sharding)
+            alphas_d = jax.device_put(alphas_d, cand_sharding)
+    users, items = _train_stacked_jit(
+        u, i, v, regs_d, alphas_d, n_users, n_items, params, user0, item0)
+    return StackedALSModel(users[:n_cand], items[:n_cand])
 
 
 # ---------------------------------------------------------------------------

@@ -812,8 +812,8 @@ def build_event_app(
             return 401, {"message": "Invalid accessKey."}
         from pio_tpu.server.http import RawResponse
         from pio_tpu.utils.tracing import (
-            PROMETHEUS_CONTENT_TYPE, prometheus_labeled_counter,
-            prometheus_text,
+            PROMETHEUS_CONTENT_TYPE, prometheus_histogram,
+            prometheus_labeled_counter, prometheus_text,
         )
 
         counters = {}
@@ -864,28 +864,15 @@ def build_event_app(
                                    "readRepairs")):
                     text += "\n".join(prometheus_labeled_counter(
                         name, [(base_l, float(c.get(key, 0)))])) + "\n"
-                # one proper histogram family: ONE TYPE header, samples
-                # named _bucket/_sum/_count (cumulative le convention)
+                # one proper histogram family through the shared
+                # renderer (utils/tracing.prometheus_histogram):
+                # _bucket/_sum/_count, cumulative le convention
                 lat = rst.get("quorumLatency") or {}
-                lab = "".join(f'{k}="{v}",' for k, v in base_l.items())
-                hlines = ["# TYPE pio_quorum_write_seconds histogram"]
-                cum = 0
-                for ub, cnt in zip(lat.get("bucketsS", []),
-                                   lat.get("counts", [])):
-                    cum += cnt
-                    hlines.append(
-                        f'pio_quorum_write_seconds_bucket'
-                        f'{{{lab}le="{ub:g}"}} {float(cum)}')
-                hlines.append(
-                    f'pio_quorum_write_seconds_bucket{{{lab}le="+Inf"}} '
-                    f'{float(lat.get("count", 0))}')
-                hlines.append(
-                    f'pio_quorum_write_seconds_sum{{{lab[:-1]}}} '
-                    f'{float(lat.get("sumSeconds", 0.0))}')
-                hlines.append(
-                    f'pio_quorum_write_seconds_count{{{lab[:-1]}}} '
-                    f'{float(lat.get("count", 0))}')
-                text += "\n".join(hlines) + "\n"
+                text += "\n".join(prometheus_histogram(
+                    "quorum_write_seconds",
+                    lat.get("bucketsS", []), lat.get("counts", []),
+                    lat.get("count", 0), lat.get("sumSeconds", 0.0),
+                    labels=base_l)) + "\n"
         # per-wire-codec ingest counters: the JSON -> binary migration
         # shows up as rate moving between the codec labels
         with wire_lock:

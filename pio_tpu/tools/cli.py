@@ -533,11 +533,57 @@ def cmd_doctor(args) -> int:
     except Exception as e:  # noqa: BLE001 - doctor reports, never dies
         sweep_error = f"{type(e).__name__}: {e}"
 
+    # eval/tuning row: the last completed sweep's verdict, and whether
+    # production actually serves the winning params — a COMPLETED
+    # instance batch-tagged `from-eval:<id>` was trained by
+    # `pio train --from-eval` from that sweep's best_params record
+    eval_row = None
+    eval_error = ""
+    try:
+        from pio_tpu.tuning.records import latest_best_params
+
+        storage = get_storage()
+        found = latest_best_params(storage)
+        if found is not None:
+            inst, payload = found
+            completed = [
+                i for i in
+                storage.get_metadata_engine_instances().get_all()
+                if i.status == "COMPLETED"
+            ]
+            if payload.get("engineId"):
+                # NO fallback to other engines' instances: a sweep for
+                # an engine that was never trained must report "not
+                # trained yet", not point at an unrelated engine
+                completed = [i for i in completed
+                             if i.engine_id == payload["engineId"]]
+            completed.sort(key=lambda i: i.start_time, reverse=True)
+            prod = completed[0] if completed else None
+            marker = f"from-eval:{inst.id}"
+            eval_row = {
+                "evaluationInstanceId": inst.id,
+                "completedAt": inst.end_time.isoformat(),
+                "metric": payload.get("metric"),
+                "bestScore": payload.get("score"),
+                "productionInstanceId": prod.id if prod else None,
+                "productionBatch": prod.batch if prod else None,
+                # substring match: `pio train --from-eval --batch X`
+                # appends the marker to the operator's label
+                "productionHasBestParams": bool(
+                    prod and marker in (prod.batch or "")),
+            }
+    except Exception as e:  # noqa: BLE001 - doctor reports, never dies
+        eval_error = f"{type(e).__name__}: {e}"
+
     chaos_spec = os.environ.get("PIO_TPU_CHAOS", "")
     if args.json:
         out = {"surfaces": report, "zombies": zombies}
         if rollout is not None:
             out["rollout"] = rollout
+        if eval_row is not None:
+            out["eval"] = eval_row
+        if eval_error:
+            out["evalError"] = eval_error
         if sweep_error:
             out["zombieSweepError"] = sweep_error
         if chaos_spec:
@@ -573,6 +619,26 @@ def cmd_doctor(args) -> int:
         if div is not None:
             print(f"  shadow divergence: {div} over "
                   f"{rollout['shadow'].get('samples', 0)} sample(s)")
+    if eval_row is not None:
+        score = eval_row["bestScore"]
+        score_s = "nan" if score is None else f"{score:.4f}"
+        print(f"eval           last sweep {eval_row['evaluationInstanceId']}"
+              f" best {eval_row['metric']}={score_s}")
+        if eval_row["productionInstanceId"] is None:
+            print("  production: no COMPLETED engine instance yet — "
+                  f"pio train --from-eval "
+                  f"{eval_row['evaluationInstanceId']}")
+        elif eval_row["productionHasBestParams"]:
+            print(f"  production: instance "
+                  f"{eval_row['productionInstanceId']} trained from "
+                  "this sweep (best-known params in production)")
+        else:
+            print(f"  [WARN] production instance "
+                  f"{eval_row['productionInstanceId']} was NOT trained "
+                  "from the winning params — pio train --from-eval "
+                  f"{eval_row['evaluationInstanceId']}")
+    if eval_error:
+        print(f"[WARN] eval check failed: {eval_error}")
     if sweep_error:
         print(f"[WARN] zombie check failed: {sweep_error}")
     for z in zombies:
@@ -811,6 +877,17 @@ def cmd_train(args) -> int:
     from pio_tpu.controller.base import TrainingInterruption
 
     storage = get_storage()
+    batch = args.batch or ""
+    if getattr(args, "from_eval", ""):
+        ep, eval_id = _apply_from_eval(engine, ep, storage,
+                                       args.from_eval)
+        # the batch marker is how `pio doctor` knows production runs
+        # the sweep's winner (docs/evaluation.md "Close the loop") —
+        # APPENDED to an operator-supplied batch label, never displaced
+        # by it (doctor matches by substring)
+        marker = f"from-eval:{eval_id}"
+        batch = f"{batch} {marker}".strip()
+        print(f"Training with best params from evaluation {eval_id}")
     ctx = create_workflow_context(storage, use_mesh=not args.no_mesh)
     try:
         instance_id = run_train(
@@ -818,7 +895,7 @@ def cmd_train(args) -> int:
             engine_id=engine_id, engine_version=engine_version,
             engine_variant=engine_variant,
             engine_factory=variant["engineFactory"],
-            batch=args.batch or "",
+            batch=batch,
             ctx=ctx,
             stop_after_read=args.stop_after_read,
             stop_after_prepare=args.stop_after_prepare,
@@ -842,6 +919,11 @@ def cmd_train(args) -> int:
 
 
 def cmd_eval(args) -> int:
+    if args.sweep:
+        return _eval_sweep(args)
+    if not args.evaluation_class or not args.params_generator_class:
+        return _fail("pio eval takes either --sweep (grid mode) or "
+                     "<EvaluationClass> <ParamsGeneratorClass>")
     from pio_tpu.workflow.evaluate import run_evaluation_class
 
     evaluation = _load_factory(args.evaluation_class, args.engine_dir)
@@ -857,6 +939,149 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def _sweep_candidates(engine, base_ep, args) -> list:
+    """The candidate grid: either an EngineParamsGenerator class (full
+    EngineParams control) or a --grid JSON over the FIRST algorithm's
+    params — {"lambda_": [0.01, 0.1], "rank": [8, 16]} expands to the
+    cartesian product, each candidate overriding engine.json's params."""
+    import dataclasses
+    import itertools
+
+    if args.params_generator:
+        gen = _load_factory(args.params_generator, args.engine_dir)
+        return gen.params_list()
+    if not args.grid:
+        raise ValueError(
+            "--sweep needs --grid '{\"param\": [values...]}' (or "
+            "@file.json) or --params-generator pkg.Class")
+    spec = args.grid
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            grid = json.load(f)
+    else:
+        grid = json.loads(spec)
+    if not isinstance(grid, dict) or not grid:
+        raise ValueError("--grid must be a non-empty JSON object of "
+                         "param name -> list of values")
+    base_algos = base_ep.algorithms or [("", None)]
+    algo_name, algo_params = base_algos[0]
+    keys = sorted(grid)           # deterministic candidate order
+    values = []
+    for k in keys:
+        v = grid[k]
+        values.append(v if isinstance(v, list) else [v])
+    candidates = []
+    for combo in itertools.product(*values):
+        overrides = dict(zip(keys, combo))
+        if dataclasses.is_dataclass(algo_params):
+            try:
+                p = dataclasses.replace(algo_params, **overrides)
+            except TypeError:
+                valid = sorted(
+                    f.name for f in dataclasses.fields(algo_params))
+                bad = sorted(set(overrides) - set(valid))
+                raise ValueError(
+                    f"--grid key(s) {bad} are not params of "
+                    f"{type(algo_params).__name__} (valid: "
+                    f"{', '.join(valid)})") from None
+        else:
+            p = {**(algo_params or {}), **overrides}
+        # vary ONLY the first algorithm; a multi-algo engine keeps its
+        # trailing algorithms in every candidate (and in the persisted
+        # winner --from-eval deploys)
+        candidates.append(dataclasses.replace(
+            base_ep, algorithms=[(algo_name, p), *base_algos[1:]]))
+    return candidates
+
+
+def _eval_sweep(args) -> int:
+    """`pio eval --sweep` — the batched hyperparameter sweep
+    (docs/evaluation.md): grid/generator candidates over deterministic
+    k-fold or event-time splits, shape-compatible candidates trained as
+    ONE stacked device program, per-fold results checkpointed durably
+    (resume with --resume-eval), winner persisted as
+    `<eval-iid>:best_params` for `pio train/deploy --from-eval`."""
+    from pio_tpu.obs import make_recorder
+    from pio_tpu.tuning import SweepConfig, parse_metric
+    from pio_tpu.utils.tracing import Tracer
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.evaluate import run_sweep_evaluation
+
+    engine_dir = args.engine_dir or "."
+    variant = _load_variant(engine_dir)
+    engine, ep = _engine_from_variant(variant, engine_dir)
+    engine_id, engine_version, engine_variant = _engine_ids(
+        variant, engine_dir)
+    try:
+        candidates = _sweep_candidates(engine, ep, args)
+        metric = parse_metric(args.metric)
+        others = [parse_metric(s)
+                  for s in (args.other_metrics or "").split(",")
+                  if s.strip()]
+    except (ValueError, OSError) as e:
+        # OSError: --grid @file.json that does not exist/read — the
+        # same one-line error every other argument mistake gets
+        return _fail(str(e))
+    config = SweepConfig(
+        metric=metric, other_metrics=others,
+        split=args.split, folds=args.folds, seed=args.seed,
+    )
+    storage = get_storage()
+    ctx = create_workflow_context(storage, use_mesh=not args.no_mesh)
+    recorder = make_recorder("eval")
+    tracer = Tracer(recorder=recorder)
+    http = status = None
+    if args.metrics_port is not None:
+        from pio_tpu.tuning.server import EvalStatus, create_eval_server
+
+        status = EvalStatus(tracer, recorder)
+        http = create_eval_server(
+            status, ip=args.ip, port=args.metrics_port,
+            server_key=args.server_key
+            or os.environ.get("PIO_SERVER_KEY", ""))
+        http.start()
+        print(f"sweep metrics on http://{args.ip}:{http.port} "
+              "(/metrics, /debug/traces.json; watch with `pio top "
+              f"--url http://{args.ip}:{http.port}`)")
+    try:
+        instance_id, result = run_sweep_evaluation(
+            engine, candidates, storage, config,
+            engine_id=engine_id, engine_version=engine_version,
+            engine_variant=engine_variant,
+            batch=args.batch or "",
+            output_path=args.output or None,
+            resume_eval_id=args.resume_eval or None,
+            ctx=ctx, tracer=tracer,
+            status=status,
+        )
+    finally:
+        if http is not None:
+            http.stop()
+    print(f"Sweep completed. Evaluation instance: {instance_id} "
+          f"({len(candidates)} candidate(s), {args.split} x "
+          f"{args.folds})")
+    print(f"Best {result.metric_header}: [{result.best_score.score}] "
+          f"(candidate #{result.best_idx})")
+    print(f"Best params: {result.best_engine_params.to_json()}")
+    print(f"Deploy the winner: pio train --from-eval {instance_id} "
+          f"&& pio deploy --from-eval {instance_id}")
+    return 0
+
+
+def _apply_from_eval(engine, ep, storage, from_eval: str):
+    """Merge a sweep's winning ALGORITHM params into engine.json's
+    EngineParams (datasource/preparator/serving stay the operator's —
+    the sweep tuned the model, not the read). -> (merged ep, eval id)."""
+    import dataclasses
+
+    from pio_tpu.tuning.records import resolve_from_eval
+
+    eval_id, payload = resolve_from_eval(storage, from_eval)
+    tuned = engine.engine_params_from_variant(
+        {"algorithms": payload["variant"]["algorithms"]})
+    return dataclasses.replace(ep, algorithms=tuned.algorithms), eval_id
+
+
 def cmd_deploy(args) -> int:
     from pio_tpu.workflow.context import create_workflow_context
     from pio_tpu.workflow.serve import ServingConfig, create_query_server
@@ -866,6 +1091,11 @@ def cmd_deploy(args) -> int:
         # serving process (single-host server or fleet router — same
         # /rollout surface) to stage a candidate, rather than booting a
         # new one (docs/serving.md "Guarded rollout")
+        if getattr(args, "from_eval", ""):
+            return _fail("--from-eval does not combine with --canary: "
+                         "the canary stages an already-TRAINED "
+                         "instance — run `pio train --from-eval` "
+                         "first, then canary that instance")
         return _deploy_canary_cmd(args)
     variant = _load_variant(args.engine_dir)
     engine, ep = _engine_from_variant(variant, args.engine_dir)
@@ -873,6 +1103,16 @@ def cmd_deploy(args) -> int:
         variant, args.engine_dir
     )
     storage = get_storage()
+    if getattr(args, "from_eval", ""):
+        if args.shards > 0:
+            return _fail("--from-eval is not supported with --shards "
+                         "yet: fleet shards serve already-partitioned "
+                         "model blobs; train the winner "
+                         "(`pio train --from-eval`) and fleet-deploy "
+                         "that instance")
+        ep, eval_id = _apply_from_eval(engine, ep, storage,
+                                       args.from_eval)
+        print(f"Deploying with best params from evaluation {eval_id}")
     if args.shards > 0:
         # fleet path: partition the persisted model at deploy time, boot
         # N x R shard servers + the router front-end (serving_fleet/)
@@ -1714,17 +1954,68 @@ def build_parser() -> argparse.ArgumentParser:
                    help="root for per-instance step-checkpoint dirs "
                         "(default $PIO_TPU_CKPT_ROOT or "
                         "$PIO_TPU_HOME/checkpoints)")
+    x.add_argument("--from-eval", default="", metavar="EVAL_ID|latest",
+                   help="train with the winning algorithm params a "
+                        "`pio eval --sweep` persisted (the "
+                        "<eval-iid>:best_params record); the instance "
+                        "is batch-tagged from-eval:<id> so doctor can "
+                        "tell production runs the best-known params")
     x.set_defaults(fn=cmd_train)
 
     x = sub.add_parser("eval")
-    x.add_argument("evaluation_class")
-    x.add_argument("params_generator_class")
+    x.add_argument("evaluation_class", nargs="?", default="")
+    x.add_argument("params_generator_class", nargs="?", default="")
     x.add_argument("--engine-dir", default=None,
                    help="directory holding the user-code engine.py the "
-                        "classes live in (joins sys.path)")
+                        "classes live in (joins sys.path); with --sweep "
+                        "also where engine.json lives")
     x.add_argument("--output", default="best.json")
     x.add_argument("--workers", type=int, default=1,
                    help="params-grid parallelism (reference runs .par)")
+    x.add_argument("--sweep", action="store_true",
+                   help="batched hyperparameter sweep over engine.json's "
+                        "engine (docs/evaluation.md): shape-compatible "
+                        "candidates train as ONE stacked device "
+                        "program; per-fold results persist durably and "
+                        "the winner lands in <eval-iid>:best_params "
+                        "for `pio train/deploy --from-eval`")
+    x.add_argument("--grid", default="",
+                   help="with --sweep: JSON object (or @file.json) of "
+                        "algorithm-param name -> list of values; the "
+                        "cartesian product is the candidate grid, e.g. "
+                        "'{\"lambda_\": [0.01, 0.1], \"rank\": [8, 16]}'")
+    x.add_argument("--params-generator", default="",
+                   help="with --sweep: EngineParamsGenerator class path "
+                        "instead of --grid (full EngineParams control)")
+    x.add_argument("--metric", default="map@10",
+                   help="primary metric: map@K, ndcg@K, precision@K, "
+                        "or auc (batched path only)")
+    x.add_argument("--other-metrics", default="",
+                   help="comma-separated supplementary metric columns")
+    x.add_argument("--split", choices=["kfold", "time"], default="kfold",
+                   help="kfold: seeded balanced folds over deduped "
+                        "interactions; time: event-time rolling splits "
+                        "(train on the past, test on the next window)")
+    x.add_argument("--folds", type=int, default=3)
+    x.add_argument("--seed", type=int, default=42,
+                   help="kfold assignment seed (bit-reproducible)")
+    x.add_argument("--resume-eval", default="", metavar="EVAL_ID",
+                   help="resume a killed/failed sweep: completed folds "
+                        "are read from the durable record, only the "
+                        "remaining units run (result identical to an "
+                        "uninterrupted sweep)")
+    x.add_argument("--batch", default="",
+                   help="batch label recorded on the EvaluationInstance")
+    x.add_argument("--no-mesh", action="store_true")
+    x.add_argument("--metrics-port", type=int, default=None,
+                   help="with --sweep: serve /healthz /metrics "
+                        "/debug/traces.json during the sweep (0 = "
+                        "ephemeral port) so `pio top`/`pio trace` cover "
+                        "it like every other surface")
+    x.add_argument("--ip", default="127.0.0.1",
+                   help="bind address for --metrics-port")
+    x.add_argument("--server-key", default="",
+                   help="guards the sweep's /debug trace routes")
     x.set_defaults(fn=cmd_eval)
 
     x = sub.add_parser("deploy")
@@ -1772,6 +2063,11 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--canary-min-stage-samples", type=int, default=None,
                    help="with --canary auto: minimum candidate-arm "
                         "requests per stage")
+    x.add_argument("--from-eval", default="", metavar="EVAL_ID|latest",
+                   help="serve with the winning algorithm params a "
+                        "`pio eval --sweep` persisted (single-host "
+                        "mode; pair with `pio train --from-eval` so "
+                        "the served instance was trained with them)")
     x.set_defaults(fn=cmd_deploy)
 
     for verb, fn, descr in (

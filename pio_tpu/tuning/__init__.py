@@ -1,0 +1,35 @@
+"""pio_tpu.tuning — device-parallel evaluation & hyperparameter sweeps.
+
+The third DASE pillar (ROADMAP item 5): deterministic splits
+(``splits``), vectorized ranking metrics with scalar oracles
+(``metrics``), the batched sweep runner (``sweep``), durable
+fold/best-params records (``records``), and the sweep's observability
+surface (``server``). Entry points: ``pio eval --sweep`` (tools/cli.py)
+-> ``workflow.evaluate.run_sweep_evaluation``.
+"""
+
+from pio_tpu.tuning.metrics import (  # noqa: F401
+    AUC,
+    MAPAtK,
+    NDCGAtK,
+    PrecisionAtK,
+    RankingMetric,
+    RecallAtK,
+    parse_metric,
+)
+from pio_tpu.tuning.records import (  # noqa: F401
+    load_best_params,
+    resolve_from_eval,
+    save_best_params,
+)
+from pio_tpu.tuning.splits import (  # noqa: F401
+    EvalFold,
+    folds_for,
+    seeded_kfold,
+    time_rolling_folds,
+)
+from pio_tpu.tuning.sweep import (  # noqa: F401
+    SweepConfig,
+    SweepRunner,
+    group_candidates,
+)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
+from typing import Sequence
 
 # stdlib-only modules, hot-path imported once (a per-span `from ... import`
 # costs ~1us in sys.modules lookups — measurable against the bench smoke
@@ -246,6 +247,38 @@ def prometheus_labeled_counter(
             f'{k}="{escape_label_value(str(v))}"'
             for k, v in labels.items())
         lines.append(f"{prefix}_{name}{{{lab}}} {_prom_value(value)}")
+    return lines
+
+
+def prometheus_histogram(
+    name: str,
+    buckets: Sequence[float],
+    counts: Sequence[float],
+    total_count: float,
+    total_sum: float,
+    labels: dict[str, str] | None = None,
+    prefix: str = "pio",
+) -> list[str]:
+    """One proper histogram family: ONE `# TYPE` header + samples named
+    `_bucket` (cumulative `le` convention, `+Inf` last), `_sum`,
+    `_count`. The single renderer for histogram exposition so surfaces
+    cannot drift on the le/cumulation format (used by the event
+    server's quorum-latency family and the eval sweep's duration)."""
+    lab = "".join(
+        f'{k}="{escape_label_value(str(v))}",'
+        for k, v in (labels or {}).items())
+    lines = [f"# TYPE {prefix}_{name} histogram"]
+    cum = 0.0
+    for ub, cnt in zip(buckets, counts):
+        cum += cnt
+        lines.append(
+            f'{prefix}_{name}_bucket{{{lab}le="{ub:g}"}} {float(cum)}')
+    lines.append(
+        f'{prefix}_{name}_bucket{{{lab}le="+Inf"}} {float(total_count)}')
+    lines.append(
+        f'{prefix}_{name}_sum{{{lab[:-1]}}} {float(total_sum)}')
+    lines.append(
+        f'{prefix}_{name}_count{{{lab[:-1]}}} {float(total_count)}')
     return lines
 
 

@@ -1007,3 +1007,54 @@ def test_repo_lints_clean():
     report = run_lint(paths)
     assert report.failing == [], "\n".join(
         f.format() for f in report.failing)
+
+
+def test_eval_determinism_fires_in_tuning_scope():
+    """eval-determinism (ISSUE 13): unseeded RNG, ambient np.random
+    draws, wall clock, and set iteration inside pio_tpu/tuning/ are
+    findings — each breaks the sweep's bit-reproducible resume
+    contract."""
+    src = """
+        import time
+        import numpy as np
+
+        def assign_folds(n, k):
+            rng = np.random.default_rng()        # unseeded
+            tags = np.random.permutation(n) % k  # ambient state
+            salt = time.time()                   # wall clock
+            for u in set(str(i) for i in range(n)):  # hash-salted order
+                pass
+            return tags
+    """
+    fs = lint_text(textwrap.dedent(src), path="pio_tpu/tuning/splits.py",
+                   select={"eval-determinism"})
+    assert {f.rule for f in fs} == {"eval-determinism"}
+    assert len(fs) == 4
+
+
+def test_eval_determinism_scoped_and_seeded_ok():
+    """Seeded RNG and deterministic iteration pass; the same unseeded
+    code OUTSIDE pio_tpu/tuning/ is out of scope (bench/eval scripts
+    keep their own rules)."""
+    good = """
+        import numpy as np
+
+        def assign_folds(n, k, seed):
+            rng = np.random.default_rng(seed)
+            tags = rng.permutation(n) % k
+            for u in sorted(set(range(n))):
+                pass
+            return tags
+    """
+    assert lint_text(textwrap.dedent(good),
+                     path="pio_tpu/tuning/splits.py",
+                     select={"eval-determinism"}) == []
+    bad_elsewhere = """
+        import numpy as np
+
+        def shuffle(n):
+            return np.random.permutation(n)
+    """
+    assert lint_text(textwrap.dedent(bad_elsewhere),
+                     path="pio_tpu/models/x.py",
+                     select={"eval-determinism"}) == []

@@ -1,0 +1,650 @@
+"""Tuning subsystem tests (ISSUE 13): vectorized-metric scalar-oracle
+parity, deterministic splits, batched-sweep vs sequential-loop parity,
+the crash-resume drill (kill at `eval.fold` -> resume -> identical
+result), the sequential two-tower fallback, and the
+eval -> train --from-eval -> deploy --from-eval loop."""
+
+import dataclasses
+import json
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller.engine import EngineParams
+from pio_tpu.data.bimap import EntityIdIndex
+from pio_tpu.data.dao import App
+from pio_tpu.data.event import Event
+from pio_tpu.data.eventstore import Interactions
+from pio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+from pio_tpu.ops import als
+from pio_tpu.resilience import chaos
+from pio_tpu.tuning import (
+    SweepConfig,
+    load_best_params,
+    parse_metric,
+    resolve_from_eval,
+    seeded_kfold,
+)
+from pio_tpu.tuning import metrics as tm
+from pio_tpu.tuning.records import load_sweep_state
+from pio_tpu.tuning.splits import time_rolling_folds
+from pio_tpu.workflow.context import create_workflow_context
+from pio_tpu.workflow.evaluate import run_sweep_evaluation
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def _synth_interactions(n_users=60, n_items=40, nnz=900, seed=0):
+    rng = np.random.default_rng(seed)
+    return Interactions(
+        user_idx=rng.integers(0, n_users, nnz).astype(np.int32),
+        item_idx=rng.integers(0, n_items, nnz).astype(np.int32),
+        values=rng.uniform(1, 5, nnz).astype(np.float32),
+        users=EntityIdIndex([f"u{x}" for x in range(n_users)]),
+        items=EntityIdIndex([f"i{x}" for x in range(n_items)]),
+    )
+
+
+def _seed_events(storage, app_name="tuneapp", n_users=40, n_items=30,
+                 n_events=1000, seed=1):
+    app_id = storage.get_metadata_apps().insert(App(0, app_name))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(seed)
+    ev.insert_batch([
+        Event(event="rate", entity_type="user",
+              entity_id=f"u{rng.integers(0, n_users)}",
+              target_entity_type="item",
+              target_entity_id=f"i{rng.integers(0, n_items)}",
+              properties={"rating": float(rng.integers(1, 6))},
+              event_time=T0 + timedelta(minutes=j))
+        for j in range(n_events)
+    ], app_id)
+    return app_id
+
+
+def _als_candidates(app_name="tuneapp", regs=(0.01, 0.1, 1.0),
+                    rank=8, iterations=2, **ds_kw):
+    ds = DataSourceParams(app_name=app_name, **ds_kw)
+    return [
+        EngineParams(
+            datasource=("", ds),
+            algorithms=[("als", ALSAlgorithmParams(
+                rank=rank, num_iterations=iterations, lambda_=reg,
+                chunk=256))],
+        )
+        for reg in regs
+    ]
+
+
+# ---------------------------------------------------------------------------
+# metric parity: vectorized kernels vs pure-Python scalar oracles
+# ---------------------------------------------------------------------------
+
+def test_metric_parity_fuzz():
+    """Fuzzed rankings incl. ties, empty actuals, and k > catalog: the
+    batched kernels must agree with the scalar oracles everywhere."""
+    rng = np.random.default_rng(7)
+    for trial in range(60):
+        n_items = int(rng.integers(3, 25))
+        k = int(rng.integers(1, n_items + 5))       # k > catalog too
+        b = int(rng.integers(1, 5))
+        topk, actuals = [], []
+        for _ in range(b):
+            n_act = int(rng.integers(0, min(8, n_items) + 1))
+            actuals.append(rng.choice(
+                n_items, size=n_act, replace=False).astype(np.int32))
+            topk.append(rng.choice(
+                n_items, size=min(k, n_items), replace=False
+            ).astype(np.int32))
+        topk_m = tm.pad_actuals(topk, pad_to=k)
+        topk_m[topk_m < 0] = -2
+        act_m = tm.pad_actuals(actuals)
+        for batch_fn, scalar_fn in [
+            (tm.precision_at_k_batch, tm.precision_at_k_scalar),
+            (tm.recall_at_k_batch, tm.recall_at_k_scalar),
+            (tm.map_at_k_batch, tm.map_at_k_scalar),
+            (tm.ndcg_at_k_batch, tm.ndcg_at_k_scalar),
+        ]:
+            got = np.asarray(batch_fn(topk_m, act_m, k))
+            for j in range(b):
+                want = scalar_fn(list(topk[j]), list(actuals[j]), k)
+                if want is None:
+                    assert np.isnan(got[j])
+                else:
+                    assert got[j] == pytest.approx(want, abs=1e-5)
+        # AUC over integer scores: forced ties must count 0.5 like the
+        # pairwise oracle
+        scores = rng.integers(0, 4, size=(b, n_items)).astype(np.float32)
+        pos = np.zeros((b, n_items), bool)
+        valid = np.ones((b, n_items), bool)
+        for j in range(b):
+            pos[j, actuals[j]] = True
+            seen = rng.choice(n_items,
+                              size=int(rng.integers(0, n_items // 2 + 1)),
+                              replace=False)
+            valid[j, seen] = False
+            valid[j, actuals[j]] = True
+        got = np.asarray(tm.auc_batch(scores, pos, valid))
+        for j in range(b):
+            want = tm.auc_scalar(
+                list(scores[j]), list(np.flatnonzero(pos[j])),
+                list(np.flatnonzero(valid[j])))
+            if want is None:
+                assert np.isnan(got[j])
+            else:
+                assert got[j] == pytest.approx(want, abs=1e-5)
+
+
+def test_qpa_metric_matches_legacy_precision():
+    """The Metric-contract adapter scores the e2 reference example the
+    same as the legacy per-triple PrecisionAtK."""
+    from pio_tpu.e2.metrics import PrecisionAtK as Legacy
+
+    data = [(None, [
+        ({}, {"itemScores": [{"item": "a", "score": 1},
+                             {"item": "b", "score": 0.5}]}, ["a", "c"]),
+        ({}, {"itemScores": []}, ["a"]),         # no predictions: 0
+        ({}, {"itemScores": [{"item": "z", "score": 1}]}, []),  # excluded
+    ])]
+    assert tm.PrecisionAtK(2).calculate(None, data) == pytest.approx(
+        Legacy(2).calculate(None, data))
+
+
+def test_auc_refuses_qpa_path():
+    with pytest.raises(ValueError, match="full per-item score rows"):
+        tm.AUC().calculate(None, [(None, [({}, {"itemScores": []}, ["a"])])])
+
+
+def test_parse_metric():
+    assert tm.parse_metric("ndcg@5").header == "NDCG@5"
+    assert tm.parse_metric("auc").header == "AUC"
+    with pytest.raises(ValueError):
+        tm.parse_metric("bogus@3")
+
+
+# ---------------------------------------------------------------------------
+# splits: determinism + leakage
+# ---------------------------------------------------------------------------
+
+def test_seeded_kfold_deterministic_and_disjoint():
+    data = _synth_interactions()
+    a = seeded_kfold(data, 3, seed=42)
+    b = seeded_kfold(data, 3, seed=42)
+    c = seeded_kfold(data, 3, seed=7)
+    for fa, fb in zip(a, b):
+        np.testing.assert_array_equal(fa.train.user_idx, fb.train.user_idx)
+        np.testing.assert_array_equal(fa.test_user_idx, fb.test_user_idx)
+        for x, y in zip(fa.actual_idx, fb.actual_idx):
+            np.testing.assert_array_equal(x, y)
+    assert any(
+        len(fa.train.user_idx) != len(fc.train.user_idx)
+        or not np.array_equal(fa.train.user_idx, fc.train.user_idx)
+        for fa, fc in zip(a, c))
+    # folds partition the rows: train+test row counts = n every fold,
+    # and the train split keeps the FULL id tables (stable factor shapes)
+    n = len(data)
+    for f in a:
+        assert f.train.n_users == data.n_users
+        assert f.train.n_items == data.n_items
+        assert len(f.train) < n
+    sizes = [n - len(f.train) for f in a]
+    assert sum(sizes) == n
+    # qa_pairs renders the engine-facing query contract: blackList = the
+    # user's train-seen items, actuals decode back to ids
+    qa = a[0].qa_pairs(num=7)
+    assert len(qa) == a[0].n_test_users
+    q0, actual0 = qa[0]
+    assert q0["num"] == 7
+    assert q0["user"] == data.users.id_of(int(a[0].test_user_idx[0]))
+    assert set(actual0) == set(data.items.decode(a[0].actual_idx[0]))
+    if len(a[0].seen_idx[0]):
+        assert set(q0["blackList"]) == set(
+            data.items.decode(a[0].seen_idx[0]))
+    # exclude_seen: no heldout item may also be in the user's train set
+    for f in a:
+        seen_by_user = {}
+        for u, i in zip(f.train.user_idx, f.train.item_idx):
+            seen_by_user.setdefault(int(u), set()).add(int(i))
+        for j, u in enumerate(f.test_user_idx):
+            assert not (set(f.actual_idx[j].tolist())
+                        & seen_by_user.get(int(u), set()))
+
+
+def test_time_rolling_folds_no_future_leakage(memory_storage):
+    app_id = _seed_events(memory_storage, n_events=600)
+    cols = memory_storage.get_events().find_columnar(
+        app_id=app_id, entity_type="user", target_entity_type="item",
+        event_names=["rate", "buy"])
+    folds = time_rolling_folds(cols, 2, value_key="rating",
+                               default_value=4.0, value_event="rate")
+    assert len(folds) == 2
+    # train windows grow monotonically and boundaries are honored:
+    # every train interaction's effective time < the fold boundary
+    assert len(folds[0].train) < len(folds[1].train)
+    from pio_tpu.tuning.splits import _interactions_with_times
+
+    data, times = _interactions_with_times(
+        cols, "rating", 4.0, "last", "rate")
+    key = {(int(u), int(i)): int(t) for u, i, t in
+           zip(data.user_idx, data.item_idx, times)}
+    for f in folds:
+        boundary = f.info["boundaryUs"]
+        for u, i in zip(f.train.user_idx, f.train.item_idx):
+            assert key[(int(u), int(i))] < boundary
+        assert f.n_test_users > 0
+    # deterministic: second build bit-identical
+    again = time_rolling_folds(cols, 2, value_key="rating",
+                               default_value=4.0, value_event="rate")
+    for fa, fb in zip(folds, again):
+        np.testing.assert_array_equal(fa.train.user_idx, fb.train.user_idx)
+        np.testing.assert_array_equal(fa.test_user_idx, fb.test_user_idx)
+
+
+# ---------------------------------------------------------------------------
+# batched sweep vs sequential loop: score parity
+# ---------------------------------------------------------------------------
+
+def test_stacked_train_matches_sequential_scores():
+    """als_train_stacked candidate c must rank like a sequential
+    als_train with the same (reg, alpha): metric scores agree to float
+    tolerance and the top-10 rankings overlap."""
+    data = _synth_interactions(nnz=800)
+    fold = seeded_kfold(data, 2, seed=42)[0]
+    t = fold.train
+    base = als.ALSParams(rank=8, iterations=3, chunk=256)
+    regs = np.array([0.01, 0.1, 1.0], np.float32)
+    stacked = als.als_train_stacked(
+        t.user_idx, t.item_idx, t.values, t.n_users, t.n_items,
+        base, regs, np.ones(3, np.float32))
+    from pio_tpu.tuning.sweep import _score_stacked
+
+    metric = tm.MAPAtK(10)
+    batched = _score_stacked(stacked, fold, [metric], 512)
+    for c, reg in enumerate(regs):
+        seq = als.als_train(
+            t.user_idx, t.item_idx, t.values, t.n_users, t.n_items,
+            als.sweep_safe_params(
+                dataclasses.replace(base, reg=float(reg))))
+        single = als.StackedALSModel(
+            seq.user_factors[None], seq.item_factors[None])
+        s_seq = _score_stacked(single, fold, [metric], 512)
+        sum_b, n_b = batched[c][0]
+        sum_s, n_s = s_seq[0][0]
+        assert n_b == n_s
+        assert sum_b / n_b == pytest.approx(sum_s / n_s, abs=0.02)
+
+
+def test_stacked_pow2_padding_trims():
+    data = _synth_interactions(nnz=400)
+    p = als.ALSParams(rank=4, iterations=2, chunk=256)
+    st = als.als_train_stacked(
+        data.user_idx, data.item_idx, data.values,
+        data.n_users, data.n_items, p,
+        np.array([0.1, 0.2, 0.3], np.float32), np.ones(3, np.float32))
+    assert len(st) == 3                       # 3 -> bucket 4 -> trimmed
+    assert st.user_factors.shape == (3, data.n_users, 4)
+
+
+# ---------------------------------------------------------------------------
+# sweep workflow: persistence, resume drill, best-params loop
+# ---------------------------------------------------------------------------
+
+def _run_sweep(storage, candidates, ctx, split="kfold", folds=2,
+               resume=None, metric="map@5"):
+    config = SweepConfig(
+        metric=parse_metric(metric),
+        other_metrics=[parse_metric("ndcg@5")],
+        split=split, folds=folds, seed=42)
+    return run_sweep_evaluation(
+        RecommendationEngine.apply(), candidates, storage, config,
+        engine_id="tune-e", ctx=ctx, resume_eval_id=resume)
+
+
+def test_sweep_completes_and_persists(memory_storage):
+    _seed_events(memory_storage)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    cands = _als_candidates(regs=(0.01, 0.1, 1.0, 10.0))
+    eval_id, result = _run_sweep(memory_storage, cands, ctx)
+    inst = memory_storage.get_metadata_evaluation_instances().get(eval_id)
+    assert inst.status == "EVALCOMPLETED"
+    assert "bestScore" in inst.evaluator_results_json
+    payload = load_best_params(memory_storage, eval_id)
+    assert payload["metric"] == "MAP@5"
+    assert payload["variant"]["algorithms"][0]["params"]["lambda_"] == \
+        result.best_engine_params.algorithms[0][1].lambda_
+    state = load_sweep_state(memory_storage, eval_id)
+    assert set(state.completed) == {"fold0", "fold1"}
+    assert resolve_from_eval(memory_storage, "latest")[0] == eval_id
+    # every candidate carries both metric columns
+    assert all(len(ms.other_scores) == 1
+               for _, ms in result.engine_params_scores)
+
+
+def test_sweep_mixed_shapes_batch_per_group(memory_storage):
+    """Different ranks cannot share a stacked program but still batch
+    within their shape groups — and never error."""
+    _seed_events(memory_storage)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    cands = (_als_candidates(regs=(0.01, 0.1), rank=4)
+             + _als_candidates(regs=(0.01, 0.1), rank=8))
+    from pio_tpu.tuning.sweep import group_candidates
+
+    groups, batchable = group_candidates(cands)
+    assert batchable and len(groups) == 2
+    eval_id, result = _run_sweep(memory_storage, cands, ctx)
+    assert len(result.engine_params_scores) == 4
+
+
+def test_sweep_chaos_kill_then_resume_identical(memory_storage):
+    """The eval.fold chaos drill (CI eval-sweep job): kill the sweep at
+    fold 1 -> EVALFAILED with fold 0's results persisted; resume ->
+    only fold 1 runs and the final result is identical to an
+    uninterrupted sweep."""
+    _seed_events(memory_storage)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    cands = _als_candidates(regs=(0.01, 0.1, 1.0))
+
+    # the oracle: an uninterrupted sweep on a sibling storage with the
+    # SAME events/seed
+    from pio_tpu.data.storage import Storage
+
+    oracle_storage = Storage(env={
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    }, test=True)
+    _seed_events(oracle_storage)
+    oracle_ctx = create_workflow_context(oracle_storage, use_mesh=False)
+    _, oracle = _run_sweep(oracle_storage, cands, oracle_ctx)
+
+    with pytest.raises(chaos.ChaosError):
+        with chaos.inject("eval.fold.1", error=1.0):
+            _run_sweep(memory_storage, cands, ctx)
+    dao = memory_storage.get_metadata_evaluation_instances()
+    failed = [i for i in dao.get_all() if i.status == "EVALFAILED"]
+    assert len(failed) == 1
+    eval_id = failed[0].id
+    state = load_sweep_state(memory_storage, eval_id)
+    assert set(state.completed) == {"fold0"}     # fold 1 never ran
+
+    resumed_id, result = _run_sweep(
+        memory_storage, cands, ctx, resume=eval_id)
+    assert resumed_id == eval_id
+    assert dao.get(eval_id).status == "EVALCOMPLETED"
+    assert result.best_idx == oracle.best_idx
+    for (_, got), (_, want) in zip(result.engine_params_scores,
+                                   oracle.engine_params_scores):
+        assert got.score == pytest.approx(want.score, abs=1e-9)
+
+
+def test_sweep_resume_rejects_changed_plan(memory_storage):
+    _seed_events(memory_storage)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    cands = _als_candidates(regs=(0.01, 0.1, 1.0))
+    with pytest.raises(chaos.ChaosError):
+        with chaos.inject("eval.fold.1", error=1.0):
+            _run_sweep(memory_storage, cands, ctx)
+    dao = memory_storage.get_metadata_evaluation_instances()
+    eval_id = [i for i in dao.get_all() if i.status == "EVALFAILED"][0].id
+    with pytest.raises(ValueError, match="different plan"):
+        _run_sweep(memory_storage, cands, ctx, folds=3, resume=eval_id)
+    # a SAME-cardinality grid with different values must also be
+    # rejected — fold 0's persisted scores came from the old params, and
+    # mixing them with re-trained folds would corrupt the average that
+    # picks the deployed winner
+    other = _als_candidates(regs=(0.5, 2.0, 5.0))
+    with pytest.raises(ValueError, match="different plan"):
+        _run_sweep(memory_storage, other, ctx, resume=eval_id)
+    # an added metric column is a changed plan too
+    with pytest.raises(ValueError, match="different plan"):
+        config = SweepConfig(
+            metric=parse_metric("map@5"),
+            other_metrics=[parse_metric("ndcg@5"),
+                           parse_metric("precision@5")],
+            split="kfold", folds=2, seed=42)
+        run_sweep_evaluation(
+            RecommendationEngine.apply(), cands, memory_storage, config,
+            engine_id="tune-e", ctx=ctx, resume_eval_id=eval_id)
+
+
+def test_sweep_time_split(memory_storage):
+    _seed_events(memory_storage)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    cands = _als_candidates(regs=(0.01, 1.0))
+    eval_id, result = _run_sweep(memory_storage, cands, ctx,
+                                 split="time", folds=2)
+    assert load_best_params(memory_storage, eval_id) is not None
+
+
+def test_resolve_from_eval_errors(memory_storage):
+    with pytest.raises(ValueError, match="no best-params record"):
+        resolve_from_eval(memory_storage, "nope")
+    with pytest.raises(ValueError, match="no completed evaluation"):
+        resolve_from_eval(memory_storage, "latest")
+
+
+# ---------------------------------------------------------------------------
+# sequential fallback: the tuned two-tower second engine class
+# ---------------------------------------------------------------------------
+
+def _twotower_candidates(app_name="ttapp"):
+    from pio_tpu.models.twotower import (
+        TwoTowerDataSourceParams, TwoTowerParams,
+    )
+
+    ds = TwoTowerDataSourceParams(app_name=app_name, eval_k=2)
+    return [
+        EngineParams(
+            datasource=("", ds),
+            algorithms=[("twotower", TwoTowerParams(
+                embed_dim=8, hidden_dim=16, out_dim=8, steps=30,
+                batch_size=64, learning_rate=lr, temperature=temp))],
+        )
+        for lr in (5e-3, 1e-2)
+        for temp in (0.1,)
+    ]
+
+
+def test_twotower_sequential_sweep_and_from_eval_deploy(memory_storage):
+    """The acceptance loop on the second engine class: a two-tower grid
+    sweeps through the sequential fallback (non-ALS shapes never
+    error), the winner persists, `--from-eval` reconstructs its TYPED
+    params, and the tuned engine trains + serves queries end-to-end."""
+    from pio_tpu.models.twotower import TwoTowerEngine, TwoTowerParams
+    from pio_tpu.tuning.sweep import group_candidates
+    from pio_tpu.workflow.serve import ServingConfig, create_query_server
+    from pio_tpu.workflow.train import run_train
+
+    _seed_events(memory_storage, app_name="ttapp", n_users=30,
+                 n_items=20, n_events=400)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    engine = TwoTowerEngine.apply()
+    cands = _twotower_candidates()
+    _groups, batchable = group_candidates(cands)
+    assert not batchable                         # falls back, no error
+    config = SweepConfig(metric=parse_metric("precision@5"), folds=2)
+    eval_id, result = run_sweep_evaluation(
+        engine, cands, memory_storage, config,
+        engine_id="tt-e", ctx=ctx)
+    state = load_sweep_state(memory_storage, eval_id)
+    assert set(state.completed) == {"cand0", "cand1"}
+
+    # --from-eval reconstructs TYPED TwoTowerParams and closes the loop
+    from pio_tpu.tools.cli import _apply_from_eval
+
+    base_ep = cands[0]
+    tuned_ep, got_id = _apply_from_eval(
+        engine, base_ep, memory_storage, eval_id)
+    assert got_id == eval_id
+    tuned_params = tuned_ep.algorithms[0][1]
+    assert isinstance(tuned_params, TwoTowerParams)
+    assert tuned_params.learning_rate == \
+        result.best_engine_params.algorithms[0][1].learning_rate
+
+    run_train(engine, tuned_ep, memory_storage, engine_id="tt-e",
+              ctx=ctx, batch=f"from-eval:{eval_id}")
+    http, qs = create_query_server(
+        engine, tuned_ep, memory_storage,
+        ServingConfig(ip="127.0.0.1", port=0, engine_id="tt-e"),
+        ctx=ctx)
+    http.start()
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/queries.json",
+            data=json.dumps({"user": "u1", "num": 3}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert "itemScores" in body
+        assert len(body["itemScores"]) <= 3
+    finally:
+        http.stop()
+        qs.close()
+
+
+def test_sequential_fallback_rejects_auc_primary(memory_storage):
+    _seed_events(memory_storage, app_name="ttapp", n_users=20,
+                 n_items=15, n_events=200)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    from pio_tpu.models.twotower import TwoTowerEngine
+
+    config = SweepConfig(metric=parse_metric("auc"), folds=2)
+    with pytest.raises(ValueError, match="full score rows"):
+        run_sweep_evaluation(
+            TwoTowerEngine.apply(), _twotower_candidates(),
+            memory_storage, config, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# observability surface + doctor row
+# ---------------------------------------------------------------------------
+
+def test_eval_metrics_server_surface():
+    from pio_tpu.tuning.server import EvalStatus, create_eval_server
+    from pio_tpu.utils.httpclient import JsonHttpClient
+    from pio_tpu.utils.tracing import Tracer
+
+    tracer = Tracer()
+    with tracer.span("eval.fold", fold=0):
+        pass
+    status = EvalStatus(tracer)
+    status.update(phase="running", evalId="e1", mode="batched",
+                  unitsDone=1, unitsTotal=2, bestScore=0.5,
+                  metric="MAP@5")
+    status.observe_sweep_seconds(2.5)
+    http = create_eval_server(status)
+    http.start()
+    try:
+        client = JsonHttpClient(f"http://127.0.0.1:{http.port}",
+                                timeout=10)
+        health = client.request("GET", "/healthz")
+        assert health["unitsDone"] == 1 and health["unitsTotal"] == 2
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/metrics",
+                timeout=10) as resp:
+            text = resp.read().decode()
+        assert 'pio_eval_best_score{surface="eval"} 0.5' in text
+        assert '# TYPE pio_eval_sweep_seconds histogram' in text
+        assert 'pio_eval_sweep_seconds_count{surface="eval"} 1' in text
+        assert 'span="eval.fold"' in text
+    finally:
+        http.stop()
+
+
+def test_doctor_eval_row(memory_storage, capsys):
+    from pio_tpu.data.storage import set_storage
+    from pio_tpu.tools.cli import main
+
+    _seed_events(memory_storage)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    cands = _als_candidates(regs=(0.01, 1.0))
+    eval_id, _ = _run_sweep(memory_storage, cands, ctx)
+    from pio_tpu.workflow.train import run_train
+
+    run_train(RecommendationEngine.apply(), cands[0], memory_storage,
+              engine_id="tune-e", ctx=ctx, batch=f"from-eval:{eval_id}")
+    set_storage(memory_storage)
+    try:
+        main(["doctor", "--json", "--timeout", "0.2"])
+        out = json.loads(capsys.readouterr().out)
+    finally:
+        set_storage(None)
+    assert out["eval"]["evaluationInstanceId"] == eval_id
+    assert out["eval"]["productionHasBestParams"] is True
+
+
+def test_sequence_rolling_read_eval(memory_storage):
+    """The sequence engine's rolling next-item folds (its promotion to
+    the sweep's fold contract): fold f trains on each user's history
+    minus the last f+1 items and holds exactly that item out."""
+    from pio_tpu.models.sequence import (
+        SequenceDataSource, SequenceDataSourceParams,
+    )
+
+    app_id = memory_storage.get_metadata_apps().insert(App(0, "seqapp"))
+    ev = memory_storage.get_events()
+    ev.init(app_id)
+    hist = {"u0": ["a", "b", "c", "d", "e"], "u1": ["x", "y", "z"],
+            "u2": ["a", "b"]}   # u2 too short for any fold
+    events = []
+    for uid, items in hist.items():
+        for j, item in enumerate(items):
+            events.append(Event(
+                event="view", entity_type="user", entity_id=uid,
+                target_entity_type="item", target_entity_id=item,
+                event_time=T0 + timedelta(minutes=j)))
+    ev.insert_batch(events, app_id)
+    ds = SequenceDataSource(SequenceDataSourceParams(
+        app_name="seqapp", eval_k=2, max_len=8))
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    folds = ds.read_eval(ctx)
+    assert len(folds) == 2
+    train0, info0, qa0 = folds[0]
+    assert info0 == {"fold": 0, "holdout": 1}
+    assert "u0" in train0.users and "u1" in train0.users
+    actuals = {q["user"]: a for q, a in qa0}
+    assert actuals == {"u0": ["e"], "u1": ["z"]}
+    # fold 1 holds out the second-from-last item; u1 (3 events) drops
+    train1, info1, qa1 = folds[1]
+    assert {q["user"] for q, _ in qa1} == {"u0"}
+    assert qa1[0][1] == ["d"]
+    # train rows decode to the strict prefix
+    row = train1.seqs[train1.users.index_of("u0")]
+    decoded = [train1.items.id_of(i - 1) for i in row if i != 0]
+    assert decoded == ["a", "b", "c"]
+
+
+def test_sweep_spans_reach_recorder(memory_storage):
+    """The whole sweep runs as ONE root trace (the folder's cycle
+    idiom): eval.sweep/eval.fold/eval.candidate spans land in the
+    recorder's span table — what `pio top --url <metrics-port>` and
+    /debug/spans.json serve."""
+    from pio_tpu.obs.recorder import TraceRecorder
+    from pio_tpu.utils.tracing import Tracer
+
+    _seed_events(memory_storage, n_events=400)
+    ctx = create_workflow_context(memory_storage, use_mesh=False)
+    recorder = TraceRecorder("eval")
+    tracer = Tracer(recorder=recorder)
+    cands = _als_candidates(regs=(0.01, 0.1))
+    config = SweepConfig(metric=parse_metric("map@5"), folds=2, seed=42)
+    run_sweep_evaluation(
+        RecommendationEngine.apply(), cands, memory_storage, config,
+        ctx=ctx, tracer=tracer)
+    names = {r["span"] for r in recorder.span_table()}
+    assert {"eval.sweep", "eval.fold", "eval.candidate"} <= names
