@@ -914,7 +914,7 @@ def phase_smoke() -> dict:
             rank=16, num_iterations=3, lambda_=0.05, chunk=2048))],
     )
     ctx = create_workflow_context(storage, use_mesh=False)
-    run_train(engine, ep, storage, engine_id="smoke", ctx=ctx)
+    smoke_iid = run_train(engine, ep, storage, engine_id="smoke", ctx=ctx)
     http, qs = create_query_server(
         engine, ep, storage,
         ServingConfig(ip="127.0.0.1", port=0, engine_id="smoke",
@@ -951,7 +951,18 @@ def phase_smoke() -> dict:
         out["serving_p99_ms"] = round(single[1], 3)
         out["freshness"] = _smoke_freshness_cell(
             storage, ev, app_id, qs, http.port, n_users)
-        out["fleet"] = _smoke_fleet_cell(storage, one_rep, single[1])
+        # the parity oracle is the PERSISTED instance's in-process
+        # prediction — the live single-host server has already been
+        # fold-in-refreshed by the freshness cell above, so its answers
+        # legitimately differ from the partitioned instance's
+        from pio_tpu.workflow.train import load_models as _load_models
+
+        algo = engine._doers(ep)[2][0]
+        full_model = _load_models(storage, engine, ep, smoke_iid,
+                                  ctx=ctx)[0]
+        out["fleet"] = _smoke_fleet_cell(
+            storage, one_rep, single[1],
+            lambda q: algo.predict(full_model, q))
         out["tracing"] = _smoke_tracing_cell(http, qs)
     finally:
         http.stop()
@@ -959,6 +970,8 @@ def phase_smoke() -> dict:
     out["freshness_new_user_seconds"] = out["freshness"][
         "new_user_seconds"]
     out["fleet_p99_x_single_host"] = out["fleet"]["p99_x_single_host"]
+    out["pooled_binary_fleet_p99_x_fresh_json"] = out["fleet"][
+        "pooled_binary_p99_x_fresh_json"]
     out["tracing_overhead_p50_x"] = out["tracing"]["p50_overhead_x"]
     out["kernel_lab"] = _smoke_kernel_cell()
     out["sweep"] = _smoke_sweep_cell()
@@ -1135,25 +1148,79 @@ def _smoke_tracing_cell(http, qs) -> dict:
     }
 
 
-def _smoke_fleet_cell(storage, one_rep, single_p99_ms: float) -> dict:
-    """Fleet serving cell (the remaining ROADMAP item 1 measurement):
-    the same query stream through a 2-shard fleet router, best-of-3
-    p50/p99, against the single-host numbers measured moments earlier
-    on the same box (so host noise largely cancels). The gate
-    (BASELINE.json `fleet_p99_x_single_host`) bounds the ROUTER TAIL:
-    router p99 must stay within 2x the single-host oracle's p99 —
-    sharding buys capacity with two RPC hops, and this cell keeps those
-    hops honest on every PR."""
+def _smoke_fleet_cell(storage, one_rep, single_p99_ms: float,
+                      oracle) -> dict:
+    """Fleet serving cell (the remaining ROADMAP item 1 measurement +
+    the ISSUE 15 internal-RPC-plane contract): the same query stream
+    through a 2-shard fleet router, best-of-3 p50/p99, against the
+    single-host numbers measured moments earlier on the same box (so
+    host noise largely cancels). Two gates ride this cell:
+
+      * BASELINE.json `fleet_p99_x_single_host` bounds the ROUTER TAIL:
+        router p99 must stay within 2x the single-host oracle's p99 —
+        sharding buys capacity with two RPC hops, and this cell keeps
+        those hops honest on every PR;
+      * BASELINE.json `pooled_binary_fleet_p99_x_fresh_json` (absolute
+        1.0 ceiling, never --update-baseline'd) pits the DEFAULT router
+        (keep-alive pooled connections + binary top-k wire) against a
+        control router over the SAME warm shards with pooling off and
+        the JSON wire pinned — i.e. the pre-ISSUE-15 RPC plane,
+        measured moments earlier. The pooled+binary plane must win
+        outright, and both arms' answers are asserted BIT-identical to
+        the single-host oracle before any timing counts."""
+    import urllib.request
+
     from pio_tpu.serving_fleet.fleet import deploy_fleet
+    from pio_tpu.serving_fleet.router import (
+        RouterConfig, create_fleet_router,
+    )
 
     handle = deploy_fleet(storage, engine_id="smoke", n_shards=2,
                           n_replicas=1)
+    json_http = json_router = None
     try:
         port = handle.router_http.port
         one_rep(port)  # warm: first queries pay jit on each shard
+        # the control arm: fresh connection per RPC + JSON wire, over
+        # the SAME shard processes (same warm kernels, same box moment)
+        json_http, json_router = create_fleet_router(
+            storage,
+            RouterConfig(engine_id="smoke", rpc_wire="json",
+                         http_pooled=False, probe_interval_s=0),
+            handle.plan, handle.endpoints)
+        json_http.start()
+        jport = json_http.port
+        one_rep(jport)
+
+        def answer(p: int, user: str) -> dict:
+            q = json.dumps({"user": user, "num": 10}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{p}/queries.json", data=q,
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        # bit-parity gate before any timing: both wires must reproduce
+        # the single-host oracle exactly
+        for u in ("u0", "u7", "u42", "u133"):
+            want = oracle({"user": u, "num": 10})
+            got_binary = answer(port, u)
+            got_json = answer(jport, u)
+            if got_binary != want or got_json != want:
+                raise AssertionError(
+                    f"fleet answer diverged from the single-host oracle "
+                    f"for {u}: binary={got_binary!r} json={got_json!r} "
+                    f"oracle={want!r}")
+        # fresh-connection JSON arm FIRST ("measured moments earlier"),
+        # then the pooled+binary default — best-of-3 each
+        jp50, jp99 = min((one_rep(jport) for _ in range(3)),
+                         key=lambda t: t[1])
         p50, p99 = min((one_rep(port) for _ in range(3)),
                        key=lambda t: t[1])
     finally:
+        if json_router is not None:
+            json_http.stop()
+            json_router.close()
         handle.close()
     return {
         "router_p50_ms": round(p50, 3),
@@ -1161,6 +1228,10 @@ def _smoke_fleet_cell(storage, one_rep, single_p99_ms: float) -> dict:
         "single_p99_ms": round(single_p99_ms, 3),
         "p99_x_single_host": round(p99 / single_p99_ms, 3)
         if single_p99_ms > 0 else None,
+        "fresh_json_p50_ms": round(jp50, 3),
+        "fresh_json_p99_ms": round(jp99, 3),
+        "pooled_binary_p99_x_fresh_json": round(p99 / jp99, 4)
+        if jp99 > 0 else None,
     }
 
 
@@ -1666,6 +1737,21 @@ def smoke_main() -> int:
             res["fleet_p99_x_single_host"] is not None
             and res["fleet_p99_x_single_host"]
             <= base["fleet_p99_x_single_host"])
+    if "pooled_binary_fleet_p99_x_fresh_json" in base:
+        # ISSUE 15 contract CEILING, absolute and never refreshed by
+        # --update-baseline: the pooled+binary internal RPC plane
+        # (keep-alive connection pool + binary top-k wire — the
+        # default) must beat the fresh-connection JSON control arm's
+        # p99 on the same warm fleet measured moments earlier, with
+        # both arms' answers asserted bit-identical to the single-host
+        # oracle first. A pooled plane that lost to dial-per-RPC JSON
+        # would mean the pool or codec regressed into overhead.
+        checks["pooled_binary_fleet_p99_x_fresh_json"] = (
+            res["pooled_binary_fleet_p99_x_fresh_json"],
+            base["pooled_binary_fleet_p99_x_fresh_json"],
+            res["pooled_binary_fleet_p99_x_fresh_json"] is not None
+            and res["pooled_binary_fleet_p99_x_fresh_json"]
+            <= base["pooled_binary_fleet_p99_x_fresh_json"])
     if "binary_ingest_x_native" in base:
         # ISSUE 11 contract FLOOR (ROADMAP item 4), absolute and never
         # refreshed by --update-baseline: Python ingest over the binary

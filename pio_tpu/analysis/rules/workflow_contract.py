@@ -131,12 +131,16 @@ _WIRE_PACK_METHODS = frozenset({"tobytes", "frombuffer"})
 #   data/backends/mywire.py   — the MySQL client protocol (foreign format)
 #   data/backends/pgwire.py   — the Postgres client protocol (foreign
 #                               format)
+#   serving_fleet/rpcwire.py  — the fleet's binary shard-RPC wire (topk/
+#                               user_row/item_rows frames; encode/decode
+#                               live here only)
 _WIRE_CODEC_OWNERS = (
     "pio_tpu/data/columnar.py",
     "pio_tpu/utils/durable.py",
     "pio_tpu/native/eventlog.py",
     "pio_tpu/data/backends/mywire.py",
     "pio_tpu/data/backends/pgwire.py",
+    "pio_tpu/serving_fleet/rpcwire.py",
 )
 
 

@@ -410,25 +410,28 @@ def build_foldin_app(worker: FoldInWorker) -> HttpApp:
         scrapeable — not just doctor-visible — plus the cycle-stage span
         summaries, all under `surface="folder"`."""
         from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.httpclient import pool_counters
         from pio_tpu.utils.tracing import (
             PROMETHEUS_CONTENT_TYPE, prometheus_text,
         )
 
         snap = worker.snapshot()
+        counters = {
+            "staleness_seconds": snap["stalenessSeconds"],
+            "staleness_budget_seconds": snap["stalenessBudgetSeconds"],
+            "foldin_queue_depth": float(snap["queueDepth"]),
+            "foldin_folded_total": float(snap["foldedTotal"]),
+            "foldin_applied_batches_total": float(snap["appliedBatches"]),
+            "foldin_failures_total": float(snap["failures"]),
+            "uptime_seconds":
+                (utcnow() - worker.start_time).total_seconds(),
+        }
+        # the folder's tail long-poll + apply fans ride the keep-alive
+        # pool (docs/performance.md "Internal RPC plane")
+        counters.update(pool_counters())
         return 200, RawResponse(
-            prometheus_text(
-                worker.tracer.snapshot(),
-                {"staleness_seconds": snap["stalenessSeconds"],
-                 "staleness_budget_seconds":
-                     snap["stalenessBudgetSeconds"],
-                 "foldin_queue_depth": float(snap["queueDepth"]),
-                 "foldin_folded_total": float(snap["foldedTotal"]),
-                 "foldin_applied_batches_total":
-                     float(snap["appliedBatches"]),
-                 "foldin_failures_total": float(snap["failures"]),
-                 "uptime_seconds":
-                     (utcnow() - worker.start_time).total_seconds()},
-                labels={"surface": "folder"}),
+            prometheus_text(worker.tracer.snapshot(), counters,
+                            labels={"surface": "folder"}),
             PROMETHEUS_CONTENT_TYPE)
 
     # distributed tracing (pio_tpu/obs/): per-cycle traces fetchable

@@ -14,6 +14,20 @@ from __future__ import annotations
 from pio_tpu.obs.recorder import SpanRecord
 from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
 
+# one client per polled surface, memoized for the process lifetime:
+# `pio top --watch` and `pio trace` poll the same URL set every tick,
+# and a throwaway client per iteration would needlessly rebuild TLS
+# contexts (connections themselves already persist in the shared pool)
+_clients: dict[tuple[str, float], JsonHttpClient] = {}
+
+
+def _client(url: str, timeout: float) -> JsonHttpClient:
+    key = (url.rstrip("/"), timeout)
+    client = _clients.get(key)
+    if client is None:
+        client = _clients[key] = JsonHttpClient(key[0], timeout=timeout)
+    return client
+
 
 def discover_fleet_urls(router_url: str, timeout: float = 5.0) -> list[str]:
     """router URL -> [router URL, every shard replica URL] (best-effort:
@@ -21,7 +35,7 @@ def discover_fleet_urls(router_url: str, timeout: float = 5.0) -> list[str]:
     the miss per surface)."""
     urls = [router_url.rstrip("/")]
     try:
-        fleet = JsonHttpClient(router_url, timeout=timeout).request(
+        fleet = _client(router_url, timeout).request(
             "GET", "/fleet.json")
     except HttpClientError:
         return urls
@@ -46,7 +60,7 @@ def collect_trace(urls: list[str], trace_id: str, server_key: str = "",
         params["accessKey"] = server_key
     for url in urls:
         try:
-            out = JsonHttpClient(url, timeout=timeout).request(
+            out = _client(url, timeout).request(
                 "GET", "/debug/traces.json", params=params)
         except HttpClientError as e:
             misses[url] = e.message if e.status == 404 else str(e)
@@ -136,7 +150,7 @@ def collect_span_tables(urls: list[str], server_key: str = "",
     params = {"accessKey": server_key} if server_key else None
     for url in urls:
         try:
-            out = JsonHttpClient(url, timeout=timeout).request(
+            out = _client(url, timeout).request(
                 "GET", "/debug/spans.json", params=params)
         except HttpClientError as e:
             errors[url] = str(e)

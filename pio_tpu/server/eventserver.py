@@ -828,6 +828,25 @@ def build_event_app(
             counters["spill_dropped_total"] = float(s["dropped"])
             counters["spill_oldest_age_seconds"] = float(
                 s["oldestAgeSeconds"])
+        # connection reuse, both directions (docs/performance.md
+        # "Internal RPC plane"): outbound = the spill drain / remote
+        # storage RPC pool; inbound = requests per accepted keep-alive
+        # connection (SDK ingest + tail long-pollers — a fleet stuck at
+        # ~1 request/connection re-dials per call: a proxy stripping
+        # keep-alive, visible here before it is a latency page)
+        from pio_tpu.utils.httpclient import pool_counters
+
+        counters.update(pool_counters())
+        conn_stats = getattr(getattr(app, "transport", None),
+                             "connection_stats", None)
+        if callable(conn_stats):
+            cs = conn_stats()
+            counters["http_connections_accepted_total"] = float(
+                cs["connectionsAccepted"])
+            counters["http_requests_served_total"] = float(
+                cs["requestsServed"])
+            counters["http_requests_per_connection"] = float(
+                cs["requestsPerConnection"])
         text = prometheus_text(tracer.snapshot(), counters,
                                labels={"surface": "eventserver"})
         # replicated event store (docs/storage.md "Replication"): hint

@@ -19,6 +19,7 @@ import asyncio
 import json
 import logging
 import re
+import socket
 import threading
 import time
 import urllib.parse
@@ -285,15 +286,46 @@ class HttpServer:
     def __init__(self, app: HttpApp, host: str = "127.0.0.1", port: int = 0,
                  ssl_context=None):
         self.app = app
+        # connection-reuse accounting, mirroring AsyncHttpServer's
+        # (docs/operations.md); handler threads are concurrent here, so
+        # the counters take a lock
+        self.connections_accepted = 0
+        self.requests_served = 0
+        self._stats_lock = threading.Lock()
+        # sockets of live keep-alive connections: stop() severs them —
+        # shutdown() only stops ACCEPTING, and with pooled clients
+        # parking persistent connections, handler threads would
+        # otherwise keep serving a "stopped" server indefinitely
+        self._open_socks: set = set()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # TCP_NODELAY: the response is written as two sends
+            # (header block, then body); on a persistent keep-alive
+            # connection past the kernel's quick-ACK startup window,
+            # Nagle would hold the body segment for the client's
+            # delayed ACK (~40ms per response). The asyncio transport
+            # sets this by default; the threaded server must ask.
+            disable_nagle_algorithm = True
+
+            def setup(self):
+                super().setup()
+                with outer._stats_lock:
+                    outer.connections_accepted += 1
+                    outer._open_socks.add(self.connection)
+
+            def finish(self):
+                with outer._stats_lock:
+                    outer._open_socks.discard(self.connection)
+                super().finish()
 
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
             def _handle(self):
+                with outer._stats_lock:
+                    outer.requests_served += 1
                 parsed = urllib.parse.urlparse(self.path)
                 params = {
                     k: v[0]
@@ -337,6 +369,16 @@ class HttpServer:
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
 
+    def connection_stats(self) -> dict:
+        with self._stats_lock:
+            conns, reqs = self.connections_accepted, self.requests_served
+        return {
+            "connectionsAccepted": conns,
+            "requestsServed": reqs,
+            "requestsPerConnection": round(reqs / conns, 3) if conns
+            else 0.0,
+        }
+
     def start(self) -> "HttpServer":
         self._thread = threading.Thread(
             target=self._server.serve_forever, name=f"{self.app.name}-http",
@@ -356,6 +398,17 @@ class HttpServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        with self._stats_lock:
+            socks = list(self._open_socks)
+            self._open_socks.clear()
+        for sock in socks:
+            # sever parked keep-alive connections so their handler
+            # threads exit (readline sees EOF); without this a
+            # "stopped" server keeps serving pooled clients forever
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         if self._thread:
             self._thread.join(timeout=5)
 
@@ -427,6 +480,26 @@ class AsyncHttpServer:
         self._failed: BaseException | None = None
         self._main_task: asyncio.Task | None = None
         self._conns: set[asyncio.Task] = set()
+        # connection tasks with a request mid-dispatch: what _shutdown
+        # grace-drains (idle keep-alive connections are cancelled
+        # outright — see _shutdown)
+        self._busy: set[asyncio.Task] = set()
+        # connection-reuse accounting (docs/operations.md): requests per
+        # accepted connection is the server-side keep-alive reuse ratio
+        # — a client fleet stuck at 1.0 (e.g. a proxy stripping
+        # keep-alive) re-dials per request and shows up here before it
+        # shows up as a latency page. Mutated only on the event loop.
+        self.connections_accepted = 0
+        self.requests_served = 0
+
+    def connection_stats(self) -> dict:
+        conns, reqs = self.connections_accepted, self.requests_served
+        return {
+            "connectionsAccepted": conns,
+            "requestsServed": reqs,
+            "requestsPerConnection": round(reqs / conns, 3) if conns
+            else 0.0,
+        }
 
     # -- connection handling -------------------------------------------------
     async def _handle_conn(self, reader: asyncio.StreamReader,
@@ -435,6 +508,9 @@ class AsyncHttpServer:
         if task is not None:
             self._conns.add(task)
             task.add_done_callback(self._conns.discard)
+        # pio: lint-ok[attr-no-lock] counter writes happen only on the
+        # single event loop thread
+        self.connections_accepted += 1
         try:
             while True:
                 try:
@@ -446,88 +522,17 @@ class AsyncHttpServer:
                         writer, 413, {"message": "headers too large"}, True
                     )
                     return
-                lines = head.decode("latin-1").split("\r\n")
+                # a request is in flight from here until its response is
+                # written: _shutdown grace-drains busy tasks and cancels
+                # idle (parked keep-alive) ones outright
+                if task is not None:
+                    self._busy.add(task)
                 try:
-                    method, target, version = lines[0].split(" ", 2)
-                except ValueError:
-                    await self._respond(
-                        writer, 400, {"message": "malformed request line"}, True
-                    )
-                    return
-                headers: dict[str, str] = {}
-                for line in lines[1:]:
-                    if not line:
-                        continue
-                    k, _, v = line.partition(":")
-                    headers[k.strip().lower()] = v.strip()
-                try:
-                    length = int(headers.get("content-length") or 0)
-                except ValueError:
-                    await self._respond(
-                        writer, 400, {"message": "bad Content-Length"}, True
-                    )
-                    return
-                if length > _MAX_BODY:
-                    await self._respond(
-                        writer, 413, {"message": "body too large"}, True
-                    )
-                    return
-                try:
-                    body = await reader.readexactly(length) if length else b""
-                except asyncio.IncompleteReadError:
-                    return  # client closed mid-body
-                parsed = urllib.parse.urlparse(target)
-                req = Request(
-                    method=method.upper(),
-                    path=parsed.path,
-                    params={
-                        k: v[0]
-                        for k, v in urllib.parse.parse_qs(
-                            parsed.query, keep_blank_values=True
-                        ).items()
-                    },
-                    headers=headers,
-                    body=body,
-                )
-                close = (
-                    headers.get("connection", "").lower() == "close"
-                    or version == "HTTP/1.0"
-                )
-                # health probes bypass the shedder AND the worker pool
-                # (dispatched inline on the loop): a saturated pool is
-                # precisely when a balancer most needs /readyz to answer,
-                # and the probe handlers are lock-snapshot cheap
-                if parsed.path in HEALTH_PATHS:
-                    status, payload = dispatch_safe(self.app, req)
-                    await self._respond(writer, status, payload, close)
-                    if close:
-                        return
-                    continue
-                # load shedding: bounded-queue backpressure. Above the
-                # watermark new work answers 503 + Retry-After — how a
-                # balancer learns to STOP sending the traffic being shed.
-                shed = not self.shedder.try_acquire()
-                if shed:
-                    await self._respond(
-                        writer, 503,
-                        json_response(
-                            {"message": "server overloaded, retry later"},
-                            {"Retry-After":
-                             f"{self.shedder.retry_after_s:.0f}"},
-                        ),
-                        close,
-                    )
-                    if close:
-                        return
-                    continue
-                try:
-                    status, payload = await asyncio.get_running_loop() \
-                        .run_in_executor(
-                            self._pool, dispatch_safe, self.app, req)
+                    done = await self._serve_one(reader, writer, head)
                 finally:
-                    self.shedder.release()
-                await self._respond(writer, status, payload, close)
-                if close:
+                    if task is not None:
+                        self._busy.discard(task)
+                if done:
                     return
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -537,6 +542,93 @@ class AsyncHttpServer:
                 await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
+
+    async def _serve_one(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter,
+                         head: bytes) -> bool:
+        """Parse + dispatch + respond for one request whose header block
+        was already read. Returns True when the connection is done
+        (Connection: close, HTTP/1.0, or a fatal parse error)."""
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, target, version = lines[0].split(" ", 2)
+        except ValueError:
+            await self._respond(
+                writer, 400, {"message": "malformed request line"}, True
+            )
+            return True
+        headers: dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        try:
+            length = int(headers.get("content-length") or 0)
+        except ValueError:
+            await self._respond(
+                writer, 400, {"message": "bad Content-Length"}, True
+            )
+            return True
+        if length > _MAX_BODY:
+            await self._respond(
+                writer, 413, {"message": "body too large"}, True
+            )
+            return True
+        try:
+            body = await reader.readexactly(length) if length else b""
+        except asyncio.IncompleteReadError:
+            return True  # client closed mid-body
+        parsed = urllib.parse.urlparse(target)
+        req = Request(
+            method=method.upper(),
+            path=parsed.path,
+            params={
+                k: v[0]
+                for k, v in urllib.parse.parse_qs(
+                    parsed.query, keep_blank_values=True
+                ).items()
+            },
+            headers=headers,
+            body=body,
+        )
+        close = (
+            headers.get("connection", "").lower() == "close"
+            or version == "HTTP/1.0"
+        )
+        # pio: lint-ok[attr-no-lock] event-loop-thread only
+        self.requests_served += 1
+        # health probes bypass the shedder AND the worker pool
+        # (dispatched inline on the loop): a saturated pool is
+        # precisely when a balancer most needs /readyz to answer,
+        # and the probe handlers are lock-snapshot cheap
+        if parsed.path in HEALTH_PATHS:
+            status, payload = dispatch_safe(self.app, req)
+            await self._respond(writer, status, payload, close)
+            return close
+        # load shedding: bounded-queue backpressure. Above the
+        # watermark new work answers 503 + Retry-After — how a
+        # balancer learns to STOP sending the traffic being shed.
+        shed = not self.shedder.try_acquire()
+        if shed:
+            await self._respond(
+                writer, 503,
+                json_response(
+                    {"message": "server overloaded, retry later"},
+                    {"Retry-After":
+                     f"{self.shedder.retry_after_s:.0f}"},
+                ),
+                close,
+            )
+            return close
+        try:
+            status, payload = await asyncio.get_running_loop() \
+                .run_in_executor(
+                    self._pool, dispatch_safe, self.app, req)
+        finally:
+            self.shedder.release()
+        await self._respond(writer, status, payload, close)
+        return close
 
     async def _respond(self, writer, status: int, payload: Any, close: bool):
         data, ctype, extra = encode_payload(payload)
@@ -577,15 +669,36 @@ class AsyncHttpServer:
             await self._server.serve_forever()
 
     async def _shutdown(self, grace_s: float = 2.0):
-        """Stop accepting, drain in-flight responses briefly, then cancel
-        lingering (idle keep-alive) connections and the accept loop."""
-        if self._server is not None:
-            self._server.close()
+        """Drain in-flight responses briefly, cancel lingering
+        connections, then close the listener and the accept loop.
+
+        Ordering is load-bearing twice over. (1) Only BUSY connections
+        (a request mid-dispatch) get the grace wait: with keep-alive
+        clients parked in the shared connection pool, idle connections
+        routinely outlive the server and would eat the full grace on
+        every stop — they are cancelled immediately instead, and the
+        short post-cancel wait lets their finally blocks close
+        transports while the loop is still alive (closing them after
+        the loop died raises unraisable "Event loop is closed" errors).
+        (2) ``Server.close()`` cancels ``serve_forever``, which unwinds
+        ``_amain`` and CLOSES THE LOOP — so it must come after the last
+        ``await`` here, or this coroutine dies mid-drain and ``stop()``
+        blocks on a future that never resolves."""
+        # a busy task leaves self._busy when its response is written —
+        # it does NOT complete (it parks on the next keep-alive read),
+        # so poll the set instead of awaiting the tasks, or any
+        # in-flight request would burn the full grace every stop
+        deadline = asyncio.get_running_loop().time() + grace_s
+        while (any(not t.done() for t in self._busy)
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.02)
         conns = {t for t in self._conns if not t.done()}
-        if conns:
-            await asyncio.wait(conns, timeout=grace_s)
         for t in conns:
             t.cancel()
+        if conns:
+            await asyncio.wait(conns, timeout=1.0)
+        if self._server is not None:
+            self._server.close()
         if self._main_task is not None:
             self._main_task.cancel()
 

@@ -281,12 +281,13 @@ def build_storage_app(
         name + `surface="storage"` label (docs/observability.md; the
         pre-PR-9 `pio_storage_` prefix is replaced by the label)."""
         from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.httpclient import pool_counters
         from pio_tpu.utils.tracing import (
             PROMETHEUS_CONTENT_TYPE, prometheus_text,
         )
 
         return 200, RawResponse(
-            prometheus_text(tracer.snapshot(), {},
+            prometheus_text(tracer.snapshot(), dict(pool_counters()),
                             labels={"surface": "storage"}),
             PROMETHEUS_CONTENT_TYPE)
 

@@ -93,6 +93,14 @@ class RouterConfig:
     breaker_failure_rate: float = 0.5
     breaker_open_s: float = 2.0
     breaker_window_s: float = 30.0
+    # internal RPC plane (docs/performance.md): "binary" negotiates the
+    # CRC32C-framed f32/int32 shard wire (rpcwire.py) per replica, with
+    # a sticky logged-once JSON downgrade against pre-binary shards;
+    # "json" pins the legacy wire (the bench smoke cell's control arm).
+    rpc_wire: str = "binary"
+    # keep-alive pooling for the shard RPC clients; False restores a
+    # fresh connection per RPC (the other control arm)
+    http_pooled: bool = True
 
 
 @dataclass
@@ -103,6 +111,11 @@ class _Replica:
     healthy: bool = True        # last prober verdict (optimistic start)
     last_probe: float = 0.0
     info: dict = field(default_factory=dict)   # last /shard/info payload
+    # binary RPC wire negotiation state (rpcwire.py): None = untested
+    # (send JSON bodies + binary Accept), True = confirmed (top-k
+    # request bodies go binary too), False = STICKY JSON downgrade (a
+    # pre-binary shard ignored the negotiation; logged once)
+    binary_wire: bool | None = None
 
 
 class FleetRouter:
@@ -137,11 +150,16 @@ class FleetRouter:
         # stamping {"arm": "candidate"} on canary-arm RPCs.
         self.rollout = None
         self.candidate_plan: ShardPlan | None = None
+        # per-codec RPC accounting (docs/performance.md "Internal RPC
+        # plane"): which wire the shard fan-out actually rides, plus the
+        # downgrade log-once latch per replica
+        self.rpc_codec_counts = {"binary": 0, "json": 0}
         self.replicas: list[list[_Replica]] = [
             [
                 _Replica(
                     url=url,
-                    client=JsonHttpClient(url, timeout=config.rpc_timeout_s),
+                    client=JsonHttpClient(url, timeout=config.rpc_timeout_s,
+                                          pooled=config.http_pooled),
                     breaker=CircuitBreaker(
                         f"shard{s}/replica{r}",
                         min_calls=config.breaker_min_calls,
@@ -216,7 +234,7 @@ class FleetRouter:
                     retry_after_s=rep.breaker.retry_after_s() or 1.0)
                 continue
             try:
-                out = rep.client.request("POST", path, body)
+                out = self._rpc(rep, op, path, body)
             except HttpClientError as e:
                 if (e.status == 503 and isinstance(e.message, str)
                         and e.message.startswith("candidate-arm-missing")):
@@ -246,6 +264,92 @@ class FleetRouter:
                     self._preferred[shard] = r
             return out
         raise ShardUnavailable(shard, last_error)
+
+    # -- binary RPC wire (rpcwire.py) ----------------------------------------
+    _BINARY_OPS = frozenset({"user_row", "topk", "item_rows"})
+
+    def _count_rpc(self, codec: str) -> None:
+        with self._lock:
+            self.rpc_codec_counts[codec] += 1
+
+    def _rpc(self, rep: _Replica, op: str, path: str, body) -> dict:
+        """One replica RPC with wire negotiation. The scoring RPCs are
+        read-only, so they are marked idempotent — a stale pooled
+        socket gets the client's ONE transparent resend instead of
+        burning a replica failover. Binary negotiation rides Accept; a
+        replica that answers JSON anyway (pre-binary shard) is
+        downgraded STICKILY and logged once, mirroring find_columnar's
+        downgrade. Only a CONFIRMED-binary replica gets binary request
+        bodies (the top-k f32 row), so a pre-binary shard never sees a
+        frame it would 400 on."""
+        from pio_tpu.serving_fleet import rpcwire
+
+        read_op = op in self._BINARY_OPS
+        if (not read_op or self.config.rpc_wire != "binary"
+                or rep.binary_wire is False):
+            if read_op:
+                self._count_rpc("json")
+                return rep.client.request("POST", path,
+                                          self._jsonable(op, body),
+                                          idempotent=True)
+            return rep.client.request("POST", path, body)
+        if op == "topk" and rep.binary_wire:
+            try:
+                resp = rep.client.request(
+                    "POST", path,
+                    raw=rpcwire.encode_topk_request(
+                        body["row"], body["k"], body.get("arm", ARM_ACTIVE)),
+                    content_type=rpcwire.RPC_CONTENT_TYPE,
+                    accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True)
+            except HttpClientError as e:
+                if not e.status:
+                    raise   # transport-level: breaker/failover handles it
+                # a CONFIRMED-binary replica answering an HTTP error to
+                # a frame it negotiated for is usually a shard rolled
+                # back to a pre-binary build mid-flight (its handler
+                # can't parse the body at all): retry this one call as
+                # JSON — a JSON success hits the sticky downgrade
+                # below, a JSON failure is the real error and raises
+                resp = rep.client.request(
+                    "POST", path, self._jsonable(op, body),
+                    accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True)
+        else:
+            resp = rep.client.request(
+                "POST", path, self._jsonable(op, body),
+                accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True)
+        if isinstance(resp, (bytes, bytearray)):
+            rep.binary_wire = True
+            self._count_rpc("binary")
+            try:
+                return rpcwire.decode_response(op, resp)
+            except rpcwire.RpcWireError as e:
+                # a corrupt frame from a confirmed-binary replica gets
+                # the transport-failure treatment: charge the breaker,
+                # fail over to the next replica
+                raise HttpClientError(
+                    0, f"corrupt binary rpc frame from {rep.url}: {e}"
+                ) from e
+        # JSON answer to a binary negotiation: pre-binary shard — pin
+        # the replica to the JSON wire for this router's lifetime
+        if rep.binary_wire is not False:
+            rep.binary_wire = False
+            log.warning(
+                "shard replica %s ignored the binary RPC negotiation "
+                "(pre-binary shard?); sticky JSON downgrade for this "
+                "replica", rep.url)
+        self._count_rpc("json")
+        return resp
+
+    @staticmethod
+    def _jsonable(op: str, body):
+        """A JSON-wire body for `op`: the top-k row may be an f32 numpy
+        array (fetched over the binary wire from the owner shard) —
+        float64 text of f32 values round-trips exactly, so converting
+        here preserves bit-parity on mixed-wire fleets."""
+        if (op == "topk" and isinstance(body, dict)
+                and not isinstance(body.get("row"), list)):
+            return {**body, "row": [float(x) for x in body["row"]]}
+        return body
 
     # -- query path ---------------------------------------------------------
     def _plan_for(self, arm: str) -> ShardPlan:
@@ -682,6 +786,9 @@ class FleetRouter:
     def shard_health(self) -> dict:
         """Per shard group: replica breaker/health detail + whether at
         least one replica is routable (breaker not open)."""
+        from pio_tpu.utils.httpclient import default_pool
+
+        pool = default_pool()
         shards = {}
         for s, group in enumerate(self.replicas):
             reps = []
@@ -692,6 +799,13 @@ class FleetRouter:
                     routable += 1
                 with self._lock:
                     healthy, info = rep.healthy, dict(rep.info)
+                # client-side connection-reuse ratio toward this replica
+                # (docs/operations.md): ~0 under steady traffic means
+                # every RPC re-dialed — a keep-alive-stripping proxy or
+                # an idle-timeout shorter than the query cadence,
+                # visible here before it becomes a latency page
+                hs = pool.host_stats(rep.url)
+                dials = hs["opened"] + hs["reused"]
                 reps.append({
                     "replica": r, "url": rep.url,
                     "breaker": snap.state,
@@ -702,6 +816,10 @@ class FleetRouter:
                     # guarded rollout: which candidate (if any) this
                     # replica has staged — doctor --fleet's coverage
                     "candidateInstanceId": info.get("candidateInstanceId"),
+                    # internal RPC plane (docs/performance.md)
+                    "binaryWire": rep.binary_wire,
+                    "connReuse": (round(hs["reused"] / dials, 3)
+                                  if dials else None),
                 })
             shards[str(s)] = {
                 "ok": routable > 0,
@@ -877,13 +995,18 @@ def build_router_app(router: FleetRouter) -> HttpApp:
 
     @app.route("GET", r"/metrics\.json")
     def metrics(req: Request):
+        from pio_tpu.utils.httpclient import default_pool
+
         with router._lock:
             degraded, rerouted = router.degraded_count, router.rerouted_count
+            codec_counts = dict(router.rpc_codec_counts)
         out = {
             "startTime": format_time(router.start_time),
             "spans": router.tracer.snapshot(),
             "degradedResponses": degraded,
             "reroutedCalls": rerouted,
+            "rpcCodecCounts": codec_counts,
+            "connPool": default_pool().stats(),
         }
         if router.recorder is not None:
             # slow-trace exemplars: each span's slowest recent trace id,
@@ -896,21 +1019,30 @@ def build_router_app(router: FleetRouter) -> HttpApp:
         """Prometheus twin of /metrics.json through the shared renderer
         (uniform `surface` label — docs/observability.md)."""
         from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.httpclient import pool_counters
         from pio_tpu.utils.tracing import (
-            PROMETHEUS_CONTENT_TYPE, prometheus_text,
+            PROMETHEUS_CONTENT_TYPE, prometheus_labeled_counter,
+            prometheus_text,
         )
 
         with router._lock:
             degraded, rerouted = router.degraded_count, router.rerouted_count
-        return 200, RawResponse(
-            prometheus_text(
-                router.tracer.snapshot(),
-                {"degraded_responses_total": float(degraded),
-                 "rerouted_calls_total": float(rerouted),
-                 "uptime_seconds":
-                     (utcnow() - router.start_time).total_seconds()},
-                labels={"surface": "router"}),
-            PROMETHEUS_CONTENT_TYPE)
+            codec_counts = dict(router.rpc_codec_counts)
+        labels = {"surface": "router"}
+        counters = {
+            "degraded_responses_total": float(degraded),
+            "rerouted_calls_total": float(rerouted),
+            "uptime_seconds":
+                (utcnow() - router.start_time).total_seconds(),
+        }
+        counters.update(pool_counters())
+        text = prometheus_text(router.tracer.snapshot(), counters,
+                               labels=labels)
+        text += "\n".join(prometheus_labeled_counter(
+            "rpc_requests_total",
+            [({**labels, "codec": codec}, float(count))
+             for codec, count in sorted(codec_counts.items())])) + "\n"
+        return 200, RawResponse(text, PROMETHEUS_CONTENT_TYPE)
 
     @app.route("POST", r"/reload")
     @app.route("GET", r"/reload")  # deprecated alias (docs/serving.md:

@@ -1,0 +1,248 @@
+"""Binary RPC wire for the fleet's shard fan-out — THE codec module.
+
+The shard RPCs (`/shard/topk`, `/shard/user_row`, `/shard/item_rows`)
+move f32 factor rows and top-k score vectors on every router query; the
+JSON wire spends the fan-out budget printing and re-parsing float text.
+This module is the single owner of the binary alternative (the
+`wire-codec` lint rule sanctions exactly this file, like
+data/columnar.py for the columnar wire): a CRC32C-framed message —
+``utils/durable.frame`` envelope with its own magic, so truncation and
+bit-rot die at the edge as a 400/failover, never a silent wrong score —
+whose numeric sections are raw little-endian f32/int32 arrays decoded by
+``np.frombuffer`` pointer-cast (the PR 11 codec discipline).
+
+Bit-parity contract: the f32 bytes ARE the shard's factor/score values,
+so the router's ``(-score, global_index)`` merge stays bit-identical to
+the single-host oracle — exactly as identical as the JSON wire, whose
+float text round-trips f32→f64 repr→parse→f32 losslessly, just without
+the printing/parsing. Entity ids keep their JSON semantics verbatim: the
+id lists travel as a JSON sidecar inside the frame, so a non-string id
+is (un)known exactly as it is on the JSON wire.
+
+Negotiation (docs/performance.md "Internal RPC plane"): the router sends
+``Accept: application/x-pio-rpc``; a binary-capable shard answers the
+frame under that Content-Type, a pre-binary shard ignores the header and
+answers JSON — the router detects the JSON body and downgrades that
+replica STICKILY (logged once), mirroring ``find_columnar``'s downgrade.
+Only after a replica has confirmed binary does the router also send the
+top-k REQUEST body (the query user's f32 row) as a frame under
+``Content-Type: application/x-pio-rpc``.
+
+Message layout inside the durable envelope::
+
+    PIOR\\x01 | crc32c(payload) | len(payload)      (durable._HEADER)
+    payload = u8 kind | u32 header_len | header_json | sections...
+
+    kind 1 TOPK_REQ       header {"k", "arm", "d"}          row f32[d]
+    kind 2 TOPK_RESP      header {"n", "items": [...]}      gidx i32[n]
+                                                            scores f32[n]
+    kind 3 USER_ROW_RESP  header {"found", "d"}             row f32[d]
+    kind 4 ITEM_ROWS_RESP header {"n", "k", "ids": [...]}   rows f32[n*k]
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from pio_tpu.utils import durable
+
+RPC_CONTENT_TYPE = "application/x-pio-rpc"
+RPC_MAGIC = b"PIOR\x01"
+
+_KIND_TOPK_REQ = 1
+_KIND_TOPK_RESP = 2
+_KIND_USER_ROW_RESP = 3
+_KIND_ITEM_ROWS_RESP = 4
+
+_PREFIX = struct.Struct(">BI")   # kind, header length
+_F32 = np.dtype("<f4")
+_I32 = np.dtype("<i4")
+
+
+class RpcWireError(ValueError):
+    """A frame that passed the CRC but violates the message layout
+    (wrong kind, forged counts, trailing bytes). Shard routes map it to
+    400; the router maps it to a transport-level failure so the replica
+    fails over."""
+
+
+# -- envelope ----------------------------------------------------------------
+
+def _seal(kind: int, header: dict, *sections: bytes) -> bytes:
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    return durable.frame(
+        _PREFIX.pack(kind, len(hdr)) + hdr + b"".join(sections),
+        magic=RPC_MAGIC)
+
+
+def _open(data: bytes, want_kind: int) -> tuple[dict, bytes]:
+    """Verify the envelope + prefix -> (header, section bytes)."""
+    if not durable.is_framed(data, RPC_MAGIC):
+        raise RpcWireError("not a PIOR rpc frame")
+    try:
+        payload = durable.unframe(data, source="rpc frame",
+                                  magic=RPC_MAGIC)
+    except durable.ModelIntegrityError as e:
+        # one exception surface for callers: a CRC/length mismatch and a
+        # layout violation get the same 400/failover treatment
+        raise RpcWireError(str(e)) from e
+    if len(payload) < _PREFIX.size:
+        raise RpcWireError("rpc frame too short for its prefix")
+    kind, hdr_len = _PREFIX.unpack_from(payload)
+    if kind != want_kind:
+        raise RpcWireError(
+            f"rpc frame kind {kind} where {want_kind} was expected "
+            "(request/response or route confusion)")
+    end = _PREFIX.size + hdr_len
+    if hdr_len > len(payload) - _PREFIX.size:
+        raise RpcWireError("rpc frame header overruns the payload")
+    try:
+        header = json.loads(payload[_PREFIX.size:end].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise RpcWireError(f"malformed rpc frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise RpcWireError("rpc frame header must be a JSON object")
+    return header, payload[end:]
+
+
+def _count(header: dict, key: str, limit: int = 1 << 28) -> int:
+    """A non-negative element count from the header, bounded BEFORE any
+    allocation so a forged count dies in microseconds (the columnar
+    wire's oversized-frame lesson)."""
+    try:
+        n = int(header[key])
+    except (KeyError, TypeError, ValueError) as e:
+        raise RpcWireError(f"rpc frame header missing count {key!r}") from e
+    if n < 0 or n > limit:
+        raise RpcWireError(f"rpc frame count {key}={n} out of range")
+    return n
+
+
+def _sections(body: bytes, *specs: tuple[np.dtype, int]) -> list[np.ndarray]:
+    """Pointer-cast the section bytes into the declared arrays; the body
+    must be EXACTLY the declared sizes (no trailing bytes: a length
+    mismatch means a drifted encoder, and silence here corrupts
+    scores)."""
+    out = []
+    off = 0
+    for dtype, n in specs:
+        nbytes = dtype.itemsize * n
+        if off + nbytes > len(body):
+            raise RpcWireError(
+                f"rpc frame truncated: section of {n} x {dtype} at "
+                f"offset {off} overruns {len(body)} body bytes")
+        out.append(np.frombuffer(body, dtype=dtype, count=n, offset=off))
+        off += nbytes
+    if off != len(body):
+        raise RpcWireError(
+            f"rpc frame has {len(body) - off} trailing bytes")
+    return out
+
+
+def _f32_bytes(arr) -> tuple[bytes, int]:
+    a = np.ascontiguousarray(np.asarray(arr), dtype=_F32)
+    return a.tobytes(), int(a.size)
+
+
+# -- messages ----------------------------------------------------------------
+
+def encode_topk_request(row, k: int, arm: str = "active") -> bytes:
+    row_bytes, d = _f32_bytes(row)
+    return _seal(_KIND_TOPK_REQ, {"k": int(k), "arm": arm, "d": d},
+                 row_bytes)
+
+
+def decode_topk_request(data: bytes) -> tuple[np.ndarray, int, str]:
+    header, body = _open(data, _KIND_TOPK_REQ)
+    d = _count(header, "d")
+    (row,) = _sections(body, (_F32, d))
+    arm = header.get("arm", "active")
+    if not isinstance(arm, str):
+        raise RpcWireError("rpc frame arm must be a string")
+    return row, _count(header, "k"), arm
+
+
+def encode_topk_response(items: list, indices, scores) -> bytes:
+    gidx = np.ascontiguousarray(np.asarray(indices), dtype=_I32)
+    score_bytes, n = _f32_bytes(scores)
+    if len(items) != n or gidx.size != n:
+        raise RpcWireError(
+            f"topk response sections disagree: {len(items)} items, "
+            f"{gidx.size} indices, {n} scores")
+    return _seal(_KIND_TOPK_RESP, {"n": n, "items": items},
+                 gidx.tobytes(), score_bytes)
+
+
+def decode_topk_response(data: bytes) -> dict:
+    header, body = _open(data, _KIND_TOPK_RESP)
+    n = _count(header, "n")
+    items = header.get("items")
+    if not isinstance(items, list) or len(items) != n:
+        raise RpcWireError("topk response id sidecar disagrees with n")
+    gidx, scores = _sections(body, (_I32, n), (_F32, n))
+    return {"items": items, "indices": gidx, "scores": scores}
+
+
+def encode_user_row_response(row) -> bytes:
+    if row is None:
+        return _seal(_KIND_USER_ROW_RESP, {"found": False, "d": 0})
+    row_bytes, d = _f32_bytes(row)
+    return _seal(_KIND_USER_ROW_RESP, {"found": True, "d": d}, row_bytes)
+
+
+def decode_user_row_response(data: bytes) -> dict:
+    header, body = _open(data, _KIND_USER_ROW_RESP)
+    if not header.get("found"):
+        _sections(body)     # nothing may trail a not-found response
+        return {"found": False}
+    d = _count(header, "d")
+    (row,) = _sections(body, (_F32, d))
+    return {"found": True, "row": row}
+
+
+def encode_item_rows_response(ids: list, rows) -> bytes:
+    mat = np.ascontiguousarray(np.asarray(rows), dtype=_F32)
+    if mat.size == 0:
+        mat = mat.reshape(0, 0)
+    if mat.ndim != 2 or mat.shape[0] != len(ids):
+        raise RpcWireError(
+            f"item_rows response: {len(ids)} ids but row matrix shape "
+            f"{mat.shape}")
+    return _seal(
+        _KIND_ITEM_ROWS_RESP,
+        {"n": len(ids), "k": int(mat.shape[1]), "ids": ids},
+        mat.tobytes())
+
+
+def decode_item_rows_response(data: bytes) -> dict:
+    header, body = _open(data, _KIND_ITEM_ROWS_RESP)
+    n = _count(header, "n")
+    k = _count(header, "k")
+    ids = header.get("ids")
+    if not isinstance(ids, list) or len(ids) != n:
+        raise RpcWireError("item_rows response id sidecar disagrees "
+                           "with n")
+    (flat,) = _sections(body, (_F32, n * k))
+    rows = flat.reshape(n, k) if n else flat.reshape(0, k or 0)
+    return {"rows": {ids[i]: rows[i] for i in range(n)}}
+
+
+_RESPONSE_DECODERS = {
+    "topk": decode_topk_response,
+    "user_row": decode_user_row_response,
+    "item_rows": decode_item_rows_response,
+}
+
+
+def decode_response(op: str, data: bytes) -> dict:
+    """Router-side dispatch: one negotiated response frame -> the same
+    dict shape the JSON wire yields for `op` (arrays where JSON had
+    number lists — exact f32 values either way)."""
+    try:
+        decoder = _RESPONSE_DECODERS[op]
+    except KeyError:
+        raise RpcWireError(f"no binary decoder for rpc op {op!r}") from None
+    return decoder(data)

@@ -45,6 +45,7 @@ from pio_tpu.resilience.health import (
 from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, server_key_ok,
 )
+from pio_tpu.serving_fleet import rpcwire
 from pio_tpu.serving_fleet.plan import (
     ShardPartition, load_partition, partitioned_instances,
 )
@@ -137,6 +138,11 @@ class ShardServer:
         # active one (queries carry {"arm": "candidate"} to ride it)
         self.candidate: _ArmState | None = None
         self._candidate_foldin_pending: dict = {}
+        # per-codec RPC accounting (docs/performance.md "Internal RPC
+        # plane"): how many scoring RPCs answered on the binary wire vs
+        # JSON — a fleet stuck on "json" after a rollout is a router
+        # downgrade worth investigating, visible on /metrics
+        self.rpc_codec_counts = {"binary": 0, "json": 0}
         # streaming fold-in accounting (upsert_user_rows): surfaced on
         # /shard/info so `pio doctor --fleet` can compare fold-in lag
         # across shard groups
@@ -319,57 +325,91 @@ class ShardServer:
                     self._user_row_of, self._item_local_of)
 
     # -- RPC bodies ---------------------------------------------------------
-    def user_row(self, user, arm: str = "active") -> list[float] | None:
+    # Each scoring RPC has an *_arrays variant producing the raw numpy
+    # factor/score values — what the binary wire (rpcwire.py) frames
+    # directly, and what the JSON routes float()-convert. One compute
+    # path under the two codecs, so their values cannot drift.
+
+    def count_rpc(self, codec: str) -> None:
+        with self._lock:
+            self.rpc_codec_counts[codec] += 1
+
+    def user_row_array(self, user, arm: str = "active") -> np.ndarray | None:
         with self.tracer.span("user_row",
                               shard=self.config.shard_index, arm=arm):
             part, _, row_of, _ = self._arm(arm)
             row = row_of.get(user)
             if row is None:
                 return None
-            return [float(x) for x in part.user_rows[row]]
+            return np.asarray(part.user_rows[row], dtype=np.float32)
 
-    def topk(self, row: list[float], k: int, arm: str = "active") -> dict:
+    def user_row(self, user, arm: str = "active") -> list[float] | None:
+        row = self.user_row_array(user, arm=arm)
+        return None if row is None else [float(x) for x in row]
+
+    def topk_arrays(self, row, k: int, arm: str = "active",
+                    ) -> tuple[list, np.ndarray, np.ndarray]:
         """Partial top-k of the query user's row against this shard's
-        item slice — same kernel as the single-host path, so the per-item
-        scores are bit-identical and the router's merge is exact. The
-        `topk` span IS this shard's model span in the merged trace."""
+        item slice — same kernel as the single-host path, so the
+        per-item scores are bit-identical and the router's merge is
+        exact. -> (item ids, global indices i32, scores f32). The `topk`
+        span IS this shard's model span in the merged trace."""
         with self.tracer.span("topk",
                               shard=self.config.shard_index, arm=arm):
-            return self._topk(row, k, arm)
+            return self._topk_arrays(row, k, arm)
 
-    def _topk(self, row: list[float], k: int, arm: str) -> dict:
+    def _topk_arrays(self, row, k: int, arm: str,
+                     ) -> tuple[list, np.ndarray, np.ndarray]:
         from pio_tpu.ops import als
 
         part, item_dev, _, _ = self._arm(arm)
         n_local = len(part.item_ids)
         if n_local == 0:
-            return {"items": [], "indices": [], "scores": []}
+            return ([], np.zeros(0, dtype=np.int32),
+                    np.zeros(0, dtype=np.float32))
         u = np.asarray(row, dtype=np.float32)[None, :]
         local = als.ALSModel(u, item_dev)
         scores, idx = als.recommend_topk(local, np.array([0]), int(k))
         scores = np.asarray(scores)[0]
         idx = np.asarray(idx)[0]
+        gidx = np.asarray(part.item_gidx)[idx].astype(np.int32)
+        return [part.item_ids[i] for i in idx], gidx, scores
+
+    def topk(self, row: list[float], k: int, arm: str = "active") -> dict:
+        items, gidx, scores = self.topk_arrays(row, k, arm=arm)
         return {
-            "items": [part.item_ids[i] for i in idx],
-            "indices": [int(part.item_gidx[i]) for i in idx],
+            "items": items,
+            "indices": [int(g) for g in gidx],
             "scores": [float(s) for s in scores],
         }
 
-    def item_rows(self, items: list, arm: str = "active") -> dict:
+    def item_rows_arrays(self, items: list, arm: str = "active",
+                         ) -> tuple[list, np.ndarray]:
         """Factor ROWS for the subset of `items` this shard owns (the
-        whiteList path's row-fetch) — keyed by item id; unowned ids are
-        simply absent, which is how the router learns ownership. The
-        ROUTER scores candidates, in one einsum with the exact operand
-        shapes the single-host oracle uses: per-pair scores computed
-        shard-side in smaller batches drift by an ULP (XLA's einsum
-        lowering is shape-sensitive), which would break bit-parity."""
+        whiteList path's row-fetch) — (owned ids, f32 row matrix) in
+        request order; unowned ids are simply absent, which is how the
+        router learns ownership. The ROUTER scores candidates, in one
+        einsum with the exact operand shapes the single-host oracle
+        uses: per-pair scores computed shard-side in smaller batches
+        drift by an ULP (XLA's einsum lowering is shape-sensitive),
+        which would break bit-parity."""
         with self.tracer.span("item_rows",
                               shard=self.config.shard_index, arm=arm):
             part, _, _, local_of = self._arm(arm)
             owned = [(it, local_of[it]) for it in items if it in local_of]
-            return {"rows": {
-                it: [float(x) for x in part.item_rows[i]] for it, i in owned
-            }}
+            if not owned:
+                k = (int(part.item_rows.shape[1])
+                     if getattr(part.item_rows, "ndim", 0) == 2 else 0)
+                return [], np.zeros((0, k), dtype=np.float32)
+            rows = np.asarray(part.item_rows,
+                              dtype=np.float32)[[i for _, i in owned]]
+            return [it for it, _ in owned], rows
+
+    def item_rows(self, items: list, arm: str = "active") -> dict:
+        ids, rows = self.item_rows_arrays(items, arm=arm)
+        return {"rows": {
+            it: [float(x) for x in rows[i]] for i, it in enumerate(ids)
+        }}
 
     def upsert_user_rows(self, rows: dict,
                          staleness_s: float | None = None) -> dict:
@@ -552,6 +592,23 @@ def build_shard_app(server: ShardServer) -> HttpApp:
     def check_server_key(req: Request) -> bool:
         return server_key_ok(req, config.server_key)
 
+    def _media_type(req: Request, header: str) -> str:
+        return (req.header(header) or "").split(";")[0].strip().lower()
+
+    def _binary_accept(req: Request) -> bool:
+        """Accept negotiation for the binary RPC wire (rpcwire.py): a
+        router that sent Accept: application/x-pio-rpc gets the framed
+        f32/int32 body; everyone else keeps JSON. Pre-binary routers
+        never send the header, so they are untouched."""
+        return _media_type(req, "accept") == rpcwire.RPC_CONTENT_TYPE
+
+    def _binary_response(items, gidx, scores):
+        from pio_tpu.server.http import RawResponse
+
+        return 200, RawResponse(
+            rpcwire.encode_topk_response(items, gidx, scores),
+            rpcwire.RPC_CONTENT_TYPE)
+
     @app.route("GET", r"/")
     def root(req: Request):
         return 200, server.info()
@@ -562,11 +619,14 @@ def build_shard_app(server: ShardServer) -> HttpApp:
 
     @app.route("GET", r"/metrics\.json")
     def metrics_json(req: Request):
+        with server._lock:
+            codec_counts = dict(server.rpc_codec_counts)
         out = {
             "startTime": format_time(server.start_time),
             "spans": server.tracer.snapshot(),
             "shardIndex": config.shard_index,
             "foldin": server.foldin_status(),
+            "rpcCodecCounts": codec_counts,
         }
         if server.recorder is not None:
             out["exemplars"] = server.recorder.exemplars()
@@ -576,25 +636,35 @@ def build_shard_app(server: ShardServer) -> HttpApp:
     def metrics_prometheus(req: Request):
         """Prometheus exposition through the shared renderer with the
         uniform label set: `surface="shard", shard="<i>"` on every
-        sample (docs/observability.md)."""
+        sample (docs/observability.md), plus the per-codec RPC counters
+        and the outbound connection-pool counters (docs/performance.md
+        "Internal RPC plane")."""
         from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.httpclient import pool_counters
         from pio_tpu.utils.tracing import (
-            PROMETHEUS_CONTENT_TYPE, prometheus_text,
+            PROMETHEUS_CONTENT_TYPE, prometheus_labeled_counter,
+            prometheus_text,
         )
 
         with server._lock:
             part = server.partition
             applied = server.foldin_applied_users
-        return 200, RawResponse(
-            prometheus_text(
-                server.tracer.snapshot(),
-                {"partition_bytes": float(part.nbytes() if part else 0),
-                 "foldin_applied_users_total": float(applied),
-                 "uptime_seconds":
-                     (utcnow() - server.start_time).total_seconds()},
-                labels={"surface": "shard",
-                        "shard": str(config.shard_index)}),
-            PROMETHEUS_CONTENT_TYPE)
+            codec_counts = dict(server.rpc_codec_counts)
+        labels = {"surface": "shard", "shard": str(config.shard_index)}
+        counters = {
+            "partition_bytes": float(part.nbytes() if part else 0),
+            "foldin_applied_users_total": float(applied),
+            "uptime_seconds":
+                (utcnow() - server.start_time).total_seconds(),
+        }
+        counters.update(pool_counters())
+        text = prometheus_text(server.tracer.snapshot(), counters,
+                               labels=labels)
+        text += "\n".join(prometheus_labeled_counter(
+            "rpc_requests_total",
+            [({**labels, "codec": codec}, float(count))
+             for codec, count in sorted(codec_counts.items())])) + "\n"
+        return 200, RawResponse(text, PROMETHEUS_CONTENT_TYPE)
 
     def _arm_of(body: dict):
         """The arm a scoring RPC rides ({"arm": "candidate"} during a
@@ -612,36 +682,64 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         arm, err = _arm_of(body)
         if err:
             return err
+        binary = _binary_accept(req)
+        server.count_rpc("binary" if binary else "json")
         # RAW value lookup, no str() coercion: the single-host oracle
         # treats a non-string id as unknown (not in the id index), and
         # the fleet must agree
         try:
-            row = server.user_row(body["user"], arm=arm)
+            row = server.user_row_array(body["user"], arm=arm)
         except CandidateArmMissing as e:
             # the "candidate-arm-missing:" prefix is the router's cue to
             # fail over WITHOUT charging this replica's breaker: the
             # replica is healthy, it just has no staged arm
             return 503, {"message": f"candidate-arm-missing: {e}"}
+        if binary:
+            from pio_tpu.server.http import RawResponse
+
+            return 200, RawResponse(
+                rpcwire.encode_user_row_response(row),
+                rpcwire.RPC_CONTENT_TYPE)
         if row is None:
             return 200, {"found": False}
-        return 200, {"found": True, "row": row}
+        return 200, {"found": True, "row": [float(x) for x in row]}
 
     @app.route("POST", r"/shard/topk")
     def shard_topk(req: Request):
-        body = req.json()
-        if (not isinstance(body, dict) or "row" not in body
-                or "k" not in body):
-            return 400, {"message": "body must be {\"row\": [...], \"k\": n}"}
-        arm, err = _arm_of(body)
-        if err:
-            return err
+        if _media_type(req, "content-type") == rpcwire.RPC_CONTENT_TYPE:
+            # binary request body: the query user's f32 row rides the
+            # frame verbatim (the router only sends it after this
+            # replica confirmed the wire with a binary response)
+            try:
+                row, k, arm = rpcwire.decode_topk_request(req.body)
+            except rpcwire.RpcWireError as e:
+                return 400, {"message": f"bad rpc frame: {e}"}
+            if arm not in ("active", "candidate"):
+                return 400, {"message": f"unknown arm {arm!r}"}
+        else:
+            body = req.json()
+            if (not isinstance(body, dict) or "row" not in body
+                    or "k" not in body):
+                return 400, {
+                    "message": "body must be {\"row\": [...], \"k\": n}"}
+            arm, err = _arm_of(body)
+            if err:
+                return err
+            row, k = body["row"], int(body["k"])
+        binary = _binary_accept(req)
+        server.count_rpc("binary" if binary else "json")
         try:
-            return 200, server.topk(body["row"], int(body["k"]), arm=arm)
+            items, gidx, scores = server.topk_arrays(row, k, arm=arm)
         except CandidateArmMissing as e:
             # the "candidate-arm-missing:" prefix is the router's cue to
             # fail over WITHOUT charging this replica's breaker: the
             # replica is healthy, it just has no staged arm
             return 503, {"message": f"candidate-arm-missing: {e}"}
+        if binary:
+            return _binary_response(items, gidx, scores)
+        return 200, {"items": items,
+                     "indices": [int(g) for g in gidx],
+                     "scores": [float(s) for s in scores]}
 
     @app.route("POST", r"/shard/item_rows")
     def shard_item_rows(req: Request):
@@ -652,15 +750,27 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         arm, err = _arm_of(body)
         if err:
             return err
+        binary = _binary_accept(req)
+        server.count_rpc("binary" if binary else "json")
         # raw values: see /shard/user_row — membership must match the
         # single-host id-index semantics exactly
         try:
-            return 200, server.item_rows(list(body["items"]), arm=arm)
+            ids, rows = server.item_rows_arrays(list(body["items"]),
+                                                arm=arm)
         except CandidateArmMissing as e:
             # the "candidate-arm-missing:" prefix is the router's cue to
             # fail over WITHOUT charging this replica's breaker: the
             # replica is healthy, it just has no staged arm
             return 503, {"message": f"candidate-arm-missing: {e}"}
+        if binary:
+            from pio_tpu.server.http import RawResponse
+
+            return 200, RawResponse(
+                rpcwire.encode_item_rows_response(ids, rows),
+                rpcwire.RPC_CONTENT_TYPE)
+        return 200, {"rows": {
+            it: [float(x) for x in rows[i]] for i, it in enumerate(ids)
+        }}
 
     @app.route("POST", r"/shard/load_candidate")
     def shard_load_candidate(req: Request):

@@ -220,6 +220,15 @@ def _doctor_fleet(args) -> int:
                 "breaker": rep["breaker"], "instance": instance,
                 "candidate": candidate,
                 "foldin": foldin,
+                # internal RPC plane (docs/performance.md): the
+                # router's client-side connection-reuse ratio toward
+                # this replica and the negotiated wire — a 0% reuse
+                # replica under steady traffic means every RPC
+                # re-dialed (keep-alive-stripping proxy, idle timeout
+                # below the query cadence): a latency page in the
+                # making, visible here first
+                "connReuse": rep.get("connReuse"),
+                "binaryWire": rep.get("binaryWire"),
             })
         # per-group candidate coverage (guarded rollout): how many
         # replicas have the canary candidate staged — a group at 0/N
@@ -274,14 +283,25 @@ def _doctor_fleet(args) -> int:
     print(f"  users/shard: {plan.get('userCounts')}  "
           f"items/shard: {plan.get('itemCounts')}")
     print(f"{'shard':>5} {'rep':>3} {'live':<5} {'ready':<5} "
-          f"{'breaker':<9} {'instance':<12} url")
+          f"{'breaker':<9} {'instance':<12} {'wire':<6} {'reuse':>6} url")
     for r in rows:
+        reuse = r.get("connReuse")
+        wire = r.get("binaryWire")
         print(f"{r['shard']:>5} {r['replica']:>3} "
               f"{'up' if r['live'] else 'DOWN':<5} "
               f"{'yes' if r['ready'] else 'NO':<5} "
-              f"{r['breaker']:<9} {str(r['instance']):<12} {r['url']}")
+              f"{r['breaker']:<9} {str(r['instance']):<12} "
+              f"{'binary' if wire else ('json' if wire is False else '-'):<6} "
+              f"{'-' if reuse is None else f'{reuse:.0%}':>6} {r['url']}")
     print("replication (routable/total): "
           + ", ".join(f"shard {s}: {v}" for s, v in replication.items()))
+    zero_reuse = [f"shard{r['shard']}/replica{r['replica']}"
+                  for r in rows if r.get("connReuse") == 0.0]
+    if zero_reuse:
+        print("[WARN] 0% connection reuse toward: "
+              + ", ".join(zero_reuse)
+              + " — every RPC re-dials (a keep-alive-stripping proxy or "
+              "an idle timeout below the query cadence?)")
     lag_cells = []
     for s, lag in sorted(foldin_lag.items(), key=lambda kv: int(kv[0])):
         ms = lag["maxStalenessSeconds"]

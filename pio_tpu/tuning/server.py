@@ -99,11 +99,14 @@ def build_eval_app(status: EvalStatus, server_key: str = "") -> HttpApp:
 
     @app.route("GET", r"/metrics")
     def metrics_prometheus(req: Request):
+        from pio_tpu.utils.httpclient import pool_counters
+
         snap = status.snapshot()
         counters = {
             "eval_units_done": float(snap["unitsDone"]),
             "eval_units_total": float(snap["unitsTotal"]),
         }
+        counters.update(pool_counters())
         if snap["bestScore"] is not None:
             counters["eval_best_score"] = float(snap["bestScore"])
         text = prometheus_text(

@@ -1303,19 +1303,24 @@ def build_serving_app(server: QueryServer) -> HttpApp:
         through the shared renderer with the uniform `surface` label
         (docs/observability.md)."""
         from pio_tpu.server.http import RawResponse
+        from pio_tpu.utils.httpclient import pool_counters
         from pio_tpu.utils.tracing import (
             PROMETHEUS_CONTENT_TYPE, prometheus_text,
         )
 
+        counters = {
+            "hedged_dispatches_total": float(server.hedged_dispatches),
+            "foldin_applied_users_total":
+                float(server.foldin_applied_users),
+            "uptime_seconds":
+                (utcnow() - server.start_time).total_seconds(),
+        }
+        # outbound keep-alive pool (docs/performance.md "Internal RPC
+        # plane"): the serving process's storage DAO RPCs ride it
+        counters.update(pool_counters())
         return 200, RawResponse(
-            prometheus_text(
-                server.tracer.snapshot(),
-                {"hedged_dispatches_total": float(server.hedged_dispatches),
-                 "foldin_applied_users_total":
-                     float(server.foldin_applied_users),
-                 "uptime_seconds":
-                     (utcnow() - server.start_time).total_seconds()},
-                labels={"surface": "serving"}),
+            prometheus_text(server.tracer.snapshot(), counters,
+                            labels={"surface": "serving"}),
             PROMETHEUS_CONTENT_TYPE)
 
     @app.route("POST", r"/profile/start")

@@ -1,0 +1,420 @@
+"""Keep-alive connection pool lifecycle (utils/httpclient.py):
+
+  * transparent reuse against both transports + server-side conn stats,
+  * stale-socket recovery — a peer that closes idle connections at
+    random moments under concurrent fan-out causes ZERO request
+    failures for idempotent requests (one transparent resend), while
+    non-idempotent POSTs surface the error to the caller's RetryPolicy,
+  * CircuitBreakers stay uncharged by transparent retries but still
+    observe real failures through the pool,
+  * pool-exhaustion fairness (overflow dials fresh, never blocks),
+    idle reaping, LIFO reuse, the PIO_TPU_HTTP_POOL=off kill switch,
+  * the `http.pool.<host>` chaos point.
+
+The rpc-parity CI job runs this suite with tests/test_rpcwire.py.
+"""
+
+import json
+import random
+import socket
+import threading
+
+import pytest
+
+from pio_tpu.resilience import CircuitBreaker
+from pio_tpu.resilience import chaos
+from pio_tpu.server.http import AsyncHttpServer, HttpApp, HttpServer
+from pio_tpu.utils.httpclient import (
+    ConnectionPool, HttpClientError, JsonHttpClient,
+)
+
+
+def _app() -> HttpApp:
+    app = HttpApp("pool-test")
+
+    @app.route("GET", r"/ping")
+    def ping(req):
+        return 200, {"ok": True}
+
+    @app.route("POST", r"/echo")
+    def echo(req):
+        return 200, {"echo": req.json()}
+
+    return app
+
+
+class FlakyKeepAliveServer:
+    """A raw-socket HTTP/1.1 server that ANNOUNCES keep-alive but closes
+    the connection after each response with probability `close_p`
+    (seeded) — the lying peer the stale-socket retry exists for. With
+    close_p=1.0 every pooled reuse hits a dead socket."""
+
+    def __init__(self, close_p: float = 1.0, seed: int = 0):
+        self.close_p = close_p
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self.requests_served = 0
+        self._lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            buf = b""
+            while not self._stop.is_set():
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                headers = {}
+                for line in head.decode("latin-1").split("\r\n")[1:]:
+                    k, _, v = line.partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length") or 0)
+                while len(buf) < length:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                buf = buf[length:]
+                with self._lock:
+                    self.requests_served += 1
+                body = json.dumps({"ok": True}).encode()
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"Connection: keep-alive\r\n\r\n" + body)
+                with self._rng_lock:
+                    lying_close = self._rng.random() < self.close_p
+                if lying_close:
+                    return
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- reuse --------------------------------------------------------------------
+
+@pytest.mark.parametrize("server_cls", [AsyncHttpServer, HttpServer])
+def test_pooled_client_reuses_one_connection(server_cls):
+    srv = server_cls(_app()).start()
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        for _ in range(5):
+            assert c.request("GET", "/ping") == {"ok": True}
+        s = pool.stats()
+        assert s["opened"] == 1 and s["reused"] == 4
+        cs = srv.connection_stats()
+        assert cs["connectionsAccepted"] == 1
+        assert cs["requestsServed"] == 5
+        assert cs["requestsPerConnection"] == 5.0
+    finally:
+        srv.stop()
+
+
+def test_unpooled_client_dials_per_request():
+    srv = AsyncHttpServer(_app()).start()
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pooled=False,
+                           pool=pool)
+        for _ in range(3):
+            assert c.request("GET", "/ping") == {"ok": True}
+        assert pool.stats()["opened"] == 0    # never touched the pool
+        assert srv.connection_stats()["connectionsAccepted"] == 3
+    finally:
+        srv.stop()
+
+
+def test_env_kill_switch_disables_pooling(monkeypatch):
+    monkeypatch.setenv("PIO_TPU_HTTP_POOL", "off")
+    srv = AsyncHttpServer(_app()).start()
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        c.request("GET", "/ping")
+        c.request("GET", "/ping")
+        assert pool.stats()["opened"] == 0
+        assert srv.connection_stats()["connectionsAccepted"] == 2
+    finally:
+        srv.stop()
+
+
+def test_clients_share_the_pool_per_host():
+    """Throwaway clients (CLI probes, doctor loops) still reuse
+    connections: the pool outlives them, keyed by (host, port)."""
+    srv = AsyncHttpServer(_app()).start()
+    pool = ConnectionPool()
+    try:
+        for _ in range(4):
+            JsonHttpClient(f"http://127.0.0.1:{srv.port}",
+                           pool=pool).request("GET", "/ping")
+        s = pool.stats()
+        assert s["opened"] == 1 and s["reused"] == 3
+    finally:
+        srv.stop()
+
+
+def test_base_url_path_prefix_is_preserved():
+    """A base URL mounted under a path prefix (a reverse proxy serving
+    a surface at /pio): the request target is base-path + path, exactly
+    like the pre-pool urllib transport's base + path join."""
+    app = HttpApp("prefixed")
+
+    @app.route("GET", r"/pio/ping")
+    def ping(req):
+        return 200, {"ok": True}
+
+    srv = AsyncHttpServer(app).start()
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}/pio", pool=pool)
+        assert c.request("GET", "/ping") == {"ok": True}
+    finally:
+        srv.stop()
+
+
+def test_redirect_is_a_loud_error_not_a_silent_none():
+    """The pooled transport does not follow 3xx (no in-repo surface
+    issues one) — but a redirect must raise, never parse the empty
+    redirect body as a successful None."""
+    from pio_tpu.server.http import json_response
+
+    app = HttpApp("redirecting")
+
+    @app.route("GET", r"/moved")
+    def moved(req):
+        return 302, json_response({}, {"Location": "/elsewhere"})
+
+    srv = AsyncHttpServer(app).start()
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        with pytest.raises(HttpClientError) as err:
+            c.request("GET", "/moved")
+        assert err.value.status == 302
+        assert "/elsewhere" in err.value.message
+    finally:
+        srv.stop()
+
+
+# -- stale sockets ------------------------------------------------------------
+
+def test_idempotent_request_survives_lying_keepalive_peer():
+    """close_p=1.0: EVERY reuse hits a socket the peer already closed —
+    each GET transparently resends once on a fresh connection, the
+    caller never sees a failure."""
+    srv = FlakyKeepAliveServer(close_p=1.0)
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        for _ in range(6):
+            assert c.request("GET", "/ping") == {"ok": True}
+        s = pool.stats()
+        assert s["staleRetries"] == 5       # every request after the first
+        assert srv.requests_served == 6     # and exactly ONE send each
+    finally:
+        srv.stop()
+
+
+def test_non_idempotent_post_surfaces_stale_socket_error():
+    """A POST on a stale reused socket must NOT be transparently resent
+    (the server may have processed it): the transport error surfaces to
+    the caller's RetryPolicy."""
+    srv = FlakyKeepAliveServer(close_p=1.0)
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        assert c.request("POST", "/echo", {"a": 1}) is not None  # fresh conn
+        with pytest.raises(HttpClientError) as err:
+            c.request("POST", "/echo", {"a": 2})                 # stale conn
+        assert err.value.status == 0
+        assert pool.stats()["staleRetries"] == 0
+        assert srv.requests_served == 1     # the failed POST was NOT resent
+    finally:
+        srv.stop()
+
+
+def test_post_opt_in_idempotent_gets_transparent_retry():
+    """Read-only POST RPCs (the router's shard fan-out) opt in with
+    idempotent=True and get the same one-resend recovery as GETs."""
+    srv = FlakyKeepAliveServer(close_p=1.0)
+    pool = ConnectionPool()
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        for i in range(4):
+            assert c.request("POST", "/echo", {"i": i},
+                             idempotent=True) == {"ok": True}
+        assert pool.stats()["staleRetries"] == 3
+    finally:
+        srv.stop()
+
+
+def test_stale_socket_fuzz_concurrent_fanout_zero_failures():
+    """The ISSUE acceptance fuzz: the server closes connections at
+    random moments (seeded) under concurrent fan-out — zero request
+    failures, and per-request breakers stay UNCHARGED because the
+    transparent resend hides the stale socket entirely."""
+    srv = FlakyKeepAliveServer(close_p=0.35, seed=7)
+    pool = ConnectionPool()
+    breaker = CircuitBreaker("fuzz", min_calls=4, failure_rate=0.25)
+    failures: list[Exception] = []
+
+    def worker(w: int):
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        for i in range(40):
+            try:
+                with breaker.guard():
+                    assert c.request("GET", "/ping",
+                                     params={"w": w, "i": i}) == {"ok": True}
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                failures.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not failures, failures[:3]
+        s = pool.stats()
+        assert s["staleRetries"] > 0        # the fuzz actually bit
+        snap = breaker.snapshot()
+        assert snap.state == "closed" and snap.failures == 0
+    finally:
+        srv.stop()
+
+
+def test_breaker_still_observes_real_failures_through_the_pool():
+    """Regression guard: pooling must not swallow REAL outages — a dead
+    peer charges the breaker on every attempt and opens it."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    dead_port = sock.getsockname()[1]
+    sock.close()                            # nothing listens here now
+    pool = ConnectionPool()
+    breaker = CircuitBreaker("dead", min_calls=3, failure_rate=0.5)
+    c = JsonHttpClient(f"http://127.0.0.1:{dead_port}", pool=pool)
+    for _ in range(4):
+        with pytest.raises((HttpClientError, Exception)):
+            with breaker.guard():
+                c.request("GET", "/ping")
+        if breaker.snapshot().state == "open":
+            break
+    assert breaker.snapshot().state == "open"
+
+
+# -- pool sizing / lifecycle --------------------------------------------------
+
+def test_pool_exhaustion_is_fair_and_bounded():
+    """Demand beyond max_per_host dials fresh connections (no caller
+    ever blocks on the pool) and the idle set stays bounded — the
+    surplus is evicted on release."""
+    app = HttpApp("slow")
+    gate = threading.Event()
+
+    @app.route("GET", r"/slow")
+    def slow(req):
+        gate.wait(timeout=10)
+        return 200, {"ok": True}
+
+    srv = AsyncHttpServer(app, workers=16).start()
+    pool = ConnectionPool(max_per_host=2)
+    results: list = []
+    try:
+        def one():
+            c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+            results.append(c.request("GET", "/slow"))
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(0.5)      # all 8 in flight, holding 8 connections
+        gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 8
+        s = pool.stats()
+        assert s["opened"] == 8
+        assert s["idle"] <= 2                    # bounded idle set
+        assert s["evictedOverflow"] >= 6         # surplus closed
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_idle_connections_are_reaped():
+    srv = AsyncHttpServer(_app()).start()
+    pool = ConnectionPool(max_idle_s=0.05)
+    try:
+        c = JsonHttpClient(f"http://127.0.0.1:{srv.port}", pool=pool)
+        c.request("GET", "/ping")
+        import time
+
+        time.sleep(0.2)                     # parked past max_idle_s
+        c.request("GET", "/ping")
+        s = pool.stats()
+        assert s["evictedIdle"] == 1        # the stale socket never reused
+        assert s["opened"] == 2 and s["reused"] == 0
+    finally:
+        srv.stop()
+
+
+def test_pool_chaos_point_fails_the_dial():
+    pool = ConnectionPool()
+    c = JsonHttpClient("http://127.0.0.1:1", pool=pool)
+    with chaos.inject("http.pool.127.0.0.1", error=1.0) as monkey:
+        with pytest.raises(HttpClientError) as err:
+            c.request("GET", "/ping")
+        assert err.value.status == 0
+        assert any(k.startswith("http.pool.127.0.0.1")
+                   for k in monkey.injected)
+
+
+def test_host_stats_feed_the_reuse_column():
+    srv = AsyncHttpServer(_app()).start()
+    pool = ConnectionPool()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        c = JsonHttpClient(url, pool=pool)
+        for _ in range(4):
+            c.request("GET", "/ping")
+        hs = pool.host_stats(url)
+        assert hs == {"opened": 1, "reused": 3}
+        assert pool.host_stats("http://127.0.0.1:1") == {
+            "opened": 0, "reused": 0}
+    finally:
+        srv.stop()

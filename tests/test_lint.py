@@ -856,9 +856,11 @@ def test_wire_codec_owner_modules_and_suppression_silent():
             return HEAD.pack(1, 0, n, 0, 0, 0)
     """
     # the codec module itself (and every sanctioned protocol owner) is
-    # exactly where this packing belongs
+    # exactly where this packing belongs — incl. the fleet's binary
+    # shard-RPC wire (serving_fleet/rpcwire.py, ISSUE 15)
     for owner in ("pio_tpu/data/columnar.py", "pio_tpu/utils/durable.py",
-                  "pio_tpu/data/backends/pgwire.py"):
+                  "pio_tpu/data/backends/pgwire.py",
+                  "pio_tpu/serving_fleet/rpcwire.py"):
         assert lint_text(textwrap.dedent(src), path=owner,
                          select=["wire-codec"]) == []
     suppressed = """

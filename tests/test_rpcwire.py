@@ -1,0 +1,456 @@
+"""Binary shard-RPC wire (serving_fleet/rpcwire.py) + the pooled RPC
+plane end to end:
+
+  * codec round-trips incl. non-string ids, empty shards, direction
+    confusion; every truncation length and 64 random bit-flips rejected
+    (the columnar wire's fuzz discipline),
+  * shard-route Accept/Content-Type negotiation with bit-identical
+    values across both codecs,
+  * fleet results on the binary wire, the JSON wire, and a MIXED fleet
+    (one pre-binary legacy shard -> sticky logged-once downgrade) all
+    BIT-identical to the single-host oracle,
+  * per-codec RPC counters on router + shard /metrics,
+  * the keep-alive chaos drill: kill a shard listener mid-pool ->
+    router fails over with zero 5xx, the pool evicts the dead sockets,
+    and re-dials when the listener rejoins.
+
+The rpc-parity CI job runs this suite with tests/test_httpclient_pool.py.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_fleet import call, seed_and_train
+
+from pio_tpu.serving_fleet import rpcwire
+from pio_tpu.serving_fleet.fleet import deploy_fleet, resolve_fleet_model
+from pio_tpu.serving_fleet.router import RouterConfig, create_fleet_router
+from pio_tpu.serving_fleet.shard import ShardConfig, create_shard_server
+from pio_tpu.server.http import HttpApp, HttpServer
+from pio_tpu.utils.httpclient import JsonHttpClient, default_pool
+from pio_tpu.workflow.train import load_models
+
+
+@pytest.fixture()
+def trained(memory_storage):
+    engine, ep, ctx, iid = seed_and_train(memory_storage)
+    return memory_storage, engine, ep, ctx, iid
+
+
+# -- codec --------------------------------------------------------------------
+
+def test_topk_roundtrip_bit_exact():
+    rng = np.random.default_rng(0)
+    scores = rng.standard_normal(17).astype(np.float32)
+    gidx = rng.integers(0, 1000, 17).astype(np.int32)
+    items = [f"i{n}" for n in range(16)] + [42]   # non-string id rides too
+    out = rpcwire.decode_topk_response(
+        rpcwire.encode_topk_response(items, gidx, scores))
+    assert out["items"] == items                  # 42 stays an int
+    assert out["indices"].tolist() == gidx.tolist()
+    assert out["scores"].tobytes() == scores.tobytes()   # BIT-exact f32
+
+
+def test_topk_request_roundtrip():
+    row = np.random.default_rng(1).standard_normal(8).astype(np.float32)
+    got_row, k, arm = rpcwire.decode_topk_request(
+        rpcwire.encode_topk_request(row, 7, "candidate"))
+    assert got_row.tobytes() == row.tobytes()
+    assert (k, arm) == (7, "candidate")
+    # list input (a JSON-wire row forwarded) encodes to the same bytes
+    assert rpcwire.encode_topk_request(
+        [float(x) for x in row], 7, "candidate") == \
+        rpcwire.encode_topk_request(row, 7, "candidate")
+
+
+def test_user_row_and_item_rows_roundtrip():
+    assert rpcwire.decode_user_row_response(
+        rpcwire.encode_user_row_response(None)) == {"found": False}
+    row = np.arange(4, dtype=np.float32) / 3
+    out = rpcwire.decode_user_row_response(
+        rpcwire.encode_user_row_response(row))
+    assert out["found"] and out["row"].tobytes() == row.tobytes()
+
+    mat = np.random.default_rng(2).standard_normal((3, 4)).astype(np.float32)
+    rows = rpcwire.decode_item_rows_response(
+        rpcwire.encode_item_rows_response(["a", "b", 9], mat))["rows"]
+    assert set(rows) == {"a", "b", 9}
+    assert rows["b"].tobytes() == mat[1].tobytes()
+    empty = rpcwire.decode_item_rows_response(
+        rpcwire.encode_item_rows_response([], np.zeros((0, 4),
+                                                       np.float32)))
+    assert empty["rows"] == {}
+
+
+def test_direction_and_kind_confusion_rejected():
+    frame = rpcwire.encode_topk_request(np.zeros(4, np.float32), 3)
+    with pytest.raises(rpcwire.RpcWireError):
+        rpcwire.decode_topk_response(frame)
+    with pytest.raises(rpcwire.RpcWireError):
+        rpcwire.decode_user_row_response(frame)
+    with pytest.raises(rpcwire.RpcWireError):
+        rpcwire.decode_response("nope", frame)
+
+
+def test_every_truncation_and_bitflip_rejected():
+    """The durable-envelope contract: a damaged frame NEVER decodes to
+    wrong values — every prefix and every single-bit flip raises."""
+    scores = np.arange(9, dtype=np.float32)
+    gidx = np.arange(9, dtype=np.int32)
+    frame = rpcwire.encode_topk_response(
+        [f"i{n}" for n in range(9)], gidx, scores)
+    for n in range(len(frame)):
+        with pytest.raises(rpcwire.RpcWireError):
+            rpcwire.decode_topk_response(frame[:n])
+    rng = random.Random(0)
+    for _ in range(64):
+        flipped = bytearray(frame)
+        pos = rng.randrange(len(frame))
+        flipped[pos] ^= 1 << rng.randrange(8)
+        with pytest.raises(rpcwire.RpcWireError):
+            rpcwire.decode_topk_response(bytes(flipped))
+
+
+def test_forged_count_dies_before_allocation():
+    import json as _json
+    import struct
+
+    from pio_tpu.utils import durable
+
+    hdr = _json.dumps({"n": 1 << 40, "items": []}).encode()
+    payload = struct.pack(">BI", 2, len(hdr)) + hdr
+    frame = durable.frame(payload, magic=rpcwire.RPC_MAGIC)
+    t0 = time.monotonic()
+    with pytest.raises(rpcwire.RpcWireError):
+        rpcwire.decode_topk_response(frame)
+    assert time.monotonic() - t0 < 0.1    # rejected from the header row
+
+
+# -- shard route negotiation --------------------------------------------------
+
+def test_shard_routes_negotiate_binary_bit_identical(trained):
+    storage, *_ = trained
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    try:
+        url = handle.endpoints[0][0]
+        c = JsonHttpClient(url)
+        jrow = c.request("POST", "/shard/user_row", {"user": "u0"})
+        braw = c.request("POST", "/shard/user_row", {"user": "u0"},
+                         accept=rpcwire.RPC_CONTENT_TYPE)
+        assert isinstance(braw, bytes)
+        brow = rpcwire.decode_user_row_response(braw)
+        if jrow["found"]:
+            assert [float(x) for x in brow["row"]] == jrow["row"]
+        row = jrow.get("row") or [0.0] * 4
+        jtop = c.request("POST", "/shard/topk", {"row": row, "k": 5})
+        # binary response to a JSON request body...
+        btop = rpcwire.decode_topk_response(c.request(
+            "POST", "/shard/topk", {"row": row, "k": 5},
+            accept=rpcwire.RPC_CONTENT_TYPE))
+        # ...and to a binary request body: all three bit-identical
+        btop2 = rpcwire.decode_topk_response(c.request(
+            "POST", "/shard/topk",
+            raw=rpcwire.encode_topk_request(row, 5),
+            content_type=rpcwire.RPC_CONTENT_TYPE,
+            accept=rpcwire.RPC_CONTENT_TYPE))
+        for b in (btop, btop2):
+            assert b["items"] == jtop["items"]
+            assert b["indices"].tolist() == jtop["indices"]
+            assert [float(s) for s in b["scores"]] == jtop["scores"]
+        jrows = c.request("POST", "/shard/item_rows",
+                          {"items": jtop["items"][:3] + ["nope"]})
+        brows = rpcwire.decode_item_rows_response(c.request(
+            "POST", "/shard/item_rows",
+            {"items": jtop["items"][:3] + ["nope"]},
+            accept=rpcwire.RPC_CONTENT_TYPE))
+        assert {i: [float(x) for x in r]
+                for i, r in brows["rows"].items()} == jrows["rows"]
+        # a garbage frame is a 400, not a 500
+        from pio_tpu.utils.httpclient import HttpClientError
+
+        with pytest.raises(HttpClientError) as err:
+            c.request("POST", "/shard/topk", raw=b"PIOR\x01garbage",
+                      content_type=rpcwire.RPC_CONTENT_TYPE)
+        assert err.value.status == 400
+    finally:
+        handle.close()
+
+
+# -- fleet parity over both wires + mixed downgrade ---------------------------
+
+def _oracle(trained):
+    storage, engine, ep, ctx, iid = trained
+    algo = engine._doers(ep)[2][0]
+    full = load_models(storage, engine, ep, iid, ctx=ctx)[0]
+    return lambda q: algo.predict(full, dict(q))
+
+
+QUERIES = [
+    {"user": "u0", "num": 4},
+    {"user": "u3", "num": 6, "blackList": ["i1", "i5"]},
+    {"user": "u5", "num": 3, "whiteList": ["i2", "i7", "i9", "nope"]},
+    {"user": "ghost", "num": 4},
+    {"user": "u7", "num": 50},
+]
+
+
+def test_binary_and_json_wires_bit_identical_to_oracle(trained):
+    """The acceptance parity: pooled+binary (the default) and the
+    fresh-connection JSON control arm produce byte-for-byte the oracle's
+    answers on the same warm fleet."""
+    storage, *_ = trained
+    oracle = _oracle(trained)
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    json_router = None
+    try:
+        json_http, json_router = create_fleet_router(
+            storage, RouterConfig(engine_id="rec", rpc_wire="json",
+                                  http_pooled=False, probe_interval_s=0),
+            handle.plan, handle.endpoints)
+        for q in QUERIES:
+            want = oracle(q)
+            assert handle.router.query(dict(q)) == want, q
+            assert json_router.query(dict(q)) == want, q
+        assert handle.router.rpc_codec_counts["binary"] > 0
+        assert handle.router.rpc_codec_counts["json"] == 0
+        assert json_router.rpc_codec_counts["json"] > 0
+        assert json_router.rpc_codec_counts["binary"] == 0
+        # every replica confirmed the binary wire; surfaced on
+        # /fleet.json for doctor --fleet
+        health = handle.router.shard_health()
+        for g in health.values():
+            for rep in g["replicas"]:
+                assert rep["binaryWire"] is True
+                assert rep["connReuse"] is not None
+    finally:
+        if json_router is not None:
+            json_http.stop()
+            json_router.close()
+        handle.close()
+
+
+def _legacy_shard_http(srv) -> HttpServer:
+    """A pre-binary shard emulation: the REAL ShardServer's compute, but
+    the old JSON-only routes — no Accept negotiation, no frame decode
+    (what the routes looked like before this PR)."""
+    app = HttpApp("legacy-shard")
+
+    @app.route("POST", r"/shard/user_row")
+    def user_row(req):
+        body = req.json()
+        row = srv.user_row(body["user"], arm=body.get("arm", "active"))
+        if row is None:
+            return 200, {"found": False}
+        return 200, {"found": True, "row": row}
+
+    @app.route("POST", r"/shard/topk")
+    def topk(req):
+        body = req.json()
+        return 200, srv.topk(body["row"], int(body["k"]),
+                             arm=body.get("arm", "active"))
+
+    @app.route("POST", r"/shard/item_rows")
+    def item_rows(req):
+        body = req.json()
+        return 200, srv.item_rows(list(body["items"]),
+                                  arm=body.get("arm", "active"))
+
+    @app.route("GET", r"/shard/info")
+    def info(req):
+        return 200, srv.info()
+
+    @app.route("GET", r"/healthz")
+    @app.route("GET", r"/readyz")
+    def health(req):
+        return 200, {"ready": True}
+
+    return HttpServer(app).start()
+
+
+def test_mixed_fleet_sticky_downgrade_logged_once(trained, caplog):
+    """One shard group answers pre-binary JSON: the router downgrades
+    THAT replica stickily (warn logged once), keeps the other on the
+    binary wire, and stays bit-identical to the oracle."""
+    import logging
+
+    storage, *_ = trained
+    oracle = _oracle(trained)
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    legacy = router = None
+    try:
+        legacy = _legacy_shard_http(handle.shards[0][1])
+        endpoints = [[f"http://127.0.0.1:{legacy.port}"],
+                     handle.endpoints[1]]
+        http, router = create_fleet_router(
+            storage, RouterConfig(engine_id="rec", probe_interval_s=0),
+            handle.plan, endpoints)
+        with caplog.at_level(logging.WARNING,
+                             logger="pio_tpu.fleet.router"):
+            for q in QUERIES:
+                assert router.query(dict(q)) == oracle(q), q
+                assert router.query(dict(q)) == oracle(q), q
+        downgrades = [r for r in caplog.records
+                      if "sticky JSON downgrade" in r.message]
+        assert len(downgrades) == 1          # logged ONCE, not per call
+        assert router.replicas[0][0].binary_wire is False   # sticky
+        assert router.replicas[1][0].binary_wire is True
+        assert router.rpc_codec_counts["json"] > 0
+        assert router.rpc_codec_counts["binary"] > 0
+    finally:
+        if router is not None:
+            http.stop()
+            router.close()
+        if legacy is not None:
+            legacy.stop()
+        handle.close()
+
+
+def test_confirmed_binary_replica_rolled_back_downgrades_not_500s(
+        trained, caplog):
+    """A replica that CONFIRMED binary and was then rolled back to a
+    pre-binary build mid-flight (its routes can no longer parse a
+    frame) must not become a permanent 5xx for every query touching
+    that shard: the router retries the failing call as JSON once and
+    downgrades the replica stickily."""
+    import logging
+
+    from pio_tpu.serving_fleet.plan import shard_of
+
+    storage, *_ = trained
+    oracle = _oracle(trained)
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    legacy = router = None
+    try:
+        legacy = _legacy_shard_http(handle.shards[0][1])
+        endpoints = [[f"http://127.0.0.1:{legacy.port}"],
+                     handle.endpoints[1]]
+        http, router = create_fleet_router(
+            storage, RouterConfig(engine_id="rec", probe_interval_s=0),
+            handle.plan, endpoints)
+        # simulate "negotiated binary, then rolled back": pin the
+        # legacy (JSON-only) replica to confirmed-binary, then query a
+        # user OWNED BY SHARD 1 — its user_row RPC rides the healthy
+        # binary shard, so the first frame the legacy shard sees is the
+        # binary-framed top-k body it cannot parse
+        router.replicas[0][0].binary_wire = True
+        user = next(f"u{i}" for i in range(10)
+                    if shard_of(f"u{i}", 2) == 1)
+        q = {"user": user, "num": 4}
+        with caplog.at_level(logging.WARNING,
+                             logger="pio_tpu.fleet.router"):
+            assert router.query(dict(q)) == oracle(q)
+        assert router.replicas[0][0].binary_wire is False   # sticky
+        assert any("sticky JSON downgrade" in r.message
+                   for r in caplog.records)
+        # and it stays downgraded-but-serving, bit-identical
+        for q2 in QUERIES:
+            assert router.query(dict(q2)) == oracle(q2), q2
+    finally:
+        if router is not None:
+            http.stop()
+            router.close()
+        if legacy is not None:
+            legacy.stop()
+        handle.close()
+
+
+def test_per_codec_counters_on_metrics_surfaces(trained):
+    storage, *_ = trained
+    handle = deploy_fleet(storage, engine_id="rec", n_shards=2,
+                          n_replicas=1)
+    try:
+        for q in QUERIES:
+            handle.router.query(dict(q))
+        status, text = call_text(handle.router_http.port, "/metrics")
+        assert status == 200
+        assert 'pio_rpc_requests_total{surface="router",codec="binary"}' \
+            in text
+        assert "pio_http_client_connections_reused_total" in text
+        sport = int(handle.endpoints[0][0].rsplit(":", 1)[1])
+        status, stext = call_text(sport, "/metrics")
+        assert status == 200
+        assert 'codec="binary"' in stext
+        assert "pio_rpc_requests_total" in stext
+    finally:
+        handle.close()
+
+
+def call_text(port, path):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.status, resp.read().decode()
+
+
+# -- keep-alive chaos drill (the rpc-parity CI job's drill) -------------------
+
+def test_keepalive_chaos_drill_failover_evict_redial(trained):
+    """Kill a shard's listener while the router's pool holds warm
+    connections to it: the router fails over with ZERO 5xx, the pool
+    evicts the dead sockets, and re-dials once the listener rejoins."""
+    storage, *_ = trained
+    handle = deploy_fleet(
+        storage, engine_id="rec", n_shards=2, n_replicas=2,
+        router_config=RouterConfig(breaker_min_calls=2,
+                                   breaker_open_s=0.5,
+                                   probe_interval_s=0.2))
+    port = handle.router_http.port
+    statuses: list[int] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(w):
+        while not stop.is_set():
+            s, _ = call(port, "POST", "/queries.json",
+                        body={"user": f"u{w}", "num": 3})
+            with lock:
+                statuses.append(s)
+
+    threads = [threading.Thread(target=hammer, args=(w,))
+               for w in range(3)]
+    pool0 = default_pool().stats()
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.4)                       # pool warm, load flowing
+        handle.shards[0][0].stop()            # kill shard0/replica0 listener
+        time.sleep(1.0)                       # failover + evictions
+        old_port = int(handle.endpoints[0][0].rsplit(":", 1)[1])
+        http2, _srv2 = create_shard_server(storage, ShardConfig(
+            ip="127.0.0.1", port=old_port, shard_index=0, n_shards=2,
+            engine_id="rec"))
+        http2.start()                         # rejoin on the same port
+        try:
+            time.sleep(1.0)                   # pool re-dials the rejoiner
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert statuses and all(s < 500 for s in statuses), \
+                [s for s in statuses if s >= 500][:5]
+            pool1 = default_pool().stats()
+            # the dead listener's sockets were evicted (error/stale),
+            # and the drill actually exercised reuse
+            evicted0 = pool0["evictedError"] + pool0["staleRetries"]
+            evicted1 = pool1["evictedError"] + pool1["staleRetries"]
+            assert evicted1 > evicted0
+            assert pool1["reused"] > pool0["reused"]
+            # back to full service through the rejoined listener
+            s, body = call(port, "POST", "/queries.json",
+                           body={"user": "u2", "num": 3})
+            assert s == 200 and body["itemScores"]
+        finally:
+            http2.stop()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        handle.close()
