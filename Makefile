@@ -3,11 +3,18 @@
 
 PY ?= python
 
-.PHONY: lint test check bench-smoke
+.PHONY: lint lint-deep test check bench-smoke
 
 lint:
 	$(PY) -m pio_tpu.tools.cli lint pio_tpu/ tests/ bench.py eval/ examples/
 	$(PY) -m compileall -q pio_tpu tests eval examples bench.py
+
+# whole-program tier (docs/lint.md "Deep analysis"): lock-order cycles,
+# blocking-under-lock, context-loss, route-contract drift. Fails on any
+# finding not in pio_tpu/analysis/deep_baseline.json and on blowing the
+# 30s wall-clock budget.
+lint-deep:
+	$(PY) -m pio_tpu.tools.cli lint --deep --max-seconds 30 pio_tpu/
 
 # tier-1 verify (ROADMAP.md): CPU-only, not-slow subset
 test:
@@ -20,4 +27,4 @@ test:
 bench-smoke:
 	$(PY) bench.py --smoke
 
-check: lint test bench-smoke
+check: lint lint-deep test bench-smoke

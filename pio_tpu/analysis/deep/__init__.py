@@ -1,0 +1,26 @@
+"""Deep (whole-program) analysis tier for `pio lint --deep`.
+
+The classic tier (pio_tpu/analysis/rules/) is file-local by design;
+this package adds the interprocedural rules that need the project —
+lock-order cycles, blocking-under-lock, context-loss across thread
+boundaries, and route-contract drift between servers and clients.
+docs/lint.md ("Deep analysis") is the user-facing tour.
+"""
+
+from pio_tpu.analysis.deep.baseline import (
+    default_baseline_path, load_baseline, save_baseline,
+)
+from pio_tpu.analysis.deep.project import DeepProject, load_project
+from pio_tpu.analysis.deep.runner import DEEP_FAMILIES, run_deep_lint
+from pio_tpu.analysis.deep.summaries import summarize_all
+
+__all__ = [
+    "DEEP_FAMILIES",
+    "DeepProject",
+    "default_baseline_path",
+    "load_baseline",
+    "load_project",
+    "run_deep_lint",
+    "save_baseline",
+    "summarize_all",
+]

@@ -1,0 +1,76 @@
+"""Committed baseline for deep findings.
+
+Deep analysis is enforce-from-day-one: CI fails on any unbaselined
+finding. Pre-existing findings that are understood-but-not-yet-fixed
+live in a committed JSON file keyed by the finding's line-number-free
+fingerprint (`Finding.key`), so unrelated edits to the same file never
+churn the baseline. Removing an entry (or running
+`pio lint --deep --update-baseline` after a fix) ratchets the debt
+down; a NEW finding can only be accepted by a reviewed commit that
+adds its key.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(__file__), os.pardir, "deep_baseline.json")
+
+
+def default_baseline_path() -> str:
+    return os.path.normpath(DEFAULT_BASELINE)
+
+
+def load_baseline(path: str | None) -> dict:
+    """-> {key: entry dict}. A missing file is an empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    out = {}
+    for entry in data.get("findings", []):
+        key = entry.get("key")
+        if key:
+            out[key] = entry
+    return out
+
+
+def _portable(path: str) -> str:
+    """Repo-relative with forward slashes: the committed file must not
+    embed one machine's checkout directory (matching is by key, the
+    path is for the human reading the diff)."""
+    rel = os.path.relpath(path, os.getcwd())
+    if rel.startswith(os.pardir):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def save_baseline(path: str, findings: list) -> int:
+    """Write every finding's fingerprint (sorted, deduplicated);
+    returns the entry count."""
+    entries = {}
+    for f in findings:
+        if f.key:
+            entries.setdefault(f.key, {
+                "key": f.key,
+                "rule": f.rule,
+                "path": _portable(f.path),
+                "message": f.message,
+            })
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": ("accepted deep-lint findings; keys are line-free "
+                    "fingerprints — regenerate with "
+                    "`pio lint --deep --update-baseline`"),
+        "findings": [entries[k] for k in sorted(entries)],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return len(entries)

@@ -1,0 +1,327 @@
+"""Whole-program project model for `pio lint --deep`.
+
+The classic tier (engine.py) sees one file at a time; every rule in the
+deep tier needs the *project*: which module defines which function,
+which class inherits from which, which attribute is a `threading.Lock`,
+which decorated function is an HTTP route handler. This module parses
+every file once and builds those indexes; callgraph.py and the rule
+families consume them.
+
+Module naming: each scanned file gets a dotted module name relative to
+its scan root — `pio lint --deep pio_tpu/` names files
+`pio_tpu.workflow.serve` exactly as Python imports them, and a fixture
+directory of loose files names them `mod_a`, `mod_b` (the test suite's
+synthetic-project entry point).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from pio_tpu.analysis.engine import (
+    ModuleContext, ProjectInfo, build_context, iter_python_files,
+)
+
+# canonical constructors whose result is a mutual-exclusion primitive;
+# kind feeds the reentrancy rule (re-acquiring an RLock is legal)
+LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "rlock",  # default Condition wraps an RLock
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "rlock",
+}
+
+_LOCKISH = ("lock", "mutex", "_cv", "cond")
+
+
+@dataclass
+class FunctionInfo:
+    """One def anywhere in the project (methods and nested defs
+    included), addressable by dotted qualname."""
+
+    qualname: str          # "pio_tpu.workflow.serve.QueryServer._load"
+    module: str            # "pio_tpu.workflow.serve"
+    path: str
+    node: ast.AST          # FunctionDef | AsyncFunctionDef
+    cls: str | None = None  # enclosing class qualname, if a method
+    # lexical scope chain for bare-name resolution at call sites:
+    # innermost first, each a {name: qualname} of sibling/nested defs
+    scopes: tuple = ()
+    # static type bindings for `obj.method()` resolution, innermost
+    # first: {name: class canonical} from annotated parameters
+    # (`def build_app(server: QueryServer)`) and single-assignment
+    # constructor locals (`server = QueryServer(...)`); a name bound
+    # ambiguously maps to None. Closures see enclosing defs' bindings.
+    binds: tuple = ()
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class ClassInfo:
+    qualname: str          # "pio_tpu.workflow.serve.QueryServer"
+    module: str
+    node: ast.ClassDef
+    bases: tuple = ()      # base-class qualnames/canonicals (unresolved ok)
+    methods: dict = field(default_factory=dict)   # name -> FunctionInfo
+    # attribute name -> lock kind, from `self.x = threading.Lock()`
+    lock_attrs: dict = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    ctx: ModuleContext
+    functions: dict = field(default_factory=dict)  # qualname -> FunctionInfo
+    classes: dict = field(default_factory=dict)    # qualname -> ClassInfo
+    toplevel: dict = field(default_factory=dict)   # bare name -> qualname
+    # module-level lock name -> kind, from `X = threading.Lock()`
+    lock_globals: dict = field(default_factory=dict)
+
+
+@dataclass
+class DeepProject:
+    modules: dict = field(default_factory=dict)    # name -> ModuleInfo
+    functions: dict = field(default_factory=dict)  # qualname -> FunctionInfo
+    classes: dict = field(default_factory=dict)    # qualname -> ClassInfo
+    by_path: dict = field(default_factory=dict)    # path -> ModuleInfo
+    info: ProjectInfo = field(default_factory=ProjectInfo)
+
+    def ctx_for_path(self, path: str) -> ModuleContext | None:
+        m = self.by_path.get(path)
+        return m.ctx if m else None
+
+    def resolve_class(self, qual_or_canonical: str) -> ClassInfo | None:
+        return self.classes.get(qual_or_canonical)
+
+    def method_on(self, cls_qual: str, name: str,
+                  _seen: frozenset = frozenset()) -> FunctionInfo | None:
+        """`self.<name>` resolution: the class, then its project-internal
+        bases (depth-first, conservative — subclass overrides are not
+        chased)."""
+        cls = self.classes.get(cls_qual)
+        if cls is None or cls_qual in _seen:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        for base in cls.bases:
+            hit = self.method_on(base, name, _seen | {cls_qual})
+            if hit is not None:
+                return hit
+        return None
+
+    def lock_attr_owner(self, cls_qual: str, attr: str,
+                        _seen: frozenset = frozenset()) -> str | None:
+        """The class (self or ancestor) whose __init__ declared lock
+        attribute `attr` — so a lock inherited from a base unifies on
+        ONE identity across every subclass method that takes it."""
+        cls = self.classes.get(cls_qual)
+        if cls is None or cls_qual in _seen:
+            return None
+        if attr in cls.lock_attrs:
+            return cls_qual
+        for base in cls.bases:
+            hit = self.lock_attr_owner(base, attr, _seen | {cls_qual})
+            if hit is not None:
+                return hit
+        return None
+
+    def lock_kind(self, lock_id: str) -> str:
+        """Declared kind of a lock identity, defaulting to 'lock' (the
+        conservative choice: a plain Lock self-deadlocks on re-entry)."""
+        cls_qual, _, attr = lock_id.rpartition(".")
+        cls = self.classes.get(cls_qual)
+        if cls is not None and attr in cls.lock_attrs:
+            return cls.lock_attrs[attr]
+        mod = self.modules.get(cls_qual)
+        if mod is not None and attr in mod.lock_globals:
+            return mod.lock_globals[attr]
+        return "lock"
+
+
+def _scan_roots(paths: list[str]) -> list[tuple[str, str]]:
+    """-> [(abs scan path, abs name root)]: a package directory's name
+    root is its parent (so `pio_tpu/` files are named `pio_tpu.*`); a
+    loose directory is its own root; a file's root is its dirname."""
+    out = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap):
+            out.append((ap, os.path.dirname(ap)))
+        elif os.path.exists(os.path.join(ap, "__init__.py")):
+            out.append((ap, os.path.dirname(ap)))
+        else:
+            out.append((ap, ap))
+    return out
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    name = rel[:-3] if rel.endswith(".py") else rel
+    name = name.replace(os.sep, ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+def is_lockish_name(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _LOCKISH)
+
+
+def _collect_lock_decls(mod: ModuleInfo) -> None:
+    """`self.x = threading.Lock()` inside any method -> class lock attr;
+    `X = threading.Lock()` at module level -> module lock global."""
+    imports = mod.ctx.imports
+    for node in ast.walk(mod.ctx.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        kind = LOCK_CTORS.get(imports.canonical(value.func) or "")
+        if kind is None:
+            continue
+        tgt = node.targets[0]
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id in ("self", "cls")):
+            # innermost enclosing class by position
+            owner = None
+            for cls in mod.classes.values():
+                if (cls.node.lineno <= node.lineno
+                        <= (cls.node.end_lineno or cls.node.lineno)
+                        and (owner is None
+                             or cls.node.lineno > owner.node.lineno)):
+                    owner = cls
+            if owner is not None:
+                owner.lock_attrs[tgt.attr] = kind
+        elif isinstance(tgt, ast.Name):
+            mod.lock_globals[tgt.id] = kind
+
+
+def _collect_defs(mod: ModuleInfo, project: DeepProject) -> None:
+    """Walk the module body once, registering every class and def with
+    its dotted qualname and lexical scope chain."""
+    imports = mod.ctx.imports
+
+    def base_qual(expr: ast.AST) -> str | None:
+        name = imports.canonical(expr)
+        if name is None:
+            return None
+        if "." not in name:
+            return f"{mod.name}.{name}"  # local class reference
+        return name
+
+    def type_binds(node) -> dict:
+        """{name: class canonical | None} from a def's annotated params
+        and its `x = ClassName(...)` locals (resolved against
+        project.classes lazily, at call-resolution time)."""
+        binds: dict = {}
+        args = node.args
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            if a.annotation is not None:
+                qual = base_qual(a.annotation)
+                if qual:
+                    binds[a.arg] = qual
+        stack = list(node.body)
+        while stack:
+            stmt = stack.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue  # nested scopes bind their own names
+            stack.extend(
+                c for c in ast.iter_child_nodes(stmt)
+                if isinstance(c, ast.stmt))
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            qual = base_qual(stmt.value.func)
+            name = stmt.targets[0].id
+            if name in binds and binds[name] != qual:
+                binds[name] = None  # ambiguous: never resolve
+            else:
+                binds.setdefault(name, qual)
+        return binds
+
+    def walk(body, prefix: str, cls_qual: str | None, scopes: tuple,
+             binds: tuple = ()):
+        # names defined at this level, for bare-name sibling calls —
+        # except in a class body, whose names are NOT a lexical scope
+        # for the methods underneath (Python scoping)
+        if cls_qual is None:
+            level = {
+                node.name: f"{prefix}.{node.name}"
+                for node in body
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))
+            }
+            here = (level, *scopes)
+        else:
+            here = scopes
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                qual = f"{prefix}.{node.name}"
+                cls = ClassInfo(
+                    qualname=qual, module=mod.name, node=node,
+                    bases=tuple(b for b in map(base_qual, node.bases) if b),
+                )
+                mod.classes[qual] = cls
+                project.classes[qual] = cls
+                walk(node.body, qual, qual, here, binds)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{node.name}"
+                my_binds = (type_binds(node), *binds)
+                fn = FunctionInfo(
+                    qualname=qual, module=mod.name, path=mod.path,
+                    node=node, cls=cls_qual, scopes=here, binds=my_binds,
+                )
+                mod.functions[qual] = fn
+                project.functions[qual] = fn
+                if cls_qual is not None:
+                    cls = mod.classes[cls_qual]
+                    cls.methods.setdefault(node.name, fn)
+                elif prefix == mod.name:
+                    mod.toplevel[node.name] = qual
+                walk(node.body, qual, None, here, my_binds)
+
+    walk(mod.ctx.tree.body, mod.name, None, ())
+
+
+def load_project(paths: list[str],
+                 info: ProjectInfo | None = None) -> DeepProject:
+    """Parse every .py under `paths` into one DeepProject. Files that
+    fail to parse are skipped (the classic tier already reports
+    parse-error findings for them)."""
+    from pio_tpu.analysis.engine import load_project_info
+
+    project = DeepProject(info=info or load_project_info(paths))
+    roots = _scan_roots(paths)
+    for scan, root in roots:
+        for path in iter_python_files([scan]):
+            apath = os.path.abspath(path)
+            name = _module_name(apath, root)
+            if name in project.modules:
+                continue
+            try:
+                source = open(apath, encoding="utf-8").read()
+                ctx = build_context(path, source, project.info)
+            except (OSError, SyntaxError):
+                continue
+            mod = ModuleInfo(name=name, path=path, ctx=ctx)
+            project.modules[name] = mod
+            project.by_path[path] = mod
+    for mod in project.modules.values():
+        _collect_defs(mod, project)
+    for mod in project.modules.values():
+        _collect_lock_decls(mod)
+    return project

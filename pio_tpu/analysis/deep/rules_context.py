@@ -1,0 +1,170 @@
+"""Deep rule family 3: context-loss across thread boundaries.
+
+`Deadline` budgets and trace contexts ride ``contextvars`` — they follow
+the thread that runs the request handler and silently vanish on any
+callable handed to a pool or thread without the sanctioned wrapper::
+
+    pool.submit(contextvars.copy_context().run, fn, *args)
+
+(the router/sharded-DAO fan-out idiom). This rule flags every bare
+spawn (`pool.submit`, `threading.Thread/Timer`) on a path that carries
+context state, where "carries" means either:
+
+  * the spawning function is reachable from an HTTP route handler over
+    project-internal call edges — `dispatch_safe` binds the trace (and
+    the handler typically opens a Deadline budget), so everything under
+    a handler runs with ambient state; or
+  * the spawning function (or the spawned target) transitively touches
+    a context API — any function defined in a module that declares a
+    ``ContextVar`` (obs/context.py, resilience/policies.py here; the
+    fixture suite fakes the same shape).
+
+Deliberate detaches (feedback inserts that must not inherit the
+request's budget) are real and sanctioned — by a suppression whose
+justification says so, which is exactly the documentation the next
+reader needs.
+"""
+
+from __future__ import annotations
+
+from pio_tpu.analysis.deep.summaries import Frame
+from pio_tpu.analysis.findings import Finding, Severity
+
+FAMILY = "context-loss"
+MAX_CHAIN = 8
+
+
+def _short(qual: str) -> str:
+    parts = qual.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else qual
+
+
+def context_modules(project) -> set:
+    """Modules that declare a ContextVar — calls into them mean the
+    caller reads or binds ambient request state."""
+    return {
+        name for name, mod in project.modules.items()
+        if "ContextVar(" in mod.ctx.source
+    }
+
+
+def _touches_api(summary, ctx_modules: set, project) -> int | None:
+    """Line of a direct context-API call in this function, else None."""
+    for call in summary.calls:
+        fn = project.functions.get(call.callee)
+        if fn is not None and fn.module in ctx_modules:
+            return call.line
+    for name, line in summary.raw_calls:
+        if "Deadline" in name.split("."):
+            return line
+    return None
+
+
+def compute_uses_context(project, summaries: dict) -> dict:
+    """qualname -> (Frame, ...) chain to a context-API touch, for every
+    function that carries Deadline/trace state itself (fixpoint over
+    call AND ref edges — a partial'd callee still reads the vars when it
+    eventually runs)."""
+    ctx_modules = context_modules(project)
+    may: dict[str, tuple] = {}
+    for qual, s in summaries.items():
+        if s.fn.module in ctx_modules:
+            continue  # the API itself is not a finding seed
+        line = _touches_api(s, ctx_modules, project)
+        if line is not None:
+            may[qual] = (Frame(s.fn.path, line,
+                               f"context API use in {_short(qual)}"),)
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for qual, s in summaries.items():
+            if qual in may or s.fn.module in ctx_modules:
+                continue
+            for call in s.calls:
+                chain = may.get(call.callee)
+                if chain is None or len(chain) >= MAX_CHAIN:
+                    continue
+                may[qual] = (Frame(s.fn.path, call.line,
+                                   f"call {_short(call.callee)}"), *chain)
+                changed = True
+                break
+    return may
+
+
+def compute_handler_reach(project, summaries: dict,
+                          handler_quals: list) -> dict:
+    """qualname -> (Frame, ...) chain from a route handler down to this
+    function (BFS over call edges): everything here runs inside the
+    trace/deadline scope that dispatch_safe opened."""
+    reach: dict[str, tuple] = {}
+    queue: list = []
+    for qual in handler_quals:
+        fn = project.functions.get(qual)
+        if fn is None or qual in reach:
+            continue
+        reach[qual] = (Frame(fn.path, fn.line,
+                             f"route handler {_short(qual)}"),)
+        queue.append(qual)
+    while queue:
+        qual = queue.pop(0)
+        chain = reach[qual]
+        if len(chain) >= MAX_CHAIN:
+            continue
+        s = summaries.get(qual)
+        if s is None:
+            continue
+        # follow deferred "ref" edges too: a handler's
+        # `_budgeted(lambda: server.query(q))` runs inside the
+        # handler's dynamic extent even though the call is deferred
+        for call in s.calls:
+            if call.callee in reach:
+                continue
+            reach[call.callee] = (*chain, Frame(
+                s.fn.path, call.line, f"call {_short(call.callee)}"))
+            queue.append(call.callee)
+    return reach
+
+
+def find_context_findings(project, summaries: dict,
+                          handler_quals: list) -> list:
+    uses = compute_uses_context(project, summaries)
+    reach = compute_handler_reach(project, summaries, handler_quals)
+    findings = []
+    for qual, s in sorted(summaries.items()):
+        for sp in s.spawns:
+            if sp.copied:
+                continue
+            evidence = None
+            why = None
+            if qual in reach:
+                evidence = reach[qual]
+                why = ("runs under a route handler's trace/deadline "
+                       "scope")
+            elif qual in uses:
+                evidence = uses[qual]
+                why = "carries Deadline/trace state"
+            elif sp.target and sp.target in uses:
+                evidence = uses[sp.target]
+                why = (f"target {_short(sp.target)} reads "
+                       f"Deadline/trace state")
+            if evidence is None:
+                continue
+            verb = {"submit": "pool.submit", "Thread": "threading.Thread",
+                    "Timer": "threading.Timer"}.get(sp.via, sp.via)
+            frames = (*evidence[:MAX_CHAIN], Frame(
+                s.fn.path, sp.line,
+                f"{verb}({sp.desc}) without copy_context()"))
+            findings.append(Finding(
+                "context-loss", Severity.WARNING, s.fn.path, sp.line, 0,
+                f"{verb} hands {sp.desc!r} to another thread without "
+                f"contextvars.copy_context(), but this path {why}; the "
+                f"spawned work silently drops the Deadline budget and "
+                f"trace (wrap: pool.submit(contextvars.copy_context()"
+                f".run, fn, ...))",
+                family=FAMILY,
+                witness=tuple(fr.t() for fr in frames),
+                key=f"context-loss|{qual}|{sp.via}|{sp.desc}",
+            ))
+    return findings

@@ -1,0 +1,356 @@
+"""Deep rule families 1 & 2: lock-order cycles and blocking-under-lock.
+
+Both ride the same interprocedural machinery over the per-function
+summaries (summaries.py):
+
+  * `may_acquire` — fixpoint: every lock a function may take, directly
+    or through any project-internal callee, with a frame chain to the
+    acquisition site;
+  * `may_block`   — fixpoint: whether a function may park the calling
+    thread (HTTP client call, fsync/durable_write, time.sleep, future
+    wait / quorum fan, JAX AOT compile), with a frame chain to the op.
+
+Findings anchor at the site *inside the lock-holding function* — the
+`with self._lock:` scope is lexical, so the outermost frame where a
+lock is held is always in the function that took it, which is exactly
+where a `# pio: lint-ok[...]` suppression (and its justification)
+belongs. Spawned work (`pool.submit`, threads) is excluded from both
+fixpoints: it runs on another stack and does not inherit held locks.
+
+Lock-order reporting is per strongly-connected component of the
+acquisition graph: a 2-cycle (the PR 8 promote-vs-guard-breach shape)
+reports BOTH witness paths; longer cycles report each edge of one
+simple cycle through the component.
+"""
+
+from __future__ import annotations
+
+from pio_tpu.analysis.deep.summaries import Frame
+from pio_tpu.analysis.findings import Finding, Severity
+
+MAX_CHAIN = 8          # frames kept per interprocedural chain
+FAMILY_LOCK = "lock-order"
+FAMILY_BLOCK = "blocking-under-lock"
+
+
+def _short(qual: str) -> str:
+    """mod.sub.Class.method -> Class.method (messages stay readable;
+    witness frames carry the file anyway)."""
+    parts = qual.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else qual
+
+
+def _short_lock(lock: str) -> str:
+    parts = lock.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else lock
+
+
+def compute_may_acquire(summaries: dict) -> dict:
+    """qualname -> {lock_id: (Frame, ...)} chain to the acquisition."""
+    may: dict[str, dict] = {}
+    for qual, s in summaries.items():
+        local = {}
+        for acq in s.acquires:
+            local.setdefault(acq.lock, (Frame(
+                s.fn.path, acq.line,
+                f"acquire {_short_lock(acq.lock)} in {_short(qual)}"),))
+        may[qual] = local
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for qual, s in summaries.items():
+            mine = may[qual]
+            for call in s.calls:
+                if call.kind != "call":
+                    continue
+                callee = may.get(call.callee)
+                if not callee:
+                    continue
+                for lock, chain in callee.items():
+                    if lock in mine or len(chain) >= MAX_CHAIN:
+                        continue
+                    mine[lock] = (Frame(
+                        s.fn.path, call.line,
+                        f"call {_short(call.callee)}"), *chain)
+                    changed = True
+    return may
+
+
+def compute_may_block(summaries: dict) -> dict:
+    """qualname -> (Frame, ...) chain to a thread-parking operation."""
+    may: dict[str, tuple] = {}
+    for qual, s in summaries.items():
+        if s.blocking:
+            op = s.blocking[0]
+            may[qual] = (Frame(s.fn.path, op.line,
+                              f"{op.desc} in {_short(qual)}"),)
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for qual, s in summaries.items():
+            if qual in may:
+                continue
+            for call in s.calls:
+                if call.kind != "call":
+                    continue
+                chain = may.get(call.callee)
+                if chain is None or len(chain) >= MAX_CHAIN:
+                    continue
+                may[qual] = (Frame(s.fn.path, call.line,
+                                   f"call {_short(call.callee)}"), *chain)
+                changed = True
+                break
+    return may
+
+
+def _acquire_frame(summary, lock: str) -> Frame | None:
+    for acq in summary.acquires:
+        if acq.lock == lock:
+            return Frame(summary.fn.path, acq.line,
+                         f"acquire {_short_lock(lock)} in "
+                         f"{_short(summary.fn.qualname)}")
+    return None
+
+
+def _lock_edges(summaries: dict, may_acquire: dict):
+    """-> {(a, b): witness frames} — lock b taken while a is held,
+    directly or through a call chain."""
+    edges: dict[tuple, tuple] = {}
+
+    def add(a: str, b: str, witness: tuple) -> None:
+        edges.setdefault((a, b), witness)
+
+    for qual, s in summaries.items():
+        for acq in s.acquires:
+            for held in acq.held:
+                pre = _acquire_frame(s, held)
+                add(held, acq.lock, (
+                    *((pre,) if pre else ()),
+                    Frame(s.fn.path, acq.line,
+                          f"acquire {_short_lock(acq.lock)} in "
+                          f"{_short(qual)}")))
+        for call in s.calls:
+            if call.kind != "call" or not call.held:
+                continue
+            callee_locks = may_acquire.get(call.callee) or {}
+            for lock, chain in callee_locks.items():
+                for held in call.held:
+                    pre = _acquire_frame(s, held)
+                    add(held, lock, (
+                        *((pre,) if pre else ()),
+                        Frame(s.fn.path, call.line,
+                              f"call {_short(call.callee)} holding "
+                              f"{_short_lock(held)}"),
+                        *chain))
+    return edges
+
+
+def _sccs(nodes, adjacency) -> list:
+    """Iterative Tarjan; returns SCCs as lists of nodes."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    out: list = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(adjacency.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(adjacency.get(nxt, ()))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    top = stack.pop()
+                    on_stack.discard(top)
+                    scc.append(top)
+                    if top == node:
+                        break
+                out.append(scc)
+    return out
+
+
+def _cycle_path(scc: set, edges: dict, start: str) -> list:
+    """A simple cycle start -> ... -> start using only edges inside the
+    SCC (BFS back to start)."""
+    adj: dict[str, list] = {}
+    for (a, b) in edges:
+        if a in scc and b in scc:
+            adj.setdefault(a, []).append(b)
+    for n in adj:
+        adj[n].sort()
+    # BFS from each successor of start back to start
+    for first in adj.get(start, ()):
+        if first == start:
+            continue  # self-edges are reported as lock-self-deadlock
+        prev = {first: start}
+        queue = [first]
+        while queue:
+            node = queue.pop(0)
+            if node == start:
+                break
+            for nxt in adj.get(node, ()):
+                if nxt not in prev:
+                    prev[nxt] = node
+                    queue.append(nxt)
+        if start in prev:
+            chain = [start]
+            node = prev[start]
+            while node != start:
+                chain.append(node)
+                node = prev[node]
+            chain.append(start)
+            return list(reversed(chain))
+    return []
+
+
+def find_lock_order_findings(project, summaries: dict,
+                             may_acquire: dict) -> list:
+    findings = []
+    edges = _lock_edges(summaries, may_acquire)
+
+    # self-edges first: re-acquiring a non-reentrant lock on the same
+    # stack is a guaranteed deadlock, no second thread needed
+    for (a, b), witness in sorted(edges.items()):
+        if a != b or project.lock_kind(a) != "lock":
+            continue
+        anchor = witness[-1]
+        findings.append(Finding(
+            "lock-self-deadlock", Severity.ERROR, anchor.path,
+            anchor.line, 0,
+            f"non-reentrant lock {_short_lock(a)} may be re-acquired on "
+            f"the same call stack (threading.Lock deadlocks on "
+            f"re-entry; use RLock or hoist the lock out of the callee)",
+            family=FAMILY_LOCK,
+            witness=tuple(fr.t() for fr in witness),
+            key=f"lock-self-deadlock|{a}|{_anchor_fn(witness)}",
+        ))
+
+    adjacency: dict[str, list] = {}
+    nodes: list = []
+    for (a, b) in sorted(edges):
+        if a == b:
+            continue
+        if a not in adjacency:
+            nodes.append(a)
+        adjacency.setdefault(a, []).append(b)
+        if b not in adjacency:
+            adjacency.setdefault(b, [])
+            nodes.append(b)
+    for scc in _sccs(nodes, adjacency):
+        if len(scc) < 2:
+            continue
+        scc_set = set(scc)
+        start = sorted(scc)[0]
+        cycle = _cycle_path(scc_set, edges, start)
+        if not cycle:
+            continue
+        witness: list = []
+        for i in range(len(cycle) - 1):
+            step = edges.get((cycle[i], cycle[i + 1]))
+            if step:
+                witness.extend(step)
+        names = " -> ".join(_short_lock(lk) for lk in cycle)
+        anchor = witness[-1] if witness else Frame("<unknown>", 1, "")
+        findings.append(Finding(
+            "lock-order-cycle", Severity.ERROR, anchor.path,
+            anchor.line, 0,
+            f"lock acquisition cycle {names}: two threads taking these "
+            f"locks in opposite orders deadlock; pick one global order",
+            family=FAMILY_LOCK,
+            witness=tuple(fr.t() for fr in witness[: 2 * MAX_CHAIN]),
+            key="lock-order-cycle|" + "<>".join(sorted(scc_set)),
+        ))
+    return findings
+
+
+def _anchor_fn(witness: tuple) -> str:
+    return f"{witness[-1].path}" if witness else ""
+
+
+def find_blocking_findings(project, summaries: dict,
+                           may_block: dict) -> list:
+    findings = []
+    for qual, s in sorted(summaries.items()):
+        seen_local = set()
+        for op in s.blocking:
+            if not op.held:
+                continue
+            locks = ", ".join(sorted(_short_lock(x) for x in set(op.held)))
+            dedup = (op.desc, frozenset(op.held))
+            if dedup in seen_local:
+                continue
+            seen_local.add(dedup)
+            frames = [fr for lock in dict.fromkeys(op.held)
+                      if (fr := _acquire_frame(s, lock))]
+            frames.append(Frame(s.fn.path, op.line,
+                                f"{op.desc} while holding {locks}"))
+            findings.append(Finding(
+                "blocking-under-lock", Severity.WARNING, s.fn.path,
+                op.line, 0,
+                f"{op.desc} while holding {locks}: every thread "
+                f"contending on the lock stalls behind this I/O",
+                family=FAMILY_BLOCK,
+                witness=tuple(fr.t() for fr in frames),
+                key=f"blocking-under-lock|{qual}|{op.desc}|"
+                    + ",".join(sorted(set(op.held))),
+            ))
+        for call in s.calls:
+            if call.kind != "call" or not call.held:
+                continue
+            chain = may_block.get(call.callee)
+            if chain is None:
+                continue
+            dedup = (call.callee, frozenset(call.held))
+            if dedup in seen_local:
+                continue
+            seen_local.add(dedup)
+            locks = ", ".join(sorted(_short_lock(x)
+                                     for x in set(call.held)))
+            frames = [fr for lock in dict.fromkeys(call.held)
+                      if (fr := _acquire_frame(s, lock))]
+            frames.append(Frame(s.fn.path, call.line,
+                                f"call {_short(call.callee)} holding "
+                                f"{locks}"))
+            frames.extend(chain)
+            op_desc = chain[-1].note
+            findings.append(Finding(
+                "blocking-under-lock", Severity.WARNING, s.fn.path,
+                call.line, 0,
+                f"call to {_short(call.callee)} while holding {locks} "
+                f"reaches a blocking operation ({op_desc}); every "
+                f"thread contending on the lock stalls behind it",
+                family=FAMILY_BLOCK,
+                witness=tuple(fr.t() for fr in frames[: 2 * MAX_CHAIN]),
+                key=f"blocking-under-lock|{qual}|{call.callee}|"
+                    + ",".join(sorted(set(call.held))),
+            ))
+    return findings

@@ -1,0 +1,285 @@
+"""Deep rule family 4: route-contract drift between servers and clients.
+
+Every server surface registers handlers through one idiom::
+
+    @app.route("POST", r"/shard/topk")
+
+and every client speaks through path literals::
+
+    client.request("POST", f"/events/{eid}.json")
+
+Nothing ties the two together at runtime until a request 404s in
+production (the PR 15 near-miss: a renamed shard route left the router
+fanning out to a dead path). This family closes the loop statically:
+
+  * `route-missing`   — a client path literal that matches NO registered
+    route pattern under any method;
+  * `route-method`    — the path exists but only under other methods
+    (the server answers 405, which retry policies treat as permanent);
+  * `route-unguarded` — a `/rollout/*` or `/debug/*` registration whose
+    handler never reaches a server-key guard (`server_key_ok`,
+    `check_server_key`, `_guarded`) — these surfaces mutate deploys or
+    dump traces and must not be open;
+  * `wire-negotiation` — a client negotiating a binary content type
+    (`RPC_CONTENT_TYPE`, `COLUMNAR_CONTENT_TYPE`) against a route whose
+    handler module never mentions that constant: the server will parse
+    the frame as JSON (or answer JSON to a binary `accept`) and the
+    call degrades or breaks.
+
+Matching is cross-server on purpose: the analyzer cannot know which
+base URL a client object points at, so a path is "registered" if ANY
+surface serves it — false negatives over false positives.
+
+f-string paths probe with a placeholder token per interpolation
+(`f"/events/{eid}.json"` probes as `/events/XpX.json`), which matches
+the `([^/]+)`-style capture groups the route tables use. Fully dynamic
+paths (a variable) are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from pio_tpu.analysis.deep.summaries import HTTP_VERBS, Frame
+from pio_tpu.analysis.findings import Finding, Severity
+
+FAMILY = "route-contract"
+PROBE_TOKEN = "XpX"   # no slash, no dot: matches ([^/]+) and ([^/.]+)
+GUARDED_PREFIXES = ("/rollout", "/debug")
+BINARY_CONSTS = ("RPC_CONTENT_TYPE", "COLUMNAR_CONTENT_TYPE")
+CLIENT_METHODS = frozenset({"request", "call"})
+
+
+@dataclass
+class RouteDecl:
+    method: str
+    pattern: str          # raw regex source, as registered
+    handler: str          # handler function qualname
+    module: str
+    path: str
+    line: int             # decorator line (suppression anchor)
+
+    def matches(self, probe: str) -> bool:
+        try:
+            return re.fullmatch(self.pattern, probe) is not None
+        except re.error:
+            return False
+
+
+@dataclass
+class ClientProbe:
+    method: str
+    probe: str            # literal path, placeholders substituted
+    display: str          # what the source says (f-string braces kept)
+    path: str
+    line: int
+    binary: str | None    # binary content-type constant negotiated, if any
+
+
+def collect_routes(project) -> list:
+    """Every `@<x>.route("METHOD", r"pattern")` registration."""
+    out = []
+    for fn in project.functions.values():
+        for deco in getattr(fn.node, "decorator_list", ()):
+            if not (isinstance(deco, ast.Call)
+                    and isinstance(deco.func, ast.Attribute)
+                    and deco.func.attr == "route"
+                    and len(deco.args) >= 2
+                    and isinstance(deco.args[0], ast.Constant)
+                    and isinstance(deco.args[1], ast.Constant)
+                    and isinstance(deco.args[0].value, str)
+                    and isinstance(deco.args[1].value, str)):
+                continue
+            method = deco.args[0].value.upper()
+            if method not in HTTP_VERBS:
+                continue
+            out.append(RouteDecl(
+                method=method, pattern=deco.args[1].value,
+                handler=fn.qualname, module=fn.module, path=fn.path,
+                line=deco.lineno))
+    return out
+
+
+def _probe_from(expr: ast.AST) -> tuple[str, str] | None:
+    """(probe, display) from a path argument, or None when dynamic."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value, expr.value
+    if isinstance(expr, ast.JoinedStr):
+        probe, display = [], []
+        for part in expr.values:
+            if isinstance(part, ast.Constant):
+                probe.append(str(part.value))
+                display.append(str(part.value))
+            elif isinstance(part, ast.FormattedValue):
+                probe.append(PROBE_TOKEN)
+                display.append("{...}")
+            else:
+                return None
+        return "".join(probe), "".join(display)
+    return None
+
+
+def _binary_const(expr: ast.AST, imports) -> str | None:
+    canon = imports.canonical(expr)
+    if canon:
+        last = canon.rsplit(".", 1)[-1]
+        if last in BINARY_CONSTS:
+            return last
+    if isinstance(expr, ast.Attribute) and expr.attr in BINARY_CONSTS:
+        return expr.attr
+    return None
+
+
+def collect_client_probes(project) -> list:
+    out = []
+    for mod in project.modules.values():
+        imports = mod.ctx.imports
+        for node in ast.walk(mod.ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in CLIENT_METHODS
+                    and len(node.args) >= 2
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value in HTTP_VERBS):
+                continue
+            got = _probe_from(node.args[1])
+            if got is None:
+                continue
+            probe, display = got
+            if not probe.startswith("/"):
+                continue
+            binary = None
+            for kw in node.keywords:
+                if kw.arg in ("content_type", "accept"):
+                    binary = binary or _binary_const(kw.value, imports)
+            out.append(ClientProbe(
+                method=node.args[0].value, probe=probe, display=display,
+                path=mod.path, line=node.lineno, binary=binary))
+    return out
+
+
+def _guard_markers(summary) -> bool:
+    for call in summary.calls:
+        tail = call.callee.rsplit(".", 1)[-1]
+        if "server_key" in tail or "guard" in tail.lower():
+            return True
+    for name, _line in summary.raw_calls:
+        low = name.lower()
+        if "guard" in low or "server_key" in low or "key_ok" in low:
+            return True
+    return False
+
+
+def _handler_guarded(handler: str, summaries: dict,
+                     _cache: dict, _stack: set) -> bool:
+    if handler in _cache:
+        return _cache[handler]
+    if handler in _stack:
+        return False
+    s = summaries.get(handler)
+    if s is None:
+        return False
+    if _guard_markers(s):
+        _cache[handler] = True
+        return True
+    _stack.add(handler)
+    hit = any(_handler_guarded(c.callee, summaries, _cache, _stack)
+              for c in s.calls)
+    _stack.discard(handler)
+    _cache[handler] = hit
+    return hit
+
+
+def find_route_findings(project, summaries: dict, routes: list,
+                        probes: list) -> list:
+    findings = []
+
+    # servers: sensitive surfaces must reach a server-key guard
+    guard_cache: dict = {}
+    for r in sorted(routes, key=lambda r: (r.path, r.line)):
+        plain = r.pattern.replace("\\", "")
+        if not plain.startswith(GUARDED_PREFIXES):
+            continue
+        if _handler_guarded(r.handler, summaries, guard_cache, set()):
+            continue
+        findings.append(Finding(
+            "route-unguarded", Severity.WARNING, r.path, r.line, 0,
+            f"{r.method} {r.pattern} is a mutating/debug surface but "
+            f"its handler never checks the server key "
+            f"(server_key_ok/check_server_key); anyone who can reach "
+            f"the port can call it",
+            family=FAMILY,
+            witness=(Frame(r.path, r.line,
+                           f"route {r.method} {r.pattern}").t(),),
+            key=f"route-unguarded|{r.method} {r.pattern}|{r.module}",
+        ))
+
+    # clients: every literal path must land on a registered route
+    for p in sorted(probes, key=lambda p: (p.path, p.line)):
+        hits = [r for r in routes if r.matches(p.probe)]
+        mod = project.by_path.get(p.path)
+        mod_name = mod.name if mod else p.path
+        if not hits:
+            findings.append(Finding(
+                "route-missing", Severity.ERROR, p.path, p.line, 0,
+                f"client calls {p.method} {p.display} but no server "
+                f"registers a route matching it — this request 404s on "
+                f"every surface in the tree",
+                family=FAMILY,
+                witness=(Frame(p.path, p.line,
+                               f"client {p.method} {p.display}").t(),),
+                key=f"route-missing|{p.method} {p.display}|{mod_name}",
+            ))
+            continue
+        method_hits = [r for r in hits if r.method == p.method]
+        if not method_hits:
+            allowed = ", ".join(sorted({r.method for r in hits}))
+            example = min(hits, key=lambda r: (r.path, r.line))
+            findings.append(Finding(
+                "route-method", Severity.ERROR, p.path, p.line, 0,
+                f"client calls {p.method} {p.display} but the matching "
+                f"route(s) only accept {allowed} — the server answers "
+                f"405 Method Not Allowed",
+                family=FAMILY,
+                witness=(
+                    Frame(example.path, example.line,
+                          f"route {example.method} "
+                          f"{example.pattern}").t(),
+                    Frame(p.path, p.line,
+                          f"client {p.method} {p.display}").t(),
+                ),
+                key=f"route-method|{p.method} {p.display}|{mod_name}",
+            ))
+            continue
+        if p.binary:
+            # the serving side must speak the same binary dialect:
+            # its module references the negotiated constant
+            speaking = [
+                r for r in method_hits
+                if p.binary in project.modules[r.module].ctx.source
+            ]
+            if not speaking:
+                example = min(method_hits,
+                              key=lambda r: (r.path, r.line))
+                findings.append(Finding(
+                    "wire-negotiation", Severity.WARNING, p.path,
+                    p.line, 0,
+                    f"client negotiates {p.binary} on {p.method} "
+                    f"{p.display} but the serving module "
+                    f"({example.module}) never references that "
+                    f"content type — the exchange silently falls back "
+                    f"to JSON or fails to parse",
+                    family=FAMILY,
+                    witness=(
+                        Frame(example.path, example.line,
+                              f"route {example.method} "
+                              f"{example.pattern}").t(),
+                        Frame(p.path, p.line,
+                              f"client negotiates {p.binary}").t(),
+                    ),
+                    key=f"wire-negotiation|{p.method} {p.display}|"
+                        f"{p.binary}",
+                ))
+    return findings

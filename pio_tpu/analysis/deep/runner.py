@@ -1,0 +1,94 @@
+"""Orchestrator for `pio lint --deep`.
+
+One pass: load the project model, summarize every function, run the
+interprocedural fixpoints, dispatch the four rule families, then route
+each finding through (in order) suppression comments, --select/--ignore
+filters, and the committed baseline. The LintReport separates the three
+outcomes — `findings` fail the run, `suppressed` and `baselined` are
+reported for visibility only.
+"""
+
+from __future__ import annotations
+
+import time
+
+from pio_tpu.analysis.deep.baseline import (
+    default_baseline_path, load_baseline, save_baseline,
+)
+from pio_tpu.analysis.deep.project import load_project
+from pio_tpu.analysis.deep.rules_context import find_context_findings
+from pio_tpu.analysis.deep.rules_locks import (
+    compute_may_acquire, compute_may_block, find_blocking_findings,
+    find_lock_order_findings,
+)
+from pio_tpu.analysis.deep.rules_routes import (
+    collect_client_probes, collect_routes, find_route_findings,
+)
+from pio_tpu.analysis.deep.summaries import summarize_all
+from pio_tpu.analysis.engine import _is_suppressed
+from pio_tpu.analysis.findings import LintReport
+
+# family ids, for --select/--ignore matching and docs
+DEEP_FAMILIES = (
+    "lock-order", "blocking-under-lock", "context-loss", "route-contract",
+)
+
+
+def _matches(f, selectors: set) -> bool:
+    names = (f.family, f.rule)
+    return any(n.startswith(s) for s in selectors for n in names)
+
+
+def run_deep_lint(paths: list, select: set | None = None,
+                  ignore: set | None = None,
+                  baseline_path: str | None = None,
+                  update_baseline: bool = False,
+                  use_baseline: bool = True) -> LintReport:
+    """Analyze every .py under `paths` with the deep (whole-program)
+    tier. `baseline_path=None` uses the committed repo baseline;
+    `use_baseline=False` reports everything (the self-check mode)."""
+    t0 = time.monotonic()
+    project = load_project(paths)
+    summaries = summarize_all(project)
+
+    may_acquire = compute_may_acquire(summaries)
+    may_block = compute_may_block(summaries)
+    routes = collect_routes(project)
+    probes = collect_client_probes(project)
+
+    findings = []
+    findings += find_lock_order_findings(project, summaries, may_acquire)
+    findings += find_blocking_findings(project, summaries, may_block)
+    findings += find_context_findings(
+        project, summaries, [r.handler for r in routes])
+    findings += find_route_findings(project, summaries, routes, probes)
+
+    report = LintReport(n_files=len(project.modules))
+
+    if select:
+        findings = [f for f in findings if _matches(f, select)]
+    if ignore:
+        findings = [f for f in findings if not _matches(f, ignore)]
+
+    kept = []
+    for f in findings:
+        ctx = project.ctx_for_path(f.path)
+        if ctx is not None and _is_suppressed(ctx, f):
+            report.suppressed.append(f)
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if baseline_path is None:
+        baseline_path = default_baseline_path()
+    if update_baseline:
+        save_baseline(baseline_path, kept)
+    baseline = load_baseline(baseline_path) if use_baseline else {}
+    for f in kept:
+        if f.key and f.key in baseline:
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+
+    report.elapsed_s = time.monotonic() - t0
+    return report

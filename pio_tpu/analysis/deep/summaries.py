@@ -1,0 +1,395 @@
+"""Per-function summaries for the deep tier.
+
+One lexical walk per function produces everything the interprocedural
+rules consume:
+
+  * `acquires`  — every lock acquisition (`with self._lock:` scopes and
+    bare `.acquire()` calls) with the locks already held at that point;
+  * `calls`     — every resolved project-internal call site with the
+    lexically-held lock set (the unit the lock-order and
+    blocking-under-lock fixpoints propagate along);
+  * `blocking`  — leaf operations that park the thread: HTTP client
+    calls, `time.sleep`, `fsync`/`durable_write`, future waits /
+    quorum fans, and JAX AOT compiles;
+  * `spawns`    — work handed to another thread (`pool.submit`,
+    `threading.Thread/Timer`) and whether the closure rode
+    `contextvars.copy_context()` (the sanctioned wrapper — PR 7/9's
+    fix for Deadline/trace loss across pool boundaries);
+  * `raw_calls` — unresolved call names (guard-detection heuristics).
+
+Lock identity is static: `module.Class.attr` for `self._lock`,
+`module.NAME` for module-level locks, `module.func.name` for locals.
+Two *instances* of one class share an identity — an over-approximation
+the suppression/baseline machinery absorbs (docs/lint.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from pio_tpu.analysis.deep.project import (
+    DeepProject, FunctionInfo, ModuleInfo, is_lockish_name,
+)
+
+HTTP_VERBS = frozenset({"GET", "POST", "PUT", "DELETE", "HEAD", "PATCH"})
+
+# canonical names that block the calling thread outright
+BLOCKING_CANONICALS = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync",
+    "os.fdatasync": "os.fdatasync",
+    "pio_tpu.utils.durable.durable_write": "durable_write (fsync + rename)",
+    "durable_write": "durable_write (fsync + rename)",
+    "subprocess.run": "subprocess.run",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "socket.create_connection": "socket.create_connection",
+    "concurrent.futures.wait": "futures.wait (quorum fan)",
+    "concurrent.futures.as_completed": "futures.as_completed (quorum fan)",
+    "as_completed": "futures.as_completed (quorum fan)",
+    "jax.block_until_ready": "jax.block_until_ready",
+}
+
+# attribute-call names that block when the repo uses them: `.result()`
+# on a Future (fan-out join), `.block_until_ready()` on a jax array
+BLOCKING_ATTRS = {
+    "result": "Future.result() wait",
+    "block_until_ready": "jax block_until_ready",
+}
+
+SPAWN_CTORS = frozenset({"threading.Thread", "Thread"})
+TIMER_CTORS = frozenset({"threading.Timer", "Timer"})
+PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+COPY_CONTEXT = frozenset({
+    "contextvars.copy_context", "copy_context",
+})
+
+
+@dataclass(frozen=True)
+class Frame:
+    path: str
+    line: int
+    note: str
+
+    def t(self) -> tuple:
+        return (self.path, self.line, self.note)
+
+
+@dataclass
+class Acquire:
+    lock: str
+    line: int
+    held: tuple  # lock ids held lexically at this acquisition
+
+
+@dataclass
+class CallSite:
+    callee: str   # qualname in project.functions (or class qual -> __init__)
+    line: int
+    held: tuple
+    kind: str = "call"   # "call" | "ref" (partial/decorator reference)
+
+
+@dataclass
+class BlockingOp:
+    desc: str
+    line: int
+    held: tuple
+
+
+@dataclass
+class SpawnSite:
+    line: int
+    target: str | None    # resolved qualname, else None
+    desc: str             # human name of the submitted callable
+    copied: bool          # rode contextvars.copy_context().run
+    via: str              # "submit" | "Thread" | "Timer"
+
+
+@dataclass
+class FuncSummary:
+    fn: FunctionInfo
+    acquires: list = field(default_factory=list)
+    calls: list = field(default_factory=list)
+    blocking: list = field(default_factory=list)
+    spawns: list = field(default_factory=list)
+    raw_calls: list = field(default_factory=list)  # (name, line)
+
+
+def resolve_call_target(expr: ast.AST, fn: FunctionInfo,
+                        mod: ModuleInfo,
+                        project: DeepProject) -> str | None:
+    """Resolve a callable expression to a project function qualname —
+    conservatively: bare names through the lexical scope chain and the
+    import map, `self.method` through the class chain, `mod.fn` through
+    canonical names. Anything dynamic resolves to None."""
+    if isinstance(expr, ast.Name):
+        for scope in fn.scopes:
+            if expr.id in scope:
+                qual = scope[expr.id]
+                if qual in project.functions:
+                    return qual
+                if qual in project.classes:
+                    init = project.method_on(qual, "__init__")
+                    return init.qualname if init else None
+        canon = mod.ctx.imports.canonical(expr)
+        if canon and canon in project.functions:
+            return canon
+        if canon and canon in project.classes:
+            init = project.method_on(canon, "__init__")
+            return init.qualname if init else None
+        return None
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name):
+            if expr.value.id in ("self", "cls") and fn.cls:
+                hit = project.method_on(fn.cls, expr.attr)
+                return hit.qualname if hit else None
+            # typed binding: `server: QueryServer` parameter (incl.
+            # closures over an enclosing def's params) or a
+            # single-assignment `server = QueryServer(...)` local
+            for binds in fn.binds:
+                if expr.value.id in binds:
+                    cls_qual = binds[expr.value.id]
+                    if cls_qual and cls_qual in project.classes:
+                        hit = project.method_on(cls_qual, expr.attr)
+                        return hit.qualname if hit else None
+                    break  # ambiguous or not a project class
+        canon = mod.ctx.imports.canonical(expr)
+        if canon and canon in project.functions:
+            return canon
+        if canon and canon in project.classes:
+            init = project.method_on(canon, "__init__")
+            return init.qualname if init else None
+    return None
+
+
+def _callable_desc(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Lambda):
+        return "lambda"
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        parts = [expr.attr]
+        node = expr.value
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ast.dump(expr)[:40]
+
+
+def _is_copy_context_run(expr: ast.AST, mod: ModuleInfo) -> bool:
+    """`contextvars.copy_context().run` — the sanctioned wrapper shape
+    (router/sharded-DAO pool fan-outs)."""
+    return (isinstance(expr, ast.Attribute) and expr.attr == "run"
+            and isinstance(expr.value, ast.Call)
+            and (mod.ctx.imports.canonical(expr.value.func)
+                 in COPY_CONTEXT))
+
+
+def _unwrap_partial(expr: ast.AST, mod: ModuleInfo) -> ast.AST:
+    """functools.partial(fn, ...) -> fn, for spawn-target resolution."""
+    if (isinstance(expr, ast.Call)
+            and mod.ctx.imports.canonical(expr.func) in PARTIAL_NAMES
+            and expr.args):
+        return expr.args[0]
+    return expr
+
+
+class _Walker:
+    """One pass over a function body, tracking the lexically-held lock
+    stack. Nested defs are NOT descended into (they have their own
+    summaries and are reached through call edges); lambdas likewise run
+    later and are only recorded as spawn targets."""
+
+    def __init__(self, summary: FuncSummary, mod: ModuleInfo,
+                 project: DeepProject):
+        self.s = summary
+        self.mod = mod
+        self.project = project
+        self.held: list[str] = []
+
+    # -- lock identity -------------------------------------------------------
+    def lock_of(self, expr: ast.AST) -> str | None:
+        fn = self.s.fn
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id in ("self", "cls")):
+            if fn.cls:
+                owner = self.project.lock_attr_owner(fn.cls, expr.attr)
+                if owner is not None:
+                    return f"{owner}.{expr.attr}"
+                # undeclared but lock-named attribute: still a lock
+                if is_lockish_name(expr.attr):
+                    return f"{fn.cls}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.mod.lock_globals:
+                return f"{self.mod.name}.{expr.id}"
+            if is_lockish_name(expr.id):
+                return f"{fn.qualname}.{expr.id}"
+        return None
+
+    # -- statements ----------------------------------------------------------
+    def walk_body(self, body: list) -> None:
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # decorators run here; the body is its own summary
+            for deco in stmt.decorator_list:
+                self.walk_expr(deco)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            # bases/decorators evaluate here; method bodies do not
+            for expr in (*stmt.bases, *stmt.decorator_list):
+                self.walk_expr(expr)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                self.walk_expr(item.context_expr)
+                lock = self.lock_of(item.context_expr)
+                if lock is not None:
+                    self.s.acquires.append(Acquire(
+                        lock, item.context_expr.lineno, tuple(self.held)))
+                    self.held.append(lock)
+                    pushed += 1
+            self.walk_body(stmt.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        # every other statement: expressions in place, sub-statements
+        # recursively (If/For/Try/match bodies keep the held stack)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self.walk_stmt(child)
+            else:
+                self.walk_expr(child)
+
+    # -- expressions ---------------------------------------------------------
+    def walk_expr(self, node: ast.AST) -> None:
+        """Recursive descent that PRUNES lambda/def subtrees (their
+        bodies run later, on other stacks) but still classifies every
+        call executed here — including nested calls in arguments. Also
+        descends through non-statement containers (excepthandler,
+        match_case) whose children are statements."""
+        if isinstance(node, ast.Lambda):
+            # the body runs later, possibly elsewhere: no lock/blocking
+            # attribution, but the call TARGETS still matter to the
+            # reachability rules (context-loss, guard detection) —
+            # record them as deferred "ref" edges with no held locks
+            self._walk_deferred(node.body)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            self.handle_call(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self.walk_stmt(child)
+            else:
+                self.walk_expr(child)
+
+    def _walk_deferred(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            target = resolve_call_target(
+                sub.func, self.s.fn, self.mod, self.project)
+            if target is not None:
+                self.s.calls.append(CallSite(
+                    target, sub.lineno, (), kind="ref"))
+            else:
+                self.s.raw_calls.append(
+                    (_callable_desc(sub.func), sub.lineno))
+
+    def handle_call(self, call: ast.Call) -> None:
+        held = tuple(self.held)
+        mod, fn, project = self.mod, self.s.fn, self.project
+        canon = mod.ctx.imports.canonical(call.func)
+        line = call.lineno
+
+        # spawn shapes first: their targets run on ANOTHER thread, so
+        # they get spawn records, not call edges
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "submit" and call.args:
+            self._record_spawn(call.args[0], call.args[1:], line, "submit")
+            return
+        if canon in SPAWN_CTORS:
+            target = next((kw.value for kw in call.keywords
+                           if kw.arg == "target"), None)
+            if target is not None:
+                self._record_spawn(target, (), line, "Thread")
+            return
+        if canon in TIMER_CTORS and len(call.args) >= 2:
+            self._record_spawn(call.args[1], (), line, "Timer")
+            return
+
+        # bare .acquire() (non-scoped acquisition)
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "acquire":
+            lock = self.lock_of(call.func.value)
+            if lock is not None:
+                self.s.acquires.append(Acquire(lock, line, held))
+                return
+
+        # blocking leaves
+        desc = BLOCKING_CANONICALS.get(canon or "")
+        if desc is None and isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in ("request", "call") and call.args \
+                    and isinstance(call.args[0], ast.Constant) \
+                    and call.args[0].value in HTTP_VERBS:
+                desc = f"HTTP {call.args[0].value} client call"
+            elif attr in BLOCKING_ATTRS and not call.args:
+                desc = BLOCKING_ATTRS[attr]
+            elif attr == "compile" and isinstance(call.func.value, ast.Call) \
+                    and isinstance(call.func.value.func, ast.Attribute) \
+                    and call.func.value.func.attr == "lower":
+                desc = "JAX AOT .lower().compile()"
+        if desc is not None:
+            self.s.blocking.append(BlockingOp(desc, line, held))
+            return
+
+        # partial(...) creates a deferred reference
+        if canon in PARTIAL_NAMES and call.args:
+            target = resolve_call_target(call.args[0], fn, mod, project)
+            if target is not None:
+                self.s.calls.append(CallSite(target, line, held, kind="ref"))
+            return
+
+        target = resolve_call_target(call.func, fn, mod, project)
+        if target is not None:
+            self.s.calls.append(CallSite(target, line, held))
+        else:
+            self.s.raw_calls.append((_callable_desc(call.func), line))
+
+    def _record_spawn(self, target_expr: ast.AST, rest_args, line: int,
+                      via: str) -> None:
+        mod, fn, project = self.mod, self.s.fn, self.project
+        copied = _is_copy_context_run(target_expr, mod)
+        if copied and rest_args:
+            target_expr = rest_args[0]
+        target_expr = _unwrap_partial(target_expr, mod)
+        target = resolve_call_target(target_expr, fn, mod, project)
+        self.s.spawns.append(SpawnSite(
+            line=line, target=target, desc=_callable_desc(target_expr),
+            copied=copied, via=via))
+
+
+def summarize(fn: FunctionInfo, project: DeepProject) -> FuncSummary:
+    summary = FuncSummary(fn=fn)
+    mod = project.modules[fn.module]
+    walker = _Walker(summary, mod, project)
+    walker.walk_body(fn.node.body)
+    return summary
+
+
+def summarize_all(project: DeepProject) -> dict:
+    return {qual: summarize(fn, project)
+            for qual, fn in project.functions.items()}

@@ -22,6 +22,7 @@ linting standalone snippets (fixtures, other repos).
 from __future__ import annotations
 
 import ast
+import dataclasses
 import io
 import os
 import re
@@ -309,6 +310,8 @@ def run_lint(paths: list[str], select: set[str] | None = None,
             for f in rule.check(ctx):
                 if not _keep_finding(rule, f, select, ignore):
                     continue
+                if not f.family:
+                    f = dataclasses.replace(f, family=rule.id)
                 (report.suppressed if _is_suppressed(ctx, f)
                  else report.findings).append(f)
     report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
@@ -330,6 +333,8 @@ def lint_text(source: str, path: str = "<snippet>.py",
         for f in rule.check(ctx):
             if _keep_finding(rule, f, select, None) \
                     and not _is_suppressed(ctx, f):
+                if not f.family:
+                    f = dataclasses.replace(f, family=rule.id)
                 findings.append(f)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings

@@ -33,6 +33,17 @@ class Finding:
 
     `rule` is the stable kebab-case id used in suppression comments
     (`# pio: lint-ok[rule]`) and in --select/--ignore.
+
+    The deep tier (pio_tpu/analysis/deep/) additionally fills:
+
+    * `family`  — the rule-family id (`lock-order`, `route-contract`,
+      ...; the classic engine back-fills it from the rule registry so
+      the JSON schema is uniform across both tiers);
+    * `witness` — the interprocedural evidence path as ordered
+      `(path, line, note)` frames, ending at the anchor location;
+    * `key`     — a line-number-free fingerprint used by the committed
+      baseline file (analysis/deep_baseline.json), so accepted findings
+      survive unrelated edits to the same file.
     """
 
     rule: str
@@ -41,20 +52,39 @@ class Finding:
     line: int
     col: int
     message: str
+    family: str = ""
+    witness: tuple = ()  # tuple[(path, line, note), ...]
+    key: str = ""
 
     def format(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: "
+        head = (f"{self.path}:{self.line}:{self.col}: "
                 f"{self.severity.label()} [{self.rule}] {self.message}")
+        if not self.witness:
+            return head
+        frames = "\n".join(
+            f"    {i + 1}. {p}:{ln}  {note}"
+            for i, (p, ln, note) in enumerate(self.witness))
+        return f"{head}\n{frames}"
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "rule": self.rule,
+            "family": self.family or self.rule,
             "severity": self.severity.label(),
             "path": self.path,
             "line": self.line,
             "col": self.col,
             "message": self.message,
+            "witness": [
+                {"path": p, "line": ln, "note": note}
+                for p, ln, note in self.witness
+            ],
+            # always present so the JSON schema is stable across the
+            # classic and deep tiers; null when the rule has no
+            # line-free fingerprint (classic findings)
+            "key": self.key,
         }
+        return out
 
 
 @dataclass
@@ -63,7 +93,15 @@ class LintReport:
 
     findings: list[Finding] = field(default_factory=list)
     suppressed: list[Finding] = field(default_factory=list)
+    # deep tier: findings accepted by the committed baseline file —
+    # reported for visibility, never failing (docs/lint.md "Deep
+    # analysis": the baseline is the enforce-from-day-one escape hatch)
+    baselined: list[Finding] = field(default_factory=list)
     n_files: int = 0
+    # deep tier: wall-clock of the whole analysis (the CI self-check
+    # gates this under --max-seconds so the deep pass stays cheap
+    # enough to run on every PR)
+    elapsed_s: float = 0.0
 
     @property
     def failing(self) -> list[Finding]:
@@ -81,7 +119,10 @@ class LintReport:
 
     def summary(self) -> str:
         c = self.counts()
-        return (f"{len(self.findings)} finding(s) "
+        base = (f"{len(self.findings)} finding(s) "
                 f"({c['error']} error, {c['warning']} warning, "
                 f"{c['info']} info; {len(self.suppressed)} suppressed) "
                 f"in {self.n_files} file(s)")
+        if self.baselined:
+            base += f" [{len(self.baselined)} baselined]"
+        return base

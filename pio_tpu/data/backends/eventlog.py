@@ -105,6 +105,16 @@ class _Namespace:
 class _EventLogEvents(d.EventsDAO):
     def __init__(self, root: str):
         self.root = root
+        # warm the native library before anyone holds self._lock: the
+        # first _Namespace would otherwise trigger the one-time g++
+        # build inside the lock, stalling every concurrent insert/find
+        # behind a compiler run (deep lint baselines the residual
+        # static findings in deep_baseline.json)
+        from pio_tpu.native import load_library
+        try:
+            load_library("eventlog")
+        except Exception:
+            pass  # surfaced properly on first real use
         self._ns_cache: dict[tuple[int, int | None], _Namespace] = {}
         self._lock = threading.RLock()
         # per-namespace recent supplied-id window (see insert): FIFO of

@@ -290,6 +290,9 @@ class FoldInWorker:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # pio: lint-ok[context-loss] deliberate detach: the fold-in loop
+        # is a process-lifetime worker started at deploy time, not on a
+        # request path — there is no Deadline/trace to carry
         self._thread = threading.Thread(
             target=self._loop, name="foldin", daemon=True)
         self._thread.start()

@@ -58,6 +58,9 @@ def build_library(name: str) -> str:
 def load_library(name: str) -> ctypes.CDLL:
     with _LOCK:
         if name not in _LIBS:
+            # pio: lint-ok[blocking-under-lock] one-time g++ build per
+            # process; the lock exists to serialize exactly this build
+            # so concurrent importers don't compile twice
             _LIBS[name] = ctypes.CDLL(build_library(name))
         return _LIBS[name]
 

@@ -103,6 +103,9 @@ class SpillQueue:
             if self.high_water and len(self._q) >= self.high_water:
                 self._saturated = True
             if self._thread is None:
+                # pio: lint-ok[context-loss] deliberate detach: the
+                # drain loop outlives the request that spilled the
+                # event — inheriting its Deadline would cancel retries
                 self._thread = threading.Thread(
                     target=self._drain_loop, name="event-spill-drain",
                     daemon=True,

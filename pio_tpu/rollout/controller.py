@@ -550,6 +550,9 @@ def install_rollout_routes(app, host, storage, check_server_key) -> None:
         return 200, {"message": "Rolled back",
                      "rollout": ctl.rollback(reason=reason)}
 
+    # pio: lint-ok[route-unguarded] read-only status surface,
+    # deliberately open like / and /metrics — `pio doctor` and the
+    # deploy watchdogs poll it without a server key
     @app.route("GET", r"/rollout/status")
     def rollout_status(req):
         ctl = _controller()

@@ -179,6 +179,9 @@ class FleetRouter:
         )
         self._prober: threading.Thread | None = None
         if config.probe_interval_s > 0:
+            # pio: lint-ok[context-loss] deliberate detach: the health
+            # prober is a process-lifetime loop with no originating
+            # request — there is no Deadline/trace to carry
             self._prober = threading.Thread(
                 target=self._probe_loop, name="fleet-prober", daemon=True
             )

@@ -932,6 +932,9 @@ def main(argv: list[str] | None = None) -> int:
         srv._stop_requested.wait()
         http.stop()
 
+    # pio: lint-ok[context-loss] deliberate detach: shutdown watcher
+    # waits for the /stop signal for the process lifetime; no request
+    # context applies
     threading.Thread(target=watch_stop, daemon=True).start()
     try:
         http.wait()

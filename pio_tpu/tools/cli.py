@@ -1165,6 +1165,8 @@ def cmd_deploy(args) -> int:
         qs._stop_requested.wait()
         http.stop()
 
+    # pio: lint-ok[context-loss] deliberate detach: shutdown watcher
+    # waits for /stop for the process lifetime; no request context
     threading.Thread(target=watch_stop, daemon=True).start()
     try:
         http.wait()
@@ -1228,6 +1230,8 @@ def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
         handle.router._stop_requested.wait()
         handle.router_http.stop()
 
+    # pio: lint-ok[context-loss] deliberate detach: shutdown watcher
+    # waits for /stop for the process lifetime; no request context
     threading.Thread(target=watch_stop, daemon=True).start()
     try:
         handle.wait()
@@ -1722,26 +1726,55 @@ def cmd_lint(args) -> int:
     """Static trace-safety & concurrency analysis (pio_tpu/analysis/):
     the compile-time net the reference gets from Scala's type system.
     Exits 0 when no error/warning findings survive suppressions (INFO
-    findings are advisory). See docs/lint.md for the rule catalogue."""
-    from pio_tpu.analysis import run_lint
-
+    findings are advisory). `--deep` switches to the whole-program tier
+    (lock-order cycles, blocking-under-lock, context-loss,
+    route-contract drift) with its committed baseline. See docs/lint.md
+    for both rule catalogues."""
     select = {s for s in (args.select or "").split(",") if s}
     ignore = {s for s in (args.ignore or "").split(",") if s}
-    report = run_lint(args.paths, select=select or None,
-                      ignore=ignore or None)
+    paths = args.paths
+    if args.deep:
+        from pio_tpu.analysis.deep import run_deep_lint
+
+        # `pio lint --deep` from the repo root means the package, not
+        # the tree of tests/fixtures around it
+        if paths == ["."] and os.path.isdir("pio_tpu"):
+            paths = ["pio_tpu"]
+        report = run_deep_lint(
+            paths, select=select or None, ignore=ignore or None,
+            baseline_path=args.baseline,
+            update_baseline=args.update_baseline,
+            use_baseline=not args.no_baseline)
+    else:
+        from pio_tpu.analysis import run_lint
+
+        report = run_lint(paths, select=select or None,
+                          ignore=ignore or None)
+    exit_code = report.exit_code
+    if args.deep and args.max_seconds and report.elapsed_s > args.max_seconds:
+        exit_code = exit_code or 1
     if args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in report.findings],
+            "baselined": [f.to_dict() for f in report.baselined],
             "suppressed": len(report.suppressed),
             "files": report.n_files,
+            "elapsed_s": round(report.elapsed_s, 3),
+            "deep": bool(args.deep),
         }, indent=2))
-        return report.exit_code
+        return exit_code
     shown = [f for f in report.findings
              if args.show_info or f.severity.label() != "info"]
     for f in shown:
         print(f.format())
     print(report.summary())
-    return report.exit_code
+    if args.deep:
+        print(f"deep analysis took {report.elapsed_s:.2f}s"
+              + (f" (budget {args.max_seconds:.0f}s"
+                 + (" EXCEEDED)" if report.elapsed_s > args.max_seconds
+                    else " ok)")
+                 if args.max_seconds else ""))
+    return exit_code
 
 
 def cmd_template(args) -> int:
@@ -2319,6 +2352,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule-id prefixes to skip")
     x.add_argument("--show-info", action="store_true",
                    help="print INFO-level (advisory) findings too")
+    x.add_argument("--deep", action="store_true",
+                   help="whole-program tier: lock-order cycles, "
+                        "blocking-under-lock, context-loss, "
+                        "route-contract drift (docs/lint.md)")
+    x.add_argument("--baseline", default=None,
+                   help="baseline JSON for --deep (default: the "
+                        "committed pio_tpu/analysis/deep_baseline.json)")
+    x.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to accept every current "
+                        "deep finding (ratchet after review)")
+    x.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline and report everything "
+                        "(the CI self-check mode)")
+    x.add_argument("--max-seconds", type=float, default=0.0,
+                   help="fail if the deep analysis wall-clock exceeds "
+                        "this budget (CI uses 30)")
     x.set_defaults(fn=cmd_lint)
 
     x = sub.add_parser("template")

@@ -284,6 +284,9 @@ class FrameLog:
                 # through the tmp+rename dance below)
                 f.write(rec)
                 f.flush()
+                # pio: lint-ok[blocking-under-lock] fsync under the log
+                # lock IS the durability contract: the append is not
+                # ordered (and not durable) until it hits the platter
                 os.fsync(f.fileno())
             self._depth += 1
 
@@ -329,6 +332,10 @@ class FrameLog:
                     # the compaction half of the FrameLog implementation
                     f.write(body)
                     f.flush()
+                    # pio: lint-ok[blocking-under-lock] compaction must
+                    # exclude appenders for its whole tmp+fsync+rename
+                    # span — a write that slips between scan and rename
+                    # would be silently dropped
                     os.fsync(f.fileno())
                 os.replace(tmp, self.path)
             except BaseException:
@@ -337,6 +344,8 @@ class FrameLog:
                 except OSError:
                     pass
                 raise
+            # pio: lint-ok[blocking-under-lock] same span as above: the
+            # rename is not durable until the directory entry is synced
             _fsync_dir(directory)
             tail_payloads, tail_corrupt, _ = self._scan_bytes(tail)
             self._depth = len(keep) + len(tail_payloads)

@@ -22,6 +22,7 @@ sample query so first-request latency is compile-free.
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import queue
 from contextlib import nullcontext
@@ -410,6 +411,9 @@ class QueryServer:
             if callable(close := getattr(algo, "close", None))
         ]
         if retired:
+            # pio: lint-ok[context-loss] deliberate detach: the delayed
+            # close must outlive the request (and its budget) that
+            # triggered the reload
             t = threading.Timer(30.0, lambda: [c() for c in retired])
             t.daemon = True
             t.start()
@@ -528,6 +532,9 @@ class QueryServer:
             finally:
                 self._buckets_ready.set()
 
+        # pio: lint-ok[context-loss] deliberate detach: bucket warm-up
+        # is best-effort background compile priming, not on the
+        # triggering request's clock or trace
         threading.Thread(
             target=go, name="bucket-warm", daemon=True
         ).start()
@@ -552,9 +559,13 @@ class QueryServer:
                     # concurrent per-algo predict (the parallelization
                     # the reference left as TODO, CreateServer.scala:516);
                     # device dispatch releases the GIL so the algos
-                    # genuinely overlap
+                    # genuinely overlap. copy_context: predict runs ON
+                    # the request path — the Deadline budget and trace
+                    # must follow it onto the pool worker
                     futures = [
-                        self._predict_pool.submit(a.predict, m, supplemented)
+                        self._predict_pool.submit(
+                            contextvars.copy_context().run,
+                            a.predict, m, supplemented)
                         for a, m in zip(algorithms, models)
                     ]
                     predictions = [f.result() for f in futures]
@@ -611,7 +622,11 @@ class QueryServer:
             started.set()
             return fn(*a)
 
-        futs = [self._hedge_pool.submit(wrapped, *args)]
+        # copy_context on both attempts: the hedged dispatch is the
+        # request's own predict — it must see the Deadline budget and
+        # parent its spans into the request trace
+        futs = [self._hedge_pool.submit(
+            contextvars.copy_context().run, wrapped, *args)]
         if not started.wait(timeout):
             # saturated pool: no worker picked the task up within the
             # hedge window — duplicates add load without cutting latency
@@ -622,7 +637,8 @@ class QueryServer:
         except FuturesTimeoutError:
             with self._lock:
                 self.hedged_dispatches += 1
-            futs.append(self._hedge_pool.submit(fn, *args))
+            futs.append(self._hedge_pool.submit(
+                contextvars.copy_context().run, fn, *args))
         # first SUCCESS wins; an attempt's exception propagates only once
         # every attempt has failed (a tunnel reset may fail the stalled
         # original while the duplicate is still inbound with the answer)
@@ -703,6 +719,7 @@ class QueryServer:
             if len(algorithms) > 1:
                 futures = [
                     self._predict_pool.submit(
+                        contextvars.copy_context().run,
                         self._hedged, a.batch_predict, m, supplemented)
                     for a, m in zip(algorithms, models)
                 ]
@@ -788,6 +805,10 @@ class QueryServer:
             except Exception:  # noqa: BLE001 - feedback must not fail serving
                 log.error("feedback event failed", exc_info=True)
 
+        # pio: lint-ok[context-loss] deliberate detach (see
+        # Deadline docstring): the feedback insert must not be
+        # cancelled by the request's exhausted budget, and it runs
+        # after the response is already decided
         threading.Thread(target=send, daemon=True).start()
         if isinstance(prediction, dict) and "prId" in prediction:
             prediction = dict(prediction, prId=new_pr_id)
@@ -1045,6 +1066,9 @@ def _auto_pipeline_depth() -> int:
 
             one = jnp.ones(())
             add = jax.jit(lambda x: x + 1)
+            # pio: lint-ok[blocking-under-lock] one-time boot probe:
+            # the lock exists to serialize exactly this measurement
+            # (docstring above); steady state returns the cache
             jax.block_until_ready(add(one))  # compile, not measurement
             samples = []
             for _ in range(5):
