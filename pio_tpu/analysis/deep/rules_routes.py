@@ -47,7 +47,7 @@ from pio_tpu.analysis.findings import Finding, Severity
 
 FAMILY = "route-contract"
 PROBE_TOKEN = "XpX"   # no slash, no dot: matches ([^/]+) and ([^/.]+)
-GUARDED_PREFIXES = ("/rollout", "/debug")
+GUARDED_PREFIXES = ("/rollout", "/debug", "/reshard")
 BINARY_CONSTS = ("RPC_CONTENT_TYPE", "COLUMNAR_CONTENT_TYPE")
 CLIENT_METHODS = frozenset({"request", "call"})
 

@@ -12,7 +12,13 @@ keeps the users pending and the cursor does not advance):
   * ``RouterFleetApplier``   — ``POST /fleet/upsert_users`` on the
     fleet router, which crc32c-routes each row to EVERY replica of its
     owning shard group (the same plan queries route by, so a fold-in
-    lands exactly where /shard/user_row will look for it).
+    lands exactly where /shard/user_row will look for it). During a
+    live reshard the router ALSO dual-writes rows of moving partitions
+    to their NEW owner group (docs/serving.md "Elastic resharding"), so
+    freshness never regresses across the cutover; dual-write delivery is
+    best-effort and reported under ``reshardDualFailures`` without ever
+    flipping ``ok`` — the primary (old-plan) owner remains the applier's
+    durability contract until the plan swap.
 
 Apply is idempotent (a row upsert with the same bytes is a no-op in
 effect), so the folder may replay after a crash or partial failure

@@ -71,19 +71,27 @@ class RolloutRecord:
         )
 
 
+def save_transition(storage, model_id: str, record):
+    """The shared transition writer: stamp ``updated``, frame, upsert —
+    the one durability discipline every controller-style state machine
+    (rollout records here, the fleet's reshard records in
+    serving_fleet/reshard.py) persists its transitions through. The
+    record needs only a mutable ``updated`` attribute and ``to_json``."""
+    from pio_tpu.data.dao import Model
+
+    record.updated = format_time(utcnow())
+    storage.get_model_data_models().insert(Model(
+        model_id, frame(record.to_json().encode("utf-8"))))
+    return record
+
+
 def save_record(storage, record: RolloutRecord) -> RolloutRecord:
     """Persist (upsert) the record, CRC32C-framed; stamps `updated`.
     This is the ONLY writer of rollout state — controller transitions
     call it, nothing else does (the `rollout-state` lint rule keeps it
     that way)."""
-    from pio_tpu.data.dao import Model
-
-    record.updated = format_time(utcnow())
-    storage.get_model_data_models().insert(Model(
-        rollout_model_id(record.instance_id),
-        frame(record.to_json().encode("utf-8")),
-    ))
-    return record
+    return save_transition(storage, rollout_model_id(record.instance_id),
+                           record)
 
 
 def load_record(storage, instance_id: str) -> RolloutRecord | None:

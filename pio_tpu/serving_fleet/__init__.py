@@ -29,23 +29,45 @@ shard server as its own process. See docs/serving.md "Sharded fleet".
 """
 
 from pio_tpu.serving_fleet.plan import (
+    N_PARTITIONS,
     ShardPlan,
     build_plan,
+    compute_reshard_owners,
     partition_model,
+    partition_of,
     persist_fleet_artifacts,
+    plan_diff,
+    resharded_plan,
     shard_of,
+    slice_partition,
+)
+from pio_tpu.serving_fleet.reshard import (
+    ReshardController,
+    ReshardRecord,
+    load_reshard_record,
+    reshard_model_id,
 )
 from pio_tpu.serving_fleet.router import FleetRouter, RouterConfig
 from pio_tpu.serving_fleet.shard import ShardConfig, ShardServer
 
 __all__ = [
     "FleetRouter",
+    "N_PARTITIONS",
+    "ReshardController",
+    "ReshardRecord",
     "RouterConfig",
     "ShardConfig",
     "ShardPlan",
     "ShardServer",
     "build_plan",
+    "compute_reshard_owners",
+    "load_reshard_record",
     "partition_model",
+    "partition_of",
     "persist_fleet_artifacts",
+    "plan_diff",
+    "reshard_model_id",
+    "resharded_plan",
     "shard_of",
+    "slice_partition",
 ]

@@ -20,6 +20,19 @@ the EngineInstance's own blob:
 Partitions carry entity ids in dense-index order, so per-shard local
 order preserves global order and ``lax.top_k``'s lowest-index-first tie
 break survives the merge.
+
+Elastic resharding (docs/serving.md "Elastic resharding"): entities hash
+into ``N_PARTITIONS`` fixed virtual partitions (``partition_of``) and a
+plan's ``owners`` map assigns each partition to a shard. A fresh deploy
+uses ``default_owners(n)`` — for the power-of-two topologies the fleet
+ships with this is byte-identical to the historical direct
+``crc32c(e) % n`` placement — and a reshard only rewrites the owners
+map: ``compute_reshard_owners`` keeps every partition whose owner
+survives under the new target loads, so ``plan_diff`` (the move set) is
+minimal and deterministic. Resharded plans carry ``plan_version > 1``
+and their partition blobs live under ``<iid>:plan<v>:shard<i>`` —
+writing the plan JSON is the single durable cutover point: a crash
+before it leaves the old plan + old blobs fully intact.
 """
 
 from __future__ import annotations
@@ -41,18 +54,58 @@ PLAN_STRATEGY = "crc32c"
 PLAN_VERSION = 1
 FALLBACK_ITEMS = 50  # popularity list length recorded in the plan
 
+# Virtual partitions: the fixed unit of placement AND of migration. An
+# entity's partition never changes; only the partition->shard owners map
+# does, so a reshard moves whole partitions instead of re-hashing every
+# entity. 32 keeps per-partition blobs big enough to stream efficiently
+# while still dividing evenly across every fleet size the tests run.
+N_PARTITIONS = 32
+
+_DEFAULT_OWNERS_CACHE: dict[int, tuple[int, ...]] = {}
+
+
+def partition_of(entity_id: str) -> int:
+    """The entity's fixed virtual partition — stable across processes,
+    restarts, and reshards (crc32c, never the salted stdlib hash)."""
+    return crc32c(str(entity_id).encode("utf-8")) % N_PARTITIONS
+
+
+def default_owners(n_shards: int) -> tuple[int, ...]:
+    """The deploy-time partition->shard map: partition p on shard
+    ``p % n_shards``. When ``n_shards`` divides N_PARTITIONS this places
+    every entity exactly where the pre-resharding direct
+    ``crc32c(e) % n_shards`` did."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    owners = _DEFAULT_OWNERS_CACHE.get(n_shards)
+    if owners is None:
+        owners = tuple(p % n_shards for p in range(N_PARTITIONS))
+        _DEFAULT_OWNERS_CACHE[n_shards] = owners
+    return owners
+
 
 def shard_of(entity_id: str, n_shards: int) -> int:
-    """Owning shard for an entity id — stable across processes/hosts."""
-    return crc32c(str(entity_id).encode("utf-8")) % n_shards
+    """Owning shard for an entity id under the DEFAULT owners map —
+    stable across processes/hosts. Plan-aware callers (router, shard
+    ownership checks) go through ``ShardPlan.owner_of`` instead so a
+    resharded owners map is honoured."""
+    return default_owners(n_shards)[partition_of(entity_id)]
 
 
 def plan_model_id(instance_id: str) -> str:
     return f"{instance_id}:shardplan"
 
 
-def shard_model_id(instance_id: str, shard_index: int) -> str:
-    return f"{instance_id}:shard{shard_index}"
+def shard_model_id(instance_id: str, shard_index: int,
+                   plan_version: int = 1) -> str:
+    """Partition-blob key. Version 1 keeps the legacy unversioned key so
+    pre-resharding fleets keep resolving their blobs; resharded plans
+    (version > 1) get distinct keys so commit can write the new
+    topology's blobs BEFORE the plan JSON flips — the old generation
+    stays readable until the cutover point."""
+    if plan_version <= 1:
+        return f"{instance_id}:shard{shard_index}"
+    return f"{instance_id}:plan{plan_version}:shard{shard_index}"
 
 
 @dataclass(frozen=True)
@@ -68,6 +121,17 @@ class ShardPlan:
     item_counts: tuple[int, ...]   # items per shard
     fallback: tuple[dict, ...]     # [{"item": id, "score": s}, ...]
     plan_hash: str                 # crc32c of the partition content
+    # empty owners means default_owners(n_shards) — deploy-time plans
+    # stay byte-compatible with pre-resharding readers
+    owners: tuple[int, ...] = ()
+    plan_version: int = 1          # bumped by every committed reshard
+
+    def effective_owners(self) -> tuple[int, ...]:
+        return self.owners or default_owners(self.n_shards)
+
+    def owner_of(self, entity_id: str) -> int:
+        """Owning shard under THIS plan's (possibly resharded) map."""
+        return self.effective_owners()[partition_of(entity_id)]
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), sort_keys=True)
@@ -83,6 +147,8 @@ class ShardPlan:
             item_counts=tuple(d["item_counts"]),
             fallback=tuple(d["fallback"]),
             plan_hash=d["plan_hash"],
+            owners=tuple(int(o) for o in d.get("owners") or ()),
+            plan_version=int(d.get("plan_version", 1)),
         )
 
 
@@ -137,23 +203,28 @@ def model_nbytes(model: Any) -> int:
     return int(uf.nbytes + itf.nbytes)
 
 
-def _assignments(ids: list[str], n_shards: int) -> np.ndarray:
+def _assignments(ids: list[str], n_shards: int,
+                 owners: tuple[int, ...] | None = None) -> np.ndarray:
+    own = owners or default_owners(n_shards)
     return np.fromiter(
-        (shard_of(i, n_shards) for i in ids), dtype=np.int32, count=len(ids)
+        (own[partition_of(i)] for i in ids), dtype=np.int32, count=len(ids)
     )
 
 
-def partition_model(model: Any, instance_id: str,
-                    n_shards: int) -> list[ShardPartition]:
+def partition_model(model: Any, instance_id: str, n_shards: int,
+                    owners: tuple[int, ...] | None = None,
+                    ) -> list[ShardPartition]:
     """Split a factor-table model into ``n_shards`` partitions, each
-    holding only its users' and items' rows (in dense-index order)."""
+    holding only its users' and items' rows (in dense-index order).
+    ``owners`` overrides the default partition->shard map (the reshard
+    controller's storage-rebuild fallback re-cuts under the NEW map)."""
     if n_shards < 1:
         raise ValueError(f"n_shards must be >= 1, got {n_shards}")
     uf, itf, users, items = _factor_tables(model)
     user_ids = users.ids()
     item_ids = items.ids()
-    ua = _assignments(user_ids, n_shards)
-    ia = _assignments(item_ids, n_shards)
+    ua = _assignments(user_ids, n_shards, owners)
+    ia = _assignments(item_ids, n_shards, owners)
     out = []
     for s in range(n_shards):
         usel = np.flatnonzero(ua == s)
@@ -169,6 +240,175 @@ def partition_model(model: Any, instance_id: str,
             item_rows=np.ascontiguousarray(itf[isel]),
         ))
     return out
+
+
+# -- elastic resharding: owners-map rebalance + partition slices -------------
+
+def compute_reshard_owners(old_owners: tuple[int, ...],
+                           n_new: int) -> tuple[int, ...]:
+    """The new partition->shard map for an N->N' reshard, minimising
+    movement: a partition keeps its owner whenever that shard survives
+    the resize and is still under its new target load; only the
+    overflow (and partitions on removed shards) move, to under-target
+    shards in ascending order. Pure function of (old_owners, n_new) —
+    the determinism the move-set tests pin down."""
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
+    n_parts = len(old_owners)
+    base, rem = divmod(n_parts, n_new)
+    targets = [base + (1 if s < rem else 0) for s in range(n_new)]
+    new = [-1] * n_parts
+    counts = [0] * n_new
+    for p, o in enumerate(old_owners):
+        if 0 <= o < n_new and counts[o] < targets[o]:
+            new[p] = o
+            counts[o] += 1
+    for p in range(n_parts):
+        if new[p] >= 0:
+            continue
+        for s in range(n_new):
+            if counts[s] < targets[s]:
+                new[p] = s
+                counts[s] += 1
+                break
+    return tuple(new)
+
+
+def plan_diff(old_owners: tuple[int, ...], new_owners: tuple[int, ...],
+              ) -> tuple[tuple[int, int, int], ...]:
+    """The move set: ``(partition, old_owner, new_owner)`` for exactly
+    the partitions whose owner changes. Minimal by construction —
+    an unmoved partition can never appear — and deterministic."""
+    if len(old_owners) != len(new_owners):
+        raise ValueError(
+            f"owner maps disagree on partition count: "
+            f"{len(old_owners)} vs {len(new_owners)}")
+    return tuple(
+        (p, o, n)
+        for p, (o, n) in enumerate(zip(old_owners, new_owners))
+        if o != n
+    )
+
+
+@dataclass
+class PartitionSlice:
+    """One virtual partition's entities + factor rows — the unit a
+    reshard streams from old owner to new owner. ``item_gidx`` keeps the
+    GLOBAL dense indices so the destination can re-sort its merged item
+    table into dense-index order and preserve the top-k tie-break."""
+
+    partition: int
+    instance_id: str
+    k: int                     # factor dimension
+    user_ids: list[str]
+    user_rows: np.ndarray      # (n_users, k) float32
+    item_ids: list[str]
+    item_gidx: np.ndarray      # (n_items,) int32
+    item_rows: np.ndarray      # (n_items, k) float32
+
+    def nbytes(self) -> int:
+        return int(self.user_rows.nbytes + self.item_rows.nbytes)
+
+
+def slice_partition(part: ShardPartition, p: int) -> PartitionSlice:
+    """Extract virtual partition ``p``'s entities from a shard's
+    partition (row order preserved, so dense-index order survives)."""
+    usel = [i for i, u in enumerate(part.user_ids) if partition_of(u) == p]
+    isel = [i for i, it in enumerate(part.item_ids) if partition_of(it) == p]
+    k = int(part.user_rows.shape[1]) if part.user_rows.ndim == 2 else (
+        int(part.item_rows.shape[1]) if part.item_rows.ndim == 2 else 0)
+    return PartitionSlice(
+        partition=p,
+        instance_id=part.instance_id,
+        k=k,
+        user_ids=[part.user_ids[i] for i in usel],
+        user_rows=np.ascontiguousarray(part.user_rows[usel], dtype=np.float32),
+        item_ids=[part.item_ids[i] for i in isel],
+        item_gidx=np.ascontiguousarray(part.item_gidx[isel], dtype=np.int32),
+        item_rows=np.ascontiguousarray(part.item_rows[isel], dtype=np.float32),
+    )
+
+
+def merge_reshard(part: ShardPartition, staged: dict[int, PartitionSlice],
+                  new_owners: tuple[int, ...], shard_index: int,
+                  n_new: int) -> ShardPartition:
+    """Commit-time rebuild of one shard's partition under the NEW owners
+    map: keep every resident entity the shard still owns, graft in the
+    staged slices it gained, drop what moved away — then re-sort the
+    merged item table by global dense index, which restores the
+    lowest-index-first ``lax.top_k`` tie order the router merge depends
+    on for oracle bit-parity."""
+    user_ids: list[str] = []
+    user_rows: list[np.ndarray] = []
+    for i, u in enumerate(part.user_ids):
+        if new_owners[partition_of(u)] == shard_index:
+            user_ids.append(u)
+            user_rows.append(part.user_rows[i])
+    item_ids: list[str] = []
+    item_gidx: list[int] = []
+    item_rows: list[np.ndarray] = []
+    for i, it in enumerate(part.item_ids):
+        if new_owners[partition_of(it)] == shard_index:
+            item_ids.append(it)
+            item_gidx.append(int(part.item_gidx[i]))
+            item_rows.append(part.item_rows[i])
+    for p in sorted(staged):
+        sl = staged[p]
+        if new_owners[p] != shard_index:
+            continue
+        user_ids.extend(sl.user_ids)
+        user_rows.extend(np.asarray(sl.user_rows))
+        item_ids.extend(sl.item_ids)
+        item_gidx.extend(int(g) for g in sl.item_gidx)
+        item_rows.extend(np.asarray(sl.item_rows))
+    k = 0
+    if part.user_rows.ndim == 2 and part.user_rows.shape[1]:
+        k = int(part.user_rows.shape[1])
+    elif part.item_rows.ndim == 2 and part.item_rows.shape[1]:
+        k = int(part.item_rows.shape[1])
+    else:
+        # empty join-boot partition: the rank comes from what arrived
+        for p in sorted(staged):
+            if staged[p].k:
+                k = int(staged[p].k)
+                break
+    order = sorted(range(len(item_ids)), key=lambda i: item_gidx[i])
+    return ShardPartition(
+        shard_index=shard_index,
+        n_shards=n_new,
+        instance_id=part.instance_id,
+        user_ids=user_ids,
+        user_rows=(np.stack(user_rows).astype(np.float32, copy=False)
+                   if user_rows else np.zeros((0, k), dtype=np.float32)),
+        item_ids=[item_ids[i] for i in order],
+        item_gidx=np.asarray([item_gidx[i] for i in order], dtype=np.int32),
+        item_rows=(np.stack([item_rows[i] for i in order])
+                   .astype(np.float32, copy=False)
+                   if item_rows else np.zeros((0, k), dtype=np.float32)),
+    )
+
+
+def resharded_plan(old: ShardPlan, new_owners: tuple[int, ...], n_new: int,
+                   user_counts: tuple[int, ...],
+                   item_counts: tuple[int, ...]) -> ShardPlan:
+    """The successor plan record: same instance + fallback list, new
+    owners map, plan_version bumped, hash chained from the old plan's so
+    plan identity still covers the full placement history."""
+    h = crc32c(json.dumps([old.plan_hash, list(new_owners)],
+                          separators=(",", ":")).encode("utf-8"))
+    return ShardPlan(
+        instance_id=old.instance_id,
+        n_shards=n_new,
+        n_replicas=old.n_replicas,
+        strategy=old.strategy,
+        version=old.version,
+        user_counts=tuple(user_counts),
+        item_counts=tuple(item_counts),
+        fallback=old.fallback,
+        plan_hash=f"{h:#010x}",
+        owners=tuple(new_owners),
+        plan_version=old.plan_version + 1,
+    )
 
 
 def _popularity_fallback(model: Any, k: int = FALLBACK_ITEMS) -> list[dict]:
@@ -261,6 +501,18 @@ def persist_fleet_artifacts(storage, instance_id: str, model: Any,
     return plan
 
 
+def save_plan(storage, plan: ShardPlan) -> None:
+    """Overwrite the instance's plan JSON — THE durable reshard cutover
+    point. Partition blobs for ``plan.plan_version`` must already be
+    persisted: a crash one instruction before this write leaves the old
+    plan (and its still-present blobs) fully in charge."""
+    from pio_tpu.data.dao import Model
+
+    storage.get_model_data_models().insert(Model(
+        plan_model_id(plan.instance_id),
+        frame(plan.to_json().encode("utf-8"))))
+
+
 def load_plan(storage, instance_id: str) -> ShardPlan | None:
     """The recorded plan for an instance, or None when it was never
     partitioned. Raises ModelIntegrityError on a corrupt plan blob."""
@@ -272,11 +524,11 @@ def load_plan(storage, instance_id: str) -> ShardPlan | None:
         .decode("utf-8"))
 
 
-def load_partition(storage, instance_id: str,
-                   shard_index: int) -> ShardPartition | None:
+def load_partition(storage, instance_id: str, shard_index: int,
+                   plan_version: int = 1) -> ShardPartition | None:
     """One shard's partition blob, or None when absent. Raises
     ModelIntegrityError on corruption (callers fall back last-good)."""
-    mid = shard_model_id(instance_id, shard_index)
+    mid = shard_model_id(instance_id, shard_index, plan_version)
     rec = storage.get_model_data_models().get(mid)
     if rec is None:
         return None

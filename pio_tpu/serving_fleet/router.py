@@ -2,7 +2,7 @@
 
 Query path (POST /queries.json):
 
-  1. owner = plan.shard_of(user) — fetch the user's factor row from the
+  1. owner = plan.owner_of(user) — fetch the user's factor row from the
      owning shard group (row-fetch RPC, replica failover);
   2. fan a partial-top-k RPC to EVERY shard group concurrently (each
      scores the row against its item slice with the single-host kernel);
@@ -26,6 +26,19 @@ A background prober keeps per-replica /readyz freshness for replica
 ordering, ``/fleet.json`` (what ``pio doctor --fleet`` reads), and the
 router's own ``/readyz`` (ready while every shard group has a live
 replica).
+
+Live elastic resharding (docs/serving.md "Elastic resharding"): while a
+``ReshardController`` (serving_fleet/reshard.py) migrates partitions to
+a new topology, the router double-routes the affected groups the way a
+rollout runs two arms — every scoring RPC pins the topology it was
+planned against via the ``X-Pio-Plan-Version`` header (a shard answers
+from its active, prepared, or retired arm accordingly), fold-in upserts
+are dual-written to BOTH owners of a moving partition, and a user_row
+miss on a dead old owner fails over to the new owner's staged copy. The
+cutover itself is one plan swap under the router lock
+(``apply_reshard_plan``), after which in-flight old-plan fans still
+complete against the shards' retired arms — zero 5xx either side of the
+flip.
 """
 
 from __future__ import annotations
@@ -47,7 +60,7 @@ from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
     server_key_ok,
 )
-from pio_tpu.serving_fleet.plan import ShardPlan, shard_of
+from pio_tpu.serving_fleet.plan import ShardPlan, partition_of
 from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
 from pio_tpu.utils.time import format_time, utcnow
 from pio_tpu.utils.tracing import Tracer
@@ -150,6 +163,19 @@ class FleetRouter:
         # stamping {"arm": "candidate"} on canary-arm RPCs.
         self.rollout = None
         self.candidate_plan: ShardPlan | None = None
+        # live elastic resharding (serving_fleet/reshard.py): the
+        # controller driving a migration, plus the router-side routing
+        # state while one is in flight. `reshard_routing` holds
+        # {"moving": {partition: (old_owner, new_owner)},
+        #  "staged": set[partition]} — what the dual-write fan and the
+        # alternate-owner read fallback consult; None outside a
+        # migration. The moved/pending counts back the
+        # pio_reshard_partitions_{moved,pending}_total gauges.
+        self.reshard = None
+        self.reshard_routing: dict | None = None
+        self.reshard_partitions_moved = 0
+        self.reshard_partitions_pending = 0
+        self.reshard_dual_failures = 0
         # per-codec RPC accounting (docs/performance.md "Internal RPC
         # plane"): which wire the shard fan-out actually rides, plus the
         # downgrade log-once latch per replica
@@ -188,20 +214,21 @@ class FleetRouter:
             self._prober.start()
 
     # -- shard RPC with failover --------------------------------------------
-    def _replica_order(self, shard: int) -> list[int]:
+    def _replica_order(self, shard: int, group: list[_Replica]) -> list[int]:
         """Preferred (last-good) replica first, then prober-healthy ones,
         then the rest — a dead replica is tried LAST, not skipped, so a
         stale health verdict can never strand a reachable shard."""
-        group = self.replicas[shard]
         with self._lock:
-            pref = self._preferred[shard]
+            pref = (self._preferred[shard]
+                    if shard < len(self._preferred) else 0)
         order = sorted(
             range(len(group)),
             key=lambda r: (r != pref, not group[r].healthy, r),
         )
         return order
 
-    def _call(self, shard: int, op: str, path: str, body) -> dict:
+    def _call(self, shard: int, op: str, path: str, body,
+              plan_version: int | None = None) -> dict:
         """One shard-group RPC: replicas in preference order, per-replica
         breaker guard, transient failures roll to the next replica.
         Raises ShardUnavailable when the whole group is down. The whole
@@ -213,9 +240,10 @@ class FleetRouter:
         arm = (body.get("arm", ARM_ACTIVE) if isinstance(body, dict)
                else ARM_ACTIVE)
         with self.tracer.span("shard.rpc", shard=shard, op=op, arm=arm):
-            return self._call_group(shard, op, path, body)
+            return self._call_group(shard, op, path, body, plan_version)
 
-    def _call_group(self, shard: int, op: str, path: str, body) -> dict:
+    def _call_group(self, shard: int, op: str, path: str, body,
+                    plan_version: int | None = None) -> dict:
         Deadline.check(f"shard {shard} {op}")
         try:
             # drill point: a spec targeting fleet.shard<i> takes that
@@ -226,9 +254,16 @@ class FleetRouter:
             chaos.maybe_inject(f"fleet.shard{shard}.{op}")
         except ConnectionError as e:
             raise ShardUnavailable(shard, e) from e
-        group = self.replicas[shard]
+        # snapshot: a reshard swaps self.replicas wholesale (never
+        # mutates in place), so an in-flight old-plan fan racing a
+        # shrink's group trim degrades instead of IndexError-ing
+        replicas = self.replicas
+        if shard >= len(replicas):
+            raise ShardUnavailable(
+                shard, ConnectionError("shard group removed by reshard"))
+        group = replicas[shard]
         last_error: Exception | None = None
-        for r in self._replica_order(shard):
+        for r in self._replica_order(shard, group):
             Deadline.check(f"shard {shard} {op} replica {r}")
             rep = group[r]
             if not rep.breaker.allow():
@@ -237,21 +272,24 @@ class FleetRouter:
                     retry_after_s=rep.breaker.retry_after_s() or 1.0)
                 continue
             try:
-                out = self._rpc(rep, op, path, body)
+                out = self._rpc(rep, op, path, body, plan_version)
             except HttpClientError as e:
                 if (e.status == 503 and isinstance(e.message, str)
-                        and e.message.startswith("candidate-arm-missing")):
+                        and e.message.startswith(("candidate-arm-missing",
+                                                  "plan-version-missing"))):
                     # the replica is HEALTHY — it just has no staged
                     # candidate arm (restarted mid-canary, or its
-                    # load_candidate failed while a sibling's
-                    # succeeded). Fail over to a replica that has it
-                    # WITHOUT charging this replica's breaker, or
-                    # active-arm traffic would lose the replica too
+                    # load_candidate failed while a sibling's succeeded)
+                    # or no arm for the pinned plan version (restarted
+                    # mid-reshard and lost the epoch). Fail over to a
+                    # replica that has it WITHOUT charging this
+                    # replica's breaker, or active-arm traffic would
+                    # lose the replica too
                     rep.breaker.record(True)
                     last_error = e
-                    log.warning("shard %d replica %d (%s) has no "
-                                "candidate arm for %s; trying next",
-                                shard, r, rep.url, op)
+                    log.warning("shard %d replica %d (%s) has no arm "
+                                "for %s (%s); trying next",
+                                shard, r, rep.url, op, e.message)
                     continue
                 rep.breaker.record(not is_transient(e))
                 if e.status and e.status not in (408, 429, 502, 503, 504):
@@ -262,7 +300,8 @@ class FleetRouter:
                 continue
             rep.breaker.record(True)
             with self._lock:
-                if self._preferred[shard] != r:
+                if (shard < len(self._preferred)
+                        and self._preferred[shard] != r):
                     self.rerouted_count += 1
                     self._preferred[shard] = r
             return out
@@ -275,7 +314,8 @@ class FleetRouter:
         with self._lock:
             self.rpc_codec_counts[codec] += 1
 
-    def _rpc(self, rep: _Replica, op: str, path: str, body) -> dict:
+    def _rpc(self, rep: _Replica, op: str, path: str, body,
+             plan_version: int | None = None) -> dict:
         """One replica RPC with wire negotiation. The scoring RPCs are
         read-only, so they are marked idempotent — a stale pooled
         socket gets the client's ONE transparent resend instead of
@@ -284,9 +324,15 @@ class FleetRouter:
         downgraded STICKILY and logged once, mirroring find_columnar's
         downgrade. Only a CONFIRMED-binary replica gets binary request
         bodies (the top-k f32 row), so a pre-binary shard never sees a
-        frame it would 400 on."""
+        frame it would 400 on. ``plan_version`` pins the topology the
+        query was planned against (the reshard cutover's two-arm
+        discipline) as an ``X-Pio-Plan-Version`` header — a HEADER so it
+        rides both the JSON and the binary wire without a frame-format
+        change; a pre-reshard shard simply ignores it."""
         from pio_tpu.serving_fleet import rpcwire
 
+        hdrs = ({"X-Pio-Plan-Version": str(int(plan_version))}
+                if plan_version is not None else None)
         read_op = op in self._BINARY_OPS
         if (not read_op or self.config.rpc_wire != "binary"
                 or rep.binary_wire is False):
@@ -294,7 +340,7 @@ class FleetRouter:
                 self._count_rpc("json")
                 return rep.client.request("POST", path,
                                           self._jsonable(op, body),
-                                          idempotent=True)
+                                          idempotent=True, headers=hdrs)
             return rep.client.request("POST", path, body)
         if op == "topk" and rep.binary_wire:
             try:
@@ -303,7 +349,8 @@ class FleetRouter:
                     raw=rpcwire.encode_topk_request(
                         body["row"], body["k"], body.get("arm", ARM_ACTIVE)),
                     content_type=rpcwire.RPC_CONTENT_TYPE,
-                    accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True)
+                    accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True,
+                    headers=hdrs)
             except HttpClientError as e:
                 if not e.status:
                     raise   # transport-level: breaker/failover handles it
@@ -315,11 +362,13 @@ class FleetRouter:
                 # below, a JSON failure is the real error and raises
                 resp = rep.client.request(
                     "POST", path, self._jsonable(op, body),
-                    accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True)
+                    accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True,
+                    headers=hdrs)
         else:
             resp = rep.client.request(
                 "POST", path, self._jsonable(op, body),
-                accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True)
+                accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True,
+                headers=hdrs)
         if isinstance(resp, (bytes, bytearray)):
             rep.binary_wire = True
             self._count_rpc("binary")
@@ -382,7 +431,7 @@ class FleetRouter:
         arm = rollout.arm_for(q) if rollout is not None else ARM_ACTIVE
         # RAW id value, no str() coercion: the single-host oracle treats
         # a non-string id as unknown (dict-keyed id index), and the
-        # fleet must agree; shard_of str-coerces only for hashing
+        # fleet must agree; owner routing str-coerces only for hashing
         out = self._query_inner(user, num, black, white, arm=arm)
         if out.get("degraded"):
             with self._lock:
@@ -410,23 +459,65 @@ class FleetRouter:
             with self._lock:
                 if self.candidate_plan is None:
                     arm = ARM_ACTIVE
-        owner = shard_of(user, self._plan_for(arm).n_shards)
+        # ONE plan snapshot per query: owner routing, the top-k fan set,
+        # and the plan-version pin must all describe the SAME topology,
+        # or a reshard cutover racing this query could fan the new
+        # group count against old-plan partitions (duplicate or missing
+        # item coverage). Every shard answers the pinned version from
+        # its matching arm, so the merged answer is always one
+        # consistent topology's answer.
+        plan = self._plan_for(arm)
+        owner = plan.owner_of(user)
         with self.tracer.span("user_row"):
             try:
                 row_resp = self._call(
                     owner, "user_row", "/shard/user_row",
-                    self._arm_body({"user": user}, arm))
+                    self._arm_body({"user": user}, arm),
+                    plan_version=plan.plan_version)
             except ShardUnavailable as e:
-                return self._fallback(num, black, str(e), arm=arm)
+                row_resp = self._reshard_alt_user_row(user, owner, arm,
+                                                      plan)
+                if row_resp is None:
+                    return self._fallback(num, black, str(e), arm=arm)
         if not row_resp.get("found"):
             return {"itemScores": []}  # unknown user: same as single-host
         row = row_resp["row"]
         if white:
-            return self._white_query(row, num, black, white, arm=arm)
-        return self._topk_query(row, num, black, arm=arm)
+            return self._white_query(row, num, black, white, arm=arm,
+                                     plan=plan)
+        return self._topk_query(row, num, black, arm=arm, plan=plan)
 
-    def _fan(self, op: str, path: str, body,
-             shards=None) -> tuple[dict[int, dict], list[int]]:
+    def _reshard_alt_user_row(self, user, owner: int, arm: str,
+                              plan: ShardPlan) -> dict | None:
+        """During a live reshard a MOVING partition has a second copy —
+        the staged slice (or prepared arm) on its other owner. When the
+        planned owner's whole group is down, try that copy before
+        degrading to the popularity fallback; None means no usable
+        alternate (caller degrades exactly as before resharding)."""
+        with self._lock:
+            rs = self.reshard_routing
+        if rs is None:
+            return None
+        mv = rs["moving"].get(partition_of(user))
+        if mv is None:
+            return None
+        alt = mv[1] if mv[1] != owner else mv[0]
+        if alt == owner or alt >= len(self.replicas):
+            return None
+        try:
+            out = self._call(alt, "user_row", "/shard/user_row",
+                             self._arm_body({"user": user}, arm),
+                             plan_version=plan.plan_version)
+        except ShardUnavailable:
+            return None
+        # only a FOUND row counts: the alternate may not hold the copy
+        # yet (transfer not staged), and `found: false` from it would
+        # masquerade as "unknown user" instead of a degraded answer
+        return out if out.get("found") else None
+
+    def _fan(self, op: str, path: str, body, shards=None,
+             plan_version: int | None = None,
+             ) -> tuple[dict[int, dict], list[int]]:
         """Concurrent RPC to `shards` (default: every shard group) ->
         ({shard: result}, [down shards]). Each task runs in a COPY of
         the caller's context so the ambient Deadline follows the work
@@ -437,7 +528,7 @@ class FleetRouter:
         futs = {
             s: self._pool.submit(
                 contextvars.copy_context().run,
-                self._call, s, op, path, body)
+                self._call, s, op, path, body, plan_version)
             for s in (range(self.plan.n_shards) if shards is None
                       else shards)
         }
@@ -452,16 +543,21 @@ class FleetRouter:
         return results, down
 
     def _topk_query(self, row: list[float], num: int, black: set,
-                    arm: str = ARM_ACTIVE) -> dict:
+                    arm: str = ARM_ACTIVE,
+                    plan: ShardPlan | None = None) -> dict:
+        if plan is None:
+            plan = self._plan_for(arm)
         # over-fetch exactly like ALSAlgorithm.predict: k = num + |black|
         # capped at the (global) item count, so blacklist filtering can
         # never starve the result below the single-host answer
-        n_items = sum(self._plan_for(arm).item_counts)
+        n_items = sum(plan.item_counts)
         k = min(num + len(black), n_items)
         with self.tracer.span("score"):
             results, down = self._fan(
                 "topk", "/shard/topk",
-                self._arm_body({"row": row, "k": k}, arm))
+                self._arm_body({"row": row, "k": k}, arm),
+                shards=range(plan.n_shards),
+                plan_version=plan.plan_version)
         merged: list[tuple[float, int, str]] = []
         for res in results.values():
             merged.extend(zip(res["scores"], res["indices"], res["items"]))
@@ -482,7 +578,10 @@ class FleetRouter:
                            arm=arm)
 
     def _white_query(self, row: list[float], num: int, black: set,
-                     white: list, arm: str = ARM_ACTIVE) -> dict:
+                     white: list, arm: str = ARM_ACTIVE,
+                     plan: ShardPlan | None = None) -> dict:
+        if plan is None:
+            plan = self._plan_for(arm)
         # row-fetch the candidates' factor rows from their owning shards
         # ONLY (a non-owner group being down is irrelevant to this
         # query and must not flag it degraded), then score HERE in one
@@ -490,12 +589,12 @@ class FleetRouter:
         # uses (n candidates at once) — shard-side per-subset scoring
         # drifts by an ULP because XLA's einsum lowering is
         # shape-sensitive
-        owners = sorted({shard_of(w, self._plan_for(arm).n_shards)
-                         for w in white})
+        owners = sorted({plan.owner_of(w) for w in white})
         with self.tracer.span("score"):
             results, down = self._fan(
                 "item_rows", "/shard/item_rows",
-                self._arm_body({"items": list(white)}, arm), shards=owners)
+                self._arm_body({"items": list(white)}, arm), shards=owners,
+                plan_version=plan.plan_version)
         rows: dict[str, list[float]] = {}
         for res in results.values():
             rows.update(res["rows"])
@@ -687,12 +786,97 @@ class FleetRouter:
             self.candidate_plan = None
         self._fan_control("drop_candidate", "/shard/drop_candidate", {})
 
+    # -- live elastic resharding (serving_fleet/reshard.py) ------------------
+    def add_shard_groups(self, endpoint_groups: list[list[str]]) -> None:
+        """Append replica groups for shards JOINING a grow: the replica
+        table covers the old and new topology for the whole migration,
+        so health probing, dual-writes, and post-swap queries all
+        address one table. The table is REPLACED, never mutated in
+        place — concurrent readers hold a consistent snapshot."""
+        if not endpoint_groups:
+            return
+        c = self.config
+        with self._lock:
+            base = len(self.replicas)
+        groups = [
+            [
+                _Replica(
+                    url=url,
+                    client=JsonHttpClient(url, timeout=c.rpc_timeout_s,
+                                          pooled=c.http_pooled),
+                    breaker=CircuitBreaker(
+                        f"shard{base + i}/replica{r}",
+                        min_calls=c.breaker_min_calls,
+                        failure_rate=c.breaker_failure_rate,
+                        open_s=c.breaker_open_s,
+                        window_s=c.breaker_window_s,
+                    ),
+                )
+                for r, url in enumerate(urls)
+            ]
+            for i, urls in enumerate(endpoint_groups)
+        ]
+        with self._lock:
+            self.replicas = self.replicas + groups
+            self._preferred = self._preferred + [0] * len(groups)
+
+    def set_reshard_routing(self, moving) -> None:
+        """Install the migration's routing state: the move set feeds
+        the dual-write fan, the alternate-owner read fallback, and the
+        progress gauges. Queries keep riding the OLD plan until
+        ``apply_reshard_plan``."""
+        with self._lock:
+            self.reshard_routing = {
+                "moving": {int(p): (int(o), int(n)) for p, o, n in moving},
+                "staged": set(),
+            }
+            self.reshard_partitions_moved = 0
+            self.reshard_partitions_pending = len(moving)
+
+    def mark_partition_staged(self, p: int) -> None:
+        with self._lock:
+            rs = self.reshard_routing
+            if rs is None:
+                return
+            rs["staged"].add(int(p))
+            self.reshard_partitions_moved = len(rs["staged"])
+            self.reshard_partitions_pending = (
+                len(rs["moving"]) - len(rs["staged"]))
+
+    def apply_reshard_plan(self, new_plan: ShardPlan) -> None:
+        """The router-side cutover: ONE plan swap under the lock (the
+        promote_candidate discipline). New queries plan against v<new>
+        and pin it on every RPC — shards that have not activated yet
+        answer from their prepared arm, so the swap is safe in either
+        order relative to the activate fan. A shrink trims the replica
+        table; an in-flight old-plan fan racing the trim degrades (the
+        _call_group snapshot), never errors."""
+        with self._lock:
+            self.plan = new_plan
+            self.reshard_routing = None
+            self.reshard_partitions_pending = 0
+            if len(self.replicas) > new_plan.n_shards:
+                self.replicas = self.replicas[:new_plan.n_shards]
+                self._preferred = self._preferred[:new_plan.n_shards]
+
+    def clear_reshard_routing(self, trim_to: int | None = None) -> None:
+        """Abort path: drop the routing state and any groups added for
+        the abandoned grow. The active plan was never swapped, so
+        serving is bit-identical to pre-reshard."""
+        with self._lock:
+            self.reshard_routing = None
+            self.reshard_partitions_pending = 0
+            self.reshard_partitions_moved = 0
+            if trim_to is not None and len(self.replicas) > trim_to:
+                self.replicas = self.replicas[:trim_to]
+                self._preferred = self._preferred[:trim_to]
+
     # -- streaming fold-in (pio_tpu/freshness/) ------------------------------
     def upsert_users(self, rows: dict,
                      staleness_s: float | None = None) -> dict:
         """Fan refreshed user rows to EVERY replica of each row's
-        crc32c owner shard group — the same ``shard_of`` routing
-        queries use, so a fold-in lands exactly where the next
+        owner shard group under the active plan — the same ``owner_of``
+        routing queries use, so a fold-in lands exactly where the next
         ``/shard/user_row`` will look. Unlike the query path this is a
         fan-to-ALL, not a failover scan: every replica must hold the
         row or it serves stale until the next fold or /reload. A group
@@ -700,11 +884,31 @@ class FleetRouter:
         ``RouterFleetApplier`` — keep those users pending and retry); a
         partially-applied group stays ok, with the lagging replica
         visible in per-replica results and in ``pio doctor --fleet``'s
-        fold-in lag column."""
+        fold-in lag column.
+
+        During a live reshard, rows whose partition is MOVING are
+        additionally dual-written to the partition's NEW owner group,
+        where they land in the arriving copy (prepared arm, staged
+        slice, or the pending queue — shard.upsert_user_rows) so no
+        fold-in is lost at the cutover. Dual delivery is best-effort:
+        failures are counted under ``reshardDualFailures`` and never
+        flip ``ok`` — the old-plan owner stays the durability contract
+        until the plan swap (freshness/apply.py)."""
+        with self._lock:
+            plan = self.plan
+            rs = self.reshard_routing
+        replicas = self.replicas
+        owners = plan.effective_owners()
         groups: dict[int, dict] = {}
+        dual: dict[int, dict] = {}
         for uid, row in rows.items():
-            groups.setdefault(
-                shard_of(uid, self.plan.n_shards), {})[uid] = row
+            p = partition_of(uid)
+            owner = owners[p]
+            groups.setdefault(owner, {})[uid] = row
+            if rs is not None:
+                mv = rs["moving"].get(p)
+                if mv is not None and mv[1] != owner:
+                    dual.setdefault(mv[1], {})[uid] = row
         key = self.config.server_key
         results: dict[str, dict] = {}
         failed_groups: list[int] = []
@@ -723,7 +927,8 @@ class FleetRouter:
                 continue
             reps: dict[str, dict] = {}
             ok_replicas = 0
-            for r, rep in enumerate(self.replicas[s]):
+            for r, rep in enumerate(replicas[s] if s < len(replicas)
+                                    else ()):
                 Deadline.check(f"shard {s} upsert replica {r}")
                 try:
                     # same per-replica breaker as the query path: a dead
@@ -757,11 +962,48 @@ class FleetRouter:
                 failed_groups.append(s)
             results[str(s)] = {"ok": ok_replicas > 0,
                                "fullyApplied":
-                                   ok_replicas == len(self.replicas[s]),
+                                   ok_replicas == len(replicas[s])
+                                   if s < len(replicas) else False,
                                "replicas": reps}
-        return {"ok": not failed_groups, "groups": results,
-                "failedGroups": failed_groups,
-                "engineInstanceId": self.plan.instance_id}
+        out = {"ok": not failed_groups, "groups": results,
+               "failedGroups": failed_groups,
+               "engineInstanceId": plan.instance_id}
+        if rs is not None:
+            out["reshardDualFailures"] = self._dual_write(dual, staleness_s,
+                                                          key, replicas)
+        return out
+
+    def _dual_write(self, dual: dict[int, dict],
+                    staleness_s: float | None, key: str,
+                    replicas: list[list[_Replica]]) -> int:
+        """Best-effort second copy of moving-partition rows on their NEW
+        owner group (see upsert_users). Returns the count of failed
+        per-replica deliveries — reported, never fatal."""
+        failures = 0
+        for s, dual_rows in sorted(dual.items()):
+            body: dict = {"users": dual_rows}
+            if staleness_s is not None:
+                body["stalenessSeconds"] = staleness_s
+            group = replicas[s] if s < len(replicas) else ()
+            if not group:
+                failures += 1
+                continue
+            for r, rep in enumerate(group):
+                Deadline.check(f"shard {s} dual-write replica {r}")
+                try:
+                    with rep.breaker.guard():
+                        rep.client.request(
+                            "POST", "/shard/upsert_users", body,
+                            params={"accessKey": key} if key else None)
+                except (CircuitOpenError, HttpClientError) as e:
+                    failures += 1
+                    log.warning("reshard dual-write of %d row(s) to "
+                                "shard %d replica %d failed: %s",
+                                len(dual_rows), s, r, e)
+        if failures:
+            with self._lock:
+                self.reshard_dual_failures += failures
+        return failures
 
     def query_batch(self, queries: list[dict]) -> list[dict]:
         # sequential on purpose: each query already fans across shards
@@ -819,6 +1061,11 @@ class FleetRouter:
                     # guarded rollout: which candidate (if any) this
                     # replica has staged — doctor --fleet's coverage
                     "candidateInstanceId": info.get("candidateInstanceId"),
+                    # elastic resharding: the plan version this replica
+                    # actually serves — `pio doctor --fleet` WARNs when
+                    # replicas disagree (a stale-plan replica missed the
+                    # activate fan and needs a /reload)
+                    "planVersion": info.get("planVersion"),
                     # internal RPC plane (docs/performance.md)
                     "binaryWire": rep.binary_wire,
                     "connReuse": (round(hs["reused"] / dials, 3)
@@ -841,7 +1088,10 @@ class FleetRouter:
         with self._lock:
             degraded, rerouted = self.degraded_count, self.rerouted_count
             candidate_plan = self.candidate_plan
+            moved = self.reshard_partitions_moved
+            pending = self.reshard_partitions_pending
         rollout = self.rollout
+        reshard = self.reshard
         return {
             "plan": {
                 "instanceId": self.plan.instance_id,
@@ -849,6 +1099,7 @@ class FleetRouter:
                 "nReplicas": self.plan.n_replicas,
                 "strategy": self.plan.strategy,
                 "planHash": self.plan.plan_hash,
+                "planVersion": self.plan.plan_version,
                 "userCounts": list(self.plan.user_counts),
                 "itemCounts": list(self.plan.item_counts),
             },
@@ -860,6 +1111,11 @@ class FleetRouter:
             "candidatePlanInstanceId": (candidate_plan.instance_id
                                         if candidate_plan else None),
             "rollout": rollout.status() if rollout is not None else None,
+            # elastic resharding: migration progress (what `pio reshard
+            # --status` and `pio doctor --fleet` read)
+            "reshard": reshard.status() if reshard is not None else None,
+            "reshardPartitionsMoved": moved,
+            "reshardPartitionsPending": pending,
         }
 
     def reload(self) -> dict:
@@ -903,6 +1159,10 @@ class FleetRouter:
         self._stop_requested.set()
         if self.rollout is not None:
             self.rollout.close()
+        if self.reshard is not None:
+            # stop the migration worker without recording a verdict —
+            # an IN_FLIGHT record is exactly what resume keys off
+            self.reshard.stop()
         self._pool.shutdown(wait=False)
         if self._prober is not None:
             self._prober.join(timeout=2)
@@ -1003,12 +1263,18 @@ def build_router_app(router: FleetRouter) -> HttpApp:
         with router._lock:
             degraded, rerouted = router.degraded_count, router.rerouted_count
             codec_counts = dict(router.rpc_codec_counts)
+            reshard = {
+                "partitionsMoved": router.reshard_partitions_moved,
+                "partitionsPending": router.reshard_partitions_pending,
+                "dualWriteFailures": router.reshard_dual_failures,
+            }
         out = {
             "startTime": format_time(router.start_time),
             "spans": router.tracer.snapshot(),
             "degradedResponses": degraded,
             "reroutedCalls": rerouted,
             "rpcCodecCounts": codec_counts,
+            "reshard": reshard,
             "connPool": default_pool().stats(),
         }
         if router.recorder is not None:
@@ -1031,6 +1297,8 @@ def build_router_app(router: FleetRouter) -> HttpApp:
         with router._lock:
             degraded, rerouted = router.degraded_count, router.rerouted_count
             codec_counts = dict(router.rpc_codec_counts)
+            moved = router.reshard_partitions_moved
+            pending = router.reshard_partitions_pending
         labels = {"surface": "router"}
         counters = {
             "degraded_responses_total": float(degraded),
@@ -1045,7 +1313,75 @@ def build_router_app(router: FleetRouter) -> HttpApp:
             "rpc_requests_total",
             [({**labels, "codec": codec}, float(count))
              for codec, count in sorted(codec_counts.items())])) + "\n"
+        # elastic resharding progress gauges (gauges, not counters:
+        # pending DECREASES as partitions land) — what the reshard-chaos
+        # CI drill scrapes for convergence
+        text += "\n".join(prometheus_labeled_counter(
+            "reshard_partitions_moved_total", [(labels, float(moved))],
+            mtype="gauge")) + "\n"
+        text += "\n".join(prometheus_labeled_counter(
+            "reshard_partitions_pending_total", [(labels, float(pending))],
+            mtype="gauge")) + "\n"
         return 200, RawResponse(text, PROMETHEUS_CONTENT_TYPE)
+
+    # -- live elastic resharding (serving_fleet/reshard.py) ------------------
+    @app.route("POST", r"/reshard/begin")
+    def reshard_begin(req: Request):
+        """Start an N->N' migration: ``{"nShards": N', "endpoints"?:
+        [[url, ...], ...], "block"?: bool}`` — endpoint groups for the
+        JOINING shards when growing. Answers immediately (the migration
+        runs on a controller worker; poll /reshard/status) unless
+        ``block`` is true. Guarded: it changes production topology."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        try:
+            body = req.json()
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"Invalid body: {e}"}
+        if not isinstance(body, dict) or "nShards" not in body:
+            return 400, {"message": "body must be {\"nShards\": N', "
+                                    "\"endpoints\"?: [[url, ...], ...]}"}
+        from pio_tpu.serving_fleet.reshard import ReshardController
+
+        ctl = router.reshard
+        if ctl is None:
+            ctl = ReshardController(router, router.storage,
+                                    server_key=config.server_key)
+            router.reshard = ctl
+        try:
+            out = ctl.begin(
+                int(body["nShards"]),
+                [list(g) for g in body.get("endpoints") or []],
+                block=bool(body.get("block", False)))
+        except ValueError as e:
+            return 409, {"message": str(e)}
+        return 200, out
+
+    @app.route("GET", r"/reshard/status")
+    def reshard_status(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        ctl = router.reshard
+        if ctl is None:
+            return 200, {"inFlight": False,
+                         "planVersion": router.plan.plan_version}
+        out = ctl.status()
+        out["planVersion"] = router.plan.plan_version
+        return 200, out
+
+    @app.route("POST", r"/reshard/abort")
+    def reshard_abort(req: Request):
+        """Abort the in-flight migration: the old plan was never
+        swapped, so serving is restored bit-identical."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        ctl = router.reshard
+        if ctl is None:
+            return 409, {"message": "no reshard in flight"}
+        try:
+            return 200, ctl.abort()
+        except ValueError as e:
+            return 409, {"message": str(e)}
 
     @app.route("POST", r"/reload")
     @app.route("GET", r"/reload")  # deprecated alias (docs/serving.md:
@@ -1082,8 +1418,21 @@ def build_router_app(router: FleetRouter) -> HttpApp:
             "ok": True,
             "instanceId": router.plan.instance_id,
             "planHash": router.plan.plan_hash,
+            "planVersion": router.plan.plan_version,
             "instanceSkew": len(instances) > 1,
         }
+        # reshard visibility, never a gate — a fleet mid-migration
+        # serves every query from a consistent topology by design
+        reshard = router.reshard
+        if reshard is not None:
+            st = reshard.status()
+            checks["reshard"] = {
+                "ok": True,
+                "inFlight": st.get("inFlight", False),
+                "verdict": st.get("verdict"),
+                "partitionsStaged": st.get("partitionsStaged"),
+                "partitionsMoving": st.get("partitionsMoving"),
+            }
         # rollout visibility, never a gate (a breached canary already
         # rolled itself back to the active plan)
         rollout = router.rollout

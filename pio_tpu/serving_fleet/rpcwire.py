@@ -38,6 +38,15 @@ Message layout inside the durable envelope::
                                                             scores f32[n]
     kind 3 USER_ROW_RESP  header {"found", "d"}             row f32[d]
     kind 4 ITEM_ROWS_RESP header {"n", "k", "ids": [...]}   rows f32[n*k]
+    kind 5 RESHARD_PART   header {"p", "iid", "nu", "ni",   user_rows f32[nu*k]
+                          "k", "userIds", "itemIds"}        gidx i32[ni]
+                                                            item_rows f32[ni*k]
+
+Kind 5 is the reshard migration unit (docs/serving.md "Elastic
+resharding"): one virtual partition's factor rows, streamed old-owner ->
+controller -> new owner CRC32C-framed end-to-end, so a partition that
+arrives corrupt dies at the destination's decode as a 400 and the
+transfer retries — never a silently wrong row in the new topology.
 """
 
 from __future__ import annotations
@@ -56,6 +65,7 @@ _KIND_TOPK_REQ = 1
 _KIND_TOPK_RESP = 2
 _KIND_USER_ROW_RESP = 3
 _KIND_ITEM_ROWS_RESP = 4
+_KIND_RESHARD_PART = 5
 
 _PREFIX = struct.Struct(">BI")   # kind, header length
 _F32 = np.dtype("<f4")
@@ -228,6 +238,62 @@ def decode_item_rows_response(data: bytes) -> dict:
     (flat,) = _sections(body, (_F32, n * k))
     rows = flat.reshape(n, k) if n else flat.reshape(0, k or 0)
     return {"rows": {ids[i]: rows[i] for i in range(n)}}
+
+
+def encode_partition_slice(sl) -> bytes:
+    """A plan.PartitionSlice as one reshard transfer frame."""
+    user_bytes, nu_k = _f32_bytes(sl.user_rows)
+    item_bytes, ni_k = _f32_bytes(sl.item_rows)
+    gidx = np.ascontiguousarray(np.asarray(sl.item_gidx), dtype=_I32)
+    nu, ni, k = len(sl.user_ids), len(sl.item_ids), int(sl.k)
+    if nu_k != nu * k or ni_k != ni * k or gidx.size != ni:
+        raise RpcWireError(
+            f"partition slice sections disagree: {nu} users x {k} but "
+            f"{nu_k} user floats; {ni} items but {ni_k} item floats, "
+            f"{gidx.size} indices")
+    return _seal(
+        _KIND_RESHARD_PART,
+        {"p": int(sl.partition), "iid": sl.instance_id, "nu": nu,
+         "ni": ni, "k": k, "userIds": list(sl.user_ids),
+         "itemIds": list(sl.item_ids)},
+        user_bytes, gidx.tobytes(), item_bytes)
+
+
+def decode_partition_slice(data: bytes):
+    """Verify + rebuild the PartitionSlice from a kind-5 frame. The
+    destination shard stages exactly what this returns; a truncated or
+    bit-rotted transfer dies here as RpcWireError (400 -> retry)."""
+    from pio_tpu.serving_fleet.plan import PartitionSlice
+
+    header, body = _open(data, _KIND_RESHARD_PART)
+    nu = _count(header, "nu")
+    ni = _count(header, "ni")
+    k = _count(header, "k", limit=1 << 16)
+    user_ids = header.get("userIds")
+    item_ids = header.get("itemIds")
+    if not isinstance(user_ids, list) or len(user_ids) != nu:
+        raise RpcWireError("reshard frame user id sidecar disagrees "
+                           "with nu")
+    if not isinstance(item_ids, list) or len(item_ids) != ni:
+        raise RpcWireError("reshard frame item id sidecar disagrees "
+                           "with ni")
+    iid = header.get("iid")
+    if not isinstance(iid, str) or not iid:
+        raise RpcWireError("reshard frame missing instance id")
+    user_flat, gidx, item_flat = _sections(
+        body, (_F32, nu * k), (_I32, ni), (_F32, ni * k))
+    return PartitionSlice(
+        partition=_count(header, "p", limit=1 << 16),
+        instance_id=iid,
+        k=k,
+        user_ids=[str(u) for u in user_ids],
+        user_rows=user_flat.reshape(nu, k) if nu else
+        user_flat.reshape(0, k),
+        item_ids=[str(i) for i in item_ids],
+        item_gidx=np.asarray(gidx, dtype=_I32),
+        item_rows=item_flat.reshape(ni, k) if ni else
+        item_flat.reshape(0, k),
+    )
 
 
 _RESPONSE_DECODERS = {

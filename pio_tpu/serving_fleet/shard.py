@@ -26,6 +26,19 @@ the previous COMPLETED instance's partition — one bad blob on one shard
 must never take down the fleet. An optional ``memory_budget_bytes``
 makes "loads only its partition" an enforced invariant, not a habit.
 
+Elastic resharding (docs/serving.md "Elastic resharding"): a reshard
+epoch opened by ``/shard/begin_reshard`` streams whole virtual
+partitions between shards as kind-5 rpcwire frames
+(``/shard/extract_partition`` -> ``/shard/stage_partition``);
+``/shard/prepare_reshard`` merges the staged slices into a SECOND
+partition arm held alongside the active one — the rollout two-arm
+discipline — which ``/shard/activate_reshard`` swaps in after the
+router has flipped plans. Scoring RPCs address a specific topology via
+the ``X-Pio-Plan-Version`` header, so during cutover a replica serves
+the old partition to old-plan fans and the prepared one to new-plan
+fans, and a mixed-moment fleet still answers every query from exactly
+one consistent topology (zero 5xx, oracle bit-parity throughout).
+
 Run standalone (its own host/process) via
 ``python -m pio_tpu.serving_fleet shard --shard-index I --n-shards N``
 with the storage configured by the usual PIO_STORAGE_* environment.
@@ -47,7 +60,9 @@ from pio_tpu.server.http import (
 )
 from pio_tpu.serving_fleet import rpcwire
 from pio_tpu.serving_fleet.plan import (
-    ShardPartition, load_partition, partitioned_instances,
+    PartitionSlice, ShardPartition, default_owners, load_partition,
+    load_plan, merge_reshard, partition_of, partition_to_bytes,
+    partitioned_instances, shard_model_id, slice_partition,
 )
 from pio_tpu.utils.durable import ModelIntegrityError
 from pio_tpu.utils.time import format_time, utcnow
@@ -67,6 +82,14 @@ class CandidateArmMissing(RuntimeError):
     arm."""
 
 
+class PlanVersionMissing(RuntimeError):
+    """A scoring RPC addressed a plan version this replica holds no arm
+    for (mid-cutover skew: the prepared arm is not built yet, or the
+    retired one was already dropped). 503, same non-breaker-charging
+    failover cue as CandidateArmMissing — serving the WRONG topology
+    would double-count or drop items in the router's merge."""
+
+
 @dataclass
 class ShardConfig:
     ip: str = "127.0.0.1"
@@ -83,6 +106,10 @@ class ShardConfig:
     # never evict a serving one.
     memory_budget_bytes: int = 0
     backend: str = "threaded"     # many shards ride one test process
+    # grow-path boot: a NEW shard joining a reshard has no partition
+    # blob for its topology yet — it boots empty and waits for staged
+    # slices instead of failing resolution
+    join_reshard: bool = False
 
 
 @dataclass
@@ -97,6 +124,35 @@ class _ArmState:
     item_factors_dev: object
     user_row_of: dict
     item_local_of: dict
+
+
+def _slice_with_rows(sl: PartitionSlice, rows: dict) -> PartitionSlice:
+    """Copy-on-write user-row upsert into a staged partition slice (the
+    dual-write landing path). Raises ValueError on a rank mismatch —
+    the caller queues those rows instead."""
+    k = int(sl.k)
+    if any(len(r) != k for r in rows.values()):
+        raise ValueError("fold-in row rank does not match the slice")
+    user_ids = list(sl.user_ids)
+    user_rows = np.array(sl.user_rows, dtype=np.float32, copy=True)
+    at_of = {u: i for i, u in enumerate(user_ids)}
+    appended: list[np.ndarray] = []
+    for uid, row in rows.items():
+        vec = np.asarray(row, dtype=np.float32)
+        at = at_of.get(uid)
+        if at is not None:
+            user_rows[at] = vec
+        else:
+            at_of[uid] = len(user_ids)
+            user_ids.append(uid)
+            appended.append(vec)
+    if appended:
+        user_rows = np.concatenate(
+            [user_rows.reshape(-1, k), np.stack(appended)]
+        ).astype(np.float32)
+    import dataclasses
+
+    return dataclasses.replace(sl, user_ids=user_ids, user_rows=user_rows)
 
 
 def _prepare_arm(part: ShardPartition) -> "_ArmState":
@@ -138,6 +194,15 @@ class ShardServer:
         # active one (queries carry {"arm": "candidate"} to ride it)
         self.candidate: _ArmState | None = None
         self._candidate_foldin_pending: dict = {}
+        # elastic resharding: the serving plan's partition->shard owners
+        # map + version (set by _load), the in-flight epoch state, and
+        # the retired arm kept after activation so in-flight old-plan
+        # fans still complete (dropped on the next load/epoch)
+        self.owners: tuple[int, ...] = default_owners(
+            max(1, config.n_shards))
+        self.plan_version: int = 1
+        self._reshard: dict | None = None
+        self._retired: tuple[int, _ArmState] | None = None
         # per-codec RPC accounting (docs/performance.md "Internal RPC
         # plane"): how many scoring RPCs answered on the binary wire vs
         # JSON — a fleet stuck on "json" after a rollout is a router
@@ -169,6 +234,78 @@ class ShardServer:
             )
         return [i.id for i in insts]
 
+    def _resolve_partition(self, instance_id: str | None,
+                           ) -> tuple[ShardPartition, object]:
+        """-> (partition, plan-or-None) with last-good fallback; a
+        join-reshard boot that finds no blob for its topology
+        synthesises an EMPTY partition on the newest partitioned
+        instance and awaits staged slices."""
+        part = None
+        plan = None
+        last_error: Exception | None = None
+        try:
+            cids = self._candidates(instance_id)
+        except ValueError:
+            if not self.config.join_reshard:
+                raise
+            cids = []
+        for cid in cids:
+            try:
+                plan = load_plan(self.storage, cid)
+                part = load_partition(
+                    self.storage, cid, self.config.shard_index,
+                    plan.plan_version if plan is not None else 1)
+            except ModelIntegrityError as e:
+                log.error(
+                    "shard %d partition of instance %s is corrupt "
+                    "(%s); trying the previous COMPLETED instance",
+                    self.config.shard_index, cid, e,
+                )
+                last_error = e
+                continue
+            if part is None:
+                last_error = ValueError(
+                    f"instance {cid} has no partition blob for shard "
+                    f"{self.config.shard_index}"
+                )
+                continue
+            break
+        if part is None and self.config.join_reshard:
+            iid, plan = self._join_instance(instance_id)
+            part = ShardPartition(
+                shard_index=self.config.shard_index,
+                n_shards=self.config.n_shards,
+                instance_id=iid,
+                user_ids=[],
+                user_rows=np.zeros((0, 0), dtype=np.float32),
+                item_ids=[],
+                item_gidx=np.zeros(0, dtype=np.int32),
+                item_rows=np.zeros((0, 0), dtype=np.float32),
+            )
+            log.info("shard %d joining reshard of instance %s with an "
+                     "empty partition", self.config.shard_index, iid)
+        if part is None:
+            raise last_error or ValueError("no partition found")
+        return part, plan
+
+    def _join_instance(self, instance_id: str | None):
+        """Join-reshard boot target: the pinned instance, or the newest
+        COMPLETED instance that has a shard plan at all (any topology —
+        this shard is not in the old owners map yet)."""
+        c = self.config
+        if instance_id:
+            return instance_id, load_plan(self.storage, instance_id)
+        instances = self.storage.get_metadata_engine_instances()
+        for inst in instances.get_completed(c.engine_id, c.engine_version,
+                                            c.engine_variant):
+            plan = load_plan(self.storage, inst.id)
+            if plan is not None:
+                return inst.id, plan
+        raise ValueError(
+            f"join-reshard boot: no COMPLETED instance of engine "
+            f"{c.engine_id} {c.engine_version} {c.engine_variant} has a "
+            "shard plan yet")
+
     def _load(self, instance_id: str | None = None) -> None:
         """Resolve + restore + swap, with last-good fallback: a corrupt
         partition blob on the latest instance falls back to the previous
@@ -176,30 +313,9 @@ class ShardServer:
         not fall back — the operator asked for THAT one). The swap is
         atomic under self._lock; a failed load leaves the serving
         partition untouched."""
-        with self._load_lock:
-            part = None
-            last_error: Exception | None = None
-            for cid in self._candidates(instance_id):
-                try:
-                    part = load_partition(
-                        self.storage, cid, self.config.shard_index)
-                except ModelIntegrityError as e:
-                    log.error(
-                        "shard %d partition of instance %s is corrupt "
-                        "(%s); trying the previous COMPLETED instance",
-                        self.config.shard_index, cid, e,
-                    )
-                    last_error = e
-                    continue
-                if part is None:
-                    last_error = ValueError(
-                        f"instance {cid} has no partition blob for shard "
-                        f"{self.config.shard_index}"
-                    )
-                    continue
-                break
-            if part is None:
-                raise last_error or ValueError("no partition found")
+        with self._load_lock, self.tracer.span(
+                "reload", shard=self.config.shard_index):
+            part, plan = self._resolve_partition(instance_id)
             budget = self.config.memory_budget_bytes
             if budget and part.nbytes() > budget:
                 raise ShardMemoryBudgetExceeded(
@@ -208,15 +324,33 @@ class ShardServer:
                     f"bytes but the shard's budget is {budget} — deploy "
                     "with more shards"
                 )
-            arm = _prepare_arm(part)
-            with self._lock:
-                self.partition = part
-                self._item_factors_dev = arm.item_factors_dev
-                self._user_row_of = arm.user_row_of
-                self._item_local_of = arm.item_local_of
-            log.info("shard %d serving instance %s (%d users, %d items, "
-                     "%d bytes)", self.config.shard_index, part.instance_id,
-                     len(part.user_ids), len(part.item_ids), part.nbytes())
+            owners = (plan.effective_owners() if plan is not None
+                      else default_owners(self.config.n_shards))
+            pv = plan.plan_version if plan is not None else 1
+            # the blob-load span `pio trace` shows for a migration:
+            # which partition landed and how many bytes moved
+            with self.tracer.span(
+                    "reload.partition", shard=self.config.shard_index,
+                    instance=part.instance_id, bytes=part.nbytes()):
+                arm = _prepare_arm(part)
+                with self._lock:
+                    if self._reshard is not None:
+                        log.warning(
+                            "shard %d reload drops an in-flight reshard "
+                            "epoch (plan %s)", self.config.shard_index,
+                            self._reshard["planVersion"])
+                    self.partition = part
+                    self._item_factors_dev = arm.item_factors_dev
+                    self._user_row_of = arm.user_row_of
+                    self._item_local_of = arm.item_local_of
+                    self.owners = owners
+                    self.plan_version = pv
+                    self._reshard = None
+                    self._retired = None
+            log.info("shard %d serving instance %s plan v%d (%d users, "
+                     "%d items, %d bytes)", self.config.shard_index,
+                     part.instance_id, pv, len(part.user_ids),
+                     len(part.item_ids), part.nbytes())
 
     def reload(self) -> str:
         try:
@@ -306,12 +440,226 @@ class ShardServer:
                 self._candidate_foldin_pending = {}
                 return self.partition.instance_id
 
-    def _arm(self, arm: str):
+    # -- elastic resharding epoch (docs/serving.md) --------------------------
+    def begin_reshard(self, instance_id: str, plan_version: int,
+                      new_owners: tuple[int, ...], n_new: int,
+                      incoming: list[int]) -> dict:
+        """Open a reshard epoch: remember the successor owners map and
+        which partitions this shard will RECEIVE. Idempotent for the
+        same plan version (the controller retries its fan); a different
+        in-flight epoch is refused — one reshard at a time."""
+        if len(new_owners) == 0 or n_new < 1:
+            raise ValueError("reshard needs a non-empty owners map and "
+                             "n_new >= 1")
+        with self._lock:
+            part = self.partition
+            if part is None:
+                raise ValueError("shard has no partition loaded")
+            if instance_id != part.instance_id:
+                raise ValueError(
+                    f"reshard targets instance {instance_id} but this "
+                    f"shard serves {part.instance_id}")
+            if plan_version <= self.plan_version:
+                raise ValueError(
+                    f"reshard plan version {plan_version} is not newer "
+                    f"than the serving plan v{self.plan_version}")
+            rs = self._reshard
+            if rs is not None and rs["planVersion"] != int(plan_version):
+                raise ValueError(
+                    f"another reshard (plan v{rs['planVersion']}) is "
+                    "already in flight on this shard")
+            if rs is None:
+                self._reshard = {
+                    "planVersion": int(plan_version),
+                    "instanceId": instance_id,
+                    "newOwners": tuple(int(o) for o in new_owners),
+                    "nShardsNew": int(n_new),
+                    "incoming": {int(p) for p in incoming},
+                    "staged": {},
+                    "pending": {},
+                    "prepared": None,
+                }
+            self._retired = None    # a new epoch retires the retiree
+        return self.reshard_status()
+
+    def extract_partition(self, p: int) -> PartitionSlice:
+        """Slice virtual partition ``p`` out of the ACTIVE partition for
+        a transfer — the shard keeps serving it until activation, so an
+        extract is always safe to retry."""
+        with self.tracer.span("reshard.extract",
+                              shard=self.config.shard_index, partition=p):
+            with self._lock:
+                part = self.partition
+            if part is None:
+                raise ValueError("shard has no partition loaded")
+            return slice_partition(part, int(p))
+
+    def stage_partition(self, sl: PartitionSlice) -> dict:
+        """Land a transferred slice for an incoming partition. Queued
+        dual-write fold-ins for that partition are applied OVER the
+        slice (they are newer than the extracted blob). Idempotent: a
+        resumed transfer restages harmlessly."""
+        with self._lock:
+            rs = self._reshard
+            if rs is None:
+                raise ValueError("no reshard epoch open on this shard")
+            part = self.partition
+            if part is not None and sl.instance_id != part.instance_id:
+                raise ValueError(
+                    f"slice belongs to instance {sl.instance_id}, shard "
+                    f"serves {part.instance_id}")
+            if sl.partition not in rs["incoming"]:
+                raise ValueError(
+                    f"partition {sl.partition} is not incoming on shard "
+                    f"{self.config.shard_index}")
+            pending = rs["pending"].pop(sl.partition, {})
+            if pending:
+                try:
+                    sl = _slice_with_rows(sl, pending)
+                except ValueError:
+                    rs["pending"][sl.partition] = pending
+            rs["staged"][sl.partition] = sl
+            staged = sorted(rs["staged"])
+        return {"staged": staged, "partition": sl.partition,
+                "bytes": sl.nbytes()}
+
+    def reshard_status(self) -> dict:
+        with self._lock:
+            rs = self._reshard
+            out = {
+                "inFlight": rs is not None,
+                "planVersion": self.plan_version,
+                "retiredPlanVersion": (self._retired[0]
+                                       if self._retired else None),
+            }
+            if rs is not None:
+                out.update({
+                    "reshardPlanVersion": rs["planVersion"],
+                    "incoming": sorted(rs["incoming"]),
+                    "staged": sorted(rs["staged"]),
+                    "pendingRows": sum(len(v)
+                                       for v in rs["pending"].values()),
+                    "prepared": rs["prepared"] is not None,
+                })
+            return out
+
+    def prepare_reshard(self, plan_version: int) -> dict:
+        """Build + persist this shard's NEW-topology partition (resident
+        entities it keeps + staged slices it gained, items re-sorted by
+        global index) and hold it as a second arm. Serving stays on the
+        OLD partition: the router flips plans first and addresses this
+        arm by plan version until activate swaps it in. Idempotent per
+        plan version."""
+        from pio_tpu.data.dao import Model
+
+        with self._load_lock:
+            with self._lock:
+                if plan_version <= self.plan_version:
+                    # already activated past it (a retried fan)
+                    return {"prepared": True,
+                            "planVersion": self.plan_version,
+                            "users": len(self.partition.user_ids),
+                            "items": len(self.partition.item_ids),
+                            "bytes": self.partition.nbytes()}
+                rs = self._reshard
+                if rs is None or rs["planVersion"] != int(plan_version):
+                    raise ValueError(
+                        f"no reshard epoch at plan v{plan_version} on "
+                        f"shard {self.config.shard_index}")
+                if rs["prepared"] is not None:
+                    new_part = rs["prepared"].partition
+                    return {"prepared": True, "planVersion": plan_version,
+                            "users": len(new_part.user_ids),
+                            "items": len(new_part.item_ids),
+                            "bytes": new_part.nbytes()}
+                missing = rs["incoming"] - set(rs["staged"])
+                if missing:
+                    raise ValueError(
+                        f"cannot prepare plan v{plan_version}: partitions "
+                        f"{sorted(missing)} are not staged yet")
+                part = self.partition
+                staged = dict(rs["staged"])
+                new_owners = rs["newOwners"]
+                n_new = rs["nShardsNew"]
+            new_part = merge_reshard(part, staged, new_owners,
+                                     self.config.shard_index, n_new)
+            budget = self.config.memory_budget_bytes
+            if budget and new_part.nbytes() > budget:
+                raise ShardMemoryBudgetExceeded(
+                    f"resharded partition of instance "
+                    f"{new_part.instance_id} needs {new_part.nbytes()} "
+                    f"bytes over shard {self.config.shard_index}'s "
+                    f"{budget}-byte budget")
+            # durable BEFORE the plan flips anywhere: the v<N> blob key
+            # is unreferenced until save_plan writes the successor plan
+            self.storage.get_model_data_models().insert(Model(
+                shard_model_id(new_part.instance_id,
+                               self.config.shard_index, int(plan_version)),
+                partition_to_bytes(new_part)))
+            arm = _prepare_arm(new_part)
+            with self._lock:
+                rs2 = self._reshard
+                if rs2 is not None and rs2["planVersion"] == int(plan_version):
+                    rs2["prepared"] = arm
+            return {"prepared": True, "planVersion": int(plan_version),
+                    "users": len(new_part.user_ids),
+                    "items": len(new_part.item_ids),
+                    "bytes": new_part.nbytes()}
+
+    def activate_reshard(self, plan_version: int) -> dict:
+        """The prepared arm becomes the active partition (a pointer swap
+        under the lock — the /reload discipline); the old arm is kept
+        RETIRED so old-plan fans already in flight still complete.
+        Idempotent: a replica that already swapped answers success so a
+        retried controller fan converges."""
+        with self._load_lock, self._lock:
+            if self.plan_version >= int(plan_version):
+                return {"activated": True,
+                        "planVersion": self.plan_version}
+            rs = self._reshard
+            if (rs is None or rs["planVersion"] != int(plan_version)
+                    or rs["prepared"] is None):
+                raise ValueError(
+                    f"no prepared arm for plan v{plan_version} on shard "
+                    f"{self.config.shard_index}")
+            old_pv = self.plan_version
+            old = _ArmState(
+                partition=self.partition,
+                item_factors_dev=self._item_factors_dev,
+                user_row_of=self._user_row_of,
+                item_local_of=self._item_local_of)
+            arm = rs["prepared"]
+            self.partition = arm.partition
+            self._item_factors_dev = arm.item_factors_dev
+            self._user_row_of = arm.user_row_of
+            self._item_local_of = arm.item_local_of
+            self.owners = rs["newOwners"]
+            self.plan_version = int(plan_version)
+            self.config.n_shards = rs["nShardsNew"]
+            self._retired = (old_pv, old)
+            self._reshard = None
+            return {"activated": True, "planVersion": self.plan_version}
+
+    def abort_reshard(self) -> dict:
+        """Drop the epoch: staged slices, pending dual-writes, and the
+        prepared arm. The active partition was never touched, so
+        serving is bit-identical to pre-reshard. Idempotent."""
+        with self._lock:
+            was = self._reshard is not None
+            self._reshard = None
+        return {"aborted": was, "planVersion": self.plan_version}
+
+    def _arm(self, arm: str, plan_version: int | None = None):
         """-> (partition, item_dev, user_row_of, item_local_of) for one
         arm. Unlike the single-host server this does NOT silently fall
         back for a missing candidate: a replica without the candidate
         loaded must 503 so the router fails over, never serve the wrong
-        model as if it were the right one."""
+        model as if it were the right one. ``plan_version`` (the
+        ``X-Pio-Plan-Version`` header) addresses a TOPOLOGY during a
+        reshard cutover: the prepared arm answers for the successor
+        plan before activation, the retired arm keeps answering the old
+        plan just after it — and a version this replica holds no arm
+        for 503s rather than serving the wrong partition cut."""
         with self._lock:
             if arm == "candidate":
                 c = self.candidate
@@ -321,6 +669,23 @@ class ShardServer:
                         "candidate arm loaded")
                 return (c.partition, c.item_factors_dev, c.user_row_of,
                         c.item_local_of)
+            if (plan_version is not None
+                    and plan_version != self.plan_version):
+                rs = self._reshard
+                if (rs is not None and rs["planVersion"] == plan_version
+                        and rs["prepared"] is not None):
+                    p = rs["prepared"]
+                    return (p.partition, p.item_factors_dev,
+                            p.user_row_of, p.item_local_of)
+                ret = self._retired
+                if ret is not None and ret[0] == plan_version:
+                    p = ret[1]
+                    return (p.partition, p.item_factors_dev,
+                            p.user_row_of, p.item_local_of)
+                raise PlanVersionMissing(
+                    f"shard {self.config.shard_index} replica serves "
+                    f"plan v{self.plan_version}, has no arm for "
+                    f"v{plan_version}")
             return (self.partition, self._item_factors_dev,
                     self._user_row_of, self._item_local_of)
 
@@ -334,20 +699,56 @@ class ShardServer:
         with self._lock:
             self.rpc_codec_counts[codec] += 1
 
-    def user_row_array(self, user, arm: str = "active") -> np.ndarray | None:
+    def user_row_array(self, user, arm: str = "active",
+                       plan_version: int | None = None,
+                       ) -> np.ndarray | None:
         with self.tracer.span("user_row",
                               shard=self.config.shard_index, arm=arm):
-            part, _, row_of, _ = self._arm(arm)
+            part, _, row_of, _ = self._arm(arm, plan_version)
             row = row_of.get(user)
             if row is None:
+                if arm == "active":
+                    # mid-migration serve-from-new-owner: a user whose
+                    # partition was staged here (but not activated yet)
+                    # is readable the moment the slice lands
+                    return self._reshard_user_row(user)
                 return None
             return np.asarray(part.user_rows[row], dtype=np.float32)
 
-    def user_row(self, user, arm: str = "active") -> list[float] | None:
-        row = self.user_row_array(user, arm=arm)
+    def _reshard_user_row(self, user) -> np.ndarray | None:
+        """A staged (or dual-written pending / prepared-arm) user row
+        for an INCOMING partition — freshest source first."""
+        try:
+            p = partition_of(user)
+        except Exception:  # noqa: BLE001 - non-string id: unknown user
+            return None
+        with self._lock:
+            rs = self._reshard
+            if rs is None or p not in rs["incoming"]:
+                return None
+            row = rs["pending"].get(p, {}).get(user)
+            if row is not None:
+                return np.asarray(row, dtype=np.float32)
+            prep = rs["prepared"]
+            if prep is not None:
+                at = prep.user_row_of.get(user)
+                if at is not None:
+                    return np.asarray(prep.partition.user_rows[at],
+                                      dtype=np.float32)
+            sl = rs["staged"].get(p)
+            if sl is not None and user in sl.user_ids:
+                return np.asarray(
+                    sl.user_rows[sl.user_ids.index(user)],
+                    dtype=np.float32)
+        return None
+
+    def user_row(self, user, arm: str = "active",
+                 plan_version: int | None = None) -> list[float] | None:
+        row = self.user_row_array(user, arm=arm, plan_version=plan_version)
         return None if row is None else [float(x) for x in row]
 
     def topk_arrays(self, row, k: int, arm: str = "active",
+                    plan_version: int | None = None,
                     ) -> tuple[list, np.ndarray, np.ndarray]:
         """Partial top-k of the query user's row against this shard's
         item slice — same kernel as the single-host path, so the
@@ -356,13 +757,14 @@ class ShardServer:
         span IS this shard's model span in the merged trace."""
         with self.tracer.span("topk",
                               shard=self.config.shard_index, arm=arm):
-            return self._topk_arrays(row, k, arm)
+            return self._topk_arrays(row, k, arm, plan_version)
 
     def _topk_arrays(self, row, k: int, arm: str,
+                     plan_version: int | None = None,
                      ) -> tuple[list, np.ndarray, np.ndarray]:
         from pio_tpu.ops import als
 
-        part, item_dev, _, _ = self._arm(arm)
+        part, item_dev, _, _ = self._arm(arm, plan_version)
         n_local = len(part.item_ids)
         if n_local == 0:
             return ([], np.zeros(0, dtype=np.int32),
@@ -384,6 +786,7 @@ class ShardServer:
         }
 
     def item_rows_arrays(self, items: list, arm: str = "active",
+                         plan_version: int | None = None,
                          ) -> tuple[list, np.ndarray]:
         """Factor ROWS for the subset of `items` this shard owns (the
         whiteList path's row-fetch) — (owned ids, f32 row matrix) in
@@ -395,7 +798,7 @@ class ShardServer:
         which would break bit-parity."""
         with self.tracer.span("item_rows",
                               shard=self.config.shard_index, arm=arm):
-            part, _, _, local_of = self._arm(arm)
+            part, _, _, local_of = self._arm(arm, plan_version)
             owned = [(it, local_of[it]) for it in items if it in local_of]
             if not owned:
                 k = (int(part.item_rows.shape[1])
@@ -415,27 +818,37 @@ class ShardServer:
                          staleness_s: float | None = None) -> dict:
         """Streaming fold-in apply (pio_tpu/freshness/): replace or
         append user factor rows in THIS shard's partition. Only rows
-        this shard OWNS under the crc32c plan are accepted — a
+        this shard OWNS under the plan's owners map are accepted — a
         mis-routed row is rejected loudly (``rejected`` in the result)
-        instead of silently shadowing the owner shard's copy. Last-good
-        semantics: the updated partition is built copy-on-write and
-        swapped atomically; the memory budget is enforced BEFORE the
-        swap, exactly like /reload."""
+        instead of silently shadowing the owner shard's copy — EXCEPT
+        rows for partitions this shard is RECEIVING in an in-flight
+        reshard: those are the router's dual-writes, landed in the
+        staged/prepared arm (or queued until the slice arrives) so the
+        new topology is exactly as fresh as the old at activation.
+        Last-good semantics: the updated partition is built
+        copy-on-write and swapped atomically; the memory budget is
+        enforced BEFORE the swap, exactly like /reload."""
         import dataclasses
-
-        from pio_tpu.serving_fleet.plan import shard_of
 
         with self._lock:
             part = self.partition
+            owners = self.owners
+            rs = self._reshard
+            incoming = set(rs["incoming"]) if rs is not None else set()
         if part is None:
             raise ValueError("shard has no partition loaded")
         k = int(part.user_rows.shape[1]) if part.user_rows.size else (
             int(part.item_rows.shape[1]))
         owned: list[tuple] = []
         rejected: list = []
+        moving: dict = {}
         for uid, row in rows.items():
-            if shard_of(uid, self.config.n_shards) != self.config.shard_index:
-                rejected.append(uid)
+            p = partition_of(uid)
+            if owners[p] != self.config.shard_index:
+                if p in incoming:
+                    moving[uid] = row
+                else:
+                    rejected.append(uid)
                 continue
             if len(row) != k:
                 raise ValueError(
@@ -487,9 +900,85 @@ class ShardServer:
         # freshness never silently diverges the experiment; the ACTIVE
         # apply above is the durable one the folder's cursor rides
         queued = self._upsert_candidate_rows(dict(owned))
+        # reshard dual-writes: best-effort into the arriving topology
+        reshard_queued = self._apply_reshard_rows(moving) if moving else 0
         return {"applied": len(owned), "rejected": rejected,
                 "engineInstanceId": part.instance_id,
-                "candidateQueued": queued}
+                "candidateQueued": queued,
+                "reshardApplied": len(moving) - reshard_queued,
+                "reshardQueued": reshard_queued}
+
+    def _apply_reshard_rows(self, moving: dict) -> int:
+        """Land dual-written fold-in rows for partitions this shard is
+        RECEIVING: into the prepared arm when it exists (so activation
+        serves them), else onto the staged slice, else queued until the
+        slice arrives (the queue then wins over the transferred blob —
+        it is newer). Returns the rows left queued. Never raises — the
+        dual-write is best-effort on top of the primary owner's apply,
+        which is the folder's durability contract."""
+        import dataclasses
+
+        queued = 0
+        with self._lock:
+            rs = self._reshard
+            if rs is None:
+                return len(moving)
+            by_part: dict[int, dict] = {}
+            for uid, row in moving.items():
+                by_part.setdefault(partition_of(uid), {})[uid] = row
+            prep = rs["prepared"]
+            prep_rows: dict = {}
+            for p, prows in by_part.items():
+                if p not in rs["incoming"]:
+                    queued += len(prows)     # mis-addressed: drop count
+                    continue
+                if prep is not None:
+                    prep_rows.update(prows)
+                elif p in rs["staged"]:
+                    sl = rs["staged"][p]
+                    try:
+                        rs["staged"][p] = _slice_with_rows(sl, prows)
+                    except ValueError:
+                        rs["pending"].setdefault(p, {}).update(prows)
+                        queued += len(prows)
+                else:
+                    rs["pending"].setdefault(p, {}).update(prows)
+                    queued += len(prows)
+            if prep is not None and prep_rows:
+                part = prep.partition
+                k = (int(part.user_rows.shape[1]) if part.user_rows.size
+                     else int(part.item_rows.shape[1]))
+                if any(len(r) != k for r in prep_rows.values()):
+                    for uid, row in prep_rows.items():
+                        rs["pending"].setdefault(
+                            partition_of(uid), {})[uid] = row
+                    queued += len(prep_rows)
+                else:
+                    user_rows = np.array(part.user_rows, dtype=np.float32,
+                                         copy=True)
+                    user_ids = list(part.user_ids)
+                    row_of = dict(prep.user_row_of)
+                    appended: list[np.ndarray] = []
+                    for uid, row in prep_rows.items():
+                        at = row_of.get(uid)
+                        vec = np.asarray(row, dtype=np.float32)
+                        if at is not None:
+                            user_rows[at] = vec
+                        else:
+                            row_of[uid] = len(user_ids)
+                            user_ids.append(uid)
+                            appended.append(vec)
+                    if appended:
+                        user_rows = np.concatenate(
+                            [user_rows.reshape(-1, k),
+                             np.stack(appended)]).astype(np.float32)
+                    rs["prepared"] = _ArmState(
+                        partition=dataclasses.replace(
+                            part, user_ids=user_ids, user_rows=user_rows),
+                        item_factors_dev=prep.item_factors_dev,
+                        user_row_of=row_of,
+                        item_local_of=prep.item_local_of)
+        return queued
 
     def _upsert_candidate_rows(self, owned: dict) -> int:
         """Apply owned fold-in rows (plus anything queued) to the
@@ -566,9 +1055,23 @@ class ShardServer:
             part = self.partition
             cand = self.candidate
             cand_queued = len(self._candidate_foldin_pending)
+            plan_version = self.plan_version
+            rs = self._reshard
+            reshard = None
+            if rs is not None:
+                reshard = {
+                    "planVersion": rs["planVersion"],
+                    "incoming": sorted(rs["incoming"]),
+                    "staged": sorted(rs["staged"]),
+                    "prepared": rs["prepared"] is not None,
+                }
         return {
             "shardIndex": self.config.shard_index,
             "nShards": self.config.n_shards,
+            # plan topology version: doctor --fleet WARNs when replicas
+            # disagree (a stale-plan replica after a reshard)
+            "planVersion": plan_version,
+            "reshard": reshard,
             "engineInstanceId": part.instance_id if part else None,
             "users": len(part.user_ids) if part else 0,
             "items": len(part.item_ids) if part else 0,
@@ -608,6 +1111,19 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         return 200, RawResponse(
             rpcwire.encode_topk_response(items, gidx, scores),
             rpcwire.RPC_CONTENT_TYPE)
+
+    def _plan_version_of(req: Request) -> int | None:
+        """The topology a scoring RPC addresses (X-Pio-Plan-Version,
+        sent by reshard-aware routers mid-cutover). Absent/garbled =
+        the replica's current plan, which is also what pre-reshard
+        routers get."""
+        h = req.header("x-pio-plan-version")
+        if not h:
+            return None
+        try:
+            return int(h)
+        except ValueError:
+            return None
 
     @app.route("GET", r"/")
     def root(req: Request):
@@ -688,12 +1204,15 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         # treats a non-string id as unknown (not in the id index), and
         # the fleet must agree
         try:
-            row = server.user_row_array(body["user"], arm=arm)
+            row = server.user_row_array(body["user"], arm=arm,
+                                        plan_version=_plan_version_of(req))
         except CandidateArmMissing as e:
             # the "candidate-arm-missing:" prefix is the router's cue to
             # fail over WITHOUT charging this replica's breaker: the
             # replica is healthy, it just has no staged arm
             return 503, {"message": f"candidate-arm-missing: {e}"}
+        except PlanVersionMissing as e:
+            return 503, {"message": f"plan-version-missing: {e}"}
         if binary:
             from pio_tpu.server.http import RawResponse
 
@@ -729,12 +1248,15 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         binary = _binary_accept(req)
         server.count_rpc("binary" if binary else "json")
         try:
-            items, gidx, scores = server.topk_arrays(row, k, arm=arm)
+            items, gidx, scores = server.topk_arrays(
+                row, k, arm=arm, plan_version=_plan_version_of(req))
         except CandidateArmMissing as e:
             # the "candidate-arm-missing:" prefix is the router's cue to
             # fail over WITHOUT charging this replica's breaker: the
             # replica is healthy, it just has no staged arm
             return 503, {"message": f"candidate-arm-missing: {e}"}
+        except PlanVersionMissing as e:
+            return 503, {"message": f"plan-version-missing: {e}"}
         if binary:
             return _binary_response(items, gidx, scores)
         return 200, {"items": items,
@@ -755,13 +1277,16 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         # raw values: see /shard/user_row — membership must match the
         # single-host id-index semantics exactly
         try:
-            ids, rows = server.item_rows_arrays(list(body["items"]),
-                                                arm=arm)
+            ids, rows = server.item_rows_arrays(
+                list(body["items"]), arm=arm,
+                plan_version=_plan_version_of(req))
         except CandidateArmMissing as e:
             # the "candidate-arm-missing:" prefix is the router's cue to
             # fail over WITHOUT charging this replica's breaker: the
             # replica is healthy, it just has no staged arm
             return 503, {"message": f"candidate-arm-missing: {e}"}
+        except PlanVersionMissing as e:
+            return 503, {"message": f"plan-version-missing: {e}"}
         if binary:
             from pio_tpu.server.http import RawResponse
 
@@ -832,6 +1357,110 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         except ValueError as e:
             return 400, {"message": str(e)}
         return 200, out
+
+    @app.route("POST", r"/shard/begin_reshard")
+    def shard_begin_reshard(req: Request):
+        """Elastic resharding: open an epoch (docs/serving.md). Guarded
+        — it stages a topology change for production traffic."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        body = req.json()
+        if (not isinstance(body, dict) or not body.get("instanceId")
+                or not isinstance(body.get("newOwners"), list)):
+            return 400, {"message": "body must be {\"instanceId\", "
+                                    "\"planVersion\", \"newOwners\", "
+                                    "\"nShardsNew\", \"incoming\"}"}
+        try:
+            out = server.begin_reshard(
+                str(body["instanceId"]), int(body.get("planVersion", 0)),
+                tuple(int(o) for o in body["newOwners"]),
+                int(body.get("nShardsNew", 0)),
+                [int(p) for p in body.get("incoming") or []])
+        except ValueError as e:
+            return 409, {"message": str(e)}
+        return 200, out
+
+    @app.route("POST", r"/shard/extract_partition")
+    def shard_extract_partition(req: Request):
+        """One virtual partition's slice as a kind-5 rpc frame — what
+        the reshard controller streams to the new owner."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        from pio_tpu.server.http import RawResponse
+
+        body = req.json()
+        if not isinstance(body, dict) or "p" not in body:
+            return 400, {"message": "body must be {\"p\": partition}"}
+        try:
+            sl = server.extract_partition(int(body["p"]))
+        except ValueError as e:
+            return 409, {"message": str(e)}
+        return 200, RawResponse(rpcwire.encode_partition_slice(sl),
+                                rpcwire.RPC_CONTENT_TYPE)
+
+    @app.route("POST", r"/shard/stage_partition")
+    def shard_stage_partition(req: Request):
+        """Land a transferred partition slice (kind-5 rpc frame body).
+        CRC32C-framed end-to-end: a corrupt transfer dies here as a 400
+        and the controller retries — never a silently wrong row."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        if _media_type(req, "content-type") != rpcwire.RPC_CONTENT_TYPE:
+            return 400, {"message": "stage_partition body must be a "
+                                    f"{rpcwire.RPC_CONTENT_TYPE} frame"}
+        try:
+            sl = rpcwire.decode_partition_slice(req.body)
+        except rpcwire.RpcWireError as e:
+            return 400, {"message": f"bad rpc frame: {e}"}
+        # the migration span `pio trace` shows end-to-end: which
+        # partition landed here and how many bytes moved
+        with server.tracer.span("reshard.transfer",
+                                shard=config.shard_index,
+                                partition=sl.partition,
+                                bytes=len(req.body)):
+            try:
+                out = server.stage_partition(sl)
+            except ValueError as e:
+                return 409, {"message": str(e)}
+        return 200, out
+
+    @app.route("GET", r"/shard/reshard_status")
+    def shard_reshard_status(req: Request):
+        return 200, server.reshard_status()
+
+    @app.route("POST", r"/shard/prepare_reshard")
+    def shard_prepare_reshard(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        body = req.json()
+        if not isinstance(body, dict) or "planVersion" not in body:
+            return 400, {"message": "body must be {\"planVersion\": v}"}
+        try:
+            out = server.prepare_reshard(int(body["planVersion"]))
+        except ShardMemoryBudgetExceeded as e:
+            return 507, {"message": str(e)}
+        except ValueError as e:
+            return 409, {"message": str(e)}
+        return 200, out
+
+    @app.route("POST", r"/shard/activate_reshard")
+    def shard_activate_reshard(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        body = req.json()
+        if not isinstance(body, dict) or "planVersion" not in body:
+            return 400, {"message": "body must be {\"planVersion\": v}"}
+        try:
+            out = server.activate_reshard(int(body["planVersion"]))
+        except ValueError as e:
+            return 409, {"message": str(e)}
+        return 200, out
+
+    @app.route("POST", r"/shard/abort_reshard")
+    def shard_abort_reshard(req: Request):
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        return 200, server.abort_reshard()
 
     @app.route("POST", r"/reload")
     @app.route("GET", r"/reload")  # deprecated alias (docs/serving.md:
@@ -912,6 +1541,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--memory-budget-bytes", type=int, default=0)
     p.add_argument("--server-backend", choices=["async", "threaded"],
                    default="threaded")
+    p.add_argument("--join-reshard", action="store_true",
+                   help="grow-path boot: start empty and await staged "
+                        "partition slices when no blob exists for this "
+                        "shard's topology yet")
     args = p.parse_args(argv)
     config = ShardConfig(
         ip=args.ip, port=args.port, shard_index=args.shard_index,
@@ -921,6 +1554,7 @@ def main(argv: list[str] | None = None) -> int:
         instance_id=args.instance_id, server_key=args.server_key,
         memory_budget_bytes=args.memory_budget_bytes,
         backend=args.server_backend,
+        join_reshard=args.join_reshard,
     )
     http, srv = create_shard_server(get_storage(), config)
     http.start()

@@ -22,6 +22,7 @@ import importlib
 import json
 import os
 import sys
+import time
 
 from pio_tpu import __version__
 from pio_tpu.data.dao import AccessKey, Channel
@@ -197,6 +198,7 @@ def _doctor_fleet(args) -> int:
             instance = rep.get("engineInstanceId")
             candidate = rep.get("candidateInstanceId")
             foldin = None
+            plan_version = rep.get("planVersion")
             try:
                 probe.request("GET", "/healthz")
                 live = True
@@ -206,6 +208,7 @@ def _doctor_fleet(args) -> int:
                 instance = info.get("engineInstanceId", instance)
                 candidate = info.get("candidateInstanceId", candidate)
                 foldin = info.get("foldin")
+                plan_version = info.get("planVersion", plan_version)
             except HttpClientError:
                 pass
             group_ready += ready
@@ -220,6 +223,7 @@ def _doctor_fleet(args) -> int:
                 "breaker": rep["breaker"], "instance": instance,
                 "candidate": candidate,
                 "foldin": foldin,
+                "planVersion": plan_version,
                 # internal RPC plane (docs/performance.md): the
                 # router's client-side connection-reuse ratio toward
                 # this replica and the negotiated wire — a 0% reuse
@@ -257,6 +261,18 @@ def _doctor_fleet(args) -> int:
         # starts failing — the direct /readyz probe catches it now
         if not group["ok"] or group_ready == 0:
             exit_code = 1
+    # plan-version agreement (live elastic resharding): every replica
+    # should serve the router's plan version; a straggler answers
+    # old-topology fans correctly (retired arm) but marks a replica
+    # that missed the activate fan and is waiting on /reload
+    router_pv = plan.get("planVersion")
+    stale_plan = [f"shard{r['shard']}/replica{r['replica']}"
+                  f"(v{r['planVersion']})"
+                  for r in rows
+                  if r["planVersion"] is not None
+                  and router_pv is not None
+                  and int(r["planVersion"]) != int(router_pv)]
+    reshard = fleet.get("reshard")
     open_breakers = [f"shard{r['shard']}/replica{r['replica']}"
                      for r in rows if r["breaker"] == "open"]
     replication = {
@@ -274,10 +290,13 @@ def _doctor_fleet(args) -> int:
             "stalenessBudgetSeconds": args.staleness_budget,
             "rollout": rollout,
             "candidateCoverage": candidate_coverage,
+            "planVersion": router_pv,
+            "stalePlanReplicas": stale_plan,
+            "reshard": reshard,
         }, indent=2))
         return exit_code
     print(f"fleet router {router_url}: instance {plan.get('instanceId')} "
-          f"plan {plan.get('planHash')} "
+          f"plan {plan.get('planHash')} v{plan.get('planVersion')} "
           f"({plan.get('nShards')} shards x {plan.get('nReplicas')} "
           "replicas)")
     print(f"  users/shard: {plan.get('userCounts')}  "
@@ -335,6 +354,22 @@ def _doctor_fleet(args) -> int:
         if under:
             print(f"[WARN] candidate not staged on every replica of "
                   f"shard group(s): {', '.join(sorted(under, key=int))}")
+    if reshard and reshard.get("inFlight"):
+        print(f"reshard: {reshard.get('nShardsOld')} -> "
+              f"{reshard.get('nShardsNew')} shard(s) in flight — "
+              f"{reshard.get('partitionsStaged', 0)}/"
+              f"{reshard.get('partitionsMoving', 0)} partition(s) "
+              f"staged (plan v{reshard.get('planVersionOld')} -> "
+              f"v{reshard.get('planVersionNew')})")
+    elif reshard and reshard.get("verdict"):
+        print(f"reshard: last migration {reshard['verdict']} "
+              f"({reshard.get('reason') or 'no reason recorded'})")
+    if stale_plan:
+        print("[WARN] plan-version disagreement: router serves "
+              f"plan v{router_pv} but {', '.join(stale_plan)} "
+              "answer(s) an older version — replica(s) missed the "
+              "reshard activate fan; a /reload (or `pio reshard "
+              "--status` until convergence) clears it")
     if open_breakers:
         print(f"[WARN] open breakers: {', '.join(open_breakers)}")
     if fleet.get("instanceSkew"):
@@ -1304,6 +1339,76 @@ def cmd_rollback(args) -> int:
                          {"reason": args.reason or "operator rollback"})
 
 
+def cmd_reshard(args) -> int:
+    """`pio reshard --shards N'` — live elastic resharding: grow or
+    shrink the RUNNING fleet to N' shard groups with zero downtime
+    (docs/serving.md "Elastic resharding"). The router streams moved
+    partitions to their new owners, double-routes affected partitions
+    during the move, and flips the durable plan atomically; `--status`
+    follows an in-flight migration, `--abort` restores the old plan
+    bit-identical."""
+    from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
+
+    ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+    url = f"http://{ip}:{args.port}"
+    key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
+    params = {"accessKey": key} if key else None
+    client = JsonHttpClient(url, timeout=args.timeout)
+
+    def call(method, path, body=None):
+        return client.request(method, path, body, params=params)
+
+    try:
+        if args.status:
+            print(json.dumps(call("GET", "/reshard/status"), indent=2))
+            return 0
+        if args.abort:
+            out = call("POST", "/reshard/abort")
+            print(json.dumps(out, indent=2))
+            return 0 if out.get("verdict") == "ABORTED" else 1
+        if args.shards is None or args.shards < 1:
+            return _fail("pio reshard needs --shards N' (or --status / "
+                         "--abort)")
+        body: dict = {"nShards": args.shards}
+        if args.endpoint:
+            # each --endpoint is ONE new shard group; commas separate
+            # its replicas: --endpoint http://h1:9107,http://h2:9107
+            body["endpoints"] = [
+                [u.strip() for u in e.split(",") if u.strip()]
+                for e in args.endpoint]
+        out = call("POST", "/reshard/begin", body)
+        if out.get("noop"):
+            print(out.get("message", "nothing to do"))
+            return 0
+        print(f"resharding {out.get('nShardsOld')} -> "
+              f"{out.get('nShardsNew')} shard(s): "
+              f"{out.get('partitionsMoving')} partition(s) to move "
+              f"(plan v{out.get('planVersionOld')} -> "
+              f"v{out.get('planVersionNew')})")
+        if args.no_wait:
+            print("migration running; follow with `pio reshard "
+                  "--status`")
+            return 0
+        last = -1
+        while True:
+            st = call("GET", "/reshard/status")
+            staged = st.get("partitionsStaged", 0)
+            if staged != last:
+                print(f"  staged {staged}/"
+                      f"{st.get('partitionsMoving', 0)} partition(s)")
+                last = staged
+            if not st.get("inFlight"):
+                verdict = st.get("verdict")
+                print(f"reshard {verdict}: "
+                      f"{st.get('reason') or 'no reason recorded'}")
+                return 0 if verdict == "COMMITTED" else 1
+            time.sleep(0.2)
+    except HttpClientError as e:
+        if e.status == 0:
+            return _fail(f"no fleet router at {url}: {e.message}")
+        return _fail(f"HTTP {e.status}: {e.message}")
+
+
 def _obs_urls(args) -> list[str]:
     """The surfaces `pio trace` / `pio top` poll: explicit --url flags,
     plus (given --router-url) the router AND every shard replica it
@@ -2141,6 +2246,38 @@ def build_parser() -> argparse.ArgumentParser:
             x.add_argument("--reason", default="",
                            help="recorded on the rollout verdict")
         x.set_defaults(fn=fn)
+
+    x = sub.add_parser(
+        "reshard",
+        help="live elastic resharding: grow/shrink the RUNNING fleet "
+             "to --shards N' with zero downtime (streams moved "
+             "partitions, double-routes during the move, flips the "
+             "plan atomically; docs/serving.md)")
+    x.add_argument("--shards", type=int, default=None, metavar="N",
+                   help="target shard-group count (1..32 virtual "
+                        "partitions bound the range)")
+    x.add_argument("--endpoint", action="append", default=None,
+                   metavar="URL[,URL...]",
+                   help="one NEW shard group per flag (repeatable), "
+                        "commas separating its replica URLs — required "
+                        "when growing past the groups the router "
+                        "already knows")
+    x.add_argument("--status", action="store_true",
+                   help="report the in-flight (or last) migration and "
+                        "exit")
+    x.add_argument("--abort", action="store_true",
+                   help="abort the in-flight migration: the old plan "
+                        "was never touched, serving reverts "
+                        "bit-identical")
+    x.add_argument("--no-wait", action="store_true",
+                   help="start the migration and return immediately "
+                        "instead of following progress")
+    x.add_argument("--ip", default="127.0.0.1")
+    x.add_argument("--port", type=int, default=8000,
+                   help="fleet router port")
+    x.add_argument("--server-key")
+    x.add_argument("--timeout", type=float, default=30.0)
+    x.set_defaults(fn=cmd_reshard)
 
     def obs_args(q):
         q.add_argument("--url", action="append", default=None,

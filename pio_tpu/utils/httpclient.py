@@ -318,7 +318,8 @@ class JsonHttpClient:
                 raw: bytes | None = None,
                 content_type: str | None = None,
                 accept: str | None = None,
-                idempotent: bool | None = None) -> Any:
+                idempotent: bool | None = None,
+                headers: dict | None = None) -> Any:
         """-> parsed JSON body (None when empty). Raises HttpClientError.
 
         Binary wire support (the columnar codec, data/columnar.py, and
@@ -335,6 +336,11 @@ class JsonHttpClient:
         RPCs (the router's shard fan-out) pass True; a resend there can
         at worst recompute a pure read.
 
+        ``headers`` adds extra request headers verbatim (the fleet's
+        ``X-Pio-Plan-Version`` topology pin during a live reshard); they
+        cannot displace the transport-managed ones (Content-Type,
+        Accept, traceparent, Connection).
+
         Under an active trace context the call becomes one client span:
         a child context rides the outbound ``traceparent`` header (the
         receiving server parents its own spans under it) and the span
@@ -343,7 +349,8 @@ class JsonHttpClient:
         ctx = tracectx.current()
         if ctx is None:
             return self._request(method, path, body, params, None,
-                                 raw, content_type, accept, idempotent)
+                                 raw, content_type, accept, idempotent,
+                                 headers)
         child = ctx.child()
         recorder = tracectx.current_recorder()
         t0 = time.monotonic()
@@ -356,7 +363,8 @@ class JsonHttpClient:
         try:
             return self._request(method, path, body, params,
                                  tracectx.format_traceparent(child),
-                                 raw, content_type, accept, idempotent)
+                                 raw, content_type, accept, idempotent,
+                                 headers)
         except BaseException as e:
             status = "error"
             errmsg, labels = error_fields(e, labels)
@@ -446,7 +454,8 @@ class JsonHttpClient:
                  raw: bytes | None = None,
                  content_type: str | None = None,
                  accept: str | None = None,
-                 idempotent: bool | None = None) -> Any:
+                 idempotent: bool | None = None,
+                 extra_headers: dict | None = None) -> Any:
         # chaos point: injected ConnectionError/reset/stall surfaces to
         # callers exactly like a real transport failure (normalized to
         # HttpClientError(status=0) below)
@@ -464,6 +473,11 @@ class JsonHttpClient:
             data = (json.dumps(body, allow_nan=False).encode()
                     if body is not None else None)
         headers = {"Content-Type": content_type or "application/json"}
+        if extra_headers:
+            # caller extras first: the transport-managed headers below
+            # (Accept, traceparent, Connection) always win on collision
+            for k, v in extra_headers.items():
+                headers[str(k)] = str(v)
         if accept is not None:
             headers["Accept"] = accept
         if traceparent is not None:

@@ -105,12 +105,42 @@ print("CHILD_OK", pid, flush=True)
 """
 
 
+def _coordinator_port() -> int:
+    """A bind-tested free port BELOW the kernel's ephemeral range
+    (/proc/sys/net/ipv4/ip_local_port_range). The coordinator port is
+    handed to the children as a bare number — nothing holds it between
+    our probe and the child's bind — so a pick from the ephemeral range
+    can be grabbed meanwhile by any unrelated outbound socket under
+    full-suite load, cross-connecting gloo's TCP pairs (the
+    'op.preamble.length <= op.nbytes' flake). Ports below the floor are
+    never auto-assigned to outbound connections, which removes that
+    race instead of retrying around it."""
+    import random
+
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            floor = int(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        floor = 32768                       # the kernel default
+    lo, hi = max(10240, floor - 22000), floor
+    for _ in range(64):
+        port = random.randrange(lo, hi)
+        with socket.socket() as s:
+            try:
+                s.bind(("127.0.0.1", port))
+            except OSError:
+                continue                    # a listener lives there
+            return port
+    # sub-range exhausted (unheard of on loopback): ephemeral fallback
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _run_two_children(code, expected, extra=()):
     """Spawn the 2-process distributed child pair on a freshly chosen
     coordinator port; -> [(stdout, stderr)] per child."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = _coordinator_port()
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", code, str(port), str(pid), str(expected),
@@ -133,13 +163,16 @@ def _run_two_children(code, expected, extra=()):
 
 
 def _assert_children_ok(code, expected, extra=()):
-    """Run the child pair with ONE bounded retry on gloo's TCP-pair
-    handshake race: the bind(0)-close-reuse coordinator port can be
-    cross-connected by an unrelated ephemeral socket under full-suite
-    load, which surfaces as gloo::EnforceNotMet ('op.preamble.length <=
-    op.nbytes') inside a child — an infra race, not a product failure
-    (the tests pass in isolation). Only that signature retries; any
-    other failure, or a second gloo failure, still fails the test."""
+    """Run the child pair; on gloo's TCP-pair handshake failure
+    (gloo::EnforceNotMet, 'op.preamble.length <= op.nbytes') retry ONCE
+    on a FRESH coordinator port — _run_two_children picks a new one per
+    call, so the retry never re-rolls the dice on the same port the way
+    the old bounded same-port retry did. With coordinator ports now
+    outside the ephemeral range the race itself is gone; the fresh-port
+    retry is the backstop for a stray listener appearing between the
+    bind-probe and the children's bring-up. Only that signature
+    retries; any other failure, or a second gloo failure, still fails
+    the test."""
     for attempt in (0, 1):
         outs = _run_two_children(code, expected, extra)
         if all(f"CHILD_OK {pid}" in out
@@ -224,9 +257,7 @@ def test_two_process_training_from_shared_storage_server(tmp_path):
 def test_real_coordinator_single_process():
     """End-to-end: a subprocess joins a real (1-process) distributed runtime
     via the env vars, builds a workflow context, and runs a psum."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = _coordinator_port()
     code = f"""
 import os
 os.environ["PIO_TPU_COORDINATOR"] = "127.0.0.1:{port}"
