@@ -963,6 +963,8 @@ def phase_smoke() -> dict:
         out["fleet"] = _smoke_fleet_cell(
             storage, one_rep, single[1],
             lambda q: algo.predict(full_model, q))
+        out["tenant"] = _smoke_tenant_cell(
+            storage, lambda q: algo.predict(full_model, q))
         out["tracing"] = _smoke_tracing_cell(http, qs)
     finally:
         http.stop()
@@ -972,6 +974,7 @@ def phase_smoke() -> dict:
     out["fleet_p99_x_single_host"] = out["fleet"]["p99_x_single_host"]
     out["pooled_binary_fleet_p99_x_fresh_json"] = out["fleet"][
         "pooled_binary_p99_x_fresh_json"]
+    out["tenant_victim_p99_x_solo"] = out["tenant"]["victim_p99_x_solo"]
     out["tracing_overhead_p50_x"] = out["tracing"]["p50_overhead_x"]
     out["kernel_lab"] = _smoke_kernel_cell()
     out["sweep"] = _smoke_sweep_cell()
@@ -1232,6 +1235,151 @@ def _smoke_fleet_cell(storage, one_rep, single_p99_ms: float,
         "fresh_json_p99_ms": round(jp99, 3),
         "pooled_binary_p99_x_fresh_json": round(p99 / jp99, 4)
         if jp99 > 0 else None,
+    }
+
+
+def _smoke_tenant_cell(storage, oracle) -> dict:
+    """Noisy-neighbor cell (ISSUE 18 acceptance): two tenants on one
+    2-shard multi-tenant pool — the VICTIM's p99 while a co-tenant
+    floods at >10x its own quota, against the victim's SOLO p99 on the
+    same multi-tenant plane measured moments earlier on the same box.
+    BASELINE.json `tenant_victim_p99_x_solo` bounds the ratio as an
+    ABSOLUTE ceiling, never refreshed by --update-baseline: per-tenant
+    token-bucket admission must stop the flooder at its own 429 wall
+    before the victim's tail moves. Before any timing counts, the
+    victim's answers are asserted BIT-identical to the single-host
+    oracle and the victim stream must be zero-5xx AND zero-429 —
+    isolation that merely rate-limits everyone would fail here."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from pio_tpu.controller import EngineParams
+    from pio_tpu.data import DataMap, Event
+    from pio_tpu.data.dao import App
+    from pio_tpu.models.recommendation import (
+        ALSAlgorithmParams, DataSourceParams, RecommendationEngine,
+    )
+    from pio_tpu.serving_fleet.tenancy import (
+        TenantSpec, deploy_multi_fleet, join_fleet_plan, tenant_key,
+    )
+    from pio_tpu.workflow.context import create_workflow_context
+    from pio_tpu.workflow.train import run_train
+
+    # a second tiny engine to play the flooder tenant
+    app_id = storage.get_metadata_apps().insert(App(0, "smokebapp"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(1)
+    uu = rng.integers(0, 40, 400)
+    ii = rng.integers(0, 12, 400)
+    ev.insert_batch([
+        Event(event="rate", entity_type="user", entity_id=f"u{uu[m]}",
+              target_entity_type="item", target_entity_id=f"i{ii[m]}",
+              properties=DataMap({"rating": int(rng.integers(1, 6))}))
+        for m in range(400)
+    ], app_id)
+    engine_b = RecommendationEngine.apply()
+    ep_b = EngineParams(
+        datasource=("", DataSourceParams(app_name="smokebapp")),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=4, num_iterations=2, lambda_=0.05, chunk=1024))],
+    )
+    ctx_b = create_workflow_context(storage, use_mesh=False)
+    run_train(engine_b, ep_b, storage, engine_id="smokeb", ctx=ctx_b)
+
+    victim, flooder = tenant_key("smoke"), tenant_key("smokeb")
+    # the flooder's contract: 20 qps; the flood below attempts far more
+    join_fleet_plan(storage, "smokepool", TenantSpec("smoke"),
+                    n_shards=2, n_replicas=1)
+    join_fleet_plan(storage, "smokepool",
+                    TenantSpec("smokeb", quota_qps=20.0,
+                               quota_burst=20.0),
+                    n_shards=2, n_replicas=1)
+    handle = deploy_multi_fleet(storage, "smokepool")
+    flood_stats = {"attempts": 0, "shed": 0, "ok": 0, "other": 0}
+    stop = threading.Event()
+    try:
+        port = handle.router_http.port
+
+        def ask(tenant: str, user: str) -> tuple[int, bytes]:
+            q = json.dumps({"user": user, "num": 10}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json", data=q,
+                method="POST", headers={"X-Pio-Tenant": tenant})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+
+        def victim_rep() -> float:
+            lat = []
+            for r in range(100):
+                t0 = time.monotonic()
+                code, _ = ask(victim, f"u{r % 200}")
+                if code != 200:
+                    raise AssertionError(
+                        f"victim tenant got {code} — isolation broken")
+                if r >= 20:
+                    lat.append(time.monotonic() - t0)
+            lat.sort()
+            return lat[max(0, int(len(lat) * 0.99) - 1)] * 1e3
+
+        # warm both tenants' shards (first queries pay jit), then the
+        # bit-parity gate before any timing
+        victim_rep()
+        ask(flooder, "u0")
+        for u in ("u0", "u7", "u42", "u133"):
+            want = oracle({"user": u, "num": 10})
+            got = json.loads(ask(victim, u)[1])
+            if got != want:
+                raise AssertionError(
+                    f"multi-tenant victim answer diverged from the "
+                    f"single-host oracle for {u}: {got!r} != {want!r}")
+
+        solo_p99 = min(victim_rep() for _ in range(3))
+
+        def flood():
+            while not stop.is_set():
+                code, _ = ask(flooder, "u1")
+                flood_stats["attempts"] += 1
+                if code == 429:
+                    flood_stats["shed"] += 1
+                elif code == 200:
+                    flood_stats["ok"] += 1
+                else:
+                    flood_stats["other"] += 1
+                stop.wait(0.002)  # ~500/s/thread: >10x the 20 qps quota
+
+        threads = [threading.Thread(target=flood, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            flood_p99 = min(victim_rep() for _ in range(3))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+    finally:
+        stop.set()
+        handle.close()
+    if flood_stats["shed"] == 0:
+        raise AssertionError(
+            f"flooder was never shed — the per-tenant quota did not "
+            f"engage ({flood_stats})")
+    return {
+        "victim_p99_solo_ms": round(solo_p99, 3),
+        "victim_p99_flood_ms": round(flood_p99, 3),
+        "victim_p99_x_solo": round(flood_p99 / solo_p99, 3)
+        if solo_p99 > 0 else None,
+        "flood_attempts": flood_stats["attempts"],
+        "flood_shed_429": flood_stats["shed"],
+        "flood_admitted": flood_stats["ok"],
+        "flood_other": flood_stats["other"],
     }
 
 
@@ -1752,6 +1900,23 @@ def smoke_main() -> int:
             res["pooled_binary_fleet_p99_x_fresh_json"] is not None
             and res["pooled_binary_fleet_p99_x_fresh_json"]
             <= base["pooled_binary_fleet_p99_x_fresh_json"])
+    if "tenant_victim_p99_x_solo" in base:
+        # ISSUE 18 contract CEILING, absolute and never refreshed by
+        # --update-baseline: a victim tenant's p99 while a co-tenant
+        # floods the shared 2-shard pool at >10x its own quota must
+        # stay within this multiple of the victim's solo p99 on the
+        # SAME multi-tenant plane measured moments earlier (victim
+        # answers bit-identical to the single-host oracle, zero 5xx,
+        # zero 429, flooder provably shed at its 429 wall first). A
+        # shared token bucket or a shed path that queues instead of
+        # failing fast would blow this ratio — the noisy-neighbor
+        # regression class this gate exists to catch.
+        checks["tenant_victim_p99_x_solo"] = (
+            res["tenant_victim_p99_x_solo"],
+            base["tenant_victim_p99_x_solo"],
+            res["tenant_victim_p99_x_solo"] is not None
+            and res["tenant_victim_p99_x_solo"]
+            <= base["tenant_victim_p99_x_solo"])
     if "binary_ingest_x_native" in base:
         # ISSUE 11 contract FLOOR (ROADMAP item 4), absolute and never
         # refreshed by --update-baseline: Python ingest over the binary

@@ -47,9 +47,23 @@ from pio_tpu.analysis.findings import Finding, Severity
 
 FAMILY = "route-contract"
 PROBE_TOKEN = "XpX"   # no slash, no dot: matches ([^/]+) and ([^/.]+)
-GUARDED_PREFIXES = ("/rollout", "/debug", "/reshard")
+GUARDED_PREFIXES = ("/rollout", "/debug", "/reshard",
+                    "/fleet/attach_tenant", "/fleet/detach_tenant",
+                    "/host/attach_tenant", "/host/detach_tenant")
 BINARY_CONSTS = ("RPC_CONTENT_TYPE", "COLUMNAR_CONTENT_TYPE")
 CLIENT_METHODS = frozenset({"request", "call"})
+# multi-tenant header contract (serving_fleet/tenancy.py): these shard
+# routes carry the tenant triple in X-Pio-Tenant on a multi-tenant
+# fleet — the CLIENT always stamps it, the SHARD always validates it
+# (421 on mismatch). Both sides show the contract by referencing the
+# shared constant; a module that touches these routes without it has
+# silently opted out of tenant isolation.
+TENANT_HEADER_MARKS = ("TENANT_HEADER", "X-Pio-Tenant")
+TENANT_ROUTES = frozenset({
+    "/shard/user_row", "/shard/topk", "/shard/item_rows",
+    "/shard/upsert_users", "/shard/load_candidate",
+    "/shard/promote_candidate", "/shard/drop_candidate",
+})
 
 
 @dataclass
@@ -214,6 +228,54 @@ def find_route_findings(project, summaries: dict, routes: list,
             witness=(Frame(r.path, r.line,
                            f"route {r.method} {r.pattern}").t(),),
             key=f"route-unguarded|{r.method} {r.pattern}|{r.module}",
+        ))
+
+    # multi-tenant header contract, serving side: a module registering
+    # a tenant-scoped shard route must reference the shared header
+    # constant (the validation half of the contract)
+    for r in sorted(routes, key=lambda r: (r.path, r.line)):
+        plain = r.pattern.replace("\\", "")
+        if plain not in TENANT_ROUTES:
+            continue
+        src = project.modules[r.module].ctx.source
+        if any(m in src for m in TENANT_HEADER_MARKS):
+            continue
+        findings.append(Finding(
+            "tenant-header", Severity.WARNING, r.path, r.line, 0,
+            f"{r.method} {r.pattern} is a tenant-scoped shard route "
+            f"but its module never references TENANT_HEADER "
+            f"(X-Pio-Tenant) — the handler cannot validate which "
+            f"tenant a multi-tenant RPC was meant for and may answer "
+            f"from the wrong tenant's partitions",
+            family=FAMILY,
+            witness=(Frame(r.path, r.line,
+                           f"route {r.method} {r.pattern}").t(),),
+            key=f"tenant-header|route|{r.method} {r.pattern}|{r.module}",
+        ))
+
+    # multi-tenant header contract, client side: a module calling a
+    # tenant-scoped shard route must reference the header constant too
+    # (the stamping half)
+    for p in sorted(probes, key=lambda p: (p.path, p.line)):
+        if p.probe not in TENANT_ROUTES:
+            continue
+        mod = project.by_path.get(p.path)
+        src = mod.ctx.source if mod else ""
+        if any(m in src for m in TENANT_HEADER_MARKS):
+            continue
+        mod_name = mod.name if mod else p.path
+        findings.append(Finding(
+            "tenant-header", Severity.WARNING, p.path, p.line, 0,
+            f"client calls {p.method} {p.display} — a tenant-scoped "
+            f"shard route — but its module never references "
+            f"TENANT_HEADER (X-Pio-Tenant), so on a multi-tenant "
+            f"fleet the RPC arrives unlabeled and the shard cannot "
+            f"route or refuse it per tenant",
+            family=FAMILY,
+            witness=(Frame(p.path, p.line,
+                           f"client {p.method} {p.display}").t(),),
+            key=f"tenant-header|client|{p.method} {p.display}|"
+                f"{mod_name}",
         ))
 
     # clients: every literal path must land on a registered route

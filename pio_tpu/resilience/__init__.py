@@ -31,6 +31,7 @@ from pio_tpu.resilience.policies import (
     RetryPolicy,
     is_transient,
 )
+from pio_tpu.resilience.quota import TenantAdmission, TenantQuota, TokenBucket
 from pio_tpu.resilience.spill import SpillQueue, SpillSaturated
 
 __all__ = [
@@ -44,5 +45,8 @@ __all__ = [
     "RetryPolicy",
     "SpillQueue",
     "SpillSaturated",
+    "TenantAdmission",
+    "TenantQuota",
+    "TokenBucket",
     "is_transient",
 ]

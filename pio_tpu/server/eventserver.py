@@ -88,6 +88,15 @@ class EventServerConfig:
     # mark is clamped to capacity; low_water defaults to high_water/2.
     spill_high_water: int = 0
     spill_low_water: int = 0
+    # per-app ingest quotas (multi-tenant plane, docs/serving.md
+    # "Multi-tenant fleet"): each app's POSTs pass a token bucket IN
+    # FRONT of the spill queue, so one flooding app answers 429 +
+    # Retry-After at its own quota while co-resident apps keep their
+    # full spill/backpressure headroom. 0 qps disables (the default);
+    # burst 0 means max(rate, 1). Sheds count per app in
+    # `pio_ingest_shed_total{app=}` on /metrics.
+    ingest_quota_qps: float = 0.0
+    ingest_quota_burst: float = 0.0
 
 
 class AuthError(Exception):
@@ -167,6 +176,32 @@ def build_event_app(
     # ONLY when the live lookup fails transiently (not a TTL — a healthy
     # store is always authoritative, so revocation lag is bounded by the
     # outage length).
+    # per-app ingest admission: one token bucket per app id, in front
+    # of the spill queue (quota sheds never consume spill headroom)
+    from pio_tpu.resilience import TenantAdmission, TenantQuota
+
+    ingest_quota = (TenantAdmission()
+                    if config.ingest_quota_qps > 0 else None)
+    ingest_quota_apps: set[str] = set()
+    ingest_shed: dict[int, int] = {}
+    ingest_shed_lock = threading.Lock()
+    app.ingest_shed = ingest_shed  # exposed for tests/ops (/metrics)
+
+    def admit_ingest(ak: AccessKey) -> tuple[bool, float]:
+        tenant = str(ak.appid)
+        with ingest_shed_lock:
+            if tenant not in ingest_quota_apps:
+                # configure once — reconfiguring resets the bucket
+                ingest_quota.configure(tenant, TenantQuota(
+                    rate=config.ingest_quota_qps,
+                    burst=config.ingest_quota_burst))
+                ingest_quota_apps.add(tenant)
+        ok, retry_after, _reason = ingest_quota.admit(tenant)
+        if not ok:
+            with ingest_shed_lock:
+                ingest_shed[ak.appid] = ingest_shed.get(ak.appid, 0) + 1
+        return ok, retry_after
+
     ak_cache: dict[str, AccessKey] = {}
     ak_cache_lock = threading.Lock()
 
@@ -428,6 +463,21 @@ def build_event_app(
         def wrapper(req: Request):
             try:
                 ak, channel_id = authenticate(req)
+                if ingest_quota is not None and req.method == "POST":
+                    ok, retry_after = admit_ingest(ak)
+                    if not ok:
+                        return 429, json_response(
+                            {"message": f"app {ak.appid} over its "
+                                        f"ingest quota "
+                                        f"({config.ingest_quota_qps:g}"
+                                        f" events/s); retry later"},
+                            {"Retry-After":
+                                 f"{max(1, round(retry_after))}"},
+                        )
+                    try:
+                        return fn(req, ak, channel_id)
+                    finally:
+                        ingest_quota.release(str(ak.appid))
                 return fn(req, ak, channel_id)
             except AuthError as e:
                 return e.status, {"message": e.message}
@@ -903,6 +953,18 @@ def build_event_app(
             ]
             text += "\n".join(prometheus_labeled_counter(
                 f"ingest_wire_{metric}_total", rows)) + "\n"
+        # per-app ingest-quota sheds (multi-tenant plane): which app is
+        # being rate-limited, and how hard
+        with ingest_shed_lock:
+            shed_snap = dict(ingest_shed)
+        if shed_snap:
+            rows = [
+                ({"surface": "eventserver", "app": str(app_id)},
+                 float(n))
+                for app_id, n in sorted(shed_snap.items())
+            ]
+            text += "\n".join(prometheus_labeled_counter(
+                "ingest_shed_total", rows)) + "\n"
         if config.stats:
             rows = [
                 ({"surface": "eventserver", "app_id": k.app_id,

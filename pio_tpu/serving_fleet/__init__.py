@@ -26,6 +26,15 @@ package splits the serving tier into three roles:
 ``fleet.py`` boots the whole thing (``pio deploy --shards N
 --replicas R``); ``python -m pio_tpu.serving_fleet shard ...`` runs one
 shard server as its own process. See docs/serving.md "Sharded fleet".
+
+``tenancy.py`` stacks MANY engines on one pool of shard hosts: a
+deterministic first-fit-decreasing packer places every tenant's virtual
+partitions under the per-shard memory budget (``FleetPlan``, plan v2),
+tenant-mux shard hosts route by the ``X-Pio-Tenant`` header to
+per-tenant ShardServers, and a multi-tenant router front keeps
+per-tenant breakers/deadlines/chaos scopes plus token-bucket +
+weighted-fair admission so one noisy tenant cannot take the plane down.
+See docs/serving.md "Multi-tenant fleet".
 """
 
 from pio_tpu.serving_fleet.plan import (
@@ -49,9 +58,26 @@ from pio_tpu.serving_fleet.reshard import (
 )
 from pio_tpu.serving_fleet.router import FleetRouter, RouterConfig
 from pio_tpu.serving_fleet.shard import ShardConfig, ShardServer
+from pio_tpu.serving_fleet.tenancy import (
+    FleetCapacityError,
+    FleetPlan,
+    MultiFleetRouter,
+    TenantPlacement,
+    TenantSpec,
+    build_fleet_plan,
+    deploy_multi_fleet,
+    join_fleet_plan,
+    load_fleet_plan,
+    pack_partitions,
+    tenant_key,
+    tenant_label,
+)
 
 __all__ = [
+    "FleetCapacityError",
+    "FleetPlan",
     "FleetRouter",
+    "MultiFleetRouter",
     "N_PARTITIONS",
     "ReshardController",
     "ReshardRecord",
@@ -59,9 +85,16 @@ __all__ = [
     "ShardConfig",
     "ShardPlan",
     "ShardServer",
+    "TenantPlacement",
+    "TenantSpec",
+    "build_fleet_plan",
     "build_plan",
     "compute_reshard_owners",
+    "deploy_multi_fleet",
+    "join_fleet_plan",
+    "load_fleet_plan",
     "load_reshard_record",
+    "pack_partitions",
     "partition_model",
     "partition_of",
     "persist_fleet_artifacts",
@@ -70,4 +103,6 @@ __all__ = [
     "resharded_plan",
     "shard_of",
     "slice_partition",
+    "tenant_key",
+    "tenant_label",
 ]

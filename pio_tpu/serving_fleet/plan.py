@@ -54,6 +54,13 @@ PLAN_STRATEGY = "crc32c"
 PLAN_VERSION = 1
 FALLBACK_ITEMS = 50  # popularity list length recorded in the plan
 
+# Multi-tenant RPC contract (serving_fleet/tenancy.py): every internal
+# scoring/fold-in/rollout RPC in a multi-tenant fleet carries the tenant
+# triple in this header — the client ALWAYS sends it, the shard ALWAYS
+# validates it against its placement (pio lint --deep enforces both
+# sides; see analysis/deep/rules_routes.py tenant-header).
+TENANT_HEADER = "X-Pio-Tenant"
+
 # Virtual partitions: the fixed unit of placement AND of migration. An
 # entity's partition never changes; only the partition->shard owners map
 # does, so a reshard moves whole partitions instead of re-hashing every

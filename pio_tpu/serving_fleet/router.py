@@ -60,7 +60,7 @@ from pio_tpu.server.http import (
     AsyncHttpServer, HttpApp, HttpServer, Request, json_response,
     server_key_ok,
 )
-from pio_tpu.serving_fleet.plan import ShardPlan, partition_of
+from pio_tpu.serving_fleet.plan import TENANT_HEADER, ShardPlan, partition_of
 from pio_tpu.utils.httpclient import HttpClientError, JsonHttpClient
 from pio_tpu.utils.time import format_time, utcnow
 from pio_tpu.utils.tracing import Tracer
@@ -114,6 +114,44 @@ class RouterConfig:
     # keep-alive pooling for the shard RPC clients; False restores a
     # fresh connection per RPC (the other control arm)
     http_pooled: bool = True
+    # multi-tenant fleet (serving_fleet/tenancy.py): the tenant triple
+    # this router speaks for. Non-empty stamps X-Pio-Tenant on EVERY
+    # shard RPC (scoring, fold-in, rollout, control, probes) and labels
+    # this router's spans + Prometheus lines `tenant=`.
+    tenant: str = ""
+    # chaos drill namespace: injection points are
+    # `<chaos_prefix>.shard<i>.<op>`. The single-tenant default keeps
+    # the historical `fleet.shard...` names; a multi-tenant fleet scopes
+    # each tenant's router under `fleet.<tenant-label>` so a drill can
+    # take down exactly one tenant's fan-out.
+    chaos_prefix: str = "fleet"
+
+
+class _TenantClient(JsonHttpClient):
+    """JsonHttpClient that stamps the X-Pio-Tenant header on every
+    request — the multi-tenant wire contract's client half (the client
+    ALWAYS sends; the shard host routes + validates against placement).
+    Subclassing keeps all call sites (scoring fan, control fan, fold-in,
+    prober GETs) on one code path with zero single-tenant overhead."""
+
+    def __init__(self, url: str, tenant: str, **kw):
+        super().__init__(url, **kw)
+        self._tenant = tenant
+
+    def request(self, method, path, body=None, params=None, **kw):
+        hdrs = dict(kw.pop("headers", None) or {})
+        hdrs.setdefault(TENANT_HEADER, self._tenant)
+        return super().request(method, path, body, params,
+                               headers=hdrs, **kw)
+
+
+def _new_client(config: RouterConfig, url: str) -> JsonHttpClient:
+    if config.tenant:
+        return _TenantClient(url, config.tenant,
+                             timeout=config.rpc_timeout_s,
+                             pooled=config.http_pooled)
+    return JsonHttpClient(url, timeout=config.rpc_timeout_s,
+                          pooled=config.http_pooled)
 
 
 @dataclass
@@ -184,8 +222,7 @@ class FleetRouter:
             [
                 _Replica(
                     url=url,
-                    client=JsonHttpClient(url, timeout=config.rpc_timeout_s,
-                                          pooled=config.http_pooled),
+                    client=_new_client(config, url),
                     breaker=CircuitBreaker(
                         f"shard{s}/replica{r}",
                         min_calls=config.breaker_min_calls,
@@ -239,7 +276,10 @@ class FleetRouter:
         hop a drill (or real outage) took down."""
         arm = (body.get("arm", ARM_ACTIVE) if isinstance(body, dict)
                else ARM_ACTIVE)
-        with self.tracer.span("shard.rpc", shard=shard, op=op, arm=arm):
+        attrs = {"shard": shard, "op": op, "arm": arm}
+        if self.config.tenant:
+            attrs["tenant"] = self.config.tenant
+        with self.tracer.span("shard.rpc", **attrs):
             return self._call_group(shard, op, path, body, plan_version)
 
     def _call_group(self, shard: int, op: str, path: str, body,
@@ -251,7 +291,8 @@ class FleetRouter:
             # ConnectionError classifies as the group being unreachable,
             # so the drill exercises the same degrade path a real outage
             # does
-            chaos.maybe_inject(f"fleet.shard{shard}.{op}")
+            chaos.maybe_inject(
+                f"{self.config.chaos_prefix}.shard{shard}.{op}")
         except ConnectionError as e:
             raise ShardUnavailable(shard, e) from e
         # snapshot: a reshard swaps self.replicas wholesale (never
@@ -678,7 +719,8 @@ class FleetRouter:
         def one(s: int, r: int, rep) -> str | None:
             Deadline.check(f"shard {s} {op} replica {r}")
             try:
-                chaos.maybe_inject(f"fleet.shard{s}.{op}")
+                chaos.maybe_inject(
+                    f"{self.config.chaos_prefix}.shard{s}.{op}")
                 with rep.breaker.guard():
                     rep.client.request(
                         "POST", path, body,
@@ -802,8 +844,7 @@ class FleetRouter:
             [
                 _Replica(
                     url=url,
-                    client=JsonHttpClient(url, timeout=c.rpc_timeout_s,
-                                          pooled=c.http_pooled),
+                    client=_new_client(c, url),
                     breaker=CircuitBreaker(
                         f"shard{base + i}/replica{r}",
                         min_calls=c.breaker_min_calls,
@@ -920,7 +961,8 @@ class FleetRouter:
                 # same drill point family as the query path: a spec
                 # targeting fleet.shard<i> takes this group's applies
                 # down from the router's view
-                chaos.maybe_inject(f"fleet.shard{s}.upsert_users")
+                chaos.maybe_inject(
+                    f"{self.config.chaos_prefix}.shard{s}.upsert_users")
             except ConnectionError as e:
                 failed_groups.append(s)
                 results[str(s)] = {"ok": False, "error": str(e)}
@@ -1300,6 +1342,8 @@ def build_router_app(router: FleetRouter) -> HttpApp:
             moved = router.reshard_partitions_moved
             pending = router.reshard_partitions_pending
         labels = {"surface": "router"}
+        if router.config.tenant:
+            labels["tenant"] = router.config.tenant
         counters = {
             "degraded_responses_total": float(degraded),
             "rerouted_calls_total": float(rerouted),

@@ -60,9 +60,10 @@ from pio_tpu.server.http import (
 )
 from pio_tpu.serving_fleet import rpcwire
 from pio_tpu.serving_fleet.plan import (
-    PartitionSlice, ShardPartition, default_owners, load_partition,
-    load_plan, merge_reshard, partition_of, partition_to_bytes,
-    partitioned_instances, shard_model_id, slice_partition,
+    TENANT_HEADER, PartitionSlice, ShardPartition, default_owners,
+    load_partition, load_plan, merge_reshard, partition_of,
+    partition_to_bytes, partitioned_instances, shard_model_id,
+    slice_partition,
 )
 from pio_tpu.utils.durable import ModelIntegrityError
 from pio_tpu.utils.time import format_time, utcnow
@@ -110,6 +111,12 @@ class ShardConfig:
     # blob for its topology yet — it boots empty and waits for staged
     # slices instead of failing resolution
     join_reshard: bool = False
+    # multi-tenant fleet (serving_fleet/tenancy.py): the tenant triple
+    # this shard serves. Non-empty makes the scoring/fold-in/rollout
+    # routes VALIDATE the X-Pio-Tenant header against it (421 on
+    # mismatch — a mis-routed tenant RPC must fail loudly, never answer
+    # from the wrong tenant's partitions) and labels /metrics `tenant=`.
+    tenant: str = ""
 
 
 @dataclass
@@ -1112,6 +1119,22 @@ def build_shard_app(server: ShardServer) -> HttpApp:
             rpcwire.encode_topk_response(items, gidx, scores),
             rpcwire.RPC_CONTENT_TYPE)
 
+    def _tenant_mismatch(req: Request):
+        """The shard half of the X-Pio-Tenant contract: a request that
+        NAMES a tenant must name THIS shard's tenant. In a multi-tenant
+        pool the host mux routes on the header before this app ever
+        sees the request, so a mismatch landing here means the caller's
+        placement state is stale or corrupt — 421 (Misdirected Request)
+        fails it loudly instead of answering from the wrong tenant's
+        partitions. Headerless requests (single-tenant fleets,
+        pre-tenant routers) pass untouched."""
+        named = req.header(TENANT_HEADER.lower())
+        if named and config.tenant and named != config.tenant:
+            return 421, {
+                "message": f"tenant-mismatch: this shard serves "
+                           f"{config.tenant!r}, not {named!r}"}
+        return None
+
     def _plan_version_of(req: Request) -> int | None:
         """The topology a scoring RPC addresses (X-Pio-Plan-Version,
         sent by reshard-aware routers mid-cutover). Absent/garbled =
@@ -1167,6 +1190,8 @@ def build_shard_app(server: ShardServer) -> HttpApp:
             applied = server.foldin_applied_users
             codec_counts = dict(server.rpc_codec_counts)
         labels = {"surface": "shard", "shard": str(config.shard_index)}
+        if config.tenant:
+            labels["tenant"] = config.tenant
         counters = {
             "partition_bytes": float(part.nbytes() if part else 0),
             "foldin_applied_users_total": float(applied),
@@ -1192,6 +1217,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
 
     @app.route("POST", r"/shard/user_row")
     def shard_user_row(req: Request):
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
         body = req.json()
         if not isinstance(body, dict) or "user" not in body:
             return 400, {"message": "body must be {\"user\": id}"}
@@ -1225,6 +1253,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
 
     @app.route("POST", r"/shard/topk")
     def shard_topk(req: Request):
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
         if _media_type(req, "content-type") == rpcwire.RPC_CONTENT_TYPE:
             # binary request body: the query user's f32 row rides the
             # frame verbatim (the router only sends it after this
@@ -1265,6 +1296,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
 
     @app.route("POST", r"/shard/item_rows")
     def shard_item_rows(req: Request):
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
         body = req.json()
         if not isinstance(body, dict) or not isinstance(
                 body.get("items"), list):
@@ -1302,6 +1336,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         """Guarded rollout: load the candidate instance's recorded
         partition alongside the active one. Server-key guarded — it
         stages a model for production traffic."""
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
         body = req.json()
@@ -1319,6 +1356,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
 
     @app.route("POST", r"/shard/promote_candidate")
     def shard_promote_candidate(req: Request):
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
         try:
@@ -1334,6 +1374,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
 
     @app.route("POST", r"/shard/drop_candidate")
     def shard_drop_candidate(req: Request):
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
         server.drop_candidate()
@@ -1343,6 +1386,9 @@ def build_shard_app(server: ShardServer) -> HttpApp:
     def shard_upsert_users(req: Request):
         """Streaming fold-in apply (pio_tpu/freshness/). Guarded like
         /reload — it mutates the serving partition."""
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
         body = req.json()

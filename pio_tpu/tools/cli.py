@@ -165,6 +165,120 @@ def cmd_status(args) -> int:
     return 0
 
 
+def _doctor_fleet_tenants(args, fleet: dict, router_url: str) -> int:
+    """`pio doctor --fleet` against a MULTI-TENANT router: one row per
+    tenant — placement (instance, bytes, per-shard spread), quota
+    consumption (admitted/shed/inflight), and per-tenant shard health.
+    A tenant is AFFECTED (exit 1) when any of its shard groups has zero
+    routable replicas or a shard serves a different instance than the
+    placement recorded (last-good degradation after a corrupt blob).
+    `--tenant KEY` scopes the exit code to that one tenant, so a page
+    about tenant A does not fail a check run on healthy tenant B."""
+    from pio_tpu.utils.httpclient import JsonHttpClient
+
+    tenants = fleet.get("tenants", {})
+    if args.tenant and args.tenant not in tenants:
+        return _fail(f"tenant {args.tenant!r} is not on fleet "
+                     f"{fleet.get('fleet')!r} "
+                     f"(tenants: {sorted(tenants)})")
+    rows = []
+    for key, t in sorted(tenants.items()):
+        placement = t.get("placement") or {}
+        status = t.get("status") or {}
+        quota = t.get("quota") or {}
+        shards = status.get("shards", {})
+        routable = sum(1 for g in shards.values() if g.get("ok"))
+        # the router prober fills engineInstanceId asynchronously; probe
+        # each replica ourselves (tenant-stamped) so doctor is accurate
+        # even right after deploy
+        served = set()
+        for g in shards.values():
+            for rep in g.get("replicas", ()):
+                iid = rep.get("engineInstanceId")
+                if not iid and rep.get("url"):
+                    try:
+                        info = JsonHttpClient(
+                            rep["url"], timeout=args.timeout,
+                        ).request("GET", "/shard/info",
+                                  headers={"X-Pio-Tenant": key})
+                        iid = info.get("engineInstanceId")
+                    except Exception:
+                        pass
+                if iid:
+                    served.add(str(iid))
+        served = sorted(served)
+        placed = placement.get("instanceId")
+        last_good = bool(served and placed
+                         and any(s != str(placed) for s in served))
+        affected = routable < len(shards) or last_good
+        rows.append({
+            "tenant": key,
+            "instanceId": placed,
+            "servedInstances": served,
+            "lastGoodFallback": last_good,
+            "shardsRoutable": f"{routable}/{len(shards)}",
+            "partitionBytes": placement.get("partitionBytes"),
+            "shardBytes": placement.get("shardBytes"),
+            "quotaQps": quota.get("quotaQps"),
+            "admitted": quota.get("admitted"),
+            "shed": quota.get("shedTotal"),
+            "inflight": quota.get("inflight"),
+            "instanceSkew": status.get("instanceSkew", False),
+            "degradedResponses": status.get("degradedResponses", 0),
+            "affected": affected,
+        })
+    if args.tenant:
+        exit_code = int(any(r["affected"] for r in rows
+                            if r["tenant"] == args.tenant))
+    else:
+        exit_code = int(any(r["affected"] for r in rows))
+    if args.json:
+        print(json.dumps({
+            "router": router_url,
+            "fleet": fleet.get("fleet"),
+            "multiTenant": True,
+            "nShards": fleet.get("nShards"),
+            "nReplicas": fleet.get("nReplicas"),
+            "memoryBudgetBytes": fleet.get("memoryBudgetBytes"),
+            "shardLoads": fleet.get("shardLoads"),
+            "tenants": rows,
+        }, indent=2))
+        return exit_code
+    print(f"multi-tenant fleet {fleet.get('fleet')!r} at {router_url}: "
+          f"{len(rows)} tenant(s) on {fleet.get('nShards')} shards x "
+          f"{fleet.get('nReplicas')} replicas")
+    print(f"  pool loads (bytes/shard): {fleet.get('shardLoads')}"
+          + (f"  budget: {fleet.get('memoryBudgetBytes')}"
+             if fleet.get("memoryBudgetBytes") else ""))
+    print(f"{'tenant':<28} {'instance':<12} {'shards':>6} "
+          f"{'bytes':>10} {'quota':>7} {'admitted':>8} {'shed':>6} "
+          "state")
+    for r in rows:
+        qps = r["quotaQps"]
+        state = []
+        if r["lastGoodFallback"]:
+            state.append(f"LAST-GOOD (serving {r['servedInstances']})")
+        if r["shardsRoutable"].split("/")[0] == "0":
+            state.append("DOWN")
+        elif r["affected"] and not r["lastGoodFallback"]:
+            state.append("DEGRADED")
+        if r["instanceSkew"]:
+            state.append("skew")
+        if r["degradedResponses"]:
+            state.append(f"degraded={r['degradedResponses']}")
+        print(f"{r['tenant']:<28} {str(r['instanceId']):<12} "
+              f"{r['shardsRoutable']:>6} "
+              f"{r['partitionBytes'] or 0:>10} "
+              f"{'-' if not qps else f'{qps:g}/s':>7} "
+              f"{r['admitted'] or 0:>8} {r['shed'] or 0:>6} "
+              f"{' '.join(state) or 'ok'}")
+    affected = [r["tenant"] for r in rows if r["affected"]]
+    if affected:
+        print(f"[WARN] affected tenant(s): {', '.join(affected)} — "
+              "co-resident tenants above report ok and keep serving")
+    return exit_code
+
+
 def _doctor_fleet(args) -> int:
     """`pio doctor --fleet`: one table over the whole serving fleet —
     shard plan, every shard/replica's /healthz + /readyz + serving
@@ -180,6 +294,8 @@ def _doctor_fleet(args) -> int:
     except HttpClientError as e:
         return _fail(f"fleet router at {router_url} unreachable: "
                      f"{e.message}")
+    if fleet.get("multiTenant"):
+        return _doctor_fleet_tenants(args, fleet, router_url)
     plan = fleet.get("plan", {})
     rollout = fleet.get("rollout")
     rows = []
@@ -1152,6 +1268,14 @@ def cmd_deploy(args) -> int:
                          "instance — run `pio train --from-eval` "
                          "first, then canary that instance")
         return _deploy_canary_cmd(args)
+    if args.fleet:
+        # multi-tenant pool boot: everything comes from the recorded
+        # FleetPlan (tenants, packing, pool shape) — no engine dir
+        if args.fleet_join:
+            return _fail("--fleet boots a pool from its recorded plan; "
+                         "--fleet-join adds THIS engine to a plan — "
+                         "run them as separate commands")
+        return _deploy_fleet_pool_cmd(args)
     variant = _load_variant(args.engine_dir)
     engine, ep = _engine_from_variant(variant, args.engine_dir)
     engine_id, engine_version, engine_variant = _engine_ids(
@@ -1168,6 +1292,9 @@ def cmd_deploy(args) -> int:
         ep, eval_id = _apply_from_eval(engine, ep, storage,
                                        args.from_eval)
         print(f"Deploying with best params from evaluation {eval_id}")
+    if args.fleet_join:
+        return _deploy_fleet_join_cmd(args, storage, engine_id,
+                                      engine_version, engine_variant)
     if args.shards > 0:
         # fleet path: partition the persisted model at deploy time, boot
         # N x R shard servers + the router front-end (serving_fleet/)
@@ -1259,6 +1386,108 @@ def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
           f"({args.shards} shards x {args.replicas} replicas)")
     for s, urls in enumerate(handle.endpoints):
         print(f"  shard {s}: {' '.join(urls)}")
+    import threading
+
+    def watch_stop():
+        handle.router._stop_requested.wait()
+        handle.router_http.stop()
+
+    # pio: lint-ok[context-loss] deliberate detach: shutdown watcher
+    # waits for /stop for the process lifetime; no request context
+    threading.Thread(target=watch_stop, daemon=True).start()
+    try:
+        handle.wait()
+    except KeyboardInterrupt:
+        pass
+    handle.close()
+    print("Fleet stopped.")
+    return 0
+
+
+def _deploy_fleet_join_cmd(args, storage, engine_id: str,
+                           engine_version: str,
+                           engine_variant: str) -> int:
+    """`pio deploy --fleet-join NAME`: pack THIS engine's partitions
+    into the named pool's remaining capacity (residents never move),
+    persist the placement, and — when a multi-tenant router is already
+    running at --ip/--port — fan the live attach so the tenant starts
+    serving with zero pool downtime (docs/serving.md "Multi-tenant
+    fleet")."""
+    from pio_tpu.serving_fleet.tenancy import (
+        FleetCapacityError, TenantSpec, join_fleet_plan,
+    )
+    from pio_tpu.utils.httpclient import JsonHttpClient
+
+    spec = TenantSpec(
+        engine_id=engine_id, engine_version=engine_version,
+        engine_variant=engine_variant,
+        instance_id=args.engine_instance_id or "",
+        quota_qps=args.tenant_quota_qps,
+        quota_burst=args.tenant_quota_burst,
+        weight=args.tenant_weight,
+        max_concurrency=args.tenant_max_concurrency,
+    )
+    try:
+        plan, placement = join_fleet_plan(
+            storage, args.fleet_join, spec,
+            n_shards=args.shards if args.shards > 0 else 2,
+            n_replicas=args.replicas,
+            memory_budget_bytes=args.shard_memory_budget_mb
+            * 1024 * 1024,
+        )
+    except FleetCapacityError as e:
+        return _fail(str(e))
+    except ValueError as e:
+        return _fail(f"fleet join failed: {e}")
+    print(f"Tenant {spec.key} joined fleet {plan.name!r}: instance "
+          f"{placement.instance_id}, {placement.total_bytes()} bytes "
+          f"over shard(s) {sorted(set(placement.owners))} "
+          f"(pool loads: {plan.shard_loads()})")
+    # best-effort live attach: a pool that is not running yet is fine —
+    # the recorded placement serves on the next `pio deploy --fleet`
+    ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+    key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
+    try:
+        out = JsonHttpClient(f"http://{ip}:{args.port}",
+                             timeout=30).request(
+            "POST", "/fleet/attach_tenant", {"tenant": spec.key},
+            params={"accessKey": key} if key else None)
+        print(f"live attach: {json.dumps(out)}")
+    except Exception as e:  # noqa: BLE001 - attach is best-effort
+        print(f"no live router attached at http://{ip}:{args.port} "
+              f"({e}); placement is recorded — `pio deploy --fleet "
+              f"{plan.name}` serves it")
+    return 0
+
+
+def _deploy_fleet_pool_cmd(args) -> int:
+    """`pio deploy --fleet NAME`: boot the whole multi-tenant pool —
+    tenant-mux shard hosts + the multi-tenant router — from the
+    recorded FleetPlan."""
+    from pio_tpu.serving_fleet.tenancy import deploy_multi_fleet
+
+    storage = get_storage()
+    ip = args.ip if args.ip != "0.0.0.0" else "127.0.0.1"
+    try:
+        handle = deploy_multi_fleet(
+            storage, name=args.fleet, ip=ip, router_port=args.port,
+            server_key=args.server_key
+            or os.environ.get("PIO_SERVER_KEY", ""),
+            router_backend=args.server_backend,
+        )
+    except ValueError as e:
+        return _fail(str(e))
+    plan = handle.fleet_plan
+    print(f"Multi-tenant fleet {plan.name!r} on "
+          f"http://{ip}:{handle.router_http.port} "
+          f"({plan.n_shards} shards x {plan.n_replicas} replicas, "
+          f"{len(plan.tenants)} tenants)")
+    for t in plan.tenants:
+        print(f"  tenant {t.tenant}: instance {t.instance_id}, "
+              f"{t.total_bytes()} bytes over shard(s) "
+              f"{sorted(set(t.owners))}")
+    for s, urls in enumerate(handle.endpoints):
+        print(f"  shard host {s}: {' '.join(urls)}")
     import threading
 
     def watch_stop():
@@ -1617,10 +1846,34 @@ def cmd_batchpredict(args) -> int:
 def cmd_undeploy(args) -> int:
     """POST /stop to a running deploy server (reference Console.undeploy).
     Rides utils/httpclient like every other outbound call (the obs:
-    raw-http contract — raw urllib would drop trace/deadline context)."""
+    raw-http contract — raw urllib would drop trace/deadline context).
+    With --tenant: remove ONE tenant from a multi-tenant fleet (plan
+    record + best-effort live detach) and leave the pool serving the
+    rest."""
     from pio_tpu.utils.httpclient import JsonHttpClient
 
     key = args.server_key or os.environ.get("PIO_SERVER_KEY", "")
+    if args.tenant:
+        from pio_tpu.serving_fleet.tenancy import remove_tenant
+
+        try:
+            plan = remove_tenant(get_storage(), args.fleet, args.tenant)
+        except ValueError as e:
+            return _fail(str(e))
+        print(f"Tenant {args.tenant} removed from fleet {plan.name!r} "
+              f"({len(plan.tenants)} tenant(s) remain)")
+        try:
+            out = JsonHttpClient(f"http://{args.ip}:{args.port}",
+                                 timeout=30).request(
+                "POST", "/fleet/detach_tenant",
+                {"tenant": args.tenant},
+                params={"accessKey": key} if key else None)
+            print(f"live detach: {json.dumps(out)}")
+        except Exception as e:  # noqa: BLE001 - detach is best-effort
+            print(f"no live router detached at "
+                  f"http://{args.ip}:{args.port} ({e}); the plan "
+                  f"record is updated")
+        return 0
     try:
         out = JsonHttpClient(f"http://{args.ip}:{args.port}",
                              timeout=10).request(
@@ -2023,6 +2276,11 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--staleness-budget", type=float, default=60.0,
                    help="fold-in staleness warn threshold (seconds) for "
                         "--fleet's per-group lag column")
+    x.add_argument("--tenant", default="", metavar="KEY",
+                   help="with --fleet against a multi-tenant router: "
+                        "scope the exit code to this tenant — a page "
+                        "about a noisy/broken co-tenant must not fail "
+                        "a healthy tenant's check run")
     x.set_defaults(fn=cmd_doctor)
 
     x = sub.add_parser("run")
@@ -2226,6 +2484,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "`pio eval --sweep` persisted (single-host "
                         "mode; pair with `pio train --from-eval` so "
                         "the served instance was trained with them)")
+    x.add_argument("--fleet", default="", metavar="NAME",
+                   help="boot a MULTI-TENANT pool from the named "
+                        "recorded FleetPlan (tenant-mux shard hosts + "
+                        "multi-tenant router; no engine dir needed) — "
+                        "join tenants first with --fleet-join "
+                        "(docs/serving.md \"Multi-tenant fleet\")")
+    x.add_argument("--fleet-join", default="", metavar="NAME",
+                   help="bin-pack THIS engine's partitions into the "
+                        "named fleet's remaining capacity (resident "
+                        "tenants never move), record the placement, "
+                        "and live-attach to a running router at "
+                        "--ip/--port when one answers; pool shape for "
+                        "a NEW fleet comes from --shards/--replicas/"
+                        "--shard-memory-budget-mb")
+    x.add_argument("--tenant-quota-qps", type=float, default=0.0,
+                   help="with --fleet-join: this tenant's admitted "
+                        "query rate; floods past it answer per-tenant "
+                        "429 + Retry-After while co-tenants keep their "
+                        "p99. 0 = unlimited")
+    x.add_argument("--tenant-quota-burst", type=float, default=0.0,
+                   help="with --fleet-join: token-bucket burst "
+                        "capacity; 0 = max(rate, 1)")
+    x.add_argument("--tenant-weight", type=float, default=1.0,
+                   help="with --fleet-join: weighted-fair share under "
+                        "admission pressure")
+    x.add_argument("--tenant-max-concurrency", type=int, default=0,
+                   help="with --fleet-join: cap on this tenant's "
+                        "in-flight queries; 0 = unlimited")
     x.set_defaults(fn=cmd_deploy)
 
     for verb, fn, descr in (
@@ -2385,6 +2671,13 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--ip", default="127.0.0.1")
     x.add_argument("--port", type=int, default=8000)
     x.add_argument("--server-key")
+    x.add_argument("--tenant", default="", metavar="KEY",
+                   help="remove ONE tenant (engine triple key, e.g. "
+                        "rec/1/default) from a multi-tenant fleet: "
+                        "plan record + best-effort live detach at "
+                        "--ip/--port; the pool keeps serving the rest")
+    x.add_argument("--fleet", default="default", metavar="NAME",
+                   help="with --tenant: the fleet plan to update")
     x.set_defaults(fn=cmd_undeploy)
 
     x = sub.add_parser("eventserver")

@@ -338,6 +338,51 @@ def test_route_unguarded_fires_and_guard_silences(tmp_path):
     assert not any("/rollout/abort" in x.message for x in report.findings)
 
 
+def test_tenant_header_contract_both_sides(tmp_path):
+    # a tenant-scoped shard route whose serving module never mentions
+    # TENANT_HEADER, and a client module calling it equally unaware:
+    # both halves of the X-Pio-Tenant contract fire
+    report = deep(tmp_path, {
+        "mod_srv.py": """
+            def build(app):
+                @app.route("POST", r"/shard/topk")
+                def shard_topk(req):
+                    return 200, {}
+        """,
+        "mod_cli.py": """
+            def score(client, body):
+                client.request("POST", "/shard/topk", body)
+        """,
+    })
+    hits = [f for f in report.findings if f.rule == "tenant-header"]
+    assert len(hits) == 2
+    assert any("cannot validate" in f.message for f in hits)
+    assert any("arrives unlabeled" in f.message for f in hits)
+
+
+def test_tenant_header_constant_silences(tmp_path):
+    report = deep(tmp_path, {
+        "mod_srv.py": """
+            TENANT_HEADER = "X-Pio-Tenant"
+
+            def build(app):
+                @app.route("POST", r"/shard/topk")
+                def shard_topk(req):
+                    if not req.header(TENANT_HEADER.lower()):
+                        return 421, {}
+                    return 200, {}
+        """,
+        "mod_cli.py": """
+            from mod_srv import TENANT_HEADER
+
+            def score(client, body):
+                client.request("POST", "/shard/topk", body,
+                               headers={TENANT_HEADER: "a/1/default"})
+        """,
+    })
+    assert "tenant-header" not in rules_of(report)
+
+
 def test_wire_negotiation_asymmetry(tmp_path):
     report = deep(tmp_path, {
         "mod_wire.py": 'RPC_CONTENT_TYPE = "application/x-pio-topk"\n',

@@ -1,0 +1,587 @@
+"""Multi-tenant serving fleet tests (pio_tpu/serving_fleet/tenancy.py):
+
+  * bin-packer properties: disjoint cover, per-shard budget never
+    exceeded, byte-identical plans across runs, clean error over
+    capacity, incremental join never moves residents,
+  * FleetPlan persistence roundtrip,
+  * the CI isolation drill — >= 2 tenants on a 2-shard pool:
+      (a) flooding tenant A at 10x quota answers per-tenant 429 +
+          Retry-After while tenant B stays zero-5xx and BIT-identical
+          to its solo-fleet oracle,
+      (b) tenant-scoped chaos / a corrupt blob degrades only the
+          targeted tenant (last-good fallback),
+      (c) `pio doctor --fleet` prints the per-tenant table and exits 1
+          only for the affected tenant,
+  * X-Pio-Tenant header contract (421 on mismatch, 404 on unknown),
+  * reshard-of-multi-tenant-plan refusal (409),
+  * event-server per-app ingest quotas (429 + pio_ingest_shed_total).
+"""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from pio_tpu.controller import EngineParams
+from pio_tpu.data import DataMap, Event
+from pio_tpu.data.dao import AccessKey, App, Model
+from pio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+from pio_tpu.resilience import chaos
+from pio_tpu.serving_fleet.plan import N_PARTITIONS, shard_model_id
+from pio_tpu.serving_fleet.tenancy import (
+    FleetCapacityError,
+    FleetPlan,
+    TenantPlacement,
+    TenantSpec,
+    deploy_multi_fleet,
+    join_fleet_plan,
+    load_fleet_plan,
+    pack_partitions,
+    remove_tenant,
+    tenant_key,
+    tenant_label,
+)
+from pio_tpu.workflow.context import create_workflow_context
+from pio_tpu.workflow.train import load_models, run_train
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def seed_and_train(storage, app_name, engine_id, users=20, items=12,
+                   seed=0, n_iter=3):
+    app_id = storage.get_metadata_apps().insert(App(0, app_name))
+    ev = storage.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(seed)
+    m = 0
+    for u in range(users):
+        for i in range(items):
+            match = (u % 2) == (i % 2)
+            if rng.random() < (0.8 if match else 0.1):
+                ev.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5 if match else 1}),
+                    event_time=T0 + timedelta(minutes=m)), app_id)
+                m += 1
+    engine = RecommendationEngine.apply()
+    ep = EngineParams(
+        datasource=("", DataSourceParams(app_name=app_name)),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=4, num_iterations=n_iter, lambda_=0.05, chunk=1024))],
+    )
+    ctx = create_workflow_context(storage, use_mesh=False)
+    iid = run_train(engine, ep, storage, engine_id=engine_id, ctx=ctx)
+    return engine, ep, ctx, iid
+
+
+def call(port, method, path, body=None, headers=None, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode()), \
+                dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        payload = e.read().decode()
+        return e.code, (json.loads(payload) if payload else {}), \
+            dict(e.headers)
+
+
+@pytest.fixture()
+def two_tenants(memory_storage):
+    """Two independently trained engines joined onto one 2-shard pool
+    (tenant A quota-capped, tenant B unlimited), plus each tenant's
+    single-host oracle callable."""
+    storage = memory_storage
+    ea, epa, ctxa, iida = seed_and_train(storage, "appa", "rec")
+    eb, epb, ctxb, iidb = seed_and_train(storage, "appb", "recb",
+                                         users=16, items=10, seed=3)
+    join_fleet_plan(storage, "pool",
+                    TenantSpec("rec", quota_qps=5.0, quota_burst=5.0),
+                    n_shards=2, n_replicas=1)
+    join_fleet_plan(storage, "pool", TenantSpec("recb"),
+                    n_shards=2, n_replicas=1)
+
+    def oracle(engine, ep, ctx, iid):
+        algo = engine._doers(ep)[2][0]
+        full = load_models(storage, engine, ep, iid, ctx=ctx)[0]
+        return lambda q: algo.predict(full, dict(q))
+
+    return {
+        "storage": storage,
+        "a": {"key": tenant_key("rec"), "iid": iida,
+              "oracle": oracle(ea, epa, ctxa, iida),
+              "engine": (ea, epa, ctxa)},
+        "b": {"key": tenant_key("recb"), "iid": iidb,
+              "oracle": oracle(eb, epb, ctxb, iidb)},
+    }
+
+
+# -- bin packer ---------------------------------------------------------------
+
+def _sizes(rng, lo=100, hi=5000):
+    return [int(rng.integers(lo, hi)) for _ in range(N_PARTITIONS)]
+
+
+def test_pack_disjoint_cover_under_budget():
+    rng = np.random.default_rng(42)
+    tenants = {f"t{i}/1/default": _sizes(rng) for i in range(5)}
+    budget = 120_000
+    owners = pack_partitions(tenants, 4, budget)
+    loads = [0] * 4
+    for t, sizes in tenants.items():
+        # every partition placed exactly once, on a real shard
+        assert len(owners[t]) == N_PARTITIONS
+        assert all(0 <= s < 4 for s in owners[t])
+        for p, s in enumerate(owners[t]):
+            loads[s] += sizes[p]
+    assert all(b <= budget for b in loads), loads
+
+
+def test_pack_deterministic():
+    rng = np.random.default_rng(7)
+    tenants = {f"t{i}/1/default": _sizes(rng) for i in range(3)}
+    assert pack_partitions(tenants, 3, 100_000) == \
+        pack_partitions(tenants, 3, 100_000)
+    # insertion order of the dict must not matter either
+    rev = dict(reversed(list(tenants.items())))
+    assert pack_partitions(tenants, 3, 100_000) == \
+        pack_partitions(rev, 3, 100_000)
+
+
+def test_pack_rejects_over_capacity():
+    with pytest.raises(FleetCapacityError) as ei:
+        pack_partitions({"big/1/default": [1000] * N_PARTITIONS}, 2,
+                        memory_budget_bytes=2000)
+    msg = str(ei.value)
+    assert "budget" in msg and "big/1/default" in msg
+
+
+def test_pack_incremental_join_respects_base_loads():
+    rng = np.random.default_rng(9)
+    resident = {"r/1/default": _sizes(rng)}
+    budget = 60_000
+    first = pack_partitions(resident, 2, budget)
+    base = [0, 0]
+    for p, s in enumerate(first["r/1/default"]):
+        base[s] += resident["r/1/default"][p]
+    joiner = {"j/1/default": _sizes(rng, lo=10, hi=500)}
+    second = pack_partitions(joiner, 2, budget, base_loads=base)
+    total = list(base)
+    for p, s in enumerate(second["j/1/default"]):
+        total[s] += joiner["j/1/default"][p]
+    assert all(b <= budget for b in total)
+    # the resident's placement was an INPUT, not re-decided
+    assert pack_partitions(resident, 2, budget) == first
+
+
+def test_fleet_plan_roundtrip():
+    plan = FleetPlan(
+        name="pool", n_shards=2, n_replicas=2,
+        memory_budget_bytes=1 << 20,
+        tenants=(TenantPlacement(
+            tenant="rec/1/default", engine_id="rec", engine_version="1",
+            engine_variant="default", instance_id="i42",
+            owners=tuple(p % 2 for p in range(N_PARTITIONS)),
+            partition_bytes=tuple(range(N_PARTITIONS)),
+            quota_qps=5.0, weight=2.0, max_concurrency=8),))
+    assert FleetPlan.from_json(plan.to_json()) == plan
+
+
+# -- plan build / join / remove over real storage -----------------------------
+
+def test_join_records_plan_and_artifacts(two_tenants):
+    storage = two_tenants["storage"]
+    plan = load_fleet_plan(storage, "pool")
+    assert plan is not None and len(plan.tenants) == 2
+    assert [t.tenant for t in plan.tenants] == sorted(
+        [two_tenants["a"]["key"], two_tenants["b"]["key"]])
+    models = storage.get_model_data_models()
+    for t in plan.tenants:
+        # per-tenant ShardPlan carries the PACKED owners map
+        from pio_tpu.serving_fleet.plan import load_plan
+
+        sp = load_plan(storage, t.instance_id)
+        assert sp is not None
+        assert sp.owners == t.owners
+        assert len(t.owners) == N_PARTITIONS
+        # every owning shard has its blob
+        for s in sorted(set(t.owners)):
+            assert models.get(shard_model_id(t.instance_id, s))
+    # budget zero = balancing only, but loads must still be recorded
+    assert sum(plan.shard_loads()) == sum(
+        t.total_bytes() for t in plan.tenants)
+
+
+def test_remove_tenant_keeps_others(two_tenants):
+    storage = two_tenants["storage"]
+    plan = remove_tenant(storage, "pool", two_tenants["a"]["key"])
+    assert [t.tenant for t in plan.tenants] == [two_tenants["b"]["key"]]
+    with pytest.raises(ValueError, match="not on fleet"):
+        remove_tenant(storage, "pool", two_tenants["a"]["key"])
+
+
+def test_join_over_capacity_fails_loudly(memory_storage):
+    seed_and_train(memory_storage, "appa", "rec")
+    with pytest.raises(FleetCapacityError):
+        join_fleet_plan(memory_storage, "tiny", TenantSpec("rec"),
+                        n_shards=2, n_replicas=1,
+                        memory_budget_bytes=64)
+    # a failed join records nothing
+    assert load_fleet_plan(memory_storage, "tiny") is None
+
+
+# -- serving: bit-parity, tenant resolution, header contract ------------------
+
+def test_multi_tenant_serving_bit_identical(two_tenants):
+    handle = deploy_multi_fleet(two_tenants["storage"], "pool")
+    try:
+        port = handle.router_http.port
+        for tkey in ("a", "b"):
+            t = two_tenants[tkey]
+            for q in ({"user": "u0", "num": 4},
+                      {"user": "u3", "num": 6, "blackList": ["i1"]},
+                      {"user": "ghost", "num": 3}):
+                s, body, _ = call(port, "POST", "/queries.json",
+                                  body=dict(q), tenant=t["key"])
+                assert s == 200, (tkey, q, body)
+                assert body == t["oracle"](q), (tkey, q)
+            # the header route works the same as ?tenant=
+            s, body, _ = call(port, "POST", "/queries.json",
+                              body={"user": "u0", "num": 4},
+                              headers={"X-Pio-Tenant": t["key"]})
+            assert s == 200
+            assert body == t["oracle"]({"user": "u0", "num": 4})
+    finally:
+        handle.close()
+
+
+def test_tenant_resolution_errors(two_tenants):
+    handle = deploy_multi_fleet(two_tenants["storage"], "pool")
+    try:
+        port = handle.router_http.port
+        # two tenants + no tenant named -> 400 listing the options
+        s, body, _ = call(port, "POST", "/queries.json",
+                          body={"user": "u0", "num": 3})
+        assert s == 400 and "X-Pio-Tenant" in body["message"]
+        # unknown tenant -> 404, loud
+        s, body, _ = call(port, "POST", "/queries.json",
+                          body={"user": "u0", "num": 3},
+                          tenant="nope/1/default")
+        assert s == 404 and "tenant-unknown" in body["message"]
+        # shard hosts refuse unplaced tenants the same way
+        host_port = handle.hosts[0][0].port
+        s, body, _ = call(host_port, "POST", "/shard/topk",
+                          body={"userRow": [0, 0, 0, 0], "k": 2},
+                          headers={"X-Pio-Tenant": "nope/1/default"})
+        assert s == 404 and "tenant-unknown" in body["message"]
+    finally:
+        handle.close()
+
+
+def test_shard_validates_tenant_header_421(two_tenants):
+    """The shard side of the header contract, without the mux: a
+    single-tenant ShardServer configured for tenant A answers 421
+    Misdirected Request to an RPC stamped for tenant B."""
+    from pio_tpu.serving_fleet.shard import ShardConfig, create_shard_server
+
+    storage = two_tenants["storage"]
+    a = two_tenants["a"]
+    http, _srv = create_shard_server(storage, ShardConfig(
+        shard_index=0, n_shards=2, engine_id="rec",
+        instance_id=a["iid"], tenant=a["key"]))
+    http.start()
+    try:
+        s, body, _ = call(http.port, "POST", "/shard/user_row",
+                          body={"user": "u0"},
+                          headers={"X-Pio-Tenant": "recb/1/default"})
+        assert s == 421 and "tenant-mismatch" in body["message"]
+        # the right tenant (or a headerless single-tenant call) passes
+        s, _, _ = call(http.port, "POST", "/shard/user_row",
+                       body={"user": "u0"},
+                       headers={"X-Pio-Tenant": a["key"]})
+        assert s == 200
+    finally:
+        http.stop()
+
+
+def test_reshard_refused_on_multi_tenant_plan(two_tenants):
+    handle = deploy_multi_fleet(two_tenants["storage"], "pool")
+    try:
+        port = handle.router_http.port
+        s, body, _ = call(port, "POST", "/reshard/begin",
+                          body={"shards": 3})
+        assert s == 409 and "not supported in v1" in body["message"]
+        s, body, _ = call(port, "GET", "/reshard/status")
+        assert s == 200 and body == {"inFlight": False,
+                                     "multiTenant": True}
+    finally:
+        handle.close()
+
+
+# -- isolation drills ---------------------------------------------------------
+
+def test_flooding_tenant_sheds_alone_victim_exact(two_tenants):
+    """The acceptance drill (a): tenant A floods far past its 5 qps
+    quota — A gets per-tenant 429 + Retry-After; every interleaved
+    tenant-B query stays 200 and BIT-identical to B's solo oracle."""
+    handle = deploy_multi_fleet(two_tenants["storage"], "pool")
+    try:
+        port = handle.router_http.port
+        a, b = two_tenants["a"], two_tenants["b"]
+        q = {"user": "u1", "num": 3}
+        expect_b = b["oracle"](q)
+        statuses = []
+        for _ in range(50):   # burst 5 -> the tail of the flood sheds
+            s, body, hdrs = call(port, "POST", "/queries.json",
+                                 body=dict(q), tenant=a["key"])
+            statuses.append(s)
+            if s == 429:
+                assert "Retry-After" in hdrs
+                assert body["tenant"] == a["key"]
+                assert body["reason"] == "quota"
+            # victim checks interleaved WITH the flood in flight
+            s, vbody, _ = call(port, "POST", "/queries.json",
+                               body=dict(q), tenant=b["key"])
+            assert s == 200, vbody           # zero 5xx, zero 429
+            assert vbody == expect_b         # bit-identical under fire
+        assert statuses.count(429) >= 40, statuses  # ~10x over quota
+        assert statuses.count(200) >= 1
+        # the admission plane kept per-tenant books
+        snap = handle.router.admission.snapshot()
+        assert snap[a["key"]]["shed"]["quota"] >= 40
+        assert snap[b["key"]]["shedTotal"] == 0
+    finally:
+        handle.close()
+
+
+def test_tenant_scoped_chaos_degrades_only_target(two_tenants):
+    """The acceptance drill (b1): chaos against tenant A's RPC scope
+    (`fleet.<label>.*`) degrades A only; B answers exact, un-degraded,
+    zero 5xx."""
+    handle = deploy_multi_fleet(two_tenants["storage"], "pool")
+    try:
+        port = handle.router_http.port
+        a, b = two_tenants["a"], two_tenants["b"]
+        label = tenant_label(a["key"])
+        q = {"user": "u2", "num": 3}
+        with chaos.inject(f"fleet.{label}", error=1.0, seed=7) as monkey:
+            s, body, _ = call(port, "POST", "/queries.json",
+                              body=dict(q), tenant=a["key"])
+            assert s == 200 and body["degraded"] is True
+            s, vbody, _ = call(port, "POST", "/queries.json",
+                               body=dict(q), tenant=b["key"])
+            assert s == 200 and not vbody.get("degraded")
+            assert vbody == b["oracle"](q)
+            assert all(p.startswith(f"fleet.{label}.")
+                       for p in monkey.injected), monkey.injected
+        # A recovers once the chaos lifts (breakers were A's own)
+        import time
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            s, body, _ = call(port, "POST", "/queries.json",
+                              body=dict(q), tenant=a["key"])
+            if s == 200 and not body.get("degraded"):
+                break
+            time.sleep(0.2)
+        assert s == 200 and not body.get("degraded")
+    finally:
+        handle.close()
+
+
+def test_corrupt_blob_degrades_only_that_tenant(two_tenants, cli):
+    """Drills (b2) + (c): corrupt the latest blob of ONE tenant ->
+    that tenant falls back last-good on the affected shard; the
+    co-tenant stays exact; `pio doctor --fleet` reports the per-tenant
+    table and exits 1 only for the affected tenant."""
+    storage = two_tenants["storage"]
+    a, b = two_tenants["a"], two_tenants["b"]
+    ea, epa, ctxa = a["engine"]
+    # retrain tenant A and re-join: the plan now records iid2
+    iid2 = run_train(ea, epa, storage, engine_id="rec", ctx=ctxa)
+    join_fleet_plan(storage, "pool",
+                    TenantSpec("rec", quota_qps=5.0, quota_burst=5.0))
+    plan = load_fleet_plan(storage, "pool")
+    placed = plan.tenant(a["key"])
+    assert placed.instance_id == iid2
+    # corrupt iid2's blob on one of its owning shards (CRC32C mismatch)
+    shard = placed.owners[0]
+    models = storage.get_model_data_models()
+    blob = bytearray(models.get(shard_model_id(iid2, shard)).models)
+    blob[-1] ^= 0xFF
+    models.insert(Model(shard_model_id(iid2, shard), bytes(blob)))
+
+    handle = deploy_multi_fleet(storage, "pool")
+    try:
+        port = handle.router_http.port
+        # tenant A still serves (last-good on the corrupt shard)
+        s, body, _ = call(port, "POST", "/queries.json",
+                          body={"user": "u0", "num": 3}, tenant=a["key"])
+        assert s == 200 and body["itemScores"]
+        # tenant B untouched: exact
+        q = {"user": "u1", "num": 4}
+        s, vbody, _ = call(port, "POST", "/queries.json",
+                           body=dict(q), tenant=b["key"])
+        assert s == 200 and vbody == b["oracle"](q)
+        # the tenant's own host mux serves the old instance on that shard
+        host = handle.hosts[shard][1]
+        assert host.servers[a["key"]].partition.instance_id == a["iid"]
+
+        url = f"http://127.0.0.1:{port}"
+        # doctor: table printed, exit 1 (tenant A affected)
+        code, captured = cli("doctor", "--fleet", "--router-url", url)
+        assert code == 1
+        out = captured.out
+        assert "multi-tenant fleet" in out
+        assert "LAST-GOOD" in out
+        assert a["key"] in out and b["key"] in out
+        # scoped to the HEALTHY tenant: exit 0
+        code, captured = cli("doctor", "--fleet", "--router-url", url,
+                             "--tenant", b["key"], "--json")
+        assert code == 0
+        report = json.loads(captured.out)
+        by_key = {r["tenant"]: r for r in report["tenants"]}
+        assert by_key[a["key"]]["affected"] is True
+        assert by_key[a["key"]]["lastGoodFallback"] is True
+        assert by_key[b["key"]]["affected"] is False
+        assert by_key[a["key"]]["quotaQps"] == 5.0
+    finally:
+        handle.close()
+
+
+def test_detach_attach_tenant_live(two_tenants):
+    handle = deploy_multi_fleet(two_tenants["storage"], "pool")
+    try:
+        port = handle.router_http.port
+        b = two_tenants["b"]
+        s, out, _ = call(port, "POST", "/fleet/detach_tenant",
+                         body={"tenant": b["key"]})
+        assert s == 200 and all(h["ok"] for h in out["hosts"].values())
+        s, body, _ = call(port, "POST", "/queries.json",
+                          body={"user": "u0", "num": 3}, tenant=b["key"])
+        assert s == 404
+        # the other tenant never noticed
+        s, _, _ = call(port, "POST", "/queries.json",
+                       body={"user": "u0", "num": 3},
+                       tenant=two_tenants["a"]["key"])
+        assert s == 200
+        s, out, _ = call(port, "POST", "/fleet/attach_tenant",
+                         body={"tenant": b["key"]})
+        assert s == 200, out
+        q = {"user": "u0", "num": 3}
+        s, body, _ = call(port, "POST", "/queries.json",
+                          body=dict(q), tenant=b["key"])
+        assert s == 200 and body == b["oracle"](q)
+    finally:
+        handle.close()
+
+
+def test_metrics_carry_tenant_label(two_tenants):
+    handle = deploy_multi_fleet(two_tenants["storage"], "pool")
+    try:
+        port = handle.router_http.port
+        call(port, "POST", "/queries.json",
+             body={"user": "u0", "num": 3},
+             tenant=two_tenants["a"]["key"])
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+        label = f'tenant="{two_tenants["a"]["key"]}"'
+        assert "pio_tenant_requests_total" in text
+        assert label in text
+        # shard hosts label per-tenant partition bytes too
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{handle.hosts[0][0].port}/metrics",
+                timeout=10) as resp:
+            host_text = resp.read().decode()
+        assert "pio_tenant_partition_bytes" in host_text
+        assert label in host_text
+    finally:
+        handle.close()
+
+
+# -- event-server ingest quotas ----------------------------------------------
+
+RATE = {
+    "event": "rate", "entityType": "user", "entityId": "u1",
+    "targetEntityType": "item", "targetEntityId": "i1",
+    "properties": {"rating": 4},
+    "eventTime": "2026-01-01T00:00:00.000Z",
+}
+
+
+def test_ingest_quota_sheds_per_app(memory_storage):
+    from pio_tpu.server.eventserver import (
+        EventServerConfig, create_event_server,
+    )
+
+    apps = memory_storage.get_metadata_apps()
+    keys = memory_storage.get_metadata_access_keys()
+    ev = memory_storage.get_events()
+    ids = {}
+    for name, key in (("flooder", "FKEY"), ("victim", "VKEY")):
+        app_id = apps.insert(App(0, name))
+        keys.insert(AccessKey(key, app_id, ()))
+        ev.init(app_id)
+        ids[name] = app_id
+    srv = create_event_server(
+        memory_storage,
+        EventServerConfig(ip="127.0.0.1", port=0, metrics_key="MK",
+                          ingest_quota_qps=2.0, ingest_quota_burst=2.0),
+    ).start()
+    try:
+        statuses = []
+        for _ in range(20):
+            s, body, hdrs = call(srv.port, "POST", "/events.json",
+                                 body=dict(RATE), accessKey="FKEY")
+            statuses.append(s)
+            if s == 429:
+                assert "Retry-After" in hdrs
+                assert "ingest quota" in body["message"]
+            # the victim app ingests through the whole flood
+            s, _, _ = call(srv.port, "POST", "/events.json",
+                           body=dict(RATE), accessKey="VKEY")
+            assert s in (201, 429) or pytest.fail(s)
+        assert statuses.count(429) >= 10, statuses
+        assert statuses.count(201) >= 1
+        # wait: the victim shares the 2 qps DEFAULT?  No — buckets are
+        # per app: the victim has its own 2-token burst and the loop
+        # above may exhaust it too.  The *isolation* claim is the shed
+        # COUNTER attribution below, not victim 201s at equal quotas.
+        shed = srv.app.ingest_shed
+        assert shed.get(ids["flooder"], 0) >= 10
+        # per-app sheds are visible on /metrics as
+        # pio_ingest_shed_total{app=}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics?accessKey=MK",
+                timeout=10) as resp:
+            text = resp.read().decode()
+        assert "pio_ingest_shed_total" in text
+        assert f'app="{ids["flooder"]}"' in text
+        # GETs are never quota-gated: reads don't spend ingest tokens
+        s, _, _ = call(srv.port, "GET", "/events.json", accessKey="FKEY",
+                       limit=1)
+        assert s in (200, 404)
+    finally:
+        srv.stop()
+
+
+def test_tenant_key_label_shapes():
+    assert tenant_key("rec") == "rec/1/default"
+    assert tenant_label("rec/1/default") == "rec.1.default"
+    # labels must be chaos-spec safe: no :,;= delimiters, no slash
+    assert not set(tenant_label("a/2/x")) & set(":,;=/")
