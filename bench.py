@@ -979,6 +979,8 @@ def phase_smoke() -> dict:
     out["kernel_lab"] = _smoke_kernel_cell()
     out["sweep"] = _smoke_sweep_cell()
     out["sweep_8pt_x_2seq"] = out["sweep"]["x_2seq"]
+    out["retrieval"] = _smoke_retrieval_cell()
+    out["retrieval_p99_x_exact"] = out["retrieval"]["p99_x_exact"]
     return out
 
 
@@ -1148,6 +1150,98 @@ def _smoke_tracing_cell(http, qs) -> dict:
         "rep_overheads_x": [round(t[0] / t[1], 4) for t in reps
                             if t[1] > 0],
         "enabled": recorder is not None,
+    }
+
+
+def _smoke_retrieval_cell() -> dict:
+    """Two-stage retrieval cell (ISSUE 19 acceptance): p99 of the
+    clustered+int8 candidate tier vs the exact-f32 oracle einsum over
+    the SAME warm device-resident tables, arms measured moments apart
+    in one process (an HTTP hop would add an identical constant to
+    both arms and mask the tier under test — the contract here is the
+    scan itself). BASELINE.json `retrieval_p99_x_exact: 1.0` is an
+    ABSOLUTE ceiling, never refreshed by --update-baseline: a clustered
+    scan that loses to brute force has regressed into overhead.
+
+    Catalog: 128k items x rank 64, a 64-center mixture (items cluster —
+    the structure real catalogs have and k-means exists to exploit);
+    131k is the smallest catalog where the scan's win clears dispatch
+    overhead on a CPU CI box (measured: ratio ~0.31 at nprobe=16/512
+    clusters, ~0.88 at nprobe=32; below ~64k items brute force wins on
+    CPU and the whole tier should stay off — docs/performance.md).
+    recall@10 over 128 users is asserted >= 0.95 BEFORE any timing
+    counts and reported alongside, so the ratio can never be bought
+    with a recall regression."""
+    import numpy as np
+
+    from pio_tpu.ops import als
+    from pio_tpu.ops import retrieval as rt
+
+    n_items, rank, n_users = 131072, 64, 256
+    rng = np.random.default_rng(7)
+    centers = rng.normal(size=(64, rank)).astype(np.float32)
+    itf = (centers[rng.integers(0, 64, n_items)]
+           + 0.25 * rng.normal(size=(n_items, rank))).astype(np.float32)
+    uf = (centers[rng.integers(0, 64, n_users)]
+          + 0.25 * rng.normal(size=(n_users, rank))).astype(np.float32)
+    # nprobe=16 of 512 clusters: the cell pins a scan fraction (1/32)
+    # deep enough to show the win; serving defaults (nprobe=32) are
+    # tuned for recall on trained factors, not for this cell
+    params = rt.RetrievalParams(mode="clustered", dtype="int8",
+                                nprobe=16, rerank_k=512)
+    idx = rt.build_index(itf, params)
+    didx = rt.build_device_index(idx)
+    import jax
+
+    itf_dev = jax.device_put(itf)
+    model = als.ALSModel(jax.device_put(uf), itf_dev)
+
+    def exact_one(i: int):
+        _, ix = als.recommend_topk(model, np.array([i % n_users]), 10)
+        return np.asarray(ix)[0]
+
+    def clustered_one(i: int):
+        _, ix = rt.candidate_topk(didx, itf_dev, uf[i % n_users], 10)
+        return ix[0]
+
+    exact_one(0)
+    clustered_one(0)  # warm: both arms' jits compiled before timing
+    hits = 0
+    for i in range(128):
+        want = set(int(x) for x in exact_one(i))
+        got = set(int(x) for x in clustered_one(i) if x >= 0)
+        hits += len(want & got)
+    recall = hits / (128 * 10)
+    if recall < 0.95:
+        raise AssertionError(
+            f"retrieval cell recall@10 {recall:.3f} < 0.95 at "
+            f"nprobe={params.nprobe} — no timing is comparable when "
+            "the candidate tier drops the answers")
+
+    def p99(f) -> float:
+        lat = []
+        for i in range(100):
+            t0 = time.monotonic()
+            f(i)
+            lat.append(time.monotonic() - t0)
+        lat.sort()
+        return lat[max(0, int(len(lat) * 0.99) - 1)] * 1e3
+
+    # exact arm first ("measured moments earlier"), best-of-3 each
+    e99 = min(p99(exact_one) for _ in range(3))
+    c99 = min(p99(clustered_one) for _ in range(3))
+    qbytes = idx.nbytes()
+    fbytes = itf.nbytes
+    return {
+        "exact_p99_ms": round(e99, 3),
+        "clustered_p99_ms": round(c99, 3),
+        "p99_x_exact": round(c99 / e99, 4) if e99 > 0 else None,
+        "recall_at_10": round(recall, 4),
+        "n_items": n_items,
+        "nprobe": params.nprobe,
+        "quantized_bytes": qbytes,
+        "f32_bytes": fbytes,
+        "hbm_cut_x": round(fbytes / qbytes, 2) if qbytes else None,
     }
 
 
@@ -1970,6 +2064,21 @@ def smoke_main() -> int:
             base["sweep_8pt_x_2seq"],
             res["sweep_8pt_x_2seq"] is not None
             and res["sweep_8pt_x_2seq"] <= base["sweep_8pt_x_2seq"])
+    if "retrieval_p99_x_exact" in base:
+        # ISSUE 19 contract CEILING, absolute and never refreshed by
+        # --update-baseline: the clustered+int8 candidate tier's p99
+        # must beat the exact-f32 oracle einsum outright on the same
+        # warm device tables (128k-item mixture catalog, recall@10
+        # asserted >= 0.95 before timing so the ratio cannot be bought
+        # with dropped answers). A clustered scan slower than brute
+        # force is pure overhead — the regression class this gate
+        # exists to catch.
+        checks["retrieval_p99_x_exact"] = (
+            res["retrieval_p99_x_exact"],
+            base["retrieval_p99_x_exact"],
+            res["retrieval_p99_x_exact"] is not None
+            and res["retrieval_p99_x_exact"]
+            <= base["retrieval_p99_x_exact"])
     ok = all(passed for _, _, passed in checks.values())
     print(json.dumps({
         "smoke": "pass" if ok else "FAIL",

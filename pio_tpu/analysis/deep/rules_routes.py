@@ -61,6 +61,7 @@ CLIENT_METHODS = frozenset({"request", "call"})
 TENANT_HEADER_MARKS = ("TENANT_HEADER", "X-Pio-Tenant")
 TENANT_ROUTES = frozenset({
     "/shard/user_row", "/shard/topk", "/shard/item_rows",
+    "/shard/candidates",
     "/shard/upsert_users", "/shard/load_candidate",
     "/shard/promote_candidate", "/shard/drop_candidate",
 })

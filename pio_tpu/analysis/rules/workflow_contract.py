@@ -141,6 +141,9 @@ _WIRE_CODEC_OWNERS = (
     "pio_tpu/data/backends/mywire.py",
     "pio_tpu/data/backends/pgwire.py",
     "pio_tpu/serving_fleet/rpcwire.py",
+    # quantized retrieval tables (two-stage retrieval): the PIOQ frame
+    # codec (table_to_bytes/table_from_bytes) owns that format
+    "pio_tpu/ops/retrieval.py",
 )
 
 

@@ -23,6 +23,14 @@ keeps the users pending and the cursor does not advance):
 Apply is idempotent (a row upsert with the same bytes is a no-op in
 effect), so the folder may replay after a crash or partial failure
 without corrupting serving state.
+
+All three appliers also take ``items`` (item id → row): EXISTING items'
+factor rows are upserted together with the two-stage retrieval sidecar
+(quantized table + cluster assignment, ops/retrieval.py) in the same
+atomic swap, so refreshed items are retrievable through the candidate
+tier the moment apply returns. Unknown item ids are rejected, never
+appended — a new item needs the dense index space only a retrain
+assigns.
 """
 
 from __future__ import annotations
@@ -43,8 +51,11 @@ class LocalServingApplier:
         self.query_server = query_server
 
     def apply(self, rows: Mapping[object, Sequence[float]],
-              staleness_s: float | None = None) -> dict:
-        return self.query_server.foldin_upsert(rows, staleness_s)
+              staleness_s: float | None = None,
+              items: Mapping[object, Sequence[float]] | None = None,
+              ) -> dict:
+        return self.query_server.foldin_upsert(rows, staleness_s,
+                                               items=items)
 
 
 class ServingHttpApplier:
@@ -58,11 +69,16 @@ class ServingHttpApplier:
         self.server_key = server_key
 
     def apply(self, rows: Mapping[object, Sequence[float]],
-              staleness_s: float | None = None) -> dict:
+              staleness_s: float | None = None,
+              items: Mapping[object, Sequence[float]] | None = None,
+              ) -> dict:
         from pio_tpu.utils.httpclient import HttpClientError
 
         body = {"users": {u: [float(x) for x in r]
                           for u, r in rows.items()}}
+        if items:
+            body["items"] = {i: [float(x) for x in r]
+                             for i, r in items.items()}
         if staleness_s is not None:
             body["stalenessSeconds"] = staleness_s
         params = ({"accessKey": self.server_key}
@@ -87,11 +103,16 @@ class RouterFleetApplier:
         self.server_key = server_key
 
     def apply(self, rows: Mapping[object, Sequence[float]],
-              staleness_s: float | None = None) -> dict:
+              staleness_s: float | None = None,
+              items: Mapping[object, Sequence[float]] | None = None,
+              ) -> dict:
         from pio_tpu.utils.httpclient import HttpClientError
 
         body = {"users": {u: [float(x) for x in r]
                           for u, r in rows.items()}}
+        if items:
+            body["items"] = {i: [float(x) for x in r]
+                             for i, r in items.items()}
         if staleness_s is not None:
             body["stalenessSeconds"] = staleness_s
         params = ({"accessKey": self.server_key}

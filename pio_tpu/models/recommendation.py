@@ -121,6 +121,12 @@ class ALSAlgorithmParams(Params):
     # measured on ML-20M the final sweep is ~4.6% worse than the curve
     # minimum). 0 disables (exact reference behavior: last sweep wins).
     validation_fraction: float = 0.0
+    # two-stage retrieval (ops/retrieval.py; docs/serving.md): the
+    # engine.json `retrieval` block. None/absent = exact mode — every
+    # query rides the oracle einsum exactly as before. {"mode":
+    # "clustered", ...} serves top-k via the quantized candidate scan +
+    # exact re-rank; whiteList queries always stay on predict_pairs.
+    retrieval: dict | None = None
 
 
 @jax.tree_util.register_pytree_node_class
@@ -154,6 +160,31 @@ class ALSAlgorithm(PAlgorithm):
 
     def __init__(self, params: ALSAlgorithmParams):
         self.params = params
+        # parse the retrieval block NOW so a typo'd knob fails engine
+        # construction (deploy/train time), never silently serves exact
+        from pio_tpu.ops.retrieval import RetrievalParams
+
+        self._rparams = RetrievalParams.from_config(params.retrieval)
+
+    def _retrieval_index(self, model: RecommendationModel):
+        """The (RetrievalIndex, DeviceRetrievalIndex) pair for this
+        model's CURRENT item factors, cached on the model object (a
+        plain attribute — pytree aux ignores it) and keyed by item-table
+        identity, so a fold-in swap that replaces the factors rebuilds
+        the sidecar while the hot path pays the k-means exactly once.
+        The fold-in applier updates the cache in the SAME swap
+        (workflow/serve.py), so this rebuild is the cold-start/fallback
+        path, not the freshness contract."""
+        from pio_tpu.ops import retrieval as rt
+
+        itf = model.factors.item_factors
+        cached = getattr(model, "_retrieval_cache", None)
+        if cached is not None and cached[0] is itf:
+            return cached[1]
+        idx = rt.build_index(np.asarray(itf), self._rparams)
+        pair = (idx, rt.build_device_index(idx))
+        model._retrieval_cache = (itf, pair)
+        return pair
 
     def _als_params(self) -> als.ALSParams:
         p = self.params
@@ -232,12 +263,30 @@ class ALSAlgorithm(PAlgorithm):
                 )
             )
             return _rank_candidates(cand, scores, num)
-        k = min(num + len(black), model.factors.item_factors.shape[0])
-        scores, idx = als.recommend_topk(
-            model.factors, np.array([uidx]), k
-        )
-        scores = np.asarray(scores)[0]
-        idx = np.asarray(idx)[0]
+        n_items = model.factors.item_factors.shape[0]
+        k = min(num + len(black), n_items)
+        rp = self._rparams
+        if rp.mode == "clustered" and not rp.is_exhaustive(n_items):
+            # two-stage tier: quantized clustered scan picks candidates,
+            # the exact oracle einsum re-scores them (ops/retrieval.py).
+            # Exhaustive knobs (nprobe >= n_clusters) take the oracle
+            # branch below instead — bit-parity by running the literal
+            # same computation, the module's exactness contract.
+            from pio_tpu.ops import retrieval as rt
+
+            _, didx = self._retrieval_index(model)
+            urow = np.asarray(model.factors.user_factors)[uidx]
+            scores, idx = rt.candidate_topk(
+                didx, model.factors.item_factors, urow, k)
+            scores, idx = scores[0], idx[0]
+            keep = idx >= 0   # fewer real survivors than k: drop pads
+            scores, idx = scores[keep], idx[keep]
+        else:
+            scores, idx = als.recommend_topk(
+                model.factors, np.array([uidx]), k
+            )
+            scores = np.asarray(scores)[0]
+            idx = np.asarray(idx)[0]
         item_ids = model.items.decode(idx)
         out = []
         for item, score in zip(item_ids, scores):
@@ -298,15 +347,26 @@ class ALSAlgorithm(PAlgorithm):
                 for qi, _ in known),
             n_items,
         )
-        scores, idx = als.recommend_topk(model.factors, rows, k)
-        scores, idx = np.asarray(scores), np.asarray(idx)
+        rp = self._rparams
+        if rp.mode == "clustered" and not rp.is_exhaustive(n_items):
+            # batched two-stage tier (same branch contract as predict)
+            from pio_tpu.ops import retrieval as rt
+
+            _, didx = self._retrieval_index(model)
+            urows = np.asarray(model.factors.user_factors)[rows]
+            scores, idx = rt.candidate_topk(
+                didx, model.factors.item_factors, urows, k)
+        else:
+            scores, idx = als.recommend_topk(model.factors, rows, k)
+            scores, idx = np.asarray(scores), np.asarray(idx)
         for row, (qi, _) in enumerate(known):
             q = queries[qi]
             n = int(q.get("num", 10))
             black = set(q.get("blackList") or ())
-            items = model.items.decode(idx[row])
+            keep = idx[row] >= 0
+            items = model.items.decode(idx[row][keep])
             out = []
-            for it, s in zip(items, scores[row]):
+            for it, s in zip(items, scores[row][keep]):
                 if it in black:
                     continue
                 out.append({"item": it, "score": float(s)})

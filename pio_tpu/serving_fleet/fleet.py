@@ -108,12 +108,19 @@ def deploy_fleet(
     repartition: bool = True,
     router_config: RouterConfig | None = None,
     shard_backend: str = "threaded",
+    retrieval: dict | None = None,
 ) -> FleetHandle:
     """Partition (unless already recorded and ``repartition`` is False)
     and boot the whole fleet in this process. Returns once everything is
     bound; with port 0 everywhere, real ports live on the handle."""
     if n_shards < 1 or n_replicas < 1:
         raise ValueError("need n_shards >= 1 and n_replicas >= 1")
+    # two-stage retrieval (ops/retrieval.py): validate the engine.json
+    # block ONCE before any shard boots — a typo'd knob fails the whole
+    # deploy here, not shard-by-shard
+    from pio_tpu.ops.retrieval import RetrievalParams
+
+    rparams = RetrievalParams.from_config(retrieval)
     instance, model = resolve_fleet_model(
         storage, engine_id, engine_version, engine_variant, instance_id)
     plan = None if repartition else load_plan(storage, instance.id)
@@ -139,6 +146,7 @@ def deploy_fleet(
                     instance_id=shard_instance, server_key=server_key,
                     memory_budget_bytes=memory_budget_bytes,
                     backend=shard_backend,
+                    retrieval=retrieval,
                 ))
                 http.start()
                 shards.append((http, srv))
@@ -151,6 +159,7 @@ def deploy_fleet(
             base, ip=ip, port=router_port, engine_id=engine_id,
             engine_version=engine_version, engine_variant=engine_variant,
             server_key=base.server_key or server_key,
+            retrieval_mode=rparams.mode,
         )
         router_http, router = create_fleet_router(
             storage, rc, plan, endpoints)

@@ -312,9 +312,22 @@ class PartitionSlice:
     item_ids: list[str]
     item_gidx: np.ndarray      # (n_items,) int32
     item_rows: np.ndarray      # (n_items, k) float32
+    # Optional quantized sidecar rows (two-stage retrieval). The source
+    # shard attaches its already-encoded rows so the destination can
+    # verify carried == re-encoded (encode_rows is deterministic) instead
+    # of trusting the wire blindly. ``None`` on exact-mode fleets and on
+    # slices cut before the retrieval tier existed.
+    qdtype: str | None = None             # "bf16" | "int8"
+    item_qrows: np.ndarray | None = None  # (n_items, k) uint16|int8
+    item_qscales: np.ndarray | None = None  # (n_items,) float32
 
     def nbytes(self) -> int:
-        return int(self.user_rows.nbytes + self.item_rows.nbytes)
+        n = int(self.user_rows.nbytes + self.item_rows.nbytes)
+        if self.item_qrows is not None:
+            n += int(self.item_qrows.nbytes)
+        if self.item_qscales is not None:
+            n += int(self.item_qscales.nbytes)
+        return n
 
 
 def slice_partition(part: ShardPartition, p: int) -> PartitionSlice:

@@ -125,6 +125,13 @@ class RouterConfig:
     # each tenant's router under `fleet.<tenant-label>` so a drill can
     # take down exactly one tenant's fan-out.
     chaos_prefix: str = "fleet"
+    # two-stage retrieval (ops/retrieval.py): "clustered" fans the
+    # top-k as the /shard/candidates op — quantized candidate scan +
+    # exact re-rank shard-side; the merge is unchanged because the
+    # candidates RPC answers on the same kind-2 frame with the same
+    # (-score, global_index) semantics. "exact" (default) keeps the
+    # /shard/topk fan, including against pre-retrieval shards.
+    retrieval_mode: str = "exact"
 
 
 class _TenantClient(JsonHttpClient):
@@ -349,7 +356,8 @@ class FleetRouter:
         raise ShardUnavailable(shard, last_error)
 
     # -- binary RPC wire (rpcwire.py) ----------------------------------------
-    _BINARY_OPS = frozenset({"user_row", "topk", "item_rows"})
+    _BINARY_OPS = frozenset({"user_row", "topk", "candidates",
+                             "item_rows"})
 
     def _count_rpc(self, codec: str) -> None:
         with self._lock:
@@ -383,11 +391,14 @@ class FleetRouter:
                                           self._jsonable(op, body),
                                           idempotent=True, headers=hdrs)
             return rep.client.request("POST", path, body)
-        if op == "topk" and rep.binary_wire:
+        if op in ("topk", "candidates") and rep.binary_wire:
+            encode_req = (rpcwire.encode_candidates_request
+                          if op == "candidates"
+                          else rpcwire.encode_topk_request)
             try:
                 resp = rep.client.request(
                     "POST", path,
-                    raw=rpcwire.encode_topk_request(
+                    raw=encode_req(
                         body["row"], body["k"], body.get("arm", ARM_ACTIVE)),
                     content_type=rpcwire.RPC_CONTENT_TYPE,
                     accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True,
@@ -439,7 +450,7 @@ class FleetRouter:
         array (fetched over the binary wire from the owner shard) —
         float64 text of f32 values round-trips exactly, so converting
         here preserves bit-parity on mixed-wire fleets."""
-        if (op == "topk" and isinstance(body, dict)
+        if (op in ("topk", "candidates") and isinstance(body, dict)
                 and not isinstance(body.get("row"), list)):
             return {**body, "row": [float(x) for x in body["row"]]}
         return body
@@ -593,9 +604,17 @@ class FleetRouter:
         # never starve the result below the single-host answer
         n_items = sum(plan.item_counts)
         k = min(num + len(black), n_items)
+        # two-stage retrieval: a clustered fleet fans the candidates op
+        # instead — same body, same kind-2 response frame, same merge;
+        # exact-mode (and exhaustive) shards answer it from the literal
+        # /shard/topk compute path, so flipping this knob on an
+        # exact fleet changes no bit of any response
+        op, path = (("candidates", "/shard/candidates")
+                    if self.config.retrieval_mode == "clustered"
+                    else ("topk", "/shard/topk"))
         with self.tracer.span("score"):
             results, down = self._fan(
-                "topk", "/shard/topk",
+                op, path,
                 self._arm_body({"row": row, "k": k}, arm),
                 shards=range(plan.n_shards),
                 plan_version=plan.plan_version)
@@ -914,7 +933,8 @@ class FleetRouter:
 
     # -- streaming fold-in (pio_tpu/freshness/) ------------------------------
     def upsert_users(self, rows: dict,
-                     staleness_s: float | None = None) -> dict:
+                     staleness_s: float | None = None,
+                     items: dict | None = None) -> dict:
         """Fan refreshed user rows to EVERY replica of each row's
         owner shard group under the active plan — the same ``owner_of``
         routing queries use, so a fold-in lands exactly where the next
@@ -934,7 +954,20 @@ class FleetRouter:
         fold-in is lost at the cutover. Dual delivery is best-effort:
         failures are counted under ``reshardDualFailures`` and never
         flip ``ok`` — the old-plan owner stays the durability contract
-        until the plan swap (freshness/apply.py)."""
+        until the plan swap (freshness/apply.py).
+
+        ``items`` (item id → row) upserts EXISTING items' factor rows
+        plus their two-stage retrieval sidecar (shard.upsert_item_rows).
+        Items are index-partitioned — the router has no id→shard map for
+        them — so item rows fan to EVERY group and each shard applies
+        the subset it owns, rejecting the rest; an item is failed only
+        if NO group applied it (``itemsFailed``). Item rejections never
+        flip a group's ``ok``: a cross-shard reject is the routing
+        working, not a fault. Item upserts land on the ACTIVE partition
+        only — during a live reshard, items of a moving partition may
+        need a refold after the cutover (users dual-write; items do
+        not)."""
+        items = items or {}
         with self._lock:
             plan = self.plan
             rs = self.reshard_routing
@@ -950,11 +983,18 @@ class FleetRouter:
                 mv = rs["moving"].get(p)
                 if mv is not None and mv[1] != owner:
                     dual.setdefault(mv[1], {})[uid] = row
+        if items:
+            # every group gets the full item batch (see docstring)
+            for s in range(len(replicas)):
+                groups.setdefault(s, {})
         key = self.config.server_key
         results: dict[str, dict] = {}
         failed_groups: list[int] = []
+        items_landed: set = set()
         for s, group_rows in sorted(groups.items()):
             body: dict = {"users": group_rows}
+            if items:
+                body["items"] = items
             if staleness_s is not None:
                 body["stalenessSeconds"] = staleness_s
             try:
@@ -998,6 +1038,11 @@ class FleetRouter:
                 reps[str(r)] = {"ok": not rejected,
                                 "applied": out.get("applied"),
                                 "rejected": rejected}
+                if items:
+                    items_rej = set(out.get("itemsRejected") or ())
+                    items_landed.update(
+                        i for i in items if i not in items_rej)
+                    reps[str(r)]["itemsApplied"] = out.get("itemsApplied")
                 if not rejected:
                     ok_replicas += 1
             if ok_replicas == 0:
@@ -1010,6 +1055,10 @@ class FleetRouter:
         out = {"ok": not failed_groups, "groups": results,
                "failedGroups": failed_groups,
                "engineInstanceId": plan.instance_id}
+        if items:
+            out["itemsApplied"] = len(items_landed)
+            out["itemsFailed"] = sorted(
+                (str(i) for i in items if i not in items_landed))
         if rs is not None:
             out["reshardDualFailures"] = self._dual_write(dual, staleness_s,
                                                           key, replicas)
@@ -1279,20 +1328,26 @@ def build_router_app(router: FleetRouter) -> HttpApp:
     @app.route("POST", r"/fleet/upsert_users")
     def fleet_upsert_users(req: Request):
         """Streaming fold-in apply surface (pio_tpu/freshness/):
-        ``{"users": {id: [row]}, "stalenessSeconds"?: s}`` routed to
-        every replica of each row's owner shard group. Guarded like
-        /reload — it mutates serving state."""
+        ``{"users": {id: [row]}, "items"?: {id: [row]},
+        "stalenessSeconds"?: s}``. User rows route to every replica of
+        each row's owner shard group; item rows fan to EVERY group
+        (index-partitioned — each shard applies the subset it owns).
+        Guarded like /reload — it mutates serving state."""
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
         try:
             body = req.json()
         except Exception as e:  # noqa: BLE001 - malformed body
             return 400, {"message": f"Invalid body: {e}"}
-        if not isinstance(body, dict) or not isinstance(
-                body.get("users"), dict):
-            return 400, {"message": "body must be {\"users\": {id: [row]}}"}
+        users = body.get("users") if isinstance(body, dict) else None
+        items = body.get("items") if isinstance(body, dict) else None
+        if not isinstance(users, dict) and not isinstance(items, dict):
+            return 400, {"message": "body must be {\"users\": {id: [row]}}"
+                                    " and/or {\"items\": {id: [row]}}"}
         return 200, router.upsert_users(
-            body["users"], body.get("stalenessSeconds"))
+            users if isinstance(users, dict) else {},
+            body.get("stalenessSeconds"),
+            items=items if isinstance(items, dict) else None)
 
     @app.route("GET", r"/fleet\.json")
     def fleet(req: Request):

@@ -39,14 +39,29 @@ Message layout inside the durable envelope::
     kind 3 USER_ROW_RESP  header {"found", "d"}             row f32[d]
     kind 4 ITEM_ROWS_RESP header {"n", "k", "ids": [...]}   rows f32[n*k]
     kind 5 RESHARD_PART   header {"p", "iid", "nu", "ni",   user_rows f32[nu*k]
-                          "k", "userIds", "itemIds"}        gidx i32[ni]
-                                                            item_rows f32[ni*k]
+                          "k", "userIds", "itemIds"         gidx i32[ni]
+                          [, "qdtype"]}                     item_rows f32[ni*k]
+                                                            [qrows i8|u16[ni*k]
+                                                             qscales f32[ni]]
+    kind 6 CAND_REQ       header {"k", "arm", "d"}          row f32[d]
 
 Kind 5 is the reshard migration unit (docs/serving.md "Elastic
 resharding"): one virtual partition's factor rows, streamed old-owner ->
 controller -> new owner CRC32C-framed end-to-end, so a partition that
 arrives corrupt dies at the destination's decode as a 400 and the
-transfer retries — never a silently wrong row in the new topology.
+transfer retries — never a silently wrong row in the new topology. When
+the source shard serves clustered retrieval, the slice also carries the
+QUANTIZED item rows (``qdtype`` names the encoding; per-row scales ride
+as their own section) so the destination stages the candidate tier
+without re-quantizing — encoding is deterministic (ops/retrieval.py
+encode_rows), so carried and rebuilt tables are byte-identical and the
+destination verifies exactly that before trusting them.
+
+Kind 6 is the candidate-generation RPC (docs/serving.md "Two-stage
+retrieval"): same row+k shape as the top-k request, answered on the
+SAME kind-2 response frame — exact re-ranked f32 scores — so the
+router's ``(-score, global_index)`` merge code is shared verbatim
+between the exact and clustered tiers.
 """
 
 from __future__ import annotations
@@ -66,10 +81,12 @@ _KIND_TOPK_RESP = 2
 _KIND_USER_ROW_RESP = 3
 _KIND_ITEM_ROWS_RESP = 4
 _KIND_RESHARD_PART = 5
+_KIND_CAND_REQ = 6
 
 _PREFIX = struct.Struct(">BI")   # kind, header length
 _F32 = np.dtype("<f4")
 _I32 = np.dtype("<i4")
+_QDTYPES = {"bf16": np.dtype("<u2"), "int8": np.dtype("<i1")}
 
 
 class RpcWireError(ValueError):
@@ -175,6 +192,26 @@ def decode_topk_request(data: bytes) -> tuple[np.ndarray, int, str]:
     return row, _count(header, "k"), arm
 
 
+def encode_candidates_request(row, k: int, arm: str = "active") -> bytes:
+    """Kind 6: the candidate-tier fan-out body — the query user's f32
+    row + k, exactly the top-k request's shape on its own kind so a
+    route/codec confusion dies at `_open` instead of serving a
+    clustered answer where an exact one was promised."""
+    row_bytes, d = _f32_bytes(row)
+    return _seal(_KIND_CAND_REQ, {"k": int(k), "arm": arm, "d": d},
+                 row_bytes)
+
+
+def decode_candidates_request(data: bytes) -> tuple[np.ndarray, int, str]:
+    header, body = _open(data, _KIND_CAND_REQ)
+    d = _count(header, "d")
+    (row,) = _sections(body, (_F32, d))
+    arm = header.get("arm", "active")
+    if not isinstance(arm, str):
+        raise RpcWireError("rpc frame arm must be a string")
+    return row, _count(header, "k"), arm
+
+
 def encode_topk_response(items: list, indices, scores) -> bytes:
     gidx = np.ascontiguousarray(np.asarray(indices), dtype=_I32)
     score_bytes, n = _f32_bytes(scores)
@@ -241,7 +278,11 @@ def decode_item_rows_response(data: bytes) -> dict:
 
 
 def encode_partition_slice(sl) -> bytes:
-    """A plan.PartitionSlice as one reshard transfer frame."""
+    """A plan.PartitionSlice as one reshard transfer frame. A slice
+    carrying a quantized sidecar (``item_qrows``/``item_qscales`` set
+    by the source shard's extract) appends the quantized sections and
+    names the encoding in the header; sidecar-less slices stay
+    byte-identical to the pre-retrieval wire."""
     user_bytes, nu_k = _f32_bytes(sl.user_rows)
     item_bytes, ni_k = _f32_bytes(sl.item_rows)
     gidx = np.ascontiguousarray(np.asarray(sl.item_gidx), dtype=_I32)
@@ -251,12 +292,27 @@ def encode_partition_slice(sl) -> bytes:
             f"partition slice sections disagree: {nu} users x {k} but "
             f"{nu_k} user floats; {ni} items but {ni_k} item floats, "
             f"{gidx.size} indices")
-    return _seal(
-        _KIND_RESHARD_PART,
-        {"p": int(sl.partition), "iid": sl.instance_id, "nu": nu,
-         "ni": ni, "k": k, "userIds": list(sl.user_ids),
-         "itemIds": list(sl.item_ids)},
-        user_bytes, gidx.tobytes(), item_bytes)
+    header = {"p": int(sl.partition), "iid": sl.instance_id, "nu": nu,
+              "ni": ni, "k": k, "userIds": list(sl.user_ids),
+              "itemIds": list(sl.item_ids)}
+    sections = [user_bytes, gidx.tobytes(), item_bytes]
+    qdtype = getattr(sl, "qdtype", None)
+    if qdtype is not None:
+        if qdtype not in _QDTYPES:
+            raise RpcWireError(
+                f"partition slice qdtype {qdtype!r} not one of "
+                f"{sorted(_QDTYPES)}")
+        qrows = np.ascontiguousarray(sl.item_qrows,
+                                     dtype=_QDTYPES[qdtype])
+        qscales = np.ascontiguousarray(sl.item_qscales, dtype=_F32)
+        if qrows.shape != (ni, k) or qscales.shape != (ni,):
+            raise RpcWireError(
+                f"partition slice quantized sections disagree: "
+                f"{qrows.shape} rows / {qscales.shape} scales for "
+                f"{ni} items x {k}")
+        header["qdtype"] = qdtype
+        sections += [qrows.tobytes(), qscales.tobytes()]
+    return _seal(_KIND_RESHARD_PART, header, *sections)
 
 
 def decode_partition_slice(data: bytes):
@@ -280,8 +336,21 @@ def decode_partition_slice(data: bytes):
     iid = header.get("iid")
     if not isinstance(iid, str) or not iid:
         raise RpcWireError("reshard frame missing instance id")
-    user_flat, gidx, item_flat = _sections(
-        body, (_F32, nu * k), (_I32, ni), (_F32, ni * k))
+    qdtype = header.get("qdtype")
+    qrows = qscales = None
+    if qdtype is None:
+        user_flat, gidx, item_flat = _sections(
+            body, (_F32, nu * k), (_I32, ni), (_F32, ni * k))
+    else:
+        if qdtype not in _QDTYPES:
+            raise RpcWireError(
+                f"reshard frame qdtype {qdtype!r} not one of "
+                f"{sorted(_QDTYPES)}")
+        user_flat, gidx, item_flat, qflat, qscales = _sections(
+            body, (_F32, nu * k), (_I32, ni), (_F32, ni * k),
+            (_QDTYPES[qdtype], ni * k), (_F32, ni))
+        qrows = (qflat.reshape(ni, k) if ni
+                 else qflat.reshape(0, k))
     return PartitionSlice(
         partition=_count(header, "p", limit=1 << 16),
         instance_id=iid,
@@ -293,6 +362,10 @@ def decode_partition_slice(data: bytes):
         item_gidx=np.asarray(gidx, dtype=_I32),
         item_rows=item_flat.reshape(ni, k) if ni else
         item_flat.reshape(0, k),
+        qdtype=qdtype,
+        item_qrows=qrows,
+        item_qscales=None if qscales is None else np.asarray(
+            qscales, dtype=_F32),
     )
 
 
@@ -300,6 +373,9 @@ _RESPONSE_DECODERS = {
     "topk": decode_topk_response,
     "user_row": decode_user_row_response,
     "item_rows": decode_item_rows_response,
+    # the candidate tier answers on the top-k response frame (exact
+    # re-ranked f32 scores), so the router merge is shared verbatim
+    "candidates": decode_topk_response,
 }
 
 

@@ -4,10 +4,19 @@ Each shard process loads only its partition blob (CRC32C-framed, see
 plan.py) — never the full model — and answers three RPCs the router
 composes into a query:
 
-  POST /shard/user_row  {"user": id}            -> {"found", "row"}
-  POST /shard/topk      {"row": [...], "k": n}  -> {"items", "indices",
-                                                    "scores"}
-  POST /shard/item_rows {"items": [ids]}        -> {"rows": {id: row}}
+  POST /shard/user_row   {"user": id}            -> {"found", "row"}
+  POST /shard/topk       {"row": [...], "k": n}  -> {"items", "indices",
+                                                     "scores"}
+  POST /shard/candidates {"row": [...], "k": n}  -> same shape as /topk
+  POST /shard/item_rows  {"items": [ids]}        -> {"rows": {id: row}}
+
+``/shard/candidates`` is the two-stage retrieval tier
+(ops/retrieval.py): a clustered scan over the quantized item table
+picks candidates, the exact oracle einsum re-ranks them. On an
+exact-mode shard — or whenever the scan would be exhaustive
+(nprobe >= n_clusters) — the route answers from the LITERAL /topk
+compute path, so its response is bit-identical to /shard/topk and the
+router may fan either op without a parity caveat.
 
 (the whiteList path fetches candidate ROWS and scores router-side — see
 ``item_rows`` below for why shard-side pair scoring would break
@@ -117,6 +126,15 @@ class ShardConfig:
     # mismatch — a mis-routed tenant RPC must fail loudly, never answer
     # from the wrong tenant's partitions) and labels /metrics `tenant=`.
     tenant: str = ""
+    # two-stage retrieval (ops/retrieval.py): the engine.json
+    # ``retrieval`` block this shard serves under. None/{} = exact mode,
+    # which leaves every serving path on the oracle einsum untouched.
+    retrieval: dict | None = None
+
+    def retrieval_params(self):
+        from pio_tpu.ops.retrieval import RetrievalParams
+
+        return RetrievalParams.from_config(self.retrieval)
 
 
 @dataclass
@@ -131,6 +149,10 @@ class _ArmState:
     item_factors_dev: object
     user_row_of: dict
     item_local_of: dict
+    # two-stage retrieval sidecar: (RetrievalIndex, DeviceRetrievalIndex)
+    # built beside the f32 partition when the shard runs clustered mode;
+    # None on exact-mode shards and empty partitions
+    retrieval: object = None
 
 
 def _slice_with_rows(sl: PartitionSlice, rows: dict) -> PartitionSlice:
@@ -162,14 +184,22 @@ def _slice_with_rows(sl: PartitionSlice, rows: dict) -> PartitionSlice:
     return dataclasses.replace(sl, user_ids=user_ids, user_rows=user_rows)
 
 
-def _prepare_arm(part: ShardPartition) -> "_ArmState":
+def _prepare_arm(part: ShardPartition, rparams=None) -> "_ArmState":
     import jax
 
+    ret = None
+    if (rparams is not None and rparams.mode == "clustered"
+            and len(part.item_ids)):
+        from pio_tpu.ops import retrieval as rt
+
+        idx = rt.build_index(part.item_rows, rparams)
+        ret = (idx, rt.build_device_index(idx))
     return _ArmState(
         partition=part,
         item_factors_dev=jax.device_put(part.item_rows),
         user_row_of={u: i for i, u in enumerate(part.user_ids)},
         item_local_of={it: i for i, it in enumerate(part.item_ids)},
+        retrieval=ret,
     )
 
 
@@ -197,6 +227,12 @@ class ShardServer:
         self._item_factors_dev = None   # device copy of the item rows
         self._user_row_of: dict[str, int] = {}
         self._item_local_of: dict[str, int] = {}
+        # two-stage retrieval: the config block parses AT BOOT so a
+        # typo'd knob fails the process loudly, never silently serves
+        # exact; the sidecar for the active arm lives beside the
+        # partition pointer and swaps with it
+        self._rparams = config.retrieval_params()
+        self._retrieval = None
         # guarded rollout: candidate partition served alongside the
         # active one (queries carry {"arm": "candidate"} to ride it)
         self.candidate: _ArmState | None = None
@@ -219,6 +255,7 @@ class ShardServer:
         # /shard/info so `pio doctor --fleet` can compare fold-in lag
         # across shard groups
         self.foldin_applied_users = 0
+        self.foldin_applied_items = 0
         self.foldin_last_time = None
         self.foldin_last_staleness_s: float | None = None
         self._load(config.instance_id or None)
@@ -313,6 +350,38 @@ class ShardServer:
             f"{c.engine_id} {c.engine_version} {c.engine_variant} has a "
             "shard plan yet")
 
+    def _sidecar_estimate(self, part: ShardPartition) -> int:
+        """Bytes the two-stage retrieval sidecar would add for this
+        partition — the small-fix half of the memory-budget contract:
+        the budget must charge the f32 partition AND its quantized
+        sidecar BEFORE swap, or a clustered shard could pass the check
+        and then blow the budget building tables it never accounted."""
+        if self._rparams.mode != "clustered" or not len(part.item_ids):
+            return 0
+        from pio_tpu.ops.retrieval import sidecar_nbytes_estimate
+
+        k = (int(part.item_rows.shape[1])
+             if getattr(part.item_rows, "ndim", 0) == 2 else 0)
+        return sidecar_nbytes_estimate(len(part.item_ids), k, self._rparams)
+
+    def _enforce_budget_realized(self, part: ShardPartition, arm) -> None:
+        """The second half of the budget contract: the estimate rejects
+        obvious oversizes BEFORE the k-means build, but a pathologically
+        imbalanced clustering can pad the device scan layout past the
+        estimate's allowance — so the REALIZED f32 + sidecar bytes are
+        re-checked after the build and before any swap."""
+        budget = self.config.memory_budget_bytes
+        if not budget or arm.retrieval is None:
+            return
+        idx, didx = arm.retrieval
+        need = part.nbytes() + idx.nbytes() + didx.nbytes()
+        if need > budget:
+            raise ShardMemoryBudgetExceeded(
+                f"shard {self.config.shard_index} partition of instance "
+                f"{part.instance_id} realized {need} bytes (f32 + built "
+                f"retrieval sidecar) over the {budget}-byte budget — "
+                "deploy with more shards")
+
     def _load(self, instance_id: str | None = None) -> None:
         """Resolve + restore + swap, with last-good fallback: a corrupt
         partition blob on the latest instance falls back to the previous
@@ -324,12 +393,13 @@ class ShardServer:
                 "reload", shard=self.config.shard_index):
             part, plan = self._resolve_partition(instance_id)
             budget = self.config.memory_budget_bytes
-            if budget and part.nbytes() > budget:
+            need = part.nbytes() + self._sidecar_estimate(part)
+            if budget and need > budget:
                 raise ShardMemoryBudgetExceeded(
                     f"shard {self.config.shard_index} partition of "
-                    f"instance {part.instance_id} needs {part.nbytes()} "
-                    f"bytes but the shard's budget is {budget} — deploy "
-                    "with more shards"
+                    f"instance {part.instance_id} needs {need} "
+                    f"bytes (f32 + retrieval sidecar) but the shard's "
+                    f"budget is {budget} — deploy with more shards"
                 )
             owners = (plan.effective_owners() if plan is not None
                       else default_owners(self.config.n_shards))
@@ -339,7 +409,8 @@ class ShardServer:
             with self.tracer.span(
                     "reload.partition", shard=self.config.shard_index,
                     instance=part.instance_id, bytes=part.nbytes()):
-                arm = _prepare_arm(part)
+                arm = _prepare_arm(part, self._rparams)
+                self._enforce_budget_realized(part, arm)
                 with self._lock:
                     if self._reshard is not None:
                         log.warning(
@@ -350,6 +421,7 @@ class ShardServer:
                     self._item_factors_dev = arm.item_factors_dev
                     self._user_row_of = arm.user_row_of
                     self._item_local_of = arm.item_local_of
+                    self._retrieval = arm.retrieval
                     self.owners = owners
                     self.plan_version = pv
                     self._reshard = None
@@ -384,12 +456,14 @@ class ShardServer:
                     f"shard {self.config.shard_index} — was it deployed "
                     "with this topology?")
             budget = self.config.memory_budget_bytes
-            if budget and part.nbytes() > budget:
+            need = part.nbytes() + self._sidecar_estimate(part)
+            if budget and need > budget:
                 raise ShardMemoryBudgetExceeded(
                     f"candidate partition of instance {instance_id} needs "
-                    f"{part.nbytes()} bytes over shard "
+                    f"{need} bytes (f32 + retrieval sidecar) over shard "
                     f"{self.config.shard_index}'s {budget}-byte budget")
-            arm = _prepare_arm(part)
+            arm = _prepare_arm(part, self._rparams)
+            self._enforce_budget_realized(part, arm)
             with self._lock:
                 self.candidate = arm
                 self._candidate_foldin_pending = {}
@@ -443,6 +517,7 @@ class ShardServer:
                 self._item_factors_dev = cand.item_factors_dev
                 self._user_row_of = cand.user_row_of
                 self._item_local_of = cand.item_local_of
+                self._retrieval = cand.retrieval
                 self.candidate = None
                 self._candidate_foldin_pending = {}
                 return self.partition.instance_id
@@ -499,13 +574,42 @@ class ShardServer:
                 part = self.partition
             if part is None:
                 raise ValueError("shard has no partition loaded")
-            return slice_partition(part, int(p))
+            sl = slice_partition(part, int(p))
+            rp = self._rparams
+            if rp.mode == "clustered" and len(sl.item_ids):
+                # carry the quantized sidecar rows with the slice:
+                # encode_rows is a deterministic pure function, so the
+                # destination re-encodes and VERIFIES carried == rebuilt
+                # (stage_partition) instead of trusting the wire
+                import dataclasses
+
+                from pio_tpu.ops.retrieval import encode_rows
+
+                data, scales = encode_rows(sl.item_rows, rp.dtype)
+                sl = dataclasses.replace(sl, qdtype=rp.dtype,
+                                         item_qrows=data,
+                                         item_qscales=scales)
+            return sl
 
     def stage_partition(self, sl: PartitionSlice) -> dict:
         """Land a transferred slice for an incoming partition. Queued
         dual-write fold-ins for that partition are applied OVER the
         slice (they are newer than the extracted blob). Idempotent: a
         resumed transfer restages harmlessly."""
+        if sl.qdtype is not None and len(sl.item_ids):
+            # quantized-carry verification: re-encode the slice's f32
+            # rows (deterministic) and require byte-identity with what
+            # the wire carried — a mismatch means the sidecar and the
+            # f32 truth diverged somewhere and MUST NOT be staged
+            from pio_tpu.ops.retrieval import encode_rows
+
+            data, scales = encode_rows(sl.item_rows, sl.qdtype)
+            if not (np.array_equal(data, sl.item_qrows)
+                    and np.array_equal(scales, sl.item_qscales)):
+                raise ValueError(
+                    f"partition {sl.partition} slice carries a quantized "
+                    f"sidecar that does not match its f32 rows "
+                    f"(dtype {sl.qdtype}) — refusing to stage")
         with self._lock:
             rs = self._reshard
             if rs is None:
@@ -591,19 +695,21 @@ class ShardServer:
             new_part = merge_reshard(part, staged, new_owners,
                                      self.config.shard_index, n_new)
             budget = self.config.memory_budget_bytes
-            if budget and new_part.nbytes() > budget:
+            need = new_part.nbytes() + self._sidecar_estimate(new_part)
+            if budget and need > budget:
                 raise ShardMemoryBudgetExceeded(
                     f"resharded partition of instance "
-                    f"{new_part.instance_id} needs {new_part.nbytes()} "
-                    f"bytes over shard {self.config.shard_index}'s "
-                    f"{budget}-byte budget")
+                    f"{new_part.instance_id} needs {need} "
+                    f"bytes (f32 + retrieval sidecar) over shard "
+                    f"{self.config.shard_index}'s {budget}-byte budget")
             # durable BEFORE the plan flips anywhere: the v<N> blob key
             # is unreferenced until save_plan writes the successor plan
             self.storage.get_model_data_models().insert(Model(
                 shard_model_id(new_part.instance_id,
                                self.config.shard_index, int(plan_version)),
                 partition_to_bytes(new_part)))
-            arm = _prepare_arm(new_part)
+            arm = _prepare_arm(new_part, self._rparams)
+            self._enforce_budget_realized(new_part, arm)
             with self._lock:
                 rs2 = self._reshard
                 if rs2 is not None and rs2["planVersion"] == int(plan_version):
@@ -634,12 +740,14 @@ class ShardServer:
                 partition=self.partition,
                 item_factors_dev=self._item_factors_dev,
                 user_row_of=self._user_row_of,
-                item_local_of=self._item_local_of)
+                item_local_of=self._item_local_of,
+                retrieval=self._retrieval)
             arm = rs["prepared"]
             self.partition = arm.partition
             self._item_factors_dev = arm.item_factors_dev
             self._user_row_of = arm.user_row_of
             self._item_local_of = arm.item_local_of
+            self._retrieval = arm.retrieval
             self.owners = rs["newOwners"]
             self.plan_version = int(plan_version)
             self.config.n_shards = rs["nShardsNew"]
@@ -792,6 +900,61 @@ class ShardServer:
             "scores": [float(s) for s in scores],
         }
 
+    def _retrieval_of(self, arm: str, plan_version: int | None = None):
+        """The (RetrievalIndex, DeviceRetrievalIndex) sidecar for one
+        arm — the same arm-selection ladder as ``_arm`` (which the
+        caller runs FIRST, so missing-arm 503s are raised there and
+        this lookup only answers for arms that exist)."""
+        with self._lock:
+            if arm == "candidate":
+                c = self.candidate
+                return None if c is None else c.retrieval
+            if (plan_version is not None
+                    and plan_version != self.plan_version):
+                rs = self._reshard
+                if (rs is not None and rs["planVersion"] == plan_version
+                        and rs["prepared"] is not None):
+                    return rs["prepared"].retrieval
+                ret = self._retired
+                if ret is not None and ret[0] == plan_version:
+                    return ret[1].retrieval
+                return None
+            return self._retrieval
+
+    def candidates_arrays(self, row, k: int, arm: str = "active",
+                          plan_version: int | None = None,
+                          ) -> tuple[list, np.ndarray, np.ndarray]:
+        """Two-stage candidate top-k against this shard's item slice:
+        clustered quantized scan -> exact f32 re-rank
+        (ops/retrieval.py). The exactness contract: an exact-mode
+        shard, a shard with no sidecar for the addressed arm, or an
+        EXHAUSTIVE scan (nprobe >= n_clusters) answers from the literal
+        ``topk_arrays`` compute path — bit-identical to /shard/topk —
+        so the router can fan the candidates op unconditionally."""
+        with self.tracer.span("candidates",
+                              shard=self.config.shard_index, arm=arm):
+            part, item_dev, _, _ = self._arm(arm, plan_version)
+            ret = self._retrieval_of(arm, plan_version)
+            n_local = len(part.item_ids)
+            if n_local == 0:
+                return ([], np.zeros(0, dtype=np.int32),
+                        np.zeros(0, dtype=np.float32))
+            rp = self._rparams
+            if (ret is None or rp.mode != "clustered"
+                    or rp.is_exhaustive(n_local)):
+                return self._topk_arrays(row, k, arm, plan_version)
+            from pio_tpu.ops import retrieval as rt
+
+            _, didx = ret
+            scores, lidx = rt.candidate_topk(
+                didx, item_dev, np.asarray(row, dtype=np.float32), int(k))
+            scores, lidx = scores[0], lidx[0]
+            keep = lidx >= 0      # fewer real survivors than k: drop pads
+            lidx = lidx[keep]
+            scores = np.asarray(scores[keep], dtype=np.float32)
+            gidx = np.asarray(part.item_gidx)[lidx].astype(np.int32)
+            return [part.item_ids[int(i)] for i in lidx], gidx, scores
+
     def item_rows_arrays(self, items: list, arm: str = "active",
                          plan_version: int | None = None,
                          ) -> tuple[list, np.ndarray]:
@@ -915,6 +1078,77 @@ class ShardServer:
                 "reshardApplied": len(moving) - reshard_queued,
                 "reshardQueued": reshard_queued}
 
+    def upsert_item_rows(self, rows: dict) -> dict:
+        """Streaming fold-in for ITEM factor rows: replace rows of items
+        this shard already holds, updating the f32 partition, the device
+        scoring matrix, AND the two-stage retrieval sidecar (re-encode
+        row, reassign cluster against the frozen centroids) in the SAME
+        atomic swap — the freshness contract: an upserted item is
+        retrievable through the candidate tier the moment the apply
+        returns. Unknown item ids are rejected loudly (appending a NEW
+        item needs a global dense index, which only a repartition can
+        assign without breaking the router's merge order)."""
+        import dataclasses
+
+        with self._lock:
+            part = self.partition
+            ret = self._retrieval
+            local_of = dict(self._item_local_of)
+        if part is None:
+            raise ValueError("shard has no partition loaded")
+        k = int(part.item_rows.shape[1]) if part.item_rows.size else (
+            int(part.user_rows.shape[1]) if part.user_rows.size else 0)
+        owned: list[tuple] = []
+        rejected: list = []
+        for iid, row in rows.items():
+            at = local_of.get(iid)
+            if at is None:
+                rejected.append(iid)
+                continue
+            if len(row) != k:
+                raise ValueError(
+                    f"fold-in item row for {iid!r} has {len(row)} dims, "
+                    f"partition rank is {k}")
+            owned.append((at, row))
+        if owned:
+            positions = np.array([at for at, _ in owned], dtype=np.int64)
+            new_rows = np.stack([np.asarray(r, dtype=np.float32)
+                                 for _, r in owned])
+            item_rows = np.array(part.item_rows, dtype=np.float32,
+                                 copy=True)
+            item_rows[positions] = new_rows
+            new_part = dataclasses.replace(part, item_rows=item_rows)
+            budget = self.config.memory_budget_bytes
+            need = new_part.nbytes() + self._sidecar_estimate(new_part)
+            if budget and need > budget:
+                raise ShardMemoryBudgetExceeded(
+                    f"item fold-in would grow shard "
+                    f"{self.config.shard_index} to {need} bytes (f32 + "
+                    f"retrieval sidecar) over its {budget}-byte budget")
+            new_ret = ret
+            if ret is not None:
+                from pio_tpu.ops import retrieval as rt
+
+                idx = ret[0].updated(positions, new_rows)
+                new_ret = (idx, rt.build_device_index(idx))
+            import jax
+
+            dev = jax.device_put(item_rows)
+            with self._lock:
+                if self.partition is not part:
+                    # a /reload swapped instances mid-build (see
+                    # upsert_user_rows): mixing factor spaces is worse
+                    # than a retry
+                    raise ValueError(
+                        "partition changed during fold-in apply; retry")
+                self.partition = new_part
+                self._item_factors_dev = dev
+                self._retrieval = new_ret
+                self.foldin_applied_items += len(owned)
+                self.foldin_last_time = utcnow()
+        return {"applied": len(owned), "rejected": rejected,
+                "engineInstanceId": part.instance_id}
+
     def _apply_reshard_rows(self, moving: dict) -> int:
         """Land dual-written fold-in rows for partitions this shard is
         RECEIVING: into the prepared arm when it exists (so activation
@@ -984,7 +1218,8 @@ class ShardServer:
                             part, user_ids=user_ids, user_rows=user_rows),
                         item_factors_dev=prep.item_factors_dev,
                         user_row_of=row_of,
-                        item_local_of=prep.item_local_of)
+                        item_local_of=prep.item_local_of,
+                        retrieval=prep.retrieval)
         return queued
 
     def _upsert_candidate_rows(self, owned: dict) -> int:
@@ -1044,7 +1279,8 @@ class ShardServer:
                 partition=new_part,
                 item_factors_dev=cand2.item_factors_dev,
                 user_row_of=row_of,
-                item_local_of=cand2.item_local_of)
+                item_local_of=cand2.item_local_of,
+                retrieval=cand2.retrieval)
             self._candidate_foldin_pending = {}
         return 0
 
@@ -1052,10 +1288,47 @@ class ShardServer:
         with self._lock:
             return {
                 "appliedUsers": self.foldin_applied_users,
+                "appliedItems": self.foldin_applied_items,
                 "lastAppliedTime": (format_time(self.foldin_last_time)
                                     if self.foldin_last_time else None),
                 "stalenessSeconds": self.foldin_last_staleness_s,
             }
+
+    def _retrieval_info(self, part) -> dict:
+        """The /shard/info retrieval block `pio doctor --fleet` renders:
+        mode knobs, quantized-sidecar bytes vs the f32 item bytes they
+        stand in for, and how many MORE items fit under the memory
+        budget at this partition's per-item cost (f32 row + sidecar
+        share). Headroom is None on unbudgeted shards."""
+        rp = self._rparams
+        with self._lock:
+            ret = self._retrieval
+        qbytes = 0
+        if ret is not None:
+            qbytes = int(ret[0].nbytes() + ret[1].nbytes())
+        f32_item_bytes = int(part.item_rows.nbytes) if part is not None else 0
+        budget = self.config.memory_budget_bytes
+        headroom = None
+        if budget and part is not None:
+            n = len(part.item_ids)
+            k = (int(part.item_rows.shape[1])
+                 if getattr(part.item_rows, "ndim", 0) == 2 else 0)
+            if k:
+                per_item = k * 4
+                est = self._sidecar_estimate(part)
+                if n and est:
+                    per_item += est // n
+                used = part.nbytes() + est
+                headroom = max(0, (budget - used) // max(1, per_item))
+        return {
+            "mode": rp.mode,
+            "dtype": rp.dtype,
+            "nprobe": rp.nprobe,
+            "rerankK": rp.rerank_k,
+            "quantizedBytes": qbytes,
+            "f32ItemBytes": f32_item_bytes,
+            "itemsHeadroom": headroom,
+        }
 
     def info(self) -> dict:
         with self._lock:
@@ -1084,6 +1357,9 @@ class ShardServer:
             "items": len(part.item_ids) if part else 0,
             "partitionBytes": part.nbytes() if part else 0,
             "memoryBudgetBytes": self.config.memory_budget_bytes,
+            # two-stage retrieval: doctor --fleet renders these columns
+            # and WARNs when replicas of one group disagree on mode
+            "retrieval": self._retrieval_info(part),
             "startTime": format_time(self.start_time),
             "lastReloadError": self.last_reload_error,
             "foldin": self.foldin_status(),
@@ -1294,6 +1570,52 @@ def build_shard_app(server: ShardServer) -> HttpApp:
                      "indices": [int(g) for g in gidx],
                      "scores": [float(s) for s in scores]}
 
+    @app.route("POST", r"/shard/candidates")
+    def shard_candidates(req: Request):
+        """Two-stage retrieval candidates (ops/retrieval.py): answered
+        on the SAME kind-2 response frame as /shard/topk so the
+        router's (-score, global_index) merge is shared verbatim.
+        nprobe/rerank_k are shard config, NOT wire parameters — a
+        replica always answers from its own knobs (doctor --fleet WARNs
+        when replicas of one group disagree)."""
+        mis = _tenant_mismatch(req)
+        if mis:
+            return mis
+        if _media_type(req, "content-type") == rpcwire.RPC_CONTENT_TYPE:
+            try:
+                row, k, arm = rpcwire.decode_candidates_request(req.body)
+            except rpcwire.RpcWireError as e:
+                return 400, {"message": f"bad rpc frame: {e}"}
+            if arm not in ("active", "candidate"):
+                return 400, {"message": f"unknown arm {arm!r}"}
+        else:
+            body = req.json()
+            if (not isinstance(body, dict) or "row" not in body
+                    or "k" not in body):
+                return 400, {
+                    "message": "body must be {\"row\": [...], \"k\": n}"}
+            arm, err = _arm_of(body)
+            if err:
+                return err
+            row, k = body["row"], int(body["k"])
+        binary = _binary_accept(req)
+        server.count_rpc("binary" if binary else "json")
+        try:
+            items, gidx, scores = server.candidates_arrays(
+                row, k, arm=arm, plan_version=_plan_version_of(req))
+        except CandidateArmMissing as e:
+            # the "candidate-arm-missing:" prefix is the router's cue to
+            # fail over WITHOUT charging this replica's breaker: the
+            # replica is healthy, it just has no staged arm
+            return 503, {"message": f"candidate-arm-missing: {e}"}
+        except PlanVersionMissing as e:
+            return 503, {"message": f"plan-version-missing: {e}"}
+        if binary:
+            return _binary_response(items, gidx, scores)
+        return 200, {"items": items,
+                     "indices": [int(g) for g in gidx],
+                     "scores": [float(s) for s in scores]}
+
     @app.route("POST", r"/shard/item_rows")
     def shard_item_rows(req: Request):
         mis = _tenant_mismatch(req)
@@ -1392,12 +1714,28 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
         body = req.json()
-        if not isinstance(body, dict) or not isinstance(
-                body.get("users"), dict):
-            return 400, {"message": "body must be {\"users\": {id: [row]}}"}
+        users = body.get("users") if isinstance(body, dict) else None
+        items = body.get("items") if isinstance(body, dict) else None
+        if not isinstance(users, dict) and not isinstance(items, dict):
+            return 400, {"message": "body must be {\"users\": {id: [row]}}"
+                                    " and/or {\"items\": {id: [row]}}"}
         try:
-            out = server.upsert_user_rows(
-                body["users"], body.get("stalenessSeconds"))
+            if isinstance(users, dict):
+                out = server.upsert_user_rows(
+                    users, body.get("stalenessSeconds"))
+            else:
+                with server._lock:
+                    part = server.partition
+                out = {"applied": 0, "rejected": [],
+                       "engineInstanceId": (part.instance_id
+                                            if part else None)}
+            if isinstance(items, dict):
+                # item rows ride the SAME apply call so an upserted item
+                # is retrievable through the candidate tier the moment
+                # this request returns (the freshness contract)
+                iout = server.upsert_item_rows(items)
+                out["itemsApplied"] = iout["applied"]
+                out["itemsRejected"] = iout["rejected"]
         except ShardMemoryBudgetExceeded as e:
             return 507, {"message": str(e)}
         except ValueError as e:
@@ -1591,6 +1929,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="grow-path boot: start empty and await staged "
                         "partition slices when no blob exists for this "
                         "shard's topology yet")
+    p.add_argument("--retrieval-mode", choices=["exact", "clustered"],
+                   default="exact",
+                   help="two-stage retrieval tier (docs/serving.md): "
+                        "clustered builds the quantized candidate index "
+                        "beside the f32 partition")
+    p.add_argument("--retrieval-dtype", choices=["bf16", "int8"],
+                   default="int8")
+    p.add_argument("--retrieval-nprobe", type=int, default=32)
+    p.add_argument("--retrieval-rerank-k", type=int, default=1024)
     args = p.parse_args(argv)
     config = ShardConfig(
         ip=args.ip, port=args.port, shard_index=args.shard_index,
@@ -1601,6 +1948,12 @@ def main(argv: list[str] | None = None) -> int:
         memory_budget_bytes=args.memory_budget_bytes,
         backend=args.server_backend,
         join_reshard=args.join_reshard,
+        retrieval={
+            "mode": args.retrieval_mode,
+            "dtype": args.retrieval_dtype,
+            "nprobe": args.retrieval_nprobe,
+            "rerank_k": args.retrieval_rerank_k,
+        },
     )
     http, srv = create_shard_server(get_storage(), config)
     http.start()

@@ -73,6 +73,21 @@ def _engine_from_variant(variant: dict, engine_dir: str | None = None):
     return engine, ep
 
 
+def _retrieval_block(ep) -> dict | None:
+    """The engine's two-stage retrieval block (ops/retrieval.py). Fleet
+    shards score partitions themselves rather than through the algorithm
+    instance, so `pio deploy --shards` must lift the block out of the
+    algorithm params and hand it to the shard servers explicitly —
+    otherwise an engine.json that asks for clustered retrieval would
+    silently serve exact in fleet mode."""
+    for _name, p in (ep.algorithms or []):
+        block = p.get("retrieval") if isinstance(p, dict) \
+            else getattr(p, "retrieval", None)
+        if block:
+            return block
+    return None
+
+
 def _absolutize_param_paths(ep, engine_dir: str):
     """Engine-dir-relative paths in params become absolute at load time, so
     `pio train --engine-dir X` behaves the same from any cwd. Any Params
@@ -314,6 +329,7 @@ def _doctor_fleet(args) -> int:
             instance = rep.get("engineInstanceId")
             candidate = rep.get("candidateInstanceId")
             foldin = None
+            retrieval = None
             plan_version = rep.get("planVersion")
             try:
                 probe.request("GET", "/healthz")
@@ -324,6 +340,7 @@ def _doctor_fleet(args) -> int:
                 instance = info.get("engineInstanceId", instance)
                 candidate = info.get("candidateInstanceId", candidate)
                 foldin = info.get("foldin")
+                retrieval = info.get("retrieval")
                 plan_version = info.get("planVersion", plan_version)
             except HttpClientError:
                 pass
@@ -339,6 +356,7 @@ def _doctor_fleet(args) -> int:
                 "breaker": rep["breaker"], "instance": instance,
                 "candidate": candidate,
                 "foldin": foldin,
+                "retrieval": retrieval,
                 "planVersion": plan_version,
                 # internal RPC plane (docs/performance.md): the
                 # router's client-side connection-reuse ratio toward
@@ -396,6 +414,31 @@ def _doctor_fleet(args) -> int:
         for s, g in sorted(fleet.get("shards", {}).items(),
                            key=lambda kv: int(kv[0]))
     }
+    # two-stage retrieval (ops/retrieval.py): per-group mode/dtype/
+    # nprobe, quantized sidecar vs f32 bytes, items headroom under the
+    # budget. Replicas of one group MUST agree on mode — a replica
+    # quietly serving exact while its group mates serve clustered
+    # changes failover semantics (and latency) silently on the next
+    # replica scan, so disagreement is an operator page, not a detail
+    retr_by_group: dict[int, list] = {}
+    for r in rows:
+        if r.get("retrieval"):
+            retr_by_group.setdefault(r["shard"], []).append(r["retrieval"])
+    retr_cells: list[str] = []
+    retr_disagree: list[str] = []
+    for s, infos in sorted(retr_by_group.items()):
+        modes = sorted({str(i.get("mode")) for i in infos})
+        if len(modes) > 1:
+            retr_disagree.append(f"shard {s}: {'/'.join(modes)}")
+        i0 = infos[0]
+        cell = f"shard {s}: {i0.get('mode')}"
+        if i0.get("mode") == "clustered":
+            hd = i0.get("itemsHeadroom")
+            cell += (f"/{i0.get('dtype')} nprobe={i0.get('nprobe')} "
+                     f"quantized {i0.get('quantizedBytes')}B vs f32 "
+                     f"{i0.get('f32ItemBytes')}B headroom "
+                     f"{'-' if hd is None else hd}")
+        retr_cells.append(cell)
     if args.json:
         print(json.dumps({
             "router": router_url, "plan": plan, "replicas": rows,
@@ -409,6 +452,7 @@ def _doctor_fleet(args) -> int:
             "planVersion": router_pv,
             "stalePlanReplicas": stale_plan,
             "reshard": reshard,
+            "retrievalModeDisagreement": retr_disagree,
         }, indent=2))
         return exit_code
     print(f"fleet router {router_url}: instance {plan.get('instanceId')} "
@@ -447,6 +491,14 @@ def _doctor_fleet(args) -> int:
     if lag_cells:
         print("fold-in lag (max staleness at last apply): "
               + ", ".join(lag_cells))
+    if retr_cells:
+        print("retrieval: " + ", ".join(retr_cells))
+    if retr_disagree:
+        print("[WARN] retrieval mode disagreement within shard "
+              "group(s): " + "; ".join(retr_disagree)
+              + " — replicas of one group must serve the same candidate "
+              "tier (check --retrieval-* flags / the engine's retrieval "
+              "block on the odd replica out)")
     over = sorted((s for s, lag in foldin_lag.items()
                    if lag["overBudget"]), key=int)
     if over:
@@ -1299,7 +1351,8 @@ def cmd_deploy(args) -> int:
         # fleet path: partition the persisted model at deploy time, boot
         # N x R shard servers + the router front-end (serving_fleet/)
         return _deploy_fleet_cmd(args, storage, engine_id, engine_version,
-                                 engine_variant)
+                                 engine_variant,
+                                 retrieval=_retrieval_block(ep))
     ctx = create_workflow_context(storage, use_mesh=not args.no_mesh)
     config = ServingConfig(
         ip=args.ip, port=args.port,
@@ -1340,7 +1393,8 @@ def cmd_deploy(args) -> int:
 
 
 def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
-                      engine_variant: str) -> int:
+                      engine_variant: str,
+                      retrieval: dict | None = None) -> int:
     """`pio deploy --shards N [--replicas R]`: sharded, replicated
     serving (docs/serving.md "Sharded fleet"). The router binds
     --ip/--port; shard servers take ephemeral ports (printed, and always
@@ -1380,10 +1434,13 @@ def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
         server_key=args.server_key or os.environ.get("PIO_SERVER_KEY", ""),
         memory_budget_bytes=args.shard_memory_budget_mb * 1024 * 1024,
         shard_backend=args.server_backend,
+        retrieval=retrieval,
     )
+    mode = (retrieval or {}).get("mode", "exact")
     print(f"Fleet router for instance {handle.plan.instance_id} on "
           f"http://{ip}:{handle.router_http.port} "
-          f"({args.shards} shards x {args.replicas} replicas)")
+          f"({args.shards} shards x {args.replicas} replicas, "
+          f"retrieval: {mode})")
     for s, urls in enumerate(handle.endpoints):
         print(f"  shard {s}: {' '.join(urls)}")
     import threading

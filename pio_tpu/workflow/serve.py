@@ -183,6 +183,7 @@ class QueryServer:
         # rows the freshness subsystem has hot-swapped in, and the last
         # batch's measured event-ingest -> servable staleness
         self.foldin_applied_users = 0
+        self.foldin_applied_items = 0
         self.foldin_last_time = None
         self.foldin_last_staleness_s: float | None = None
         # guarded rollout (pio_tpu/rollout/): the candidate arm and the
@@ -195,6 +196,7 @@ class QueryServer:
         # (arm mid-swap, rank mismatch): queued and retried on the next
         # apply so freshness never silently diverges the experiment
         self._candidate_foldin_pending: dict = {}
+        self._candidate_item_pending: dict = {}
         # serializes whole reloads (resolve + restore + swap) end to end
         # WITHOUT blocking queries: queries snapshot state under
         # self._lock, which a reload only takes for the final swap.
@@ -359,6 +361,7 @@ class QueryServer:
                     instance=instance, models=models,
                     algorithms=algorithms, serving=serving)
                 self._candidate_foldin_pending = {}
+                self._candidate_item_pending = {}
         log.info("candidate arm loaded: instance %s", instance_id)
 
     def drop_candidate(self) -> None:
@@ -368,6 +371,7 @@ class QueryServer:
         with self._lock:
             cand, self.candidate = self.candidate, None
             self._candidate_foldin_pending = {}
+            self._candidate_item_pending = {}
             if cand is not None:
                 self._retire_algorithms(cand.algorithms)
 
@@ -386,7 +390,8 @@ class QueryServer:
                 cand = self.candidate
                 if cand is None:
                     raise ValueError("no candidate arm to promote")
-                dropped = len(self._candidate_foldin_pending)
+                dropped = (len(self._candidate_foldin_pending)
+                           + len(self._candidate_item_pending))
                 if dropped:
                     log.warning(
                         "%d queued candidate fold-in row(s) could not "
@@ -399,6 +404,7 @@ class QueryServer:
                 self.serving = cand.serving
                 self.candidate = None
                 self._candidate_foldin_pending = {}
+                self._candidate_item_pending = {}
         log.info("candidate promoted: instance %s now active",
                  self.instance.id)
 
@@ -815,7 +821,8 @@ class QueryServer:
         return prediction
 
     # -- streaming fold-in (pio_tpu/freshness/) ------------------------------
-    def foldin_upsert(self, rows, staleness_s: float | None = None) -> dict:
+    def foldin_upsert(self, rows, staleness_s: float | None = None,
+                      items=None) -> dict:
         """Hot-swap refreshed user factor rows into the serving model
         (the freshness subsystem's apply surface): existing users'
         rows are replaced in place, new users are APPENDED — id index
@@ -825,8 +832,20 @@ class QueryServer:
         failure anywhere leaves the previous model serving untouched.
         ``rows`` maps user id → (k,)-float sequence. With a rollout in
         flight the rows land on BOTH arms (or queue for the candidate),
-        so streaming freshness never silently diverges the experiment."""
-        if not rows:
+        so streaming freshness never silently diverges the experiment.
+
+        ``items`` maps item id → (k,)-float sequence and upserts
+        EXISTING items' factor rows in the same atomic swap — including
+        the two-stage retrieval sidecar (ops/retrieval.py): the cached
+        quantized table and cluster assignments are re-encoded for
+        exactly the touched rows, so an upserted item is retrievable
+        through the candidate tier immediately after this call returns,
+        not after a lazy rebuild. Unknown item ids are REJECTED (shard
+        parity: appending an item needs a global dense index that only
+        a retrain/repartition assigns)."""
+        rows = rows or {}
+        items = items or {}
+        if not rows and not items:
             with self._lock:
                 return {"applied": 0, "new": 0,
                         "engineInstanceId": self.instance.id}
@@ -834,6 +853,10 @@ class QueryServer:
             models = list(self.models)
             instance_id = self.instance.id
         mi, model, new_model, new_ids = _fold_rows_into(models, rows)
+        items_applied, items_rejected = 0, []
+        if items:
+            new_model, items_applied, items_rejected = \
+                _fold_item_rows_into(new_model, items)
         with self._lock:
             # the model may have moved while we built the new one: a
             # /reload (new instance — applying stale rows onto it would
@@ -851,11 +874,15 @@ class QueryServer:
             models[mi] = new_model
             self.models = models
             self.foldin_applied_users += len(rows)
+            self.foldin_applied_items += items_applied
             self.foldin_last_time = utcnow()
             if staleness_s is not None:
                 self.foldin_last_staleness_s = float(staleness_s)
         out = {"applied": len(rows), "new": len(new_ids),
                "engineInstanceId": instance_id}
+        if items:
+            out["itemsApplied"] = items_applied
+            out["itemsRejected"] = items_rejected
         # second arm: the ACTIVE apply above is the durable one (the
         # folder's cursor advances on it); the candidate apply is
         # best-effort-with-queue — a failure parks the rows in
@@ -864,11 +891,12 @@ class QueryServer:
         with self._lock:
             has_candidate = self.candidate is not None
         if has_candidate:
-            out["candidateQueued"] = self._apply_foldin_to_candidate(rows)
+            out["candidateQueued"] = self._apply_foldin_to_candidate(
+                rows, items)
         return out
 
-    def _apply_foldin_to_candidate(self, rows) -> int:
-        """Apply `rows` (plus anything previously queued) to the
+    def _apply_foldin_to_candidate(self, rows, items=None) -> int:
+        """Apply `rows`/`items` (plus anything previously queued) to the
         candidate arm. Returns the queue depth left behind (0 = fully
         applied). Never raises: the active apply already succeeded and
         the folder must not re-solve the window for a canary hiccup."""
@@ -876,34 +904,45 @@ class QueryServer:
             cand = self.candidate
             if cand is None:
                 self._candidate_foldin_pending = {}
+                self._candidate_item_pending = {}
                 return 0
             pending = dict(self._candidate_foldin_pending)
             pending.update(rows)
+            pending_items = dict(self._candidate_item_pending)
+            pending_items.update(items or {})
             models = list(cand.models)
         try:
             mi, model, new_model, _ = _fold_rows_into(models, pending)
+            if pending_items:
+                new_model, _, _ = _fold_item_rows_into(
+                    new_model, pending_items)
         except ValueError as e:
             with self._lock:
                 self._candidate_foldin_pending = pending
+                self._candidate_item_pending = pending_items
             log.warning("fold-in rows queued for candidate arm (%d "
-                        "users): %s", len(pending), e)
-            return len(pending)
+                        "users, %d items): %s", len(pending),
+                        len(pending_items), e)
+            return len(pending) + len(pending_items)
         with self._lock:
             cand = self.candidate
             if cand is None:
                 self._candidate_foldin_pending = {}
+                self._candidate_item_pending = {}
                 return 0
             if cand.models[mi] is not model:
                 # the arm moved mid-build (promote/drop/another apply):
                 # queue and let the next apply land on the new arm
                 self._candidate_foldin_pending = pending
-                return len(pending)
+                self._candidate_item_pending = pending_items
+                return len(pending) + len(pending_items)
             cand_models = list(cand.models)
             cand_models[mi] = new_model
             self.candidate = _CandidateArm(
                 instance=cand.instance, models=cand_models,
                 algorithms=cand.algorithms, serving=cand.serving)
             self._candidate_foldin_pending = {}
+            self._candidate_item_pending = {}
         return 0
 
     def _flush_candidate_foldin(self) -> None:
@@ -911,18 +950,21 @@ class QueryServer:
         the promoted arm is as fresh as the active one was)."""
         with self._lock:
             pending = dict(self._candidate_foldin_pending)
-        if pending:
-            self._apply_foldin_to_candidate(pending)
+            pending_items = dict(self._candidate_item_pending)
+        if pending or pending_items:
+            self._apply_foldin_to_candidate(pending, pending_items)
 
     def foldin_status(self) -> dict:
         """Bounded-staleness accounting for /readyz + /metrics.json."""
         with self._lock:
             return {
                 "appliedUsers": self.foldin_applied_users,
+                "appliedItems": self.foldin_applied_items,
                 "lastAppliedTime": (format_time(self.foldin_last_time)
                                     if self.foldin_last_time else None),
                 "stalenessSeconds": self.foldin_last_staleness_s,
-                "candidateQueued": len(self._candidate_foldin_pending),
+                "candidateQueued": (len(self._candidate_foldin_pending)
+                                    + len(self._candidate_item_pending)),
             }
 
     # -- status -------------------------------------------------------------
@@ -1024,7 +1066,71 @@ def _fold_rows_into(models: list, rows) -> tuple:
         factors=dataclasses.replace(model.factors, user_factors=new_uf),
         users=users.extended(new_ids) if new_ids else users,
     )
+    # a user-only fold-in leaves item_factors the SAME array object, so
+    # the retrieval sidecar cache (keyed by item-table identity in
+    # models/recommendation.py) stays valid — carry it so a user upsert
+    # never forces a k-means rebuild on the next clustered query
+    cache = getattr(model, "_retrieval_cache", None)
+    if cache is not None:
+        new_model._retrieval_cache = cache
     return mi, model, new_model, new_ids
+
+
+def _fold_item_rows_into(model, items) -> tuple:
+    """Upsert EXISTING items' factor rows on `model` — the item-side
+    half of streaming fold-in. Returns ``(new_model, applied,
+    rejected_ids)``; unknown ids are rejected, not appended (appending
+    an item needs the retrieval/partition tier's dense index space to
+    grow, which only a retrain assigns — shard.upsert_item_rows makes
+    the same call). When the model carries a two-stage retrieval cache
+    for its current item table, the quantized rows and cluster
+    assignments are re-encoded for the touched positions IN THIS BUILD,
+    so the swap that publishes the f32 rows publishes the candidate
+    tier's view of them too — never a stale quantized row serving
+    beside a fresh f32 one. Raises ValueError on rank mismatch."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    itf = getattr(getattr(model, "factors", None), "item_factors", None)
+    if itf is None or getattr(model, "items", None) is None:
+        raise ValueError(
+            "item fold-in needs a factor-table model (factors."
+            "item_factors + items index); the deployed model "
+            "does not qualify")
+    k = int(itf.shape[1])
+    positions: list[int] = []
+    vals: list = []
+    rejected: list = []
+    for iid, row in items.items():
+        if len(row) != k:
+            raise ValueError(
+                f"fold-in row for item {iid!r} has {len(row)} dims, "
+                f"model rank is {k}")
+        if iid in model.items:
+            positions.append(model.items.index_of(iid))
+            vals.append(row)
+        else:
+            rejected.append(iid)
+    if not positions:
+        return model, 0, rejected
+    pos = np.fromiter(positions, np.int32, count=len(positions))
+    rows_f32 = np.asarray(vals, np.float32)
+    new_itf = itf.at[jnp.asarray(pos)].set(jnp.asarray(rows_f32))
+    new_model = dataclasses.replace(
+        model,
+        factors=dataclasses.replace(model.factors, item_factors=new_itf),
+    )
+    cache = getattr(model, "_retrieval_cache", None)
+    if cache is not None and cache[0] is itf:
+        from pio_tpu.ops import retrieval as rt
+
+        idx, _didx = cache[1]
+        new_idx = idx.updated(pos, rows_f32)
+        new_model._retrieval_cache = (
+            new_itf, (new_idx, rt.build_device_index(new_idx)))
+    return new_model, len(positions), rejected
 
 
 def _depth_for_rtt(rtt_s: float) -> int:
@@ -1268,20 +1374,26 @@ def build_serving_app(server: QueryServer) -> HttpApp:
     @app.route("POST", r"/model/upsert_users")
     def upsert_users(req: Request):
         """Streaming fold-in apply surface (pio_tpu/freshness/): body
-        ``{"users": {id: [row]}, "stalenessSeconds"?: s}``. Guarded like
-        /reload — it mutates the serving model."""
+        ``{"users": {id: [row]}, "items"?: {id: [row]},
+        "stalenessSeconds"?: s}``. Item rows upsert existing items AND
+        their two-stage retrieval sidecar in the same swap. Guarded
+        like /reload — it mutates the serving model."""
         if not check_server_key(req):
             return 401, {"message": "Invalid accessKey."}
         try:
             body = req.json()
         except Exception as e:  # noqa: BLE001 - malformed body
             return 400, {"message": f"Invalid body: {e}"}
-        if not isinstance(body, dict) or not isinstance(
-                body.get("users"), dict):
-            return 400, {"message": "body must be {\"users\": {id: [row]}}"}
+        users = body.get("users") if isinstance(body, dict) else None
+        items = body.get("items") if isinstance(body, dict) else None
+        if not isinstance(users, dict) and not isinstance(items, dict):
+            return 400, {"message": "body must be {\"users\": {id: [row]}}"
+                                    " and/or {\"items\": {id: [row]}}"}
         try:
             out = server.foldin_upsert(
-                body["users"], body.get("stalenessSeconds"))
+                users if isinstance(users, dict) else {},
+                body.get("stalenessSeconds"),
+                items=items if isinstance(items, dict) else {})
         except ValueError as e:
             return 400, {"message": str(e)}
         return 200, out

@@ -588,3 +588,120 @@ def test_subprocess_fleet_chaos_drill(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+# -- two-stage retrieval: the candidate tier on the fleet ---------------------
+
+def test_clustered_fleet_serves_and_item_upsert_retrievable(trained):
+    """The candidate tier end to end on a sharded fleet: clustered
+    shards answer /shard/candidates and router queries; a router item
+    upsert fans to every group, lands on the owner, updates the
+    quantized sidecar in the same apply, and is retrievable through
+    the candidate tier immediately (the fold-in acceptance)."""
+    storage, engine, ep, ctx, iid = trained
+    _, model = resolve_fleet_model(storage, "rec")
+    handle = deploy_fleet(
+        storage, engine_id="rec", n_shards=2, n_replicas=1,
+        retrieval={"mode": "clustered", "dtype": "int8",
+                   "nprobe": 1, "rerank_k": 8})
+    try:
+        status, out = call(handle.router_http.port, "POST",
+                           "/queries.json", body={"user": "u0", "num": 3})
+        assert status == 200 and out["itemScores"]
+        # the shard surfaces its tier on /shard/info and /shard/candidates
+        sport = handle.shards[0][0].port
+        status, info = call(sport, "GET", "/shard/info")
+        assert status == 200
+        r = info["retrieval"]
+        assert (r["mode"], r["dtype"], r["nprobe"]) == ("clustered",
+                                                        "int8", 1)
+        assert r["quantizedBytes"] > 0 and r["f32ItemBytes"] > 0
+        urow = np.asarray(model.factors.user_factors)[
+            model.users.index_of("u0")]
+        status, cand = call(sport, "POST", "/shard/candidates",
+                            body={"row": [float(x) for x in urow], "k": 2})
+        assert status == 200 and cand["items"]
+        assert len(cand["items"]) == len(cand["scores"])
+        # item upsert through the router: fans to EVERY group, only the
+        # owner applies; an id no group owns is reported failed
+        status, out = call(
+            handle.router_http.port, "POST", "/fleet/upsert_users",
+            body={"items": {"i7": [float(10.0 * x) for x in urow],
+                            "zzz": [0.0, 0.0, 0.0, 0.0]}})
+        assert status == 200, out
+        assert out["itemsApplied"] == 1
+        assert out["itemsFailed"] == ["zzz"]
+        # retrievable through the candidate tier in the very next query
+        status, out = call(handle.router_http.port, "POST",
+                           "/queries.json", body={"user": "u0", "num": 1})
+        assert status == 200
+        assert out["itemScores"][0]["item"] == "i7", out
+    finally:
+        handle.close()
+
+
+def test_clustered_exhaustive_fleet_bit_identical_to_oracle(trained):
+    """The exactness contract on the fleet: a clustered config whose
+    nprobe covers every cluster branches to the literal oracle path on
+    each shard, so the routed/merged answers — blackList, whiteList,
+    over-fetch included — are BIT-identical to single-host serving."""
+    storage, engine, ep, ctx, iid = trained
+    algo = engine._doers(ep)[2][0]
+    full = load_models(storage, engine, ep, iid, ctx=ctx)[0]
+    queries = [
+        {"user": "u0", "num": 4},
+        {"user": "u3", "num": 6, "blackList": ["i1", "i5"]},
+        {"user": "u5", "num": 3, "whiteList": ["i2", "i7", "i9", "nope"]},
+        {"user": "ghost", "num": 4},
+        {"user": "u7", "num": 50},
+    ]
+    handle = deploy_fleet(
+        storage, engine_id="rec", n_shards=2, n_replicas=1,
+        retrieval={"mode": "clustered", "dtype": "int8",
+                   "nprobe": 32, "rerank_k": 64})
+    try:
+        for q in queries:
+            status, fleet_out = call(handle.router_http.port, "POST",
+                                     "/queries.json", body=dict(q))
+            assert status == 200, (q, fleet_out)
+            assert fleet_out == algo.predict(full, dict(q)), q
+    finally:
+        handle.close()
+    # a typo'd retrieval block fails the whole deploy up front
+    with pytest.raises(ValueError, match="unknown retrieval config"):
+        deploy_fleet(storage, engine_id="rec", n_shards=1, n_replicas=1,
+                     retrieval={"nprobes": 4})
+
+
+def test_shard_budget_charges_retrieval_sidecar(trained):
+    """ISSUE 19's small fix: the memory budget charges the f32
+    partition AND the quantized sidecar — a budget the bare f32
+    partition fits under must still refuse a clustered load, BEFORE
+    the k-means build; and the realized post-build bytes are re-checked
+    before any swap."""
+    storage, *_, iid = trained
+    persist_fleet_artifacts(
+        storage, iid, resolve_fleet_model(storage, "rec")[1], 1, 1)
+    part = load_partition(storage, iid, 0)
+    retrieval = {"mode": "clustered", "dtype": "int8",
+                 "nprobe": 1, "rerank_k": 8}
+    with pytest.raises(ShardMemoryBudgetExceeded, match="sidecar"):
+        create_shard_server(storage, ShardConfig(
+            shard_index=0, n_shards=1, engine_id="rec", instance_id=iid,
+            memory_budget_bytes=part.nbytes(), retrieval=retrieval))
+    # the same budget is fine in exact mode (no sidecar to charge)
+    _http, srv = create_shard_server(storage, ShardConfig(
+        shard_index=0, n_shards=1, engine_id="rec", instance_id=iid,
+        memory_budget_bytes=part.nbytes()))
+    assert srv.partition is not None
+    # realized re-check: an arm whose BUILT sidecar exceeds the budget
+    # is refused at swap time even if an estimate let it through
+    from pio_tpu.serving_fleet.shard import _prepare_arm
+
+    _http2, srv2 = create_shard_server(storage, ShardConfig(
+        shard_index=0, n_shards=1, engine_id="rec", instance_id=iid,
+        retrieval=retrieval))
+    arm = _prepare_arm(srv2.partition, srv2._rparams)
+    srv2.config.memory_budget_bytes = srv2.partition.nbytes() + 1
+    with pytest.raises(ShardMemoryBudgetExceeded, match="realized"):
+        srv2._enforce_budget_realized(srv2.partition, arm)

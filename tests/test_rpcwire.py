@@ -115,6 +115,66 @@ def test_every_truncation_and_bitflip_rejected():
             rpcwire.decode_topk_response(bytes(flipped))
 
 
+def test_candidates_request_roundtrip_and_confusion():
+    """kind-6 CAND_REQ (two-stage retrieval fan): round-trips
+    bit-exactly and cannot be confused with a kind-1 topk request —
+    the response side deliberately reuses kind-2 TOPK_RESP so the
+    router merge is shared code."""
+    row = np.random.default_rng(3).standard_normal(6).astype(np.float32)
+    got_row, k, arm = rpcwire.decode_candidates_request(
+        rpcwire.encode_candidates_request(row, 5, "candidate"))
+    assert got_row.tobytes() == row.tobytes()
+    assert (k, arm) == (5, "candidate")
+    with pytest.raises(rpcwire.RpcWireError):
+        rpcwire.decode_topk_request(
+            rpcwire.encode_candidates_request(row, 5))
+    with pytest.raises(rpcwire.RpcWireError):
+        rpcwire.decode_candidates_request(
+            rpcwire.encode_topk_request(row, 5))
+
+
+@pytest.mark.parametrize("qdtype", [None, "int8", "bf16"])
+def test_partition_slice_quantized_sections_roundtrip(qdtype):
+    """kind-5 RESHARD_PART with the optional quantized sidecar
+    sections: carried qrows/qscales round-trip bit-exactly, and a
+    pre-retrieval slice (no qdtype) still decodes — backward compat
+    with blobs cut before the candidate tier existed."""
+    from pio_tpu.ops.retrieval import encode_rows
+    from pio_tpu.serving_fleet.plan import PartitionSlice
+
+    rng = np.random.default_rng(4)
+    item_rows = rng.standard_normal((5, 3)).astype(np.float32)
+    qrows = qscales = None
+    if qdtype is not None:
+        qrows, qscales = encode_rows(item_rows, qdtype)
+    sl = PartitionSlice(
+        partition=2, instance_id="inst-1", k=3,
+        user_ids=["u1", "u2"],
+        user_rows=rng.standard_normal((2, 3)).astype(np.float32),
+        item_ids=[f"i{n}" for n in range(5)],
+        item_gidx=np.arange(5, dtype=np.int32),
+        item_rows=item_rows,
+        qdtype=qdtype, item_qrows=qrows, item_qscales=qscales)
+    frame = rpcwire.encode_partition_slice(sl)
+    out = rpcwire.decode_partition_slice(frame)
+    assert out.user_rows.tobytes() == sl.user_rows.tobytes()
+    assert out.item_rows.tobytes() == sl.item_rows.tobytes()
+    assert out.qdtype == qdtype
+    if qdtype is None:
+        assert out.item_qrows is None and out.item_qscales is None
+    else:
+        assert out.item_qrows.tobytes() == qrows.tobytes()
+        assert out.item_qscales.tobytes() == qscales.tobytes()
+        # a bit-rotted transfer dies, never stages silently
+        r = random.Random(5)
+        for _ in range(32):
+            flipped = bytearray(frame)
+            pos = r.randrange(len(flipped))
+            flipped[pos] ^= 1 << r.randrange(8)
+            with pytest.raises(rpcwire.RpcWireError):
+                rpcwire.decode_partition_slice(bytes(flipped))
+
+
 def test_forged_count_dies_before_allocation():
     import json as _json
     import struct
