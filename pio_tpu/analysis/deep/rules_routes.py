@@ -49,7 +49,11 @@ FAMILY = "route-contract"
 PROBE_TOKEN = "XpX"   # no slash, no dot: matches ([^/]+) and ([^/.]+)
 GUARDED_PREFIXES = ("/rollout", "/debug", "/reshard",
                     "/fleet/attach_tenant", "/fleet/detach_tenant",
-                    "/host/attach_tenant", "/host/detach_tenant")
+                    "/host/attach_tenant", "/host/detach_tenant",
+                    # continuous batching: the window mutator is
+                    # key-guarded; the bare /batcher.json status GET is
+                    # deliberately public (shed-exempt runbook surface)
+                    "/batcher/window")
 BINARY_CONSTS = ("RPC_CONTENT_TYPE", "COLUMNAR_CONTENT_TYPE")
 CLIENT_METHODS = frozenset({"request", "call"})
 # multi-tenant header contract (serving_fleet/tenancy.py): these shard

@@ -440,6 +440,15 @@ UNTRACED_PATHS = HEALTH_PATHS + (
     "/shard/info",
 )
 
+# observability READ surfaces exempt from load shedding (they still run
+# on the worker pool): saturation is exactly when the occupancy/shedding
+# runbooks need the scrape and the batcher status to answer — shedding
+# the diagnostics of an overload makes the overload undiagnosable. All
+# of these are lock-snapshot cheap and never touch the device.
+SHED_EXEMPT_PATHS = HEALTH_PATHS + (
+    "/metrics", "/metrics.json", "/batcher.json",
+)
+
 
 class AsyncHttpServer:
     """asyncio HTTP/1.1 server over the same HttpApp (keep-alive, bounded
@@ -609,7 +618,9 @@ class AsyncHttpServer:
         # load shedding: bounded-queue backpressure. Above the
         # watermark new work answers 503 + Retry-After — how a
         # balancer learns to STOP sending the traffic being shed.
-        shed = not self.shedder.try_acquire()
+        # Observability reads are exempt (SHED_EXEMPT_PATHS).
+        exempt = parsed.path in SHED_EXEMPT_PATHS
+        shed = not exempt and not self.shedder.try_acquire()
         if shed:
             await self._respond(
                 writer, 503,
@@ -626,7 +637,8 @@ class AsyncHttpServer:
                 .run_in_executor(
                     self._pool, dispatch_safe, self.app, req)
         finally:
-            self.shedder.release()
+            if not exempt:  # exempt paths never acquired
+                self.shedder.release()
         await self._respond(writer, status, payload, close)
         return close
 

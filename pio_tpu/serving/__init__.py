@@ -1,0 +1,11 @@
+"""Serving-side admission and batching (single-host dispatch shaping).
+
+The device-facing serving logic lives in pio_tpu/workflow/serve.py (the
+QueryServer) and pio_tpu/serving_fleet/ (the sharded fleet); this package
+holds the pieces that sit BETWEEN the HTTP edge and the device program —
+today the cross-request continuous batcher (docs/serving.md "Continuous
+batching")."""
+
+from pio_tpu.serving.batcher import ContinuousBatcher
+
+__all__ = ["ContinuousBatcher"]

@@ -46,7 +46,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass, field
 
 from pio_tpu.resilience import (
@@ -82,6 +83,173 @@ class ShardUnavailable(ConnectionError):
             + (f" (last error: {last_error})" if last_error else "")
         )
         self.shard_index = shard_index
+
+
+class _BatchUnsupported(Exception):
+    """A batched frame can't be used for this dispatch — JSON-wire
+    config, a replica not yet confirmed on the binary wire, or a
+    replica that 400'd the batched layout (pre-batch shard build).
+    Internal to the coalescer, which falls back to per-query solo
+    calls; never surfaced to a caller."""
+
+
+class _ShardCoalescer:
+    """Cross-request coalescing for the scoring RPCs: concurrent calls
+    to the same ``(shard, op, arm, plan_version)`` within one coalesce
+    window merge into ONE batched binary frame — one RPC, one device
+    program on the shard — instead of N.
+
+    Leader/follower, no dispatcher thread: the FIRST caller to open a
+    key becomes the leader. It is already running in a router worker
+    thread (the per-query fan pool or the batch pool), so it simply
+    sleeps out the window there, pops whatever accumulated, and
+    dispatches; later arrivals append and park on their futures. A
+    window that ends with a single member takes the untouched solo
+    path (``_call(..., coalesce=False)``) — same chaos point, same
+    wire negotiation, same tracing — so coalescing is strictly
+    additive. A deadline-doomed caller (budget <= window) never waits:
+    it dispatches solo immediately, and the solo path's Deadline.check
+    sheds it if the budget is already spent.
+
+    Failure semantics match solo exactly: a whole-group failure
+    (ShardUnavailable, injected chaos fault) lands on EVERY member's
+    future — each would have seen the same outcome calling alone — and
+    the router's existing degrade path flags only the affected slots.
+    ``_BatchUnsupported`` (pre-batch replica) falls back to sequential
+    per-query solo calls with per-future results/exceptions."""
+
+    def __init__(self, router: "FleetRouter", window_s: float,
+                 max_batch: int):
+        self.router = router
+        self.window_s = float(window_s)
+        self.max_batch = int(max_batch)
+        self._lock = threading.Lock()
+        # key -> list[(body, Future, t_enq)]; popped wholesale by the
+        # key's leader when its window closes
+        self._groups: dict[tuple, list] = {}
+        self.coalesced_calls = 0    # batched dispatches (>= 2 members)
+        self.coalesced_queries = 0  # queries riding them
+        self.solo_windows = 0       # windows that closed with 1 member
+        self.fallback_calls = 0     # _BatchUnsupported sequential runs
+        self.doomed_bypass = 0      # deadline-doomed immediate solos
+
+    def call(self, shard: int, op: str, path: str, body: dict,
+             plan_version: int | None) -> dict:
+        rem = Deadline.remaining()
+        if rem is not None and rem <= self.window_s:
+            # can't afford the window: dispatch solo NOW (Deadline.check
+            # on the solo path sheds it if the budget is already gone)
+            with self._lock:
+                self.doomed_bypass += 1
+            return self.router._call(shard, op, path, body,
+                                     plan_version, coalesce=False)
+        key = (shard, op, body.get("arm", ARM_ACTIVE), plan_version,
+               path)
+        fut: Future = Future()
+        with self._lock:
+            pending = self._groups.get(key)
+            if pending is not None and len(pending) < self.max_batch:
+                pending.append((body, fut, time.monotonic()))
+                leader = False
+            else:
+                self._groups[key] = [(body, fut, time.monotonic())]
+                leader = True
+        if leader:
+            # window anchored at the FIRST member's arrival (ours)
+            self._lead(key, shard, op, path, plan_version)
+            # _lead resolved every future in the batch, ours included
+        try:
+            return fut.result(timeout=rem)
+        except FuturesTimeoutError:
+            raise DeadlineExceeded(
+                f"request budget exhausted waiting for coalesced "
+                f"shard {shard} {op}") from None
+
+    def _lead(self, key: tuple, shard: int, op: str, path: str,
+              plan_version: int | None) -> None:
+        if self.window_s > 0:
+            time.sleep(self.window_s)
+        with self._lock:
+            batch = self._groups.pop(key, [])
+        if not batch:
+            return
+        now = time.monotonic()
+        tracer = self.router.tracer
+        tracer.histogram("fleet.batch_occupancy").record(
+            len(batch) / self.max_batch)
+        for _, _, t_enq in batch:
+            tracer.record("fleet.coalesce_wait", now - t_enq)
+        if len(batch) == 1:
+            with self._lock:
+                self.solo_windows += 1
+            self._solo_each(batch, shard, op, path, plan_version)
+            return
+        with self._lock:
+            self.coalesced_calls += 1
+            self.coalesced_queries += len(batch)
+        bodies = [b for b, _, _ in batch]
+        try:
+            results = self.router._call_batch(shard, op, path, bodies,
+                                              plan_version)
+        except _BatchUnsupported:
+            with self._lock:
+                self.fallback_calls += 1
+            self._solo_each(batch, shard, op, path, plan_version)
+            return
+        except BaseException as e:
+            # whole-group failure: every member sees exactly what it
+            # would have seen calling alone, and the caller's existing
+            # degrade path handles it (only the affected slots degrade)
+            for _, fut, _ in batch:
+                fut.set_exception(e)
+            return
+        if len(results) != len(batch):
+            # decode bounds every count, but nothing ties the shard's
+            # answer length to OUR request length — treat a mismatch
+            # like a corrupt frame rather than misdelivering answers
+            err = HttpClientError(
+                0, f"batched shard {shard} {op} answered "
+                   f"{len(results)} results for {len(batch)} queries")
+            for _, fut, _ in batch:
+                fut.set_exception(err)
+            return
+        for (_, fut, _), out in zip(batch, results):
+            fut.set_result(out)
+
+    def _solo_each(self, batch: list, shard: int, op: str, path: str,
+                   plan_version: int | None) -> None:
+        for body, fut, _ in batch:
+            try:
+                fut.set_result(self.router._call(
+                    shard, op, path, body, plan_version,
+                    coalesce=False))
+            except BaseException as e:
+                fut.set_exception(e)
+
+    def stats(self) -> dict:
+        tracer = self.router.tracer
+        occ = tracer.histogram("fleet.batch_occupancy")
+        wait = tracer.histogram("fleet.coalesce_wait")
+        occ_snap = occ.snapshot()
+        wait_q = wait.quantiles()
+        with self._lock:
+            out = {
+                "enabled": True,
+                "windowMs": self.window_s * 1e3,
+                "maxBatch": self.max_batch,
+                "coalescedCalls": self.coalesced_calls,
+                "coalescedQueries": self.coalesced_queries,
+                "soloWindows": self.solo_windows,
+                "fallbackCalls": self.fallback_calls,
+                "doomedBypass": self.doomed_bypass,
+            }
+        out["meanOccupancy"] = (round(occ_snap["avg"], 4)
+                                if occ_snap["count"] else None)
+        out["occupancy"] = {k: round(v, 4)
+                            for k, v in occ.quantiles().items()}
+        out["coalesceWaitMs"] = {k: round(v * 1e3, 3)
+                                 for k, v in wait_q.items()}
+        return out
 
 
 @dataclass
@@ -132,6 +300,20 @@ class RouterConfig:
     # (-score, global_index) semantics. "exact" (default) keeps the
     # /shard/topk fan, including against pre-retrieval shards.
     retrieval_mode: str = "exact"
+    # cross-request continuous batching (docs/serving.md "Continuous
+    # batching"): > 0 coalesces concurrent per-shard scoring fan-outs
+    # arriving within this window (ms) into ONE multi-query binary
+    # frame per shard group (rpcwire.py batched kinds 1/6), answered
+    # from one batched device dispatch — N concurrent user queries
+    # cost one RPC + one device program per group instead of N. Only
+    # topk/candidates coalesce; queries whose Deadline cannot survive
+    # the window dispatch solo. 2 ms is the recommended value when
+    # enabling. 0 = off (every fan-out is its own RPC, the historical
+    # behavior).
+    coalesce_window_ms: float = 0.0
+    # most queries one batched frame may carry; arrivals past it start
+    # the next batch immediately
+    coalesce_max_batch: int = 64
 
 
 class _TenantClient(JsonHttpClient):
@@ -174,6 +356,11 @@ class _Replica:
     # request bodies go binary too), False = STICKY JSON downgrade (a
     # pre-binary shard ignored the negotiation; logged once)
     binary_wire: bool | None = None
+    # batched-frame negotiation state, same ladder one level up: None =
+    # untested, True = confirmed (batched multi-query frames OK), False
+    # = STICKY per-query downgrade (a pre-batch shard 400'd the batched
+    # frame; logged once). Only meaningful once binary_wire is True.
+    batch_wire: bool | None = None
 
 
 class FleetRouter:
@@ -243,10 +430,29 @@ class FleetRouter:
             for s, urls in enumerate(endpoints)
         ]
         self._preferred = [0] * plan.n_shards
+        # with coalescing on, follower fan tasks PARK in the coalescer
+        # holding their pool thread until the leader dispatches — size
+        # the fan pool for parked concurrency, not just one fan in
+        # flight, or queued fan tasks would serialize behind each window
+        fan_workers = (max(16, 4 * plan.n_shards)
+                       if config.coalesce_window_ms > 0
+                       else max(4, 2 * plan.n_shards))
         self._pool = ThreadPoolExecutor(
-            max_workers=max(4, 2 * plan.n_shards),
+            max_workers=fan_workers,
             thread_name_prefix="fleet-fan",
         )
+        # cross-request coalescing of the scoring fan (docs/serving.md
+        # "Continuous batching"); None = historical per-query RPCs
+        self._coalescer = (
+            _ShardCoalescer(self, config.coalesce_window_ms / 1e3,
+                            config.coalesce_max_batch)
+            if config.coalesce_window_ms > 0 else None
+        )
+        # dedicated pool for query_batch concurrency under coalescing:
+        # the query layer must NEVER run on the fan pool (its shard
+        # fan-outs land there — nesting would deadlock the pool on its
+        # own children). Lazily built on first use.
+        self._batch_pool: ThreadPoolExecutor | None = None
         self._prober: threading.Thread | None = None
         if config.probe_interval_s > 0:
             # pio: lint-ok[context-loss] deliberate detach: the health
@@ -272,7 +478,8 @@ class FleetRouter:
         return order
 
     def _call(self, shard: int, op: str, path: str, body,
-              plan_version: int | None = None) -> dict:
+              plan_version: int | None = None,
+              coalesce: bool = True) -> dict:
         """One shard-group RPC: replicas in preference order, per-replica
         breaker guard, transient failures roll to the next replica.
         Raises ShardUnavailable when the whole group is down. The whole
@@ -280,7 +487,17 @@ class FleetRouter:
         arm); a whole-group failure — including an injected
         fleet.shard<i>.<op> chaos fault — records as a FAILED span
         tagged with the chaos point, so `pio trace` shows exactly which
-        hop a drill (or real outage) took down."""
+        hop a drill (or real outage) took down.
+
+        With coalescing on, scoring RPCs detour through the coalescer
+        (which groups concurrent same-(shard, op, arm, plan) calls into
+        one batched frame); `coalesce=False` is the coalescer's own
+        re-entry guard for its singleton/fallback dispatches."""
+        if (coalesce and self._coalescer is not None
+                and op in ("topk", "candidates")
+                and isinstance(body, dict)):
+            return self._coalescer.call(shard, op, path, body,
+                                        plan_version)
         arm = (body.get("arm", ARM_ACTIVE) if isinstance(body, dict)
                else ARM_ACTIVE)
         attrs = {"shard": shard, "op": op, "arm": arm}
@@ -454,6 +671,154 @@ class FleetRouter:
                 and not isinstance(body.get("row"), list)):
             return {**body, "row": [float(x) for x in body["row"]]}
         return body
+
+    # -- batched scoring RPCs (continuous batching) --------------------------
+    def _call_batch(self, shard: int, op: str, path: str, bodies: list,
+                    plan_version: int | None = None) -> list:
+        """Batched analog of _call for one coalesced window: one RPC,
+        one device program, ``len(bodies)`` answers in request order.
+        Raises _BatchUnsupported when the usable replica can't take
+        batched frames (the coalescer falls back to per-query solo
+        calls) and ShardUnavailable when the whole group is down —
+        the same degrade contract as the solo path."""
+        arm = bodies[0].get("arm", ARM_ACTIVE)
+        attrs = {"shard": shard, "op": op, "arm": arm,
+                 "batch": len(bodies)}
+        if self.config.tenant:
+            attrs["tenant"] = self.config.tenant
+        with self.tracer.span("shard.rpc", **attrs):
+            return self._call_group_batch(shard, op, path, bodies,
+                                          plan_version)
+
+    def _call_group_batch(self, shard: int, op: str, path: str,
+                          bodies: list,
+                          plan_version: int | None = None) -> list:
+        Deadline.check(f"shard {shard} {op} batch")
+        if self.config.rpc_wire != "binary":
+            raise _BatchUnsupported("json rpc wire configured")
+        try:
+            # SAME drill point as the solo path: a spec targeting
+            # fleet.shard<i>.<op> takes down coalesced dispatches too,
+            # so existing chaos drills exercise the batched plane
+            chaos.maybe_inject(
+                f"{self.config.chaos_prefix}.shard{shard}.{op}")
+        except ConnectionError as e:
+            raise ShardUnavailable(shard, e) from e
+        replicas = self.replicas
+        if shard >= len(replicas):
+            raise ShardUnavailable(
+                shard, ConnectionError("shard group removed by reshard"))
+        group = replicas[shard]
+        last_error: Exception | None = None
+        for r in self._replica_order(shard, group):
+            Deadline.check(f"shard {shard} {op} batch replica {r}")
+            rep = group[r]
+            if not rep.breaker.allow():
+                last_error = CircuitOpenError(
+                    rep.breaker.name,
+                    retry_after_s=rep.breaker.retry_after_s() or 1.0)
+                continue
+            if rep.binary_wire is not True or rep.batch_wire is False:
+                # only a CONFIRMED-binary replica that hasn't rejected
+                # a batched frame gets one; otherwise fall back to solo
+                # calls, which run the normal wire negotiation (and
+                # confirm the replica for the NEXT window)
+                raise _BatchUnsupported(
+                    f"replica {rep.url} not confirmed batch-capable")
+            try:
+                out = self._rpc_batch(rep, op, path, bodies,
+                                      plan_version)
+            except _BatchUnsupported:
+                # the replica DID answer (an application 400): it is
+                # healthy, just pre-batch — don't charge its breaker
+                rep.breaker.record(True)
+                raise
+            except HttpClientError as e:
+                if (e.status == 503 and isinstance(e.message, str)
+                        and e.message.startswith(
+                            ("candidate-arm-missing",
+                             "plan-version-missing"))):
+                    # healthy replica without the arm/epoch — fail over
+                    # without charging the breaker (same as solo)
+                    rep.breaker.record(True)
+                    last_error = e
+                    log.warning("shard %d replica %d (%s) has no arm "
+                                "for batched %s (%s); trying next",
+                                shard, r, rep.url, op, e.message)
+                    continue
+                rep.breaker.record(not is_transient(e))
+                if e.status and e.status not in (408, 429, 502, 503,
+                                                 504):
+                    raise  # application error: the shard DID answer
+                last_error = e
+                log.warning("shard %d replica %d (%s) failed batched "
+                            "%s: %s", shard, r, rep.url, op, e)
+                continue
+            rep.breaker.record(True)
+            with self._lock:
+                if (shard < len(self._preferred)
+                        and self._preferred[shard] != r):
+                    self.rerouted_count += 1
+                    self._preferred[shard] = r
+            return out
+        raise ShardUnavailable(shard, last_error)
+
+    def _rpc_batch(self, rep: _Replica, op: str, path: str,
+                   bodies: list,
+                   plan_version: int | None = None) -> list:
+        """One batched replica RPC. Only reached for a confirmed-binary
+        replica whose batch_wire isn't known-False. A 400 means a
+        pre-batch shard build whose solo decoder rejected the layout:
+        sticky ``batch_wire=False`` downgrade, logged once — the
+        binary→JSON negotiation ladder one level up (that replica keeps
+        serving solo frames; everything else keeps batching)."""
+        from pio_tpu.serving_fleet import rpcwire
+
+        hdrs = ({"X-Pio-Plan-Version": str(int(plan_version))}
+                if plan_version is not None else None)
+        rows = [b["row"] for b in bodies]
+        ks = [int(b["k"]) for b in bodies]
+        arm = bodies[0].get("arm", ARM_ACTIVE)
+        encode = (rpcwire.encode_candidates_batch_request
+                  if op == "candidates"
+                  else rpcwire.encode_topk_batch_request)
+        try:
+            resp = rep.client.request(
+                "POST", path, raw=encode(rows, ks, arm),
+                content_type=rpcwire.RPC_CONTENT_TYPE,
+                accept=rpcwire.RPC_CONTENT_TYPE, idempotent=True,
+                headers=hdrs)
+        except HttpClientError as e:
+            if e.status == 400:
+                if rep.batch_wire is not False:
+                    rep.batch_wire = False
+                    log.warning(
+                        "shard replica %s rejected the batched scoring "
+                        "frame (pre-batch shard?); sticky solo-frame "
+                        "downgrade for this replica", rep.url)
+                raise _BatchUnsupported(str(e.message)) from e
+            raise
+        if not isinstance(resp, (bytes, bytearray)):
+            # a JSON answer to a batched frame a confirmed-binary
+            # replica accepted shouldn't happen — treat it like a
+            # rejection rather than guessing at the payload shape
+            if rep.batch_wire is not False:
+                rep.batch_wire = False
+                log.warning(
+                    "shard replica %s answered a batched scoring frame "
+                    "with JSON; sticky solo-frame downgrade for this "
+                    "replica", rep.url)
+            raise _BatchUnsupported("non-binary answer to batched frame")
+        rep.batch_wire = True
+        self._count_rpc("binary")
+        try:
+            return rpcwire.decode_topk_batch_response(bytes(resp))
+        except rpcwire.RpcWireError as e:
+            # corrupt frame from a confirmed replica: transport-failure
+            # treatment — charge the breaker, fail over
+            raise HttpClientError(
+                0, f"corrupt binary rpc frame from {rep.url}: {e}"
+            ) from e
 
     # -- query path ---------------------------------------------------------
     def _plan_for(self, arm: str) -> ShardPlan:
@@ -1097,10 +1462,29 @@ class FleetRouter:
         return failures
 
     def query_batch(self, queries: list[dict]) -> list[dict]:
-        # sequential on purpose: each query already fans across shards
-        # on the router pool; nesting batch-level fan-out on the same
-        # pool could deadlock it against its own children
-        return [self.query(q) for q in queries]
+        if self._coalescer is None or len(queries) <= 1:
+            # sequential on purpose: each query already fans across
+            # shards on the router pool; nesting batch-level fan-out on
+            # the same pool could deadlock it against its own children
+            return [self.query(q) for q in queries]
+        # with the coalescer on, run the queries concurrently on a
+        # DEDICATED pool (never the fan pool — see above) so their
+        # scoring RPCs arrive inside the same coalesce window and merge
+        # into batched frames; copy_context carries the ambient
+        # Deadline/tenant into the workers
+        import contextvars
+
+        with self._lock:
+            if self._batch_pool is None:
+                self._batch_pool = ThreadPoolExecutor(
+                    max_workers=min(32, max(4,
+                                            self.config.coalesce_max_batch)),
+                    thread_name_prefix="router-batch")
+            pool = self._batch_pool
+        futs = [pool.submit(contextvars.copy_context().run, self.query,
+                            q)
+                for q in queries]
+        return [f.result() for f in futs]
 
     # -- health / status ----------------------------------------------------
     def _probe_loop(self) -> None:
@@ -1159,6 +1543,9 @@ class FleetRouter:
                     "planVersion": info.get("planVersion"),
                     # internal RPC plane (docs/performance.md)
                     "binaryWire": rep.binary_wire,
+                    # continuous batching: whether this replica accepts
+                    # batched scoring frames (None = not yet probed)
+                    "batchWire": rep.batch_wire,
                     "connReuse": (round(hs["reused"] / dials, 3)
                                   if dials else None),
                 })
@@ -1207,6 +1594,11 @@ class FleetRouter:
             "reshard": reshard.status() if reshard is not None else None,
             "reshardPartitionsMoved": moved,
             "reshardPartitionsPending": pending,
+            # continuous batching (docs/serving.md): coalescer health —
+            # what `pio doctor --fleet` renders occupancy/wait from
+            "batching": (self._coalescer.stats()
+                         if self._coalescer is not None
+                         else {"enabled": False}),
         }
 
     def reload(self) -> dict:
@@ -1255,6 +1647,8 @@ class FleetRouter:
             # an IN_FLIGHT record is exactly what resume keys off
             self.reshard.stop()
         self._pool.shutdown(wait=False)
+        if self._batch_pool is not None:
+            self._batch_pool.shutdown(wait=False)
         if self._prober is not None:
             self._prober.join(timeout=2)
 

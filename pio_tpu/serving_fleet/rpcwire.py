@@ -62,6 +62,25 @@ retrieval"): same row+k shape as the top-k request, answered on the
 SAME kind-2 response frame — exact re-ranked f32 scores — so the
 router's ``(-score, global_index)`` merge code is shared verbatim
 between the exact and clustered tiers.
+
+Batched scoring frames (docs/serving.md "Continuous batching"): kinds
+1/2/6 each grow a MULTI-QUERY layout, selected by a ``"batch"`` header
+key, under the same CRC32C envelope::
+
+    kind 1/6 batched req  header {"batch": n, "d",      rows f32[n*d]
+                          "ks": [k...], "arm"}
+    kind 2 batched resp   header {"batch": n,           gidx i32[sum]
+                          "counts": [...],              scores f32[sum]
+                          "items": [flat...]}
+
+A coalescing router fans N concurrent queries to a shard group as ONE
+frame per shard; shards answer every query from one batched device
+dispatch. Every count is bounded before allocation and the sections must
+match the counts exactly (forged-count rejection). A PRE-BATCH shard
+decoding a batched request fails the solo layout's section/`k` checks
+and answers 400 ``bad rpc frame`` — the router then downgrades that
+replica STICKILY to per-query frames (logged once), mirroring the
+binary-wire negotiation above.
 """
 
 from __future__ import annotations
@@ -210,6 +229,151 @@ def decode_candidates_request(data: bytes) -> tuple[np.ndarray, int, str]:
     if not isinstance(arm, str):
         raise RpcWireError("rpc frame arm must be a string")
     return row, _count(header, "k"), arm
+
+
+# -- batched scoring messages (continuous batching) --------------------------
+
+_SCORING_REQ_KINDS = {"topk": _KIND_TOPK_REQ, "candidates": _KIND_CAND_REQ}
+
+
+def _seal_scoring_batch(kind: int, rows, ks, arm: str) -> bytes:
+    mat = np.ascontiguousarray(np.asarray(rows), dtype=_F32)
+    if mat.ndim != 2:
+        raise RpcWireError(
+            f"batched request rows must be a 2-D matrix, got shape "
+            f"{mat.shape}")
+    n, d = int(mat.shape[0]), int(mat.shape[1])
+    if n < 1:
+        raise RpcWireError("batched request needs at least one query")
+    k_list = [int(k) for k in ks]
+    if len(k_list) != n or any(k < 0 for k in k_list):
+        raise RpcWireError(
+            f"batched request k sidecar disagrees: {len(k_list)} ks for "
+            f"{n} rows")
+    return _seal(kind, {"batch": n, "d": d, "ks": k_list, "arm": arm},
+                 mat.tobytes())
+
+
+def encode_topk_batch_request(rows, ks, arm: str = "active") -> bytes:
+    """N coalesced top-k queries as ONE kind-1 frame: stacked f32 rows +
+    per-query k (k varies with each query's num+blackList over-fetch, so
+    it rides as a sidecar, not a scalar)."""
+    return _seal_scoring_batch(_KIND_TOPK_REQ, rows, ks, arm)
+
+
+def encode_candidates_batch_request(rows, ks, arm: str = "active") -> bytes:
+    return _seal_scoring_batch(_KIND_CAND_REQ, rows, ks, arm)
+
+
+def decode_scoring_request(data: bytes, op: str):
+    """Shard-side decode for `/shard/topk` + `/shard/candidates`
+    accepting BOTH layouts -> (rows (n, d), ks, arm, batched). The solo
+    layout comes back as a 1-row batch so the routes handle one shape;
+    `batched` tells them which response frame the client expects."""
+    try:
+        kind = _SCORING_REQ_KINDS[op]
+    except KeyError:
+        raise RpcWireError(f"no scoring request kind for op {op!r}") \
+            from None
+    header, body = _open(data, kind)
+    arm = header.get("arm", "active")
+    if not isinstance(arm, str):
+        raise RpcWireError("rpc frame arm must be a string")
+    d = _count(header, "d", limit=1 << 20)
+    if "batch" not in header:
+        k = _count(header, "k")
+        (row,) = _sections(body, (_F32, d))
+        return row.reshape(1, d), [k], arm, False
+    n = _count(header, "batch", limit=1 << 16)
+    if n < 1:
+        raise RpcWireError("batched request with zero queries")
+    ks = header.get("ks")
+    if not isinstance(ks, list) or len(ks) != n:
+        raise RpcWireError("batched request k sidecar disagrees with "
+                           "batch")
+    k_list = []
+    for k in ks:
+        try:
+            ki = int(k)
+        except (TypeError, ValueError) as e:
+            raise RpcWireError("batched request ks must be integers") \
+                from e
+        if ki < 0 or ki > 1 << 28:
+            raise RpcWireError(f"batched request k={ki} out of range")
+        k_list.append(ki)
+    if n * d > 1 << 28:
+        raise RpcWireError(
+            f"batched request {n} x {d} floats out of range")
+    (flat,) = _sections(body, (_F32, n * d))
+    return flat.reshape(n, d), k_list, arm, True
+
+
+def encode_topk_batch_response(results) -> bytes:
+    """N per-query (items, indices, scores) answers, request order, as
+    ONE kind-2 frame: per-query counts + flattened id sidecar in the
+    header, concatenated i32/f32 sections."""
+    counts: list[int] = []
+    all_items: list = []
+    gidx_parts: list[bytes] = []
+    score_parts: list[bytes] = []
+    for items, indices, scores in results:
+        g = np.ascontiguousarray(np.asarray(indices), dtype=_I32)
+        score_bytes, n = _f32_bytes(scores)
+        if len(items) != n or g.size != n:
+            raise RpcWireError(
+                f"batched topk response sections disagree: {len(items)} "
+                f"items, {g.size} indices, {n} scores")
+        counts.append(n)
+        all_items.extend(items)
+        gidx_parts.append(g.tobytes())
+        score_parts.append(score_bytes)
+    return _seal(
+        _KIND_TOPK_RESP,
+        {"batch": len(results), "counts": counts, "items": all_items},
+        b"".join(gidx_parts), b"".join(score_parts))
+
+
+def decode_topk_batch_response(data: bytes) -> list[dict]:
+    """Router-side split of a batched kind-2 frame back into per-query
+    dicts (each the exact shape `decode_topk_response` yields). Every
+    count is bounded before any slice and the id sidecar + sections must
+    sum to exactly the declared totals — a forged count dies here, never
+    as a silently misattributed score."""
+    header, body = _open(data, _KIND_TOPK_RESP)
+    n = _count(header, "batch", limit=1 << 16)
+    counts = header.get("counts")
+    if not isinstance(counts, list) or len(counts) != n:
+        raise RpcWireError("batched topk response counts sidecar "
+                           "disagrees with batch")
+    total = 0
+    c_list = []
+    for c in counts:
+        try:
+            ci = int(c)
+        except (TypeError, ValueError) as e:
+            raise RpcWireError("batched topk response counts must be "
+                               "integers") from e
+        if ci < 0 or ci > 1 << 28:
+            raise RpcWireError(
+                f"batched topk response count {ci} out of range")
+        total += ci
+        c_list.append(ci)
+    if total > 1 << 28:
+        raise RpcWireError(
+            f"batched topk response total count {total} out of range")
+    items = header.get("items")
+    if not isinstance(items, list) or len(items) != total:
+        raise RpcWireError("batched topk response id sidecar disagrees "
+                           "with counts")
+    gidx, scores = _sections(body, (_I32, total), (_F32, total))
+    out = []
+    off = 0
+    for ci in c_list:
+        out.append({"items": items[off:off + ci],
+                    "indices": gidx[off:off + ci],
+                    "scores": scores[off:off + ci]})
+        off += ci
+    return out
 
 
 def encode_topk_response(items: list, indices, scores) -> bytes:

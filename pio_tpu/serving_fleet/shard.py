@@ -955,6 +955,108 @@ class ShardServer:
             gidx = np.asarray(part.item_gidx)[lidx].astype(np.int32)
             return [part.item_ids[int(i)] for i in lidx], gidx, scores
 
+    def topk_arrays_batch(self, rows, ks: list[int], arm: str = "active",
+                          plan_version: int | None = None,
+                          ) -> list[tuple[list, np.ndarray, np.ndarray]]:
+        """N coalesced queries' partial top-k in ONE device dispatch per
+        DISTINCT k (docs/serving.md "Continuous batching"): queries are
+        grouped by k because k shapes the compiled program (pow2 k
+        bucket) and, on the clustered path, the rerank width — scoring
+        everyone at max(k) would change which candidates survive for
+        smaller-k queries and break bit-parity with the solo path. The
+        serving mix has a handful of distinct k values (num +
+        blackList over-fetch), so this stays one-or-few dispatches per
+        frame. -> per-query (item ids, global indices i32, scores f32),
+        request order."""
+        with self.tracer.span("topk", shard=self.config.shard_index,
+                              arm=arm, batch=len(ks)):
+            return self._scoring_batch(rows, ks, arm, plan_version,
+                                       self._topk_group)
+
+    def candidates_arrays_batch(self, rows, ks: list[int],
+                                arm: str = "active",
+                                plan_version: int | None = None,
+                                ) -> list[tuple[list, np.ndarray,
+                                                np.ndarray]]:
+        """Batched candidate generation — same distinct-k grouping and
+        exactness contract as candidates_arrays (exact mode / no sidecar
+        / exhaustive scan answer from the literal top-k path)."""
+        with self.tracer.span("candidates",
+                              shard=self.config.shard_index,
+                              arm=arm, batch=len(ks)):
+            return self._scoring_batch(rows, ks, arm, plan_version,
+                                       self._candidates_group)
+
+    def _scoring_batch(self, rows, ks, arm, plan_version, group_fn):
+        mat = np.asarray(rows, dtype=np.float32)
+        results: list = [None] * len(ks)
+        by_k: dict[int, list[int]] = {}
+        for i, k in enumerate(ks):
+            by_k.setdefault(int(k), []).append(i)
+        for k, idxs in by_k.items():
+            for i, res in zip(idxs, group_fn(mat[idxs], k, arm,
+                                             plan_version)):
+                results[i] = res
+        return results
+
+    def _topk_group(self, rows_g: np.ndarray, k: int, arm: str,
+                    plan_version: int | None,
+                    ) -> list[tuple[list, np.ndarray, np.ndarray]]:
+        """One same-k group as one recommend_topk dispatch. Each output
+        row of the stacked matmul is an independent dot product, so
+        row i is bit-identical to the (1, d) solo dispatch — the same
+        contract the single-host batch_predict path is pinned to."""
+        from pio_tpu.ops import als
+
+        part, item_dev, _, _ = self._arm(arm, plan_version)
+        n_local = len(part.item_ids)
+        empty = ([], np.zeros(0, dtype=np.int32),
+                 np.zeros(0, dtype=np.float32))
+        if n_local == 0:
+            return [empty for _ in range(len(rows_g))]
+        local = als.ALSModel(rows_g, item_dev)
+        scores, idx = als.recommend_topk(
+            local, np.arange(len(rows_g)), int(k))
+        scores = np.asarray(scores)
+        idx = np.asarray(idx)
+        all_gidx = np.asarray(part.item_gidx)
+        out = []
+        for b in range(len(rows_g)):
+            row_idx = idx[b]
+            out.append(([part.item_ids[i] for i in row_idx],
+                        all_gidx[row_idx].astype(np.int32),
+                        scores[b]))
+        return out
+
+    def _candidates_group(self, rows_g: np.ndarray, k: int, arm: str,
+                          plan_version: int | None,
+                          ) -> list[tuple[list, np.ndarray, np.ndarray]]:
+        part, item_dev, _, _ = self._arm(arm, plan_version)
+        ret = self._retrieval_of(arm, plan_version)
+        n_local = len(part.item_ids)
+        if n_local == 0:
+            empty = ([], np.zeros(0, dtype=np.int32),
+                     np.zeros(0, dtype=np.float32))
+            return [empty for _ in range(len(rows_g))]
+        rp = self._rparams
+        if (ret is None or rp.mode != "clustered"
+                or rp.is_exhaustive(n_local)):
+            return self._topk_group(rows_g, k, arm, plan_version)
+        from pio_tpu.ops import retrieval as rt
+
+        _, didx = ret
+        scores, lidx = rt.candidate_topk(didx, item_dev, rows_g, int(k))
+        all_gidx = np.asarray(part.item_gidx)
+        out = []
+        for b in range(len(rows_g)):
+            keep = lidx[b] >= 0   # fewer real survivors than k: drop pads
+            row_lidx = lidx[b][keep]
+            row_scores = np.asarray(scores[b][keep], dtype=np.float32)
+            out.append(([part.item_ids[int(i)] for i in row_lidx],
+                        all_gidx[row_lidx].astype(np.int32),
+                        row_scores))
+        return out
+
     def item_rows_arrays(self, items: list, arm: str = "active",
                          plan_version: int | None = None,
                          ) -> tuple[list, np.ndarray]:
@@ -1527,21 +1629,41 @@ def build_shard_app(server: ShardServer) -> HttpApp:
             return 200, {"found": False}
         return 200, {"found": True, "row": [float(x) for x in row]}
 
-    @app.route("POST", r"/shard/topk")
-    def shard_topk(req: Request):
+    def _scoring_route(req: Request, op: str, solo_fn, batch_fn):
+        """Shared body of /shard/topk + /shard/candidates: JSON solo,
+        binary solo, and the batched multi-query frame (a coalescing
+        router's fan unit — answered from ONE batched device dispatch
+        via the *_arrays_batch compute and the batched kind-2 frame).
+        Binary request bodies only arrive after this replica confirmed
+        the wire with a binary response (router negotiation)."""
         mis = _tenant_mismatch(req)
         if mis:
             return mis
         if _media_type(req, "content-type") == rpcwire.RPC_CONTENT_TYPE:
-            # binary request body: the query user's f32 row rides the
-            # frame verbatim (the router only sends it after this
-            # replica confirmed the wire with a binary response)
             try:
-                row, k, arm = rpcwire.decode_topk_request(req.body)
+                rows, ks, arm, batched = rpcwire.decode_scoring_request(
+                    req.body, op)
             except rpcwire.RpcWireError as e:
                 return 400, {"message": f"bad rpc frame: {e}"}
             if arm not in ("active", "candidate"):
                 return 400, {"message": f"unknown arm {arm!r}"}
+            if batched:
+                server.count_rpc("binary")
+                try:
+                    results = batch_fn(rows, ks, arm=arm,
+                                       plan_version=_plan_version_of(req))
+                except CandidateArmMissing as e:
+                    return 503, {"message": f"candidate-arm-missing: {e}"}
+                except PlanVersionMissing as e:
+                    return 503, {"message": f"plan-version-missing: {e}"}
+                from pio_tpu.server.http import RawResponse
+
+                # a batched frame implies a batch-aware binary client:
+                # the answer is always the batched kind-2 frame
+                return 200, RawResponse(
+                    rpcwire.encode_topk_batch_response(results),
+                    rpcwire.RPC_CONTENT_TYPE)
+            row, k = rows[0], ks[0]
         else:
             body = req.json()
             if (not isinstance(body, dict) or "row" not in body
@@ -1555,7 +1677,7 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         binary = _binary_accept(req)
         server.count_rpc("binary" if binary else "json")
         try:
-            items, gidx, scores = server.topk_arrays(
+            items, gidx, scores = solo_fn(
                 row, k, arm=arm, plan_version=_plan_version_of(req))
         except CandidateArmMissing as e:
             # the "candidate-arm-missing:" prefix is the router's cue to
@@ -1570,6 +1692,11 @@ def build_shard_app(server: ShardServer) -> HttpApp:
                      "indices": [int(g) for g in gidx],
                      "scores": [float(s) for s in scores]}
 
+    @app.route("POST", r"/shard/topk")
+    def shard_topk(req: Request):
+        return _scoring_route(req, "topk", server.topk_arrays,
+                              server.topk_arrays_batch)
+
     @app.route("POST", r"/shard/candidates")
     def shard_candidates(req: Request):
         """Two-stage retrieval candidates (ops/retrieval.py): answered
@@ -1578,43 +1705,8 @@ def build_shard_app(server: ShardServer) -> HttpApp:
         nprobe/rerank_k are shard config, NOT wire parameters — a
         replica always answers from its own knobs (doctor --fleet WARNs
         when replicas of one group disagree)."""
-        mis = _tenant_mismatch(req)
-        if mis:
-            return mis
-        if _media_type(req, "content-type") == rpcwire.RPC_CONTENT_TYPE:
-            try:
-                row, k, arm = rpcwire.decode_candidates_request(req.body)
-            except rpcwire.RpcWireError as e:
-                return 400, {"message": f"bad rpc frame: {e}"}
-            if arm not in ("active", "candidate"):
-                return 400, {"message": f"unknown arm {arm!r}"}
-        else:
-            body = req.json()
-            if (not isinstance(body, dict) or "row" not in body
-                    or "k" not in body):
-                return 400, {
-                    "message": "body must be {\"row\": [...], \"k\": n}"}
-            arm, err = _arm_of(body)
-            if err:
-                return err
-            row, k = body["row"], int(body["k"])
-        binary = _binary_accept(req)
-        server.count_rpc("binary" if binary else "json")
-        try:
-            items, gidx, scores = server.candidates_arrays(
-                row, k, arm=arm, plan_version=_plan_version_of(req))
-        except CandidateArmMissing as e:
-            # the "candidate-arm-missing:" prefix is the router's cue to
-            # fail over WITHOUT charging this replica's breaker: the
-            # replica is healthy, it just has no staged arm
-            return 503, {"message": f"candidate-arm-missing: {e}"}
-        except PlanVersionMissing as e:
-            return 503, {"message": f"plan-version-missing: {e}"}
-        if binary:
-            return _binary_response(items, gidx, scores)
-        return 200, {"items": items,
-                     "indices": [int(g) for g in gidx],
-                     "scores": [float(s) for s in scores]}
+        return _scoring_route(req, "candidates", server.candidates_arrays,
+                              server.candidates_arrays_batch)
 
     @app.route("POST", r"/shard/item_rows")
     def shard_item_rows(req: Request):

@@ -439,6 +439,7 @@ def _doctor_fleet(args) -> int:
                      f"{i0.get('f32ItemBytes')}B headroom "
                      f"{'-' if hd is None else hd}")
         retr_cells.append(cell)
+    batching = fleet.get("batching") or {"enabled": False}
     if args.json:
         print(json.dumps({
             "router": router_url, "plan": plan, "replicas": rows,
@@ -453,6 +454,7 @@ def _doctor_fleet(args) -> int:
             "stalePlanReplicas": stale_plan,
             "reshard": reshard,
             "retrievalModeDisagreement": retr_disagree,
+            "batching": batching,
         }, indent=2))
         return exit_code
     print(f"fleet router {router_url}: instance {plan.get('instanceId')} "
@@ -493,6 +495,26 @@ def _doctor_fleet(args) -> int:
               + ", ".join(lag_cells))
     if retr_cells:
         print("retrieval: " + ", ".join(retr_cells))
+    # continuous batching (docs/serving.md): coalescer health. Mean
+    # occupancy pinned at ~1.0 means every window fills to max_batch —
+    # arrivals are queuing behind full dispatches, so p99 is climbing;
+    # widen --coalesce-window-ms gains nothing at that point (the batch
+    # is already full): raise max batch or add replicas
+    if batching.get("enabled"):
+        occ = batching.get("meanOccupancy")
+        wait = (batching.get("coalesceWaitMs") or {}).get("p50")
+        print(f"batching: window {batching.get('windowMs')}ms "
+              f"max {batching.get('maxBatch')} — "
+              f"{batching.get('coalescedQueries', 0)} queries over "
+              f"{batching.get('coalescedCalls', 0)} batched dispatches, "
+              f"occupancy {'-' if occ is None else f'{occ:.2f}'} mean, "
+              f"coalesce wait p50 "
+              f"{'-' if wait is None else f'{wait:.2f}ms'}")
+        if occ is not None and occ >= 0.95:
+            print("[WARN] batch occupancy ~1.0: every coalesce window "
+                  "fills to max batch — queries queue behind full "
+                  "dispatches. Raise the max batch or add replicas; a "
+                  "wider window will not help")
     if retr_disagree:
         print("[WARN] retrieval mode disagreement within shard "
               "group(s): " + "; ".join(retr_disagree)
@@ -1365,6 +1387,7 @@ def cmd_deploy(args) -> int:
         certfile=args.cert, keyfile=args.key,
         backend=args.server_backend,
         batch_window_ms=args.batch_window_ms,
+        coalesce_window_ms=args.coalesce_window_ms,
     )
     http, qs = create_query_server(
         engine, ep, storage, config, ctx=ctx,
@@ -1400,6 +1423,7 @@ def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
     --ip/--port; shard servers take ephemeral ports (printed, and always
     discoverable via the router's /fleet.json)."""
     from pio_tpu.serving_fleet.fleet import deploy_fleet
+    from pio_tpu.serving_fleet.router import RouterConfig
 
     # fail loudly on single-host-only options rather than silently
     # ignoring them — --cert/--key especially: an operator asking for
@@ -1435,6 +1459,11 @@ def _deploy_fleet_cmd(args, storage, engine_id: str, engine_version: str,
         memory_budget_bytes=args.shard_memory_budget_mb * 1024 * 1024,
         shard_backend=args.server_backend,
         retrieval=retrieval,
+        # continuous batching: coalesce concurrent fan-outs per shard
+        # group into one batched binary frame (docs/serving.md)
+        router_config=(RouterConfig(
+            coalesce_window_ms=args.coalesce_window_ms)
+            if args.coalesce_window_ms > 0 else None),
     )
     mode = (retrieval or {}).get("mode", "exact")
     print(f"Fleet router for instance {handle.plan.instance_id} on "
@@ -2511,6 +2540,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "continuous batching (no added wait; batch = "
                         "whatever queued during the previous execution); "
                         "0 = off")
+    x.add_argument("--coalesce-window-ms", type=float, default=0.0,
+                   help="continuous batching: > 0 admits queries through "
+                        "a coalescing stage that merges concurrent "
+                        "requests into one device dispatch (single-host) "
+                        "or one batched shard RPC per group (fleet); "
+                        "~2 ms is the recommended starting window. "
+                        "Deadline-doomed requests dispatch solo or shed "
+                        "503. 0 = off")
     x.add_argument("--shards", type=int, default=0,
                    help="> 0 deploys a SHARDED fleet: partition the "
                         "model's factor tables across this many shard "

@@ -120,6 +120,16 @@ class ServingConfig:
     # at its result wait, and predict stages are bounded by their own
     # hedging. 0 = off.
     request_budget_s: float = 0.0
+    # cross-request continuous batching (pio_tpu/serving/batcher.py):
+    # > 0 puts a ContinuousBatcher in front of the device program —
+    # concurrent /queries.json requests coalesce into ONE batched
+    # einsum+top_k whenever a pipeline slot frees OR this window (ms)
+    # elapses, whichever comes first (2 ms is the recommended default
+    # when enabling; docs/serving.md "Continuous batching"). Unlike
+    # batch_window_ms it is Deadline-aware: a query whose budget cannot
+    # survive the window dispatches solo or sheds 503 instead of
+    # parking. Takes precedence over batch_window_ms. 0 = off.
+    coalesce_window_ms: float = 0.0
 
 
 @dataclass
@@ -205,12 +215,25 @@ class QueryServer:
         # leaving the older one serving.
         self._load_lock = threading.Lock()
         self._load(instance_id)
-        self.batcher = (
-            QueryBatcher(self, config.batch_window_ms / 1e3, config.batch_max,
-                         pipeline_depth=config.batch_pipeline
-                         or _auto_pipeline_depth())
-            if config.batch_window_ms != 0 else None
-        )
+        # admission stage in front of the device program: the continuous
+        # batcher (deadline-aware, slot-OR-window drain) takes precedence
+        # over the window-only micro-batcher; both expose the same
+        # .query()/.close() so the serving edge and the readiness
+        # "buckets" gate treat them interchangeably
+        if config.coalesce_window_ms > 0:
+            from pio_tpu.serving.batcher import ContinuousBatcher
+
+            self.batcher = ContinuousBatcher(
+                self, config.coalesce_window_ms / 1e3, config.batch_max,
+                pipeline_depth=config.batch_pipeline
+                or _auto_pipeline_depth())
+        elif config.batch_window_ms != 0:
+            self.batcher = QueryBatcher(
+                self, config.batch_window_ms / 1e3, config.batch_max,
+                pipeline_depth=config.batch_pipeline
+                or _auto_pipeline_depth())
+        else:
+            self.batcher = None
         # persistent XLA compile cache: a re-deploy deserializes the
         # predict/bucket executables the last deployment compiled instead
         # of re-running XLA (utils/compilecache.py); the bucket registry
@@ -663,12 +686,23 @@ class QueryServer:
                 first_exc = first_exc or exc
         raise first_exc
 
-    def query_batch(self, queries: list[dict], record: bool = True) -> list:
+    def query_batch(self, queries: list[dict], record: bool = True,
+                    observe_batch_errors: bool = True) -> list:
         """Serve several queries as one batch_predict per algorithm (the
         micro-batching execution path; also the bulk path behind
         /batch/queries.json). With a rollout in flight the batch is
         partitioned by arm — each sub-batch executes against its own
-        arm's models, results reassemble in request order."""
+        arm's models, results reassemble in request order.
+
+        observe_batch_errors=False is for callers that retry each query
+        SOLO after a batch failure (QueryBatcher/ContinuousBatcher): the
+        solo retries record per-arm rollout stats themselves, so the
+        batch-level error observation here would double-count every
+        member and skew the latency-ratio guard. Double-count audit:
+        `query(record=False)` takes rollout=None (no stats at all), and
+        `_hedged` duplicates run the bare predict fn — neither path ever
+        re-records a request's per-arm stats; this flag closes the one
+        path that did."""
         t0 = time.monotonic()
         rollout = self.rollout if record else None
         if rollout is not None:
@@ -681,15 +715,16 @@ class QueryServer:
                         continue
                     sub = self._query_batch_arm(
                         [queries[i] for i in idx], arm, record, t0,
-                        rollout)
+                        rollout, observe_batch_errors)
                     for i, r in zip(idx, sub):
                         out[i] = r
                 return out
         return self._query_batch_arm(queries, ARM_ACTIVE, record, t0,
-                                     rollout)
+                                     rollout, observe_batch_errors)
 
     def _query_batch_arm(self, queries: list[dict], arm: str, record: bool,
-                         t0: float, rollout) -> list:
+                         t0: float, rollout,
+                         observe_batch_errors: bool = True) -> list:
         tr = self.tracer
         # see query(): warm-up spans stay out of the histograms
         span = tr.span if record else (lambda _n, **_kw: nullcontext())
@@ -706,7 +741,7 @@ class QueryServer:
                 queries, arm, record, t0, arm_t0, rollout, span, models,
                 algorithms, serving, instance_id)
         except Exception:
-            if rollout is not None:
+            if rollout is not None and observe_batch_errors:
                 # per-QUERY time (sub-batch wall / size): whole-batch
                 # time would make each arm's mean scale with its share
                 # of the split — at 25% the candidate would look 3x
@@ -1291,7 +1326,11 @@ class QueryBatcher:
 
     def _do_execute(self, batch, queries):
         try:
-            results = self.server.query_batch(queries)
+            # observe_batch_errors=False: the per-query retry below
+            # records each member's rollout stats exactly once on a
+            # batch failure (see query_batch's double-count audit)
+            results = self.server.query_batch(
+                queries, observe_batch_errors=False)
             for (_, fut), res in zip(batch, results):
                 fut.set_result(res)
         except Exception:  # noqa: BLE001 - isolate the bad query
@@ -1454,10 +1493,62 @@ def build_serving_app(server: QueryServer) -> HttpApp:
         # outbound keep-alive pool (docs/performance.md "Internal RPC
         # plane"): the serving process's storage DAO RPCs ride it
         counters.update(pool_counters())
-        return 200, RawResponse(
-            prometheus_text(server.tracer.snapshot(), counters,
-                            labels={"surface": "serving"}),
-            PROMETHEUS_CONTENT_TYPE)
+        text = prometheus_text(server.tracer.snapshot(), counters,
+                               labels={"surface": "serving"})
+        batcher = server.batcher
+        if batcher is not None and hasattr(batcher, "occupancy_exposition"):
+            # continuous batching: batch-occupancy distribution (fraction
+            # of batch_max per coalesced dispatch) as a real histogram
+            # family — the occupancy-pinned-at-1.0 saturation signal
+            # docs/observability.md documents
+            from pio_tpu.utils.tracing import prometheus_histogram
+
+            buckets, counts, total, occ_sum = batcher.occupancy_exposition()
+            text += "\n".join(prometheus_histogram(
+                "serving_batch_occupancy", buckets, counts, total, occ_sum,
+                labels={"surface": "serving"})) + "\n"
+        return 200, RawResponse(text, PROMETHEUS_CONTENT_TYPE)
+
+    @app.route("GET", r"/batcher\.json")
+    def batcher_status(req: Request):
+        """Admission-stage visibility: which batcher fronts the device
+        program (continuous / micro / none) and its live counters —
+        dispatches, coalesced queries, occupancy, coalesce wait, solo
+        bypasses and deadline sheds (docs/serving.md "Continuous
+        batching")."""
+        batcher = server.batcher
+        if batcher is None:
+            return 200, {"mode": None, "enabled": False}
+        if hasattr(batcher, "stats"):
+            return 200, {"enabled": True, **batcher.stats()}
+        return 200, {
+            "enabled": True, "mode": "micro",
+            "windowMs": config.batch_window_ms,
+            "maxBatch": config.batch_max,
+        }
+
+    @app.route("POST", r"/batcher/window")
+    def batcher_window(req: Request):
+        """Live coalesce-window retune (server-key guarded, like /reload):
+        the occupancy runbook's knob — widen a window whose batches run
+        near-empty, narrow one pinned at occupancy 1.0 — without a
+        redeploy. Continuous batcher only."""
+        if not check_server_key(req):
+            return 401, {"message": "Invalid accessKey."}
+        batcher = server.batcher
+        if batcher is None or not hasattr(batcher, "set_window"):
+            return 409, {"message": "continuous batching is not enabled "
+                                    "(ServingConfig.coalesce_window_ms)"}
+        try:
+            body = req.json()
+            window_ms = float(body["windowMs"])
+        except Exception as e:  # noqa: BLE001 - malformed body
+            return 400, {"message": f"body must be {{\"windowMs\": ms}}: "
+                                    f"{e}"}
+        if not (0 < window_ms <= 1000):
+            return 400, {"message": "windowMs must be in (0, 1000]"}
+        batcher.set_window(window_ms / 1e3)
+        return 200, {"message": "window updated", **batcher.stats()}
 
     @app.route("POST", r"/profile/start")
     def profile_start(req: Request):
@@ -1576,9 +1667,23 @@ def create_query_server(
     )
     from pio_tpu.server.security import server_ssl_context
 
-    server_cls = AsyncHttpServer if config.backend == "async" else HttpServer
-    http = server_cls(
-        build_serving_app(qs), host=config.ip, port=config.port,
-        ssl_context=server_ssl_context(config.certfile, config.keyfile),
-    )
+    app = build_serving_app(qs)
+    ssl_ctx = server_ssl_context(config.certfile, config.keyfile)
+    if config.backend == "async":
+        kwargs = {}
+        if config.coalesce_window_ms > 0:
+            # admission sized for coalescing: parked waiters are the
+            # mechanism, not the overload — admit what one full batch per
+            # pipeline slot (plus one forming) can absorb before the
+            # LoadShedder starts answering 503 (SLO shedding rides the
+            # same watermark as before, just sized to batch capacity)
+            depth = config.batch_pipeline or _auto_pipeline_depth()
+            kwargs["shed_watermark"] = max(
+                128, config.batch_max * (depth + 1))
+        http = AsyncHttpServer(
+            app, host=config.ip, port=config.port, ssl_context=ssl_ctx,
+            **kwargs)
+    else:
+        http = HttpServer(
+            app, host=config.ip, port=config.port, ssl_context=ssl_ctx)
     return http, qs

@@ -237,11 +237,11 @@ def test_adaptive_batching_backpressure(memory_storage):
         calls = []
         orig = qs.query_batch
 
-        def slow(queries, record=True):
+        def slow(queries, record=True, **kw):
             if record:  # ignore the background auto-warm's batches
                 calls.append(len(queries))
                 _time.sleep(0.15)  # hold the single pipeline slot
-            return orig(queries, record)
+            return orig(queries, record, **kw)
 
         qs.query_batch = slow
         results = {}
@@ -375,10 +375,10 @@ def test_micro_batching_coalesces(memory_storage):
         calls = []
         orig = qs.query_batch
 
-        def spy(queries, record=True):
+        def spy(queries, record=True, **kw):
             if record:  # ignore the background auto-warm's batches
                 calls.append(len(queries))
-            return orig(queries, record)
+            return orig(queries, record, **kw)
 
         qs.query_batch = spy
         results = {}
